@@ -1,0 +1,24 @@
+"""kubernetes_tpu — a TPU-native batched pod→node scheduler.
+
+A from-scratch reimplementation of Kubernetes' scheduling capability
+(reference: wwwtyro/kubernetes, a fork of kubernetes/kubernetes), redesigned
+for TPU: the serial per-pod Filter/Score loop becomes dense pods×nodes
+feasibility-mask + score-matrix solves compiled by XLA, with Pallas kernels
+for the irregular hot paths, exposed behind the Scheduling Framework plugin
+shapes and the scheduler-extender webhook protocol.
+
+Package map (SURVEY.md §8):
+- ``api``       — core/v1 object subset, Quantity, label selectors (L0/L1)
+- ``tensorize`` — API objects -> padded device tensors (the tensor schema)
+- ``ops``       — plugin kernels (Fit, BalancedAllocation, spread, affinity,
+  taints, ...) + NumPy oracles for parity testing
+- ``solver``    — exact-parity lax.scan solver and single-shot auction mode
+- ``state``     — cluster-state service (apiserver stand-in), scheduler
+  cache (assume/forget/generations), scheduling queue
+- ``server``    — scheduler-extender webhook (aiohttp) + bulk gRPC path
+- ``config``    — KubeSchedulerConfiguration mirror
+- ``metrics``   — Prometheus metrics with upstream names
+- ``parallel``  — device-mesh sharding of the pods×nodes solve
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,136 @@
+"""Label selectors: parse + host-side evaluation.
+
+Reference semantics:
+- staging/src/k8s.io/apimachinery/pkg/labels/selector.go#Requirement.Matches
+- staging/src/k8s.io/apimachinery/pkg/apis/meta/v1/types.go#LabelSelector
+  (matchLabels AND matchExpressions, all requirements ANDed)
+- NodeSelectorRequirement operators (In/NotIn/Exists/DoesNotExist/Gt/Lt) from
+  staging/src/k8s.io/api/core/v1/types.go#NodeSelectorOperator, evaluated in
+  k8s.io/component-helpers/scheduling/corev1/nodeaffinity/nodeaffinity.go.
+
+Matching rules (same as reference):
+- In:            key present and value in values
+- NotIn:         key absent OR value not in values
+- Exists:        key present
+- DoesNotExist:  key absent
+- Gt / Lt:       key present, label value parses as integer, int(label) >/< int(values[0])
+
+An empty LabelSelector ({}) matches everything; a nil selector matches nothing
+(callers encode that by passing None).
+
+These evaluate host-side; the tensorizer (kubernetes_tpu/tensorize) compiles
+the same requirements into bitset index programs for on-device evaluation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping
+
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+# metav1.LabelSelector only admits these (apimachinery#LabelSelectorAsSelector
+# returns an error for anything else); NodeSelectorRequirement additionally
+# admits Gt/Lt (core/v1#NodeSelectorOperator).
+_LABEL_SELECTOR_OPS = {IN, NOT_IN, EXISTS, DOES_NOT_EXIST}
+_NODE_SELECTOR_OPS = {IN, NOT_IN, EXISTS, DOES_NOT_EXIST, GT, LT}
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """One selector requirement: key <op> values."""
+
+    key: str
+    operator: str
+    values: tuple[str, ...] = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        present = self.key in labels
+        if self.operator == IN:
+            return present and labels[self.key] in self.values
+        if self.operator == NOT_IN:
+            return (not present) or labels[self.key] not in self.values
+        if self.operator == EXISTS:
+            return present
+        if self.operator == DOES_NOT_EXIST:
+            return not present
+        if self.operator in (GT, LT):
+            if not present or len(self.values) != 1:
+                return False
+            try:
+                lhs = int(labels[self.key])
+                rhs = int(self.values[0])
+            except ValueError:
+                return False
+            return lhs > rhs if self.operator == GT else lhs < rhs
+        raise ValueError(f"unknown selector operator {self.operator!r}")
+
+
+@dataclass(frozen=True)
+class Selector:
+    """AND of requirements. ``Selector(())`` matches everything."""
+
+    requirements: tuple[Requirement, ...] = ()
+
+    def matches(self, labels: Mapping[str, str]) -> bool:
+        return all(r.matches(labels) for r in self.requirements)
+
+    @property
+    def empty(self) -> bool:
+        return not self.requirements
+
+
+def selector_from_label_selector(obj: Mapping | None) -> Selector | None:
+    """Build a Selector from a metav1.LabelSelector-shaped dict.
+
+    Returns None for a nil selector (matches nothing), Selector(()) for the
+    empty selector (matches everything) — mirroring
+    apimachinery#LabelSelectorAsSelector.
+    """
+    if obj is None:
+        return None
+    reqs: list[Requirement] = []
+    for k, v in sorted((obj.get("matchLabels") or {}).items()):
+        reqs.append(Requirement(k, IN, (v,)))
+    for expr in obj.get("matchExpressions") or ():
+        op = expr.get("operator")
+        if op not in _LABEL_SELECTOR_OPS:
+            raise ValueError(f"invalid matchExpressions operator {op!r}")
+        reqs.append(
+            Requirement(expr["key"], op, tuple(expr.get("values") or ()))
+        )
+    return Selector(tuple(reqs))
+
+
+def selector_from_node_selector_requirements(exprs) -> Selector:
+    """Build a Selector from NodeSelectorRequirement dicts (Gt/Lt allowed)."""
+    reqs: list[Requirement] = []
+    for expr in exprs or ():
+        op = expr.get("operator")
+        if op not in _NODE_SELECTOR_OPS:
+            raise ValueError(f"invalid nodeSelector operator {op!r}")
+        reqs.append(Requirement(expr["key"], op, tuple(expr.get("values") or ())))
+    return Selector(tuple(reqs))
+
+
+def requirements_from_match_labels(match_labels: Mapping[str, str]) -> tuple[Requirement, ...]:
+    return tuple(Requirement(k, IN, (v,)) for k, v in sorted(match_labels.items()))
+
+
+def label_selector_to_dict(sel: Selector | None) -> dict | None:
+    """Inverse of selector_from_label_selector, for wire round-trips."""
+    if sel is None:
+        return None
+    exprs = []
+    for r in sel.requirements:
+        exprs.append({"key": r.key, "operator": r.operator, "values": list(r.values)})
+    return {"matchExpressions": exprs} if exprs else {}
+
+
+def matches_any(selectors: Iterable[Selector], labels: Mapping[str, str]) -> bool:
+    return any(s.matches(labels) for s in selectors)

@@ -1,0 +1,757 @@
+"""Pod / Node API objects — the subset of core/v1 the scheduler consumes.
+
+Reference semantics:
+- staging/src/k8s.io/api/core/v1/types.go#Pod, #PodSpec, #Node, #NodeStatus,
+  #Affinity, #Toleration, #Taint, #TopologySpreadConstraint
+- pkg/scheduler/framework/types.go#computePodResourceRequest /
+  util/pod/resources (sum containers, max initContainers, + overhead)
+- pkg/scheduler/util/non_zero.go#GetNonzeroRequests (100 mCPU / 200 MB
+  defaults for zero-request pods, used only for scoring)
+
+Objects parse from / serialize to the real v1 JSON wire shapes so the
+extender webhook server (kubernetes_tpu/server) speaks byte-compatible
+payloads. Resource quantities are canonicalized to int64 on parse
+(cpu -> milli, memory/storage -> bytes) per kubernetes_tpu/api/quantity.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from .labels import (
+    Selector,
+    label_selector_to_dict,
+    selector_from_label_selector,
+    selector_from_node_selector_requirements,
+)
+from .quantity import canonical_requests, format_canonical
+
+# Non-zero scoring defaults: pkg/scheduler/util/non_zero.go
+DEFAULT_MILLI_CPU_REQUEST = 100  # 0.1 core
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024  # 200 MiB
+
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_EPHEMERAL_STORAGE = "ephemeral-storage"
+RESOURCE_PODS = "pods"
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+# Taint effects: core/v1/types.go#TaintEffect
+TAINT_NO_SCHEDULE = "NoSchedule"
+TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_NO_EXECUTE = "NoExecute"
+
+
+def _pod_requests(
+    containers: list[dict[str, int]],
+    init_containers: list[tuple[dict[str, int], bool]],
+) -> dict[str, int]:
+    """The PodRequests aggregation from k8s.io/component-helpers
+    resource/helpers.go#PodRequests (order-sensitive sidecar semantics):
+
+    - main requests = sum over containers, plus every restartable
+      (sidecar) init container;
+    - each non-sidecar init container's *effective* request is its own
+      request plus the sidecar requests accumulated before it in declaration
+      order (those sidecars are already running when it executes);
+    - result = elementwise max(main, max over effective init requests).
+
+    Overhead is added by the caller.
+    """
+    req: dict[str, int] = {}
+    for c in containers:
+        for k, v in c.items():
+            req[k] = req.get(k, 0) + v
+    sidecar_prefix: dict[str, int] = {}
+    init_max: dict[str, int] = {}
+    for c, is_sidecar in init_containers:
+        if is_sidecar:
+            for k, v in c.items():
+                req[k] = req.get(k, 0) + v
+                sidecar_prefix[k] = sidecar_prefix.get(k, 0) + v
+            effective = dict(sidecar_prefix)
+        else:
+            effective = dict(sidecar_prefix)
+            for k, v in c.items():
+                effective[k] = effective.get(k, 0) + v
+        for k, v in effective.items():
+            if v > init_max.get(k, 0):
+                init_max[k] = v
+    for k, v in init_max.items():
+        if v > req.get(k, 0):
+            req[k] = v
+    return req
+
+
+# ---------------------------------------------------------------------------
+# Leaf types
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ContainerPort:
+    """core/v1#ContainerPort — only host ports matter to scheduling."""
+
+    host_port: int = 0
+    host_ip: str = ""
+    protocol: str = "TCP"
+    container_port: int = 0
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "ContainerPort":
+        return ContainerPort(
+            host_port=int(d.get("hostPort") or 0),
+            host_ip=d.get("hostIP") or "",
+            protocol=d.get("protocol") or "TCP",
+            container_port=int(d.get("containerPort") or 0),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        if self.container_port:
+            out["containerPort"] = self.container_port
+        if self.host_port:
+            out["hostPort"] = self.host_port
+        if self.host_ip:
+            out["hostIP"] = self.host_ip
+        if self.protocol != "TCP":
+            out["protocol"] = self.protocol
+        return out
+
+
+@dataclass(frozen=True)
+class Container:
+    name: str = ""
+    requests: Mapping[str, int] = field(default_factory=dict)  # canonical ints
+    limits: Mapping[str, int] = field(default_factory=dict)
+    ports: tuple[ContainerPort, ...] = ()
+    images: tuple[str, ...] = ()  # image name(s) for ImageLocality
+    restart_policy: str = ""  # "Always" on an initContainer => sidecar
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Container":
+        res = d.get("resources") or {}
+        image = d.get("image")
+        return Container(
+            name=d.get("name") or "",
+            requests=canonical_requests(res.get("requests")),
+            limits=canonical_requests(res.get("limits")),
+            ports=tuple(ContainerPort.from_dict(p) for p in d.get("ports") or ()),
+            images=(image,) if image else (),
+            restart_policy=d.get("restartPolicy") or "",
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"name": self.name}
+        if self.images:
+            out["image"] = self.images[0]
+        res: dict[str, Any] = {}
+        if self.requests:
+            res["requests"] = {
+                k: format_canonical(k, v) for k, v in self.requests.items()
+            }
+        if self.limits:
+            res["limits"] = {k: format_canonical(k, v) for k, v in self.limits.items()}
+        if res:
+            out["resources"] = res
+        if self.ports:
+            out["ports"] = [p.to_dict() for p in self.ports]
+        if self.restart_policy:
+            out["restartPolicy"] = self.restart_policy
+        return out
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """core/v1#Toleration; match semantics in
+    k8s.io/api/core/v1/toleration.go#ToleratesTaint."""
+
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty = all effects
+    toleration_seconds: int | None = None
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key == "":
+            # empty key with Exists tolerates everything
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.operator in ("Equal", "") and self.value == taint.value
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Toleration":
+        return Toleration(
+            key=d.get("key") or "",
+            operator=d.get("operator") or "Equal",
+            value=d.get("value") or "",
+            effect=d.get("effect") or "",
+            toleration_seconds=d.get("tolerationSeconds"),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        if self.key:
+            out["key"] = self.key
+        if self.operator != "Equal":
+            out["operator"] = self.operator
+        if self.value:
+            out["value"] = self.value
+        if self.effect:
+            out["effect"] = self.effect
+        if self.toleration_seconds is not None:
+            out["tolerationSeconds"] = self.toleration_seconds
+        return out
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = ""
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Taint":
+        return Taint(d.get("key") or "", d.get("value") or "", d.get("effect") or "")
+
+    def to_dict(self) -> dict:
+        return {"key": self.key, "value": self.value, "effect": self.effect}
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    """OR-term: AND of matchExpressions and matchFields."""
+
+    match_expressions: Selector = field(default_factory=Selector)
+    match_fields: Selector = field(default_factory=Selector)
+    # A term with no expressions and no fields matches NOTHING
+    # (nodeaffinity.go#nodeSelectorTermsMatch) — track emptiness explicitly.
+    empty: bool = True
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "NodeSelectorTerm":
+        exprs = selector_from_node_selector_requirements(d.get("matchExpressions"))
+        fields_ = selector_from_node_selector_requirements(d.get("matchFields"))
+        return NodeSelectorTerm(
+            match_expressions=exprs,
+            match_fields=fields_,
+            empty=not (d.get("matchExpressions") or d.get("matchFields")),
+        )
+
+    def matches(self, node_labels: Mapping[str, str], node_fields: Mapping[str, str]) -> bool:
+        if self.empty:
+            return False
+        return self.match_expressions.matches(node_labels) and self.match_fields.matches(
+            node_fields
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        d = label_selector_to_dict(self.match_expressions)
+        if d and d.get("matchExpressions"):
+            out["matchExpressions"] = d["matchExpressions"]
+        f = label_selector_to_dict(self.match_fields)
+        if f and f.get("matchExpressions"):
+            out["matchFields"] = f["matchExpressions"]
+        return out
+
+
+@dataclass(frozen=True)
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PreferredSchedulingTerm":
+        return PreferredSchedulingTerm(
+            weight=int(d.get("weight") or 0),
+            preference=NodeSelectorTerm.from_dict(d.get("preference") or {}),
+        )
+
+    def to_dict(self) -> dict:
+        return {"weight": self.weight, "preference": self.preference.to_dict()}
+
+
+@dataclass(frozen=True)
+class NodeAffinity:
+    """requiredDuringSchedulingIgnoredDuringExecution is an OR of terms."""
+
+    required: tuple[NodeSelectorTerm, ...] | None = None  # None = no requirement
+    preferred: tuple[PreferredSchedulingTerm, ...] = ()
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "NodeAffinity":
+        req = d.get("requiredDuringSchedulingIgnoredDuringExecution")
+        required = None
+        if req is not None:
+            required = tuple(
+                NodeSelectorTerm.from_dict(t) for t in req.get("nodeSelectorTerms") or ()
+            )
+        preferred = tuple(
+            PreferredSchedulingTerm.from_dict(t)
+            for t in d.get("preferredDuringSchedulingIgnoredDuringExecution") or ()
+        )
+        return NodeAffinity(required=required, preferred=preferred)
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        if self.required is not None:
+            out["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                "nodeSelectorTerms": [t.to_dict() for t in self.required]
+            }
+        if self.preferred:
+            out["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                t.to_dict() for t in self.preferred
+            ]
+        return out
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    """core/v1#PodAffinityTerm. label_selector=None matches no pods."""
+
+    label_selector: Selector | None = None
+    topology_key: str = ""
+    namespaces: tuple[str, ...] = ()  # empty => pod's own namespace
+    namespace_selector: Selector | None = None
+    match_label_keys: tuple[str, ...] = ()
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PodAffinityTerm":
+        return PodAffinityTerm(
+            label_selector=selector_from_label_selector(d.get("labelSelector")),
+            topology_key=d.get("topologyKey") or "",
+            namespaces=tuple(d.get("namespaces") or ()),
+            namespace_selector=selector_from_label_selector(d.get("namespaceSelector")),
+            match_label_keys=tuple(d.get("matchLabelKeys") or ()),
+        )
+
+    def matches_namespace(self, pod_namespace: str, target_ns: str,
+                          target_ns_labels: Mapping[str, str] | None = None) -> bool:
+        """Which namespaces the term selects, per
+        framework/types.go#AffinityTerm.Matches."""
+        if self.namespaces:
+            if target_ns in self.namespaces:
+                return True
+        elif self.namespace_selector is None:
+            # no namespaces and no selector => pod's own namespace
+            return target_ns == pod_namespace
+        if self.namespace_selector is not None:
+            return self.namespace_selector.matches(target_ns_labels or {})
+        return False
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"topologyKey": self.topology_key}
+        if self.label_selector is not None:
+            out["labelSelector"] = label_selector_to_dict(self.label_selector)
+        if self.namespaces:
+            out["namespaces"] = list(self.namespaces)
+        if self.namespace_selector is not None:
+            out["namespaceSelector"] = label_selector_to_dict(self.namespace_selector)
+        if self.match_label_keys:
+            out["matchLabelKeys"] = list(self.match_label_keys)
+        return out
+
+
+@dataclass(frozen=True)
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "WeightedPodAffinityTerm":
+        return WeightedPodAffinityTerm(
+            weight=int(d.get("weight") or 0),
+            term=PodAffinityTerm.from_dict(d.get("podAffinityTerm") or {}),
+        )
+
+    def to_dict(self) -> dict:
+        return {"weight": self.weight, "podAffinityTerm": self.term.to_dict()}
+
+
+@dataclass(frozen=True)
+class PodAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PodAffinity":
+        return PodAffinity(
+            required=tuple(
+                PodAffinityTerm.from_dict(t)
+                for t in d.get("requiredDuringSchedulingIgnoredDuringExecution") or ()
+            ),
+            preferred=tuple(
+                WeightedPodAffinityTerm.from_dict(t)
+                for t in d.get("preferredDuringSchedulingIgnoredDuringExecution") or ()
+            ),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        if self.required:
+            out["requiredDuringSchedulingIgnoredDuringExecution"] = [
+                t.to_dict() for t in self.required
+            ]
+        if self.preferred:
+            out["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                t.to_dict() for t in self.preferred
+            ]
+        return out
+
+
+@dataclass(frozen=True)
+class Affinity:
+    node_affinity: NodeAffinity | None = None
+    pod_affinity: PodAffinity | None = None
+    pod_anti_affinity: PodAffinity | None = None
+
+    @staticmethod
+    def from_dict(d: Mapping | None) -> "Affinity | None":
+        if not d:
+            return None
+        na = d.get("nodeAffinity")
+        pa = d.get("podAffinity")
+        paa = d.get("podAntiAffinity")
+        return Affinity(
+            node_affinity=NodeAffinity.from_dict(na) if na else None,
+            pod_affinity=PodAffinity.from_dict(pa) if pa else None,
+            pod_anti_affinity=PodAffinity.from_dict(paa) if paa else None,
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        if self.node_affinity:
+            out["nodeAffinity"] = self.node_affinity.to_dict()
+        if self.pod_affinity:
+            out["podAffinity"] = self.pod_affinity.to_dict()
+        if self.pod_anti_affinity:
+            out["podAntiAffinity"] = self.pod_anti_affinity.to_dict()
+        return out
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Selector | None = None
+    min_domains: int | None = None
+    node_affinity_policy: str = "Honor"  # Honor | Ignore
+    node_taints_policy: str = "Ignore"  # Honor | Ignore
+    match_label_keys: tuple[str, ...] = ()
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "TopologySpreadConstraint":
+        return TopologySpreadConstraint(
+            max_skew=int(d.get("maxSkew") or 1),
+            topology_key=d.get("topologyKey") or "",
+            when_unsatisfiable=d.get("whenUnsatisfiable") or "DoNotSchedule",
+            label_selector=selector_from_label_selector(d.get("labelSelector")),
+            min_domains=d.get("minDomains"),
+            node_affinity_policy=d.get("nodeAffinityPolicy") or "Honor",
+            node_taints_policy=d.get("nodeTaintsPolicy") or "Ignore",
+            match_label_keys=tuple(d.get("matchLabelKeys") or ()),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {
+            "maxSkew": self.max_skew,
+            "topologyKey": self.topology_key,
+            "whenUnsatisfiable": self.when_unsatisfiable,
+        }
+        if self.label_selector is not None:
+            out["labelSelector"] = label_selector_to_dict(self.label_selector)
+        if self.min_domains is not None:
+            out["minDomains"] = self.min_domains
+        if self.node_affinity_policy != "Honor":
+            out["nodeAffinityPolicy"] = self.node_affinity_policy
+        if self.node_taints_policy != "Ignore":
+            out["nodeTaintsPolicy"] = self.node_taints_policy
+        if self.match_label_keys:
+            out["matchLabelKeys"] = list(self.match_label_keys)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Pod
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Pod:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+    # spec
+    node_name: str = ""
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    priority: int | None = None
+    priority_class_name: str = ""
+    preemption_policy: str = ""  # "" => PreemptLowerPriority
+    scheduling_gates: tuple[str, ...] = ()
+    node_selector: dict[str, str] = field(default_factory=dict)
+    affinity: Affinity | None = None
+    tolerations: tuple[Toleration, ...] = ()
+    topology_spread_constraints: tuple[TopologySpreadConstraint, ...] = ()
+    containers: tuple[Container, ...] = ()
+    init_containers: tuple[Container, ...] = ()
+    overhead: dict[str, int] = field(default_factory=dict)  # canonical ints
+    host_network: bool = False
+
+    # status
+    phase: str = "Pending"
+    nominated_node_name: str = ""
+    # queue bookkeeping (not wire fields)
+    creation_timestamp: float = 0.0
+    resource_version: int = 0
+    start_time: float = 0.0  # for preemption victim ordering
+
+    # ---- derived, cached ----
+    _resource_request: dict[str, int] | None = field(
+        default=None, repr=False, compare=False
+    )
+    _non_zero_request: tuple[int, int] | None = field(
+        default=None, repr=False, compare=False
+    )
+
+    @property
+    def effective_priority(self) -> int:
+        return self.priority if self.priority is not None else 0
+
+    def resource_request(self) -> dict[str, int]:
+        """computePodResourceRequest: sum(containers) elementwise-max'd with
+        each initContainer, sidecars (restartPolicy=Always initContainers)
+        added to the running sum, plus pod overhead.
+
+        Ref: pkg/scheduler/framework/plugins/noderesources/fit.go
+        #computePodResourceRequest and k8s.io/component-helpers resource.
+        """
+        if self._resource_request is not None:
+            return self._resource_request
+        req = _pod_requests(
+            [dict(c.requests) for c in self.containers],
+            [(dict(c.requests), c.restart_policy == "Always") for c in self.init_containers],
+        )
+        for k, v in self.overhead.items():
+            req[k] = req.get(k, 0) + v
+        self._resource_request = req
+        return req
+
+    def non_zero_request(self) -> tuple[int, int]:
+        """(milliCPU, memoryBytes) with scoring defaults applied.
+
+        Ref: pkg/scheduler/util/non_zero.go#GetNonzeroRequests — defaults are
+        applied per *container* whose request for that resource is zero.
+        """
+        if self._non_zero_request is not None:
+            return self._non_zero_request
+
+        def defaulted(c: Container) -> dict[str, int]:
+            return {
+                RESOURCE_CPU: c.requests.get(RESOURCE_CPU, 0) or DEFAULT_MILLI_CPU_REQUEST,
+                RESOURCE_MEMORY: c.requests.get(RESOURCE_MEMORY, 0) or DEFAULT_MEMORY_REQUEST,
+            }
+
+        req = _pod_requests(
+            [defaulted(c) for c in self.containers],
+            [(defaulted(c), c.restart_policy == "Always") for c in self.init_containers],
+        )
+        cpu = req.get(RESOURCE_CPU, 0) + self.overhead.get(RESOURCE_CPU, 0)
+        mem = req.get(RESOURCE_MEMORY, 0) + self.overhead.get(RESOURCE_MEMORY, 0)
+        self._non_zero_request = (cpu, mem)
+        return self._non_zero_request
+
+    def host_ports(self) -> tuple[tuple[str, str, int], ...]:
+        """(hostIP, protocol, hostPort) triples requested by this pod.
+        Ref: plugins/nodeports/node_ports.go#getContainerPorts."""
+        out = []
+        for c in self.containers:
+            for p in c.ports:
+                if p.host_port > 0:
+                    out.append((p.host_ip or "0.0.0.0", p.protocol, p.host_port))
+        return tuple(out)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Pod":
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        aff = Affinity.from_dict(spec.get("affinity"))
+        return Pod(
+            name=meta.get("name") or "",
+            namespace=meta.get("namespace") or "default",
+            uid=meta.get("uid") or "",
+            labels=dict(meta.get("labels") or {}),
+            annotations=dict(meta.get("annotations") or {}),
+            node_name=spec.get("nodeName") or "",
+            scheduler_name=spec.get("schedulerName") or DEFAULT_SCHEDULER_NAME,
+            priority=spec.get("priority"),
+            priority_class_name=spec.get("priorityClassName") or "",
+            preemption_policy=spec.get("preemptionPolicy") or "",
+            scheduling_gates=tuple(
+                g.get("name", "") for g in spec.get("schedulingGates") or ()
+            ),
+            node_selector=dict(spec.get("nodeSelector") or {}),
+            affinity=aff,
+            tolerations=tuple(Toleration.from_dict(t) for t in spec.get("tolerations") or ()),
+            topology_spread_constraints=tuple(
+                TopologySpreadConstraint.from_dict(t)
+                for t in spec.get("topologySpreadConstraints") or ()
+            ),
+            containers=tuple(Container.from_dict(c) for c in spec.get("containers") or ()),
+            init_containers=tuple(
+                Container.from_dict(c) for c in spec.get("initContainers") or ()
+            ),
+            overhead=canonical_requests(spec.get("overhead")),
+            host_network=bool(spec.get("hostNetwork") or False),
+            phase=status.get("phase") or "Pending",
+            nominated_node_name=status.get("nominatedNodeName") or "",
+            resource_version=int(meta.get("resourceVersion") or 0),
+        )
+
+    def to_dict(self) -> dict:
+        spec: dict[str, Any] = {}
+        if self.node_name:
+            spec["nodeName"] = self.node_name
+        if self.scheduler_name != DEFAULT_SCHEDULER_NAME:
+            spec["schedulerName"] = self.scheduler_name
+        if self.priority is not None:
+            spec["priority"] = self.priority
+        if self.priority_class_name:
+            spec["priorityClassName"] = self.priority_class_name
+        if self.preemption_policy:
+            spec["preemptionPolicy"] = self.preemption_policy
+        if self.scheduling_gates:
+            spec["schedulingGates"] = [{"name": g} for g in self.scheduling_gates]
+        if self.node_selector:
+            spec["nodeSelector"] = dict(self.node_selector)
+        if self.affinity:
+            spec["affinity"] = self.affinity.to_dict()
+        if self.tolerations:
+            spec["tolerations"] = [t.to_dict() for t in self.tolerations]
+        if self.topology_spread_constraints:
+            spec["topologySpreadConstraints"] = [
+                t.to_dict() for t in self.topology_spread_constraints
+            ]
+        spec["containers"] = [c.to_dict() for c in self.containers]
+        if self.init_containers:
+            spec["initContainers"] = [c.to_dict() for c in self.init_containers]
+        if self.overhead:
+            spec["overhead"] = {
+                k: format_canonical(k, v) for k, v in self.overhead.items()
+            }
+        if self.host_network:
+            spec["hostNetwork"] = True
+        status: dict[str, Any] = {"phase": self.phase}
+        if self.nominated_node_name:
+            status["nominatedNodeName"] = self.nominated_node_name
+        meta: dict[str, Any] = {"name": self.name, "namespace": self.namespace}
+        if self.uid:
+            meta["uid"] = self.uid
+        if self.labels:
+            meta["labels"] = dict(self.labels)
+        if self.annotations:
+            meta["annotations"] = dict(self.annotations)
+        if self.resource_version:
+            meta["resourceVersion"] = str(self.resource_version)
+        return {"apiVersion": "v1", "kind": "Pod", "metadata": meta, "spec": spec, "status": status}
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ContainerImage:
+    names: tuple[str, ...] = ()
+    size_bytes: int = 0
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "ContainerImage":
+        return ContainerImage(
+            names=tuple(d.get("names") or ()), size_bytes=int(d.get("sizeBytes") or 0)
+        )
+
+    def to_dict(self) -> dict:
+        return {"names": list(self.names), "sizeBytes": self.size_bytes}
+
+
+@dataclass
+class Node:
+    name: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    unschedulable: bool = False
+    taints: tuple[Taint, ...] = ()
+    allocatable: dict[str, int] = field(default_factory=dict)  # canonical ints
+    capacity: dict[str, int] = field(default_factory=dict)
+    images: tuple[ContainerImage, ...] = ()
+    resource_version: int = 0
+
+    @property
+    def allowed_pod_number(self) -> int:
+        return self.allocatable.get(RESOURCE_PODS, 0)
+
+    def field_labels(self) -> dict[str, str]:
+        """matchFields vocabulary — only metadata.name is supported upstream
+        (nodeaffinity.go)."""
+        return {"metadata.name": self.name}
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Node":
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return Node(
+            name=meta.get("name") or "",
+            labels=dict(meta.get("labels") or {}),
+            annotations=dict(meta.get("annotations") or {}),
+            unschedulable=bool(spec.get("unschedulable") or False),
+            taints=tuple(Taint.from_dict(t) for t in spec.get("taints") or ()),
+            allocatable=canonical_requests(status.get("allocatable")),
+            capacity=canonical_requests(status.get("capacity")),
+            images=tuple(ContainerImage.from_dict(i) for i in status.get("images") or ()),
+            resource_version=int(meta.get("resourceVersion") or 0),
+        )
+
+    def to_dict(self) -> dict:
+        meta: dict[str, Any] = {"name": self.name}
+        if self.labels:
+            meta["labels"] = dict(self.labels)
+        if self.annotations:
+            meta["annotations"] = dict(self.annotations)
+        if self.resource_version:
+            meta["resourceVersion"] = str(self.resource_version)
+        spec: dict[str, Any] = {}
+        if self.unschedulable:
+            spec["unschedulable"] = True
+        if self.taints:
+            spec["taints"] = [t.to_dict() for t in self.taints]
+        status: dict[str, Any] = {}
+        if self.allocatable:
+            status["allocatable"] = {
+                k: format_canonical(k, v) for k, v in self.allocatable.items()
+            }
+        if self.capacity:
+            status["capacity"] = {
+                k: format_canonical(k, v) for k, v in self.capacity.items()
+            }
+        if self.images:
+            status["images"] = [i.to_dict() for i in self.images]
+        return {"apiVersion": "v1", "kind": "Node", "metadata": meta, "spec": spec, "status": status}

@@ -1,0 +1,14 @@
+"""Test configuration: force JAX onto CPU with 8 virtual devices BEFORE any
+jax import, so sharding tests exercise a multi-chip mesh without TPU hardware
+(SURVEY.md §6.7 — single real chip; mesh logic validated on host devices)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep compile times predictable on the 1-vCPU host.
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,279 @@
+"""Pod/Node object model: wire round-trip, resource computation, selectors,
+tolerations — semantics from framework/types.go and util/non_zero.go."""
+
+from kubernetes_tpu.api.labels import (
+    Requirement,
+    Selector,
+    selector_from_label_selector,
+)
+from kubernetes_tpu.api.objects import (
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+    Pod,
+    Node,
+    Taint,
+    Toleration,
+)
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+
+
+class TestSelectors:
+    def test_match_labels(self):
+        sel = selector_from_label_selector({"matchLabels": {"app": "web"}})
+        assert sel.matches({"app": "web", "x": "y"})
+        assert not sel.matches({"app": "db"})
+        assert not sel.matches({})
+
+    def test_empty_selector_matches_everything(self):
+        sel = selector_from_label_selector({})
+        assert sel.matches({}) and sel.matches({"a": "b"})
+
+    def test_nil_selector(self):
+        assert selector_from_label_selector(None) is None
+
+    def test_operators(self):
+        assert Requirement("k", "In", ("a", "b")).matches({"k": "a"})
+        assert not Requirement("k", "In", ("a",)).matches({})
+        assert Requirement("k", "NotIn", ("a",)).matches({})  # absent => NotIn true
+        assert Requirement("k", "NotIn", ("a",)).matches({"k": "b"})
+        assert not Requirement("k", "NotIn", ("a",)).matches({"k": "a"})
+        assert Requirement("k", "Exists").matches({"k": ""})
+        assert not Requirement("k", "Exists").matches({})
+        assert Requirement("k", "DoesNotExist").matches({})
+        assert Requirement("k", "Gt", ("5",)).matches({"k": "6"})
+        assert not Requirement("k", "Gt", ("5",)).matches({"k": "5"})
+        assert not Requirement("k", "Gt", ("5",)).matches({"k": "abc"})
+        assert not Requirement("k", "Gt", ("5",)).matches({})
+        assert Requirement("k", "Lt", ("5",)).matches({"k": "4"})
+
+    def test_and_of_requirements(self):
+        sel = Selector(
+            (Requirement("a", "In", ("1",)), Requirement("b", "Exists"))
+        )
+        assert sel.matches({"a": "1", "b": "x"})
+        assert not sel.matches({"a": "1"})
+
+
+class TestPodResources:
+    def test_sum_containers_plus_overhead(self):
+        p = (
+            MakePod()
+            .name("p")
+            .req({"cpu": "100m", "memory": "100Mi"})
+            .req({"cpu": "200m", "memory": "50Mi"})
+            .overhead({"cpu": "10m"})
+            .obj()
+        )
+        r = p.resource_request()
+        assert r["cpu"] == 310
+        assert r["memory"] == 150 * 1024**2
+
+    def test_init_container_max(self):
+        p = (
+            MakePod()
+            .name("p")
+            .req({"cpu": "100m"})
+            .init_req({"cpu": "500m"})
+            .init_req({"cpu": "300m"})
+            .obj()
+        )
+        assert p.resource_request()["cpu"] == 500
+
+    def test_sidecar_init_container_adds(self):
+        p = (
+            MakePod()
+            .name("p")
+            .req({"cpu": "100m"})
+            .init_req({"cpu": "50m"}, restart_policy="Always")
+            .obj()
+        )
+        assert p.resource_request()["cpu"] == 150
+
+    def test_non_zero_defaults(self):
+        p = MakePod().name("p").obj()  # one container, zero requests
+        cpu, mem = p.non_zero_request()
+        assert cpu == DEFAULT_MILLI_CPU_REQUEST
+        assert mem == DEFAULT_MEMORY_REQUEST
+
+    def test_non_zero_with_real_requests(self):
+        p = MakePod().name("p").req({"cpu": "250m", "memory": "1Gi"}).obj()
+        assert p.non_zero_request() == (250, 1024**3)
+
+    def test_non_zero_partial(self):
+        # cpu set, memory zero -> memory defaults
+        p = MakePod().name("p").req({"cpu": "250m"}).obj()
+        assert p.non_zero_request() == (250, DEFAULT_MEMORY_REQUEST)
+
+
+class TestTolerations:
+    def test_exact_match(self):
+        t = Toleration(key="k", operator="Equal", value="v", effect="NoSchedule")
+        assert t.tolerates(Taint("k", "v", "NoSchedule"))
+        assert not t.tolerates(Taint("k", "w", "NoSchedule"))
+        assert not t.tolerates(Taint("k", "v", "NoExecute"))
+
+    def test_exists(self):
+        t = Toleration(key="k", operator="Exists")
+        assert t.tolerates(Taint("k", "anything", "NoSchedule"))
+        assert t.tolerates(Taint("k", "", "NoExecute"))
+
+    def test_empty_key_exists_tolerates_all(self):
+        t = Toleration(operator="Exists")
+        assert t.tolerates(Taint("any", "v", "NoSchedule"))
+
+    def test_empty_effect_matches_all_effects(self):
+        t = Toleration(key="k", operator="Exists", effect="")
+        assert t.tolerates(Taint("k", "", "NoExecute"))
+
+
+class TestWireRoundTrip:
+    def test_pod_round_trip(self):
+        p = (
+            MakePod()
+            .name("web-1")
+            .namespace("prod")
+            .labels({"app": "web"})
+            .priority(100)
+            .req({"cpu": "500m", "memory": "1Gi"})
+            .node_selector({"disk": "ssd"})
+            .toleration("dedicated", "gpu", effect="NoSchedule")
+            .spread_constraint(1, "topology.kubernetes.io/zone", match_labels={"app": "web"})
+            .pod_anti_affinity("kubernetes.io/hostname", {"app": "web"})
+            .obj()
+        )
+        d = p.to_dict()
+        p2 = Pod.from_dict(d)
+        assert p2.name == "web-1" and p2.namespace == "prod"
+        assert p2.effective_priority == 100
+        assert p2.resource_request() == p.resource_request()
+        assert p2.node_selector == {"disk": "ssd"}
+        assert len(p2.tolerations) == 1
+        assert len(p2.topology_spread_constraints) == 1
+        assert p2.affinity.pod_anti_affinity.required[0].topology_key == "kubernetes.io/hostname"
+        assert p2.to_dict() == d
+
+    def test_node_round_trip(self):
+        n = (
+            MakeNode()
+            .name("node-1")
+            .label("topology.kubernetes.io/zone", "us-east1-a")
+            .capacity({"cpu": "8", "memory": "32Gi", "pods": "110"})
+            .taint("dedicated", "gpu", "NoSchedule")
+            .image("nginx:1.25", 50_000_000)
+            .obj()
+        )
+        d = n.to_dict()
+        n2 = Node.from_dict(d)
+        assert n2.name == "node-1"
+        assert n2.allocatable["cpu"] == 8000
+        assert n2.allocatable["memory"] == 32 * 1024**3
+        assert n2.allowed_pod_number == 110
+        assert n2.taints[0] == Taint("dedicated", "gpu", "NoSchedule")
+        assert n2.images[0].size_bytes == 50_000_000
+        assert n2.to_dict() == d
+
+    def test_node_affinity_round_trip(self):
+        p = (
+            MakePod()
+            .name("p")
+            .node_affinity_in("zone", ["a", "b"])
+            .preferred_node_affinity(10, "disk", ["ssd"])
+            .obj()
+        )
+        p2 = Pod.from_dict(p.to_dict())
+        na = p2.affinity.node_affinity
+        assert na.required is not None and len(na.required) == 1
+        assert na.required[0].matches({"zone": "a"}, {})
+        assert not na.required[0].matches({"zone": "c"}, {})
+        assert na.preferred[0].weight == 10
+
+    def test_host_ports(self):
+        p = MakePod().name("p").host_port(8080).host_port(9090, "UDP").obj()
+        assert p.host_ports() == (
+            ("0.0.0.0", "TCP", 8080),
+            ("0.0.0.0", "UDP", 9090),
+        )
+
+
+class TestReviewRegressions:
+    """Regressions from the parity review: sidecar ordering, operator sets,
+    resourceVersion round-trip."""
+
+    def test_sidecar_before_init_ordering(self):
+        # upstream PodRequests: non-sidecar init's effective request = own +
+        # sidecars declared before it. main=100m, sidecar=500m, init=1000m
+        # -> max(100+500, 1000+500) = 1500m
+        p = (
+            MakePod()
+            .name("p")
+            .req({"cpu": "100m"})
+            .init_req({"cpu": "500m"}, restart_policy="Always")
+            .init_req({"cpu": "1000m"})
+            .obj()
+        )
+        assert p.resource_request()["cpu"] == 1500
+
+    def test_init_before_sidecar_ordering(self):
+        # init declared BEFORE the sidecar sees no sidecar prefix:
+        # max(100+500, 1000) = 1000... main+sidecar = 600 -> result 1000
+        p = (
+            MakePod()
+            .name("p")
+            .req({"cpu": "100m"})
+            .init_req({"cpu": "1000m"})
+            .init_req({"cpu": "500m"}, restart_policy="Always")
+            .obj()
+        )
+        assert p.resource_request()["cpu"] == 1000
+
+    def test_non_zero_sidecar_ordering(self):
+        # zero-request main (defaults 100m) + sidecar 500m + init 1000m
+        # -> max(100+500, 1000+500) = 1600m? No: init defaults apply per
+        # container: init cpu=1000m given. max(600, 1500) = 1500
+        p = (
+            MakePod()
+            .name("p")
+            .init_req({"cpu": "500m", "memory": "1Gi"}, restart_policy="Always")
+            .init_req({"cpu": "1", "memory": "1Gi"})
+            .obj()
+        )
+        cpu, _ = p.non_zero_request()
+        assert cpu == 1500
+
+    def test_label_selector_rejects_gt(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            selector_from_label_selector(
+                {"matchExpressions": [{"key": "k", "operator": "Gt", "values": ["1"]}]}
+            )
+
+    def test_node_selector_allows_gt(self):
+        p = Pod.from_dict(
+            {
+                "metadata": {"name": "p"},
+                "spec": {
+                    "containers": [{"name": "c"}],
+                    "affinity": {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {"matchExpressions": [{"key": "cpus", "operator": "Gt", "values": ["4"]}]}
+                                ]
+                            }
+                        }
+                    },
+                },
+            }
+        )
+        term = p.affinity.node_affinity.required[0]
+        assert term.matches({"cpus": "8"}, {})
+        assert not term.matches({"cpus": "4"}, {})
+
+    def test_resource_version_round_trip(self):
+        p = Pod.from_dict({"metadata": {"name": "p", "resourceVersion": "42"}, "spec": {"containers": []}})
+        assert p.resource_version == 42
+        assert Pod.from_dict(p.to_dict()).resource_version == 42
+        n = Node.from_dict({"metadata": {"name": "n", "resourceVersion": "7"}})
+        assert n.resource_version == 7
+        assert Node.from_dict(n.to_dict()).resource_version == 7

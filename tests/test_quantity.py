@@ -1,0 +1,121 @@
+"""Quantity parsing vs the reference's documented semantics
+(apimachinery/pkg/api/resource/quantity.go)."""
+
+import pytest
+from fractions import Fraction
+
+from kubernetes_tpu.api.quantity import (
+    MAX_INT64,
+    QuantityError,
+    canonical,
+    canonical_requests,
+    format_canonical,
+    parse_quantity,
+    quantity_milli_value,
+    quantity_value,
+)
+
+
+class TestParse:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [
+            ("0", 0),
+            ("1", 1),
+            ("100m", Fraction(1, 10)),
+            ("1500m", Fraction(3, 2)),
+            ("1Ki", 1024),
+            ("1Mi", 1024**2),
+            ("1Gi", 1024**3),
+            ("1Ti", 1024**4),
+            ("1Pi", 1024**5),
+            ("1Ei", 1024**6),
+            ("1k", 1000),
+            ("1M", 10**6),
+            ("1G", 10**9),
+            ("1T", 10**12),
+            ("1P", 10**15),
+            ("1E", 10**18),
+            ("500M", 5 * 10**8),
+            ("1e3", 1000),
+            ("1E3", 1000),  # E as exponent when followed by digits
+            ("1.5e2", 150),
+            ("12e-3", Fraction(12, 1000)),
+            ("0.5", Fraction(1, 2)),
+            (".5", Fraction(1, 2)),
+            ("2.", 2),
+            ("+2", 2),
+            ("-2", -2),
+            ("100n", Fraction(1, 10**7)),
+            ("100u", Fraction(1, 10**4)),
+        ],
+    )
+    def test_values(self, s, expected):
+        assert parse_quantity(s) == expected
+
+    @pytest.mark.parametrize("s", ["", "abc", "1.2.3", "1Zi", "1kk", "--1", "1 Gi x"])
+    def test_invalid(self, s):
+        with pytest.raises(QuantityError):
+            parse_quantity(s)
+
+
+class TestCanonical:
+    def test_cpu_milli(self):
+        assert canonical("cpu", "100m") == 100
+        assert canonical("cpu", "2") == 2000
+        assert canonical("cpu", "1.5") == 1500
+        # sub-milli rounds UP (quantity.go#MilliValue)
+        assert canonical("cpu", "0.5m") == 1
+        assert canonical("cpu", "100n") == 1
+
+    def test_memory_bytes(self):
+        assert canonical("memory", "1Gi") == 1024**3
+        assert canonical("memory", "200M") == 200 * 10**6
+        assert canonical("memory", "128974848") == 128974848
+        # fractional bytes round UP (quantity.go#Value)
+        assert canonical("memory", "1.5") == 2
+
+    def test_pods_count(self):
+        assert canonical("pods", "110") == 110
+
+    def test_extended_resource(self):
+        assert canonical("example.com/gpu", "4") == 4
+
+    def test_saturation(self):
+        assert canonical("memory", "100E") == MAX_INT64
+        assert quantity_milli_value("10E") == MAX_INT64
+
+    def test_requests_map(self):
+        out = canonical_requests({"cpu": "250m", "memory": "64Mi"})
+        assert out == {"cpu": 250, "memory": 64 * 1024**2}
+        assert canonical_requests(None) == {}
+
+    def test_format_round_trip(self):
+        assert format_canonical("cpu", 250) == "250m"
+        assert format_canonical("cpu", 2000) == "2"
+        assert format_canonical("memory", 1024**3) == str(1024**3)
+        assert canonical("cpu", format_canonical("cpu", 1234)) == 1234
+        assert canonical("memory", format_canonical("memory", 999)) == 999
+
+
+class TestHypothesis:
+    def test_milli_value_ceiling_property(self):
+        from hypothesis import given, strategies as st
+
+        @given(st.integers(min_value=0, max_value=10**12))
+        def check(n):
+            # n nano-cores -> milli is ceil(n/1e6)
+            s = f"{n}n"
+            expect = -(-n // 10**6)
+            assert quantity_milli_value(s) == expect
+
+        check()
+
+    def test_value_vs_int_strings(self):
+        from hypothesis import given, strategies as st
+
+        @given(st.integers(min_value=0, max_value=2**62))
+        def check(n):
+            assert quantity_value(str(n)) == n
+
+        check()
