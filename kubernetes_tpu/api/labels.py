@@ -24,8 +24,19 @@ the same requirements into bitset index programs for on-device evaluation.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+import re
+from dataclasses import dataclass
 from typing import Iterable, Mapping
+
+# Gt/Lt label values must parse like Go's strconv.ParseInt: ASCII digits with
+# optional sign — no underscores, no unicode digits (int() is too lenient).
+_GO_INT_RE = re.compile(r"^[+-]?[0-9]+$")
+
+
+def _parse_go_int(s: str) -> int | None:
+    if not _GO_INT_RE.match(s):
+        return None
+    return int(s)
 
 IN = "In"
 NOT_IN = "NotIn"
@@ -62,10 +73,9 @@ class Requirement:
         if self.operator in (GT, LT):
             if not present or len(self.values) != 1:
                 return False
-            try:
-                lhs = int(labels[self.key])
-                rhs = int(self.values[0])
-            except ValueError:
+            lhs = _parse_go_int(labels[self.key])
+            rhs = _parse_go_int(self.values[0])
+            if lhs is None or rhs is None:
                 return False
             return lhs > rhs if self.operator == GT else lhs < rhs
         raise ValueError(f"unknown selector operator {self.operator!r}")
@@ -73,9 +83,16 @@ class Requirement:
 
 @dataclass(frozen=True)
 class Selector:
-    """AND of requirements. ``Selector(())`` matches everything."""
+    """AND of requirements. ``Selector(())`` matches everything.
+
+    ``match_labels`` records which leading requirements came from a
+    LabelSelector's matchLabels map so serialization reproduces the original
+    wire shape (they are ALSO present in ``requirements`` as In-requirements;
+    evaluation uses only ``requirements``).
+    """
 
     requirements: tuple[Requirement, ...] = ()
+    match_labels: tuple[tuple[str, str], ...] = ()
 
     def matches(self, labels: Mapping[str, str]) -> bool:
         return all(r.matches(labels) for r in self.requirements)
@@ -95,7 +112,8 @@ def selector_from_label_selector(obj: Mapping | None) -> Selector | None:
     if obj is None:
         return None
     reqs: list[Requirement] = []
-    for k, v in sorted((obj.get("matchLabels") or {}).items()):
+    ml = tuple(sorted((obj.get("matchLabels") or {}).items()))
+    for k, v in ml:
         reqs.append(Requirement(k, IN, (v,)))
     for expr in obj.get("matchExpressions") or ():
         op = expr.get("operator")
@@ -104,7 +122,7 @@ def selector_from_label_selector(obj: Mapping | None) -> Selector | None:
         reqs.append(
             Requirement(expr["key"], op, tuple(expr.get("values") or ()))
         )
-    return Selector(tuple(reqs))
+    return Selector(tuple(reqs), match_labels=ml)
 
 
 def selector_from_node_selector_requirements(exprs) -> Selector:
@@ -122,14 +140,28 @@ def requirements_from_match_labels(match_labels: Mapping[str, str]) -> tuple[Req
     return tuple(Requirement(k, IN, (v,)) for k, v in sorted(match_labels.items()))
 
 
+def selector_from_match_labels(match_labels: Mapping[str, str]) -> Selector:
+    """Selector equivalent to a pure matchLabels LabelSelector (wire shape
+    preserved on serialization)."""
+    ml = tuple(sorted(match_labels.items()))
+    return Selector(requirements_from_match_labels(match_labels), match_labels=ml)
+
+
 def label_selector_to_dict(sel: Selector | None) -> dict | None:
     """Inverse of selector_from_label_selector, for wire round-trips."""
     if sel is None:
         return None
-    exprs = []
-    for r in sel.requirements:
-        exprs.append({"key": r.key, "operator": r.operator, "values": list(r.values)})
-    return {"matchExpressions": exprs} if exprs else {}
+    out: dict = {}
+    n_ml = len(sel.match_labels)
+    if n_ml:
+        out["matchLabels"] = dict(sel.match_labels)
+    exprs = [
+        {"key": r.key, "operator": r.operator, "values": list(r.values)}
+        for r in sel.requirements[n_ml:]
+    ]
+    if exprs:
+        out["matchExpressions"] = exprs
+    return out
 
 
 def matches_any(selectors: Iterable[Selector], labels: Mapping[str, str]) -> bool:

@@ -175,16 +175,18 @@ class Toleration:
     toleration_seconds: int | None = None
 
     def tolerates(self, taint: "Taint") -> bool:
+        # toleration.go#ToleratesTaint: empty effect matches all effects;
+        # empty key matches all keys (no restriction); then the operator
+        # decides — Equal/"" compares values, Exists always matches.
         if self.effect and self.effect != taint.effect:
             return False
-        if self.key == "":
-            # empty key with Exists tolerates everything
-            return self.operator == "Exists"
-        if self.key != taint.key:
+        if self.key and self.key != taint.key:
             return False
         if self.operator == "Exists":
             return True
-        return self.operator in ("Equal", "") and self.value == taint.value
+        if self.operator in ("Equal", ""):
+            return self.value == taint.value
+        return False
 
     @staticmethod
     def from_dict(d: Mapping) -> "Toleration":
@@ -487,6 +489,12 @@ class TopologySpreadConstraint:
 
 @dataclass
 class Pod:
+    """Treat as immutable once scheduling sees it: resource accessors memoize
+    (``_resource_request``/``_non_zero_request``), so mutating containers/
+    overhead afterwards would serve stale totals. The state layer replaces Pod
+    objects instead of mutating them (only queue/binding bookkeeping fields —
+    node_name, nominated_node_name, resource_version — are ever written)."""
+
     name: str = ""
     namespace: str = "default"
     uid: str = ""

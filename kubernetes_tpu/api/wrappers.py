@@ -14,7 +14,13 @@ from __future__ import annotations
 
 from typing import Mapping, Sequence
 
-from .labels import IN, NOT_IN, EXISTS
+from .labels import (
+    IN,
+    NOT_IN,
+    Requirement,
+    Selector,
+    selector_from_match_labels,
+)
 from .objects import (
     Affinity,
     Container,
@@ -155,7 +161,6 @@ class MakePod:
 
     def node_affinity_in(self, key: str, vals: Sequence[str]) -> "MakePod":
         """Required node affinity: key In vals (wrappers.go#NodeAffinityIn)."""
-        from .labels import Requirement, Selector
 
         na = self._node_affinity()
         term = NodeSelectorTerm(
@@ -168,7 +173,6 @@ class MakePod:
         return self
 
     def node_affinity_not_in(self, key: str, vals: Sequence[str]) -> "MakePod":
-        from .labels import Requirement, Selector
 
         na = self._node_affinity()
         term = NodeSelectorTerm(
@@ -181,7 +185,6 @@ class MakePod:
         return self
 
     def preferred_node_affinity(self, weight: int, key: str, vals: Sequence[str]) -> "MakePod":
-        from .labels import Requirement, Selector
 
         na = self._node_affinity()
         term = PreferredSchedulingTerm(
@@ -213,11 +216,9 @@ class MakePod:
     ) -> "MakePod":
         """Required pod (anti-)affinity with a matchLabels selector
         (wrappers.go#PodAffinityExists-style helpers)."""
-        from .labels import Selector
-        from .labels import requirements_from_match_labels
 
         term = PodAffinityTerm(
-            label_selector=Selector(requirements_from_match_labels(dict(match_labels))),
+            label_selector=selector_from_match_labels(dict(match_labels)),
             topology_key=topology_key,
         )
         pa, paa = self._pod_affinity_parts()
@@ -238,12 +239,11 @@ class MakePod:
         match_labels: Mapping[str, str],
         anti: bool = False,
     ) -> "MakePod":
-        from .labels import Selector, requirements_from_match_labels
 
         wterm = WeightedPodAffinityTerm(
             weight=weight,
             term=PodAffinityTerm(
-                label_selector=Selector(requirements_from_match_labels(dict(match_labels))),
+                label_selector=selector_from_match_labels(dict(match_labels)),
                 topology_key=topology_key,
             ),
         )
@@ -263,10 +263,9 @@ class MakePod:
         match_labels: Mapping[str, str] | None = None,
         min_domains: int | None = None,
     ) -> "MakePod":
-        from .labels import Selector, requirements_from_match_labels
 
         sel = (
-            Selector(requirements_from_match_labels(dict(match_labels)))
+            selector_from_match_labels(dict(match_labels))
             if match_labels is not None
             else None
         )

@@ -1,0 +1,138 @@
+"""JAX kernels for the noderesources plugins: Fit (filter) + scoring
+strategies (LeastAllocated / MostAllocated / RequestedToCapacityRatio) +
+BalancedAllocation.
+
+Reference semantics (kernels must agree with the oracle in
+ops/oracle/noderesources.py, which transcribes):
+- fit.go#fitsRequest            -> fit_mask
+- least_allocated.go            -> least_allocated_score
+- most_allocated.go             -> most_allocated_score
+- requested_to_capacity_ratio.go-> rtc_score
+- balanced_allocation.go        -> balanced_allocation_score
+
+Design notes (TPU-first):
+- Node axis is the trailing axis everywhere -> lanes. The per-pod kernels are
+  rank-polymorphic over a leading batch axis via vmap (single-shot mode).
+- Integer score arithmetic stays in int64/int32 exactly as the reference's
+  int64 math (truncating division on non-negative values == floor_divide).
+- BalancedAllocation follows the reference into float land; dtype is a knob
+  (float64 on CPU tests for bit-parity with the Go float64 oracle, float32
+  on TPU — divergence bounded by the final int truncation and covered by
+  tie-set parity tests).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100
+
+
+def fit_mask(
+    req: jax.Array,  # [K] int
+    req_mask: jax.Array,  # [K] bool — resources the pod requests (>0)
+    alloc: jax.Array,  # [K, N] int
+    used: jax.Array,  # [K, N] int
+    pod_count: jax.Array,  # [N] int32
+    max_pods: jax.Array,  # [N] int32
+) -> jax.Array:  # [N] bool
+    """NodeResourcesFit Filter: every requested resource fits, and the node
+    has a free pod slot."""
+    res_ok = (used + req[:, None] <= alloc) | (~req_mask[:, None])
+    count_ok = pod_count + 1 <= max_pods
+    return jnp.all(res_ok, axis=0) & count_ok
+
+
+def scoring_requested(
+    nonzero_req: jax.Array,  # [2] int — pod (cpu, mem) with non-zero defaults
+    nonzero_used: jax.Array,  # [2, N] int
+) -> jax.Array:  # [2, N] int
+    """calculateResourceAllocatableRequest for the default cpu/memory scoring
+    resources: scoring uses NonZeroRequested, not Requested."""
+    return nonzero_used + nonzero_req[:, None]
+
+
+def least_allocated_score(
+    requested: jax.Array,  # [R, N] int — per scoring resource
+    alloc: jax.Array,  # [R, N] int
+    weights: jax.Array,  # [R] int
+) -> jax.Array:  # [N] int — 0..100
+    """(alloc - requested) * 100 // alloc per resource, weighted int mean."""
+    ok = (alloc > 0) & (requested <= alloc)
+    per_res = jnp.where(
+        ok,
+        (alloc - requested) * MAX_NODE_SCORE // jnp.maximum(alloc, 1),
+        0,
+    )
+    wsum = jnp.sum(weights)
+    return jnp.sum(per_res * weights[:, None], axis=0) // jnp.maximum(wsum, 1)
+
+
+def most_allocated_score(
+    requested: jax.Array, alloc: jax.Array, weights: jax.Array
+) -> jax.Array:
+    ok = (alloc > 0) & (requested <= alloc)
+    per_res = jnp.where(
+        ok, requested * MAX_NODE_SCORE // jnp.maximum(alloc, 1), 0
+    )
+    wsum = jnp.sum(weights)
+    return jnp.sum(per_res * weights[:, None], axis=0) // jnp.maximum(wsum, 1)
+
+
+def rtc_score(
+    requested: jax.Array,  # [R, N] int
+    alloc: jax.Array,  # [R, N] int
+    weights: jax.Array,  # [R] int
+    shape_x: jax.Array,  # [S] int — utilization breakpoints, ascending 0..100
+    shape_y: jax.Array,  # [S] int — scores 0..10 at the breakpoints
+) -> jax.Array:
+    """RequestedToCapacityRatio: piecewise-linear over integer utilization,
+    scaled by MaxNodeScore/10 (shape scores are 0..10 like extender
+    priorities)."""
+    util = jnp.where(
+        alloc > 0,
+        jnp.minimum(requested * 100 // jnp.maximum(alloc, 1), 100),
+        0,
+    )  # [R, N]
+
+    def interp(u):  # u: [R, N] int
+        # piecewise integer interpolation identical to the oracle's _piecewise
+        y = jnp.full_like(u, shape_y[0])
+        for i in range(1, shape_x.shape[0]):
+            x0, y0, x1, y1 = shape_x[i - 1], shape_y[i - 1], shape_x[i], shape_y[i]
+            seg = y0 + (y1 - y0) * (u - x0) // jnp.maximum(x1 - x0, 1)
+            y = jnp.where((u >= x0) & (u < x1), seg, y)
+        y = jnp.where(u >= shape_x[-1], shape_y[-1], y)
+        return y
+
+    per_res = jnp.where(alloc > 0, interp(util) * (MAX_NODE_SCORE // 10), 0)
+    wsum = jnp.sum(weights)
+    return jnp.sum(per_res * weights[:, None], axis=0) // jnp.maximum(wsum, 1)
+
+
+def balanced_allocation_score(
+    requested: jax.Array,  # [R, N] int — scoring resources (default cpu, mem)
+    alloc: jax.Array,  # [R, N] int
+    fdtype=jnp.float32,
+) -> jax.Array:  # [N] int32 — 0..100
+    """(1 - std(fractions)) * 100, truncated to int.
+
+    Exactly-two-resources case uses |f0 - f1| / 2 (reference special case);
+    >2 uses population standard deviation.
+    """
+    f = jnp.where(
+        alloc > 0,
+        requested.astype(fdtype) / jnp.maximum(alloc, 1).astype(fdtype),
+        jnp.asarray(1.0, dtype=fdtype),
+    )
+    f = jnp.minimum(f, 1.0)
+    r = requested.shape[0]
+    if r == 2:
+        std = jnp.abs(f[0] - f[1]) / 2.0
+    elif r > 2:
+        mean = jnp.mean(f, axis=0)
+        std = jnp.sqrt(jnp.mean((f - mean) ** 2, axis=0))
+    else:
+        std = jnp.zeros(requested.shape[1], dtype=fdtype)
+    return ((1.0 - std) * MAX_NODE_SCORE).astype(jnp.int32)

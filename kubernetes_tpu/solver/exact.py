@@ -1,0 +1,157 @@
+"""Exact-parity solver: a lax.scan over pods in queue order (SURVEY.md §8.4
+mode 1).
+
+This replaces the reference's scheduleOne hot path
+(pkg/scheduler/schedule_one.go#schedulePod -> findNodesThatFitPod ->
+prioritizeNodes -> selectHost) with one compiled program: each scan step is a
+dense filter-mask + score over ALL nodes at once (the per-(pod,node) Go
+interface-call overhead becomes one fused XLA loop body), and the
+assume-pod state mutation (cache.AssumePod) becomes an in-carry scatter so
+the next step sees updated node state — preserving the reference's strict
+pod-by-pod sequential semantics, which is what "binding parity" means.
+
+selectHost tie-break: the reference reservoir-samples uniformly among
+max-score ties with an unseeded RNG (schedule_one.go#selectHost). Bit-parity
+is impossible; we offer:
+- "random": uniform among ties from a seeded PRNG key (documented divergence)
+- "first":  lowest node index among ties (deterministic, used by parity tests)
+Either way the pick is provably inside the reference's tie set, which is the
+parity definition from SURVEY.md §8.8.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import noderesources as nr
+from ..tensorize.schema import CPU_IDX, MEM_IDX, NodeBatch, PodBatch
+
+TIE_RANDOM = "random"
+TIE_FIRST = "first"
+
+
+@dataclass(frozen=True)
+class ExactSolverConfig:
+    tie_break: str = TIE_RANDOM
+    seed: int = 0
+    # plugin weights (framework runtime multiplies normalized scores by
+    # config weights; defaults are 1 for both of these plugins)
+    fit_weight: int = 1
+    balanced_weight: int = 1
+    balanced_fdtype: str = "float32"  # float64 for bit-parity on CPU tests
+
+
+def _solve_scan(
+    alloc,  # [K, N] int
+    max_pods,  # [N] int32
+    node_static_mask,  # [N] bool — valid & schedulable
+    used0,  # [K, N] int
+    nonzero_used0,  # [2, N] int
+    pod_count0,  # [N] int32
+    req,  # [P, K] int
+    req_mask,  # [P, K] bool
+    nonzero_req,  # [P, 2] int
+    pod_valid,  # [P] bool
+    key,  # PRNG key
+    *,
+    tie_break: str,
+    fit_weight: int,
+    balanced_weight: int,
+    fdtype,
+):
+    alloc2 = alloc[: MEM_IDX + 1]  # cpu, memory rows for scoring
+    weights2 = jnp.ones(2, dtype=alloc.dtype)
+
+    def step(carry, xs):
+        used, nonzero_used, pod_count, k = carry
+        r, rmask, nz, pvalid = xs
+
+        mask = (
+            nr.fit_mask(r, rmask, alloc, used, pod_count, max_pods)
+            & node_static_mask
+        )
+        requested = nr.scoring_requested(nz, nonzero_used)
+        score = fit_weight * nr.least_allocated_score(requested, alloc2, weights2)
+        score = score + balanced_weight * nr.balanced_allocation_score(
+            requested, alloc2, fdtype=fdtype
+        )
+        score = jnp.where(mask, score, -1)
+
+        best = jnp.max(score)
+        feasible = best >= 0
+        ties = (score == best) & mask
+        csum = jnp.cumsum(ties)
+        if tie_break == TIE_RANDOM:
+            k, sub = jax.random.split(k)
+            n_ties = csum[-1]
+            pick_rank = jax.random.randint(sub, (), 0, jnp.maximum(n_ties, 1))
+        else:
+            pick_rank = 0
+        pick = jnp.argmax(csum > pick_rank).astype(jnp.int32)
+
+        found = feasible & pvalid
+        d = found.astype(alloc.dtype)
+        used = used.at[:, pick].add(r * d)
+        nonzero_used = nonzero_used.at[:, pick].add(nz * d)
+        pod_count = pod_count.at[pick].add(found.astype(jnp.int32))
+
+        assignment = jnp.where(found, pick, -1).astype(jnp.int32)
+        return (used, nonzero_used, pod_count, k), assignment
+
+    (used, nonzero_used, pod_count, _), assignments = jax.lax.scan(
+        step,
+        (used0, nonzero_used0, pod_count0, key),
+        (req, req_mask, nonzero_req, pod_valid),
+    )
+    return assignments, used, nonzero_used, pod_count
+
+
+_solve_scan_jit = jax.jit(
+    _solve_scan,
+    static_argnames=("tie_break", "fit_weight", "balanced_weight", "fdtype"),
+    donate_argnums=(3, 4, 5),
+)
+
+
+class ExactSolver:
+    """Host-facing wrapper: NodeBatch/PodBatch in, assignments out, node
+    state written back (the device-side 'assume')."""
+
+    def __init__(self, config: ExactSolverConfig | None = None):
+        self.config = config or ExactSolverConfig()
+        self._step_count = 0
+
+    def solve(self, nodes: NodeBatch, pods: PodBatch) -> np.ndarray:
+        """Returns assignments [num_pods] of node indices (-1 = unschedulable)
+        and updates ``nodes``' used/nonzero_used/pod_count in place."""
+        cfg = self.config
+        fdtype = jnp.float64 if cfg.balanced_fdtype == "float64" else jnp.float32
+        key = jax.random.PRNGKey(cfg.seed + self._step_count)
+        self._step_count += 1
+        node_static_mask = nodes.valid & nodes.schedulable
+        assignments, used, nonzero_used, pod_count = _solve_scan_jit(
+            jnp.asarray(nodes.allocatable),
+            jnp.asarray(nodes.max_pods),
+            jnp.asarray(node_static_mask),
+            jnp.asarray(nodes.used),
+            jnp.asarray(nodes.nonzero_used),
+            jnp.asarray(nodes.pod_count),
+            jnp.asarray(pods.req),
+            jnp.asarray(pods.req_mask),
+            jnp.asarray(pods.nonzero_req),
+            jnp.asarray(pods.valid),
+            key,
+            tie_break=cfg.tie_break,
+            fit_weight=cfg.fit_weight,
+            balanced_weight=cfg.balanced_weight,
+            fdtype=fdtype,
+        )
+        nodes.used = np.asarray(used)
+        nodes.nonzero_used = np.asarray(nonzero_used)
+        nodes.pod_count = np.asarray(pod_count)
+        return np.asarray(assignments)[: pods.num_pods]

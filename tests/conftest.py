@@ -1,14 +1,22 @@
 """Test configuration: force JAX onto CPU with 8 virtual devices BEFORE any
-jax import, so sharding tests exercise a multi-chip mesh without TPU hardware
-(SURVEY.md §6.7 — single real chip; mesh logic validated on host devices)."""
+test imports jax, so sharding tests exercise a multi-chip mesh without TPU
+hardware (SURVEY.md §6.7) and resource arithmetic stays int64.
+
+NOTE: on this box (jax 0.9 + axon PJRT) the JAX_PLATFORMS / JAX_ENABLE_X64
+environment variables are NOT honored — only jax.config.update works, so we
+import jax here (conftest runs first) and set config explicitly.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Keep compile times predictable on the 1-vCPU host.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# int64 resource arithmetic (memory bytes overflow int32) — parity requires it
+jax.config.update("jax_enable_x64", True)

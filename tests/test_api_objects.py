@@ -277,3 +277,32 @@ class TestReviewRegressions:
         n = Node.from_dict({"metadata": {"name": "n", "resourceVersion": "7"}})
         assert n.resource_version == 7
         assert Node.from_dict(n.to_dict()).resource_version == 7
+
+
+class TestReviewRegressions2:
+    def test_empty_key_equal_toleration_matches_all_keys(self):
+        # toleration.go#ToleratesTaint: empty key does not restrict; Equal
+        # compares values
+        t = Toleration(key="", operator="Equal", value="v")
+        assert t.tolerates(Taint("anykey", "v", "NoSchedule"))
+        assert not t.tolerates(Taint("anykey", "w", "NoSchedule"))
+
+    def test_gt_rejects_python_int_leniency(self):
+        # Go strconv.ParseInt rejects underscores/unicode digits
+        assert not Requirement("k", "Gt", ("5",)).matches({"k": "1_0"})
+        assert not Requirement("k", "Gt", ("5",)).matches({"k": "１０"})
+        assert Requirement("k", "Gt", ("5",)).matches({"k": "+10"})
+
+    def test_match_labels_wire_shape_preserved(self):
+        from kubernetes_tpu.api.labels import label_selector_to_dict
+
+        sel = selector_from_label_selector(
+            {"matchLabels": {"app": "web"},
+             "matchExpressions": [{"key": "tier", "operator": "Exists"}]}
+        )
+        d = label_selector_to_dict(sel)
+        assert d["matchLabels"] == {"app": "web"}
+        assert d["matchExpressions"] == [{"key": "tier", "operator": "Exists", "values": []}]
+        # and evaluation still ANDs both parts
+        assert sel.matches({"app": "web", "tier": "x"})
+        assert not sel.matches({"app": "web"})

@@ -1,0 +1,208 @@
+"""Kernel ≡ oracle parity for NodeResourcesFit + BalancedAllocation, and
+solver ≡ sequential-oracle parity for the exact scan solver.
+
+This is the test strategy from SURVEY.md §8.6: the NumPy/scalar oracle is the
+transcription of the reference semantics; hypothesis drives random and
+adversarial pod/node populations through both implementations.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.ops import noderesources as nr
+from kubernetes_tpu.ops.oracle import noderesources as onr
+from kubernetes_tpu.ops.oracle import scheduler as osched
+from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+from kubernetes_tpu.tensorize.schema import build_node_batch, build_pod_batch
+
+
+def mk_nodes(specs):
+    """specs: list of (cpu_milli, mem_bytes, pods)"""
+    return [
+        MakeNode()
+        .name(f"node-{i}")
+        .capacity({"cpu": f"{c}m", "memory": str(m), "pods": str(p)})
+        .obj()
+        for i, (c, m, p) in enumerate(specs)
+    ]
+
+
+def mk_pod(i, cpu_milli, mem_bytes):
+    req = {}
+    if cpu_milli:
+        req["cpu"] = f"{cpu_milli}m"
+    if mem_bytes:
+        req["memory"] = str(mem_bytes)
+    mp = MakePod().name(f"pod-{i}")
+    if req:
+        mp = mp.req(req)
+    return mp.obj()
+
+
+node_spec = st.tuples(
+    st.integers(min_value=0, max_value=64_000),  # cpu milli
+    st.integers(min_value=0, max_value=256 * 1024**3),  # mem bytes
+    st.integers(min_value=0, max_value=16),  # pods
+)
+pod_spec = st.tuples(
+    st.integers(min_value=0, max_value=8_000),
+    st.integers(min_value=0, max_value=32 * 1024**3),
+)
+
+
+class TestKernelVsOracle:
+    @settings(max_examples=50, deadline=None)
+    @given(
+        nodes=st.lists(node_spec, min_size=1, max_size=8),
+        placed=st.lists(pod_spec, min_size=0, max_size=6),
+        pod=pod_spec,
+        data=st.data(),
+    )
+    def test_fit_and_scores_match(self, nodes, placed, pod, data):
+        node_objs = mk_nodes(nodes)
+        # scatter pre-placed pods onto random nodes
+        pods_by_node = {}
+        placed_objs = []
+        for j, (c, m) in enumerate(placed):
+            tgt = data.draw(st.integers(0, len(node_objs) - 1))
+            po = mk_pod(1000 + j, c, m)
+            pods_by_node.setdefault(node_objs[tgt].name, []).append(po)
+            placed_objs.append(po)
+
+        batch = build_node_batch(node_objs, pods_by_node)
+        states = osched.make_node_states(node_objs, pods_by_node)
+        p = mk_pod(0, *pod)
+
+        req = jnp.asarray(batch.vocab.vectorize(p.resource_request()))
+        rmask = req > 0
+        mask = np.asarray(
+            nr.fit_mask(
+                req,
+                rmask,
+                jnp.asarray(batch.allocatable),
+                jnp.asarray(batch.used),
+                jnp.asarray(batch.pod_count),
+                jnp.asarray(batch.max_pods),
+            )
+        )
+        nz = jnp.asarray(np.array(p.non_zero_request(), dtype=np.int64))
+        requested = nr.scoring_requested(nz, jnp.asarray(batch.nonzero_used))
+        alloc2 = jnp.asarray(batch.allocatable[:2])
+        w2 = jnp.ones(2, dtype=jnp.int64)
+        least = np.asarray(nr.least_allocated_score(requested, alloc2, w2))
+        most = np.asarray(nr.most_allocated_score(requested, alloc2, w2))
+        bal = np.asarray(
+            nr.balanced_allocation_score(requested, alloc2, fdtype=jnp.float64)
+        )
+
+        for i, stt in enumerate(states):
+            assert mask[i] == (not onr.fit_filter(p, stt)), f"fit node {i}"
+            assert least[i] == onr.least_allocated_score(p, stt), f"least node {i}"
+            assert most[i] == onr.most_allocated_score(p, stt), f"most node {i}"
+            assert bal[i] == onr.balanced_allocation_score(p, stt), f"balanced node {i}"
+
+    def test_padded_lanes_never_fit(self):
+        node_objs = mk_nodes([(4000, 8 * 1024**3, 10)])
+        batch = build_node_batch(node_objs)  # padded to 128
+        p = mk_pod(0, 100, 1024**2)
+        req = jnp.asarray(batch.vocab.vectorize(p.resource_request()))
+        mask = np.asarray(
+            nr.fit_mask(
+                req,
+                req > 0,
+                jnp.asarray(batch.allocatable),
+                jnp.asarray(batch.used),
+                jnp.asarray(batch.pod_count),
+                jnp.asarray(batch.max_pods),
+            )
+        ) & np.asarray(batch.valid)
+        assert mask[0]
+        assert not mask[1:].any()
+
+    def test_rtc_shape_matches_oracle(self):
+        # default shape: 0 util -> 10, 100 util -> 0 (least-allocated-like)
+        shape = [(0, 10), (100, 0)]
+        node_objs = mk_nodes([(4000, 8 * 1024**3, 10), (2000, 4 * 1024**3, 10)])
+        pods_by_node = {"node-0": [mk_pod(9, 1000, 1024**3)]}
+        batch = build_node_batch(node_objs, pods_by_node)
+        states = osched.make_node_states(node_objs, pods_by_node)
+        p = mk_pod(0, 500, 2 * 1024**3)
+        nz = jnp.asarray(np.array(p.non_zero_request(), dtype=np.int64))
+        requested = nr.scoring_requested(nz, jnp.asarray(batch.nonzero_used))
+        got = np.asarray(
+            nr.rtc_score(
+                requested,
+                jnp.asarray(batch.allocatable[:2]),
+                jnp.ones(2, dtype=jnp.int64),
+                jnp.asarray([0, 100]),
+                jnp.asarray([10, 0]),
+            )
+        )
+        for i, stt in enumerate(states):
+            assert got[i] == onr.requested_to_capacity_ratio_score(p, stt, shape)
+
+
+class TestSolverVsOracle:
+    def _run(self, node_specs, pod_specs, tie="first"):
+        node_objs = mk_nodes(node_specs)
+        pod_objs = [mk_pod(i, c, m) for i, (c, m) in enumerate(pod_specs)]
+        batch = build_node_batch(node_objs)
+        pbatch = build_pod_batch(pod_objs, batch.vocab)
+        solver = ExactSolver(
+            ExactSolverConfig(tie_break=tie, balanced_fdtype="float64")
+        )
+        got = solver.solve(batch, pbatch)
+        return node_objs, pod_objs, got
+
+    def test_matches_oracle_first_tiebreak(self):
+        node_specs = [(4000, 8 * 1024**3, 5), (8000, 16 * 1024**3, 5), (2000, 4 * 1024**3, 5)]
+        pod_specs = [(500, 1024**3), (1000, 2 * 1024**3), (0, 0), (4000, 1024**3), (500, 1024**3)]
+        node_objs, pod_objs, got = self._run(node_specs, pod_specs)
+        oracle = osched.schedule(pod_objs, osched.make_node_states(node_objs))
+        assert list(got) == oracle.assignments
+
+    def test_random_tiebreak_stays_in_tie_set(self):
+        node_specs = [(4000, 8 * 1024**3, 10)] * 6  # identical nodes => ties
+        pod_specs = [(500, 1024**3)] * 12
+        node_objs, pod_objs, got = self._run(node_specs, pod_specs, tie="random")
+        errors = osched.validate_assignments(
+            pod_objs, osched.make_node_states(node_objs), got
+        )
+        assert not errors, errors
+
+    def test_unschedulable_pods_marked(self):
+        node_specs = [(1000, 1024**3, 1)]
+        pod_specs = [(800, 0), (800, 0)]  # second won't fit cpu
+        _, _, got = self._run(node_specs, pod_specs)
+        assert got[0] == 0 and got[1] == -1
+
+    def test_pod_count_exhaustion(self):
+        node_specs = [(100_000, 1024**4, 2)]
+        pod_specs = [(10, 0)] * 3
+        _, _, got = self._run(node_specs, pod_specs)
+        assert list(got) == [0, 0, -1]
+
+    @settings(max_examples=20, deadline=None)
+    @given(
+        nodes=st.lists(node_spec, min_size=1, max_size=6),
+        pods=st.lists(pod_spec, min_size=1, max_size=12),
+    )
+    def test_property_random_populations(self, nodes, pods):
+        node_objs, pod_objs, got = self._run(nodes, pods)
+        oracle = osched.schedule(pod_objs, osched.make_node_states(node_objs))
+        assert list(got) == oracle.assignments
+
+    def test_sequential_state_dependency(self):
+        # first pod lands on the bigger node (least-allocated prefers it),
+        # which must make the second pod see UPDATED state
+        node_specs = [(2000, 4 * 1024**3, 10), (4000, 8 * 1024**3, 10)]
+        pod_specs = [(1900, 3 * 1024**3)] * 3
+        node_objs, pod_objs, got = self._run(node_specs, pod_specs)
+        oracle = osched.schedule(pod_objs, osched.make_node_states(node_objs))
+        assert list(got) == oracle.assignments
+        # all three pods fit somewhere only if state tracking works
+        assert (np.array(got) >= 0).sum() == 3
