@@ -1,0 +1,110 @@
+"""Benchmark entry point (driver-run on real TPU hardware).
+
+Runs BASELINE.json config #2 — 5k homogeneous pods onto 1k nodes through the
+full stack (state service -> queue -> snapshot -> exact TPU solve -> bind),
+the batched equivalent of scheduler_perf's SchedulingBasic-style throughput
+measurement (test/integration/scheduler_perf, SURVEY.md §4.5).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": pods/s, "unit": "pods/s", "vs_baseline": ...}
+
+vs_baseline compares against the reference default scheduler's ~300 pods/s
+sustained upper bound from BASELINE.md (API-bound 5k-node density tests).
+Steady-state throughput excludes the first batch (XLA compile); total wall
+including compile is reported alongside, as is pure device solve time
+(BASELINE.md measurement protocol: service time vs solve time separated).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+N_NODES = 1_000
+N_PODS = 5_000
+BATCH = 1_024
+BASELINE_PODS_PER_SEC = 300.0
+
+
+def main() -> None:
+    import jax
+
+    # jax 0.9 + axon ignores the JAX_ENABLE_X64 env var; resource arithmetic
+    # is int64 (memory bytes overflow int32), so set it via config.
+    jax.config.update("jax_enable_x64", True)
+
+    from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.solver.exact import ExactSolverConfig
+    from kubernetes_tpu.state.cluster import ClusterState
+
+    cs = ClusterState()
+    for i in range(N_NODES):
+        cs.create_node(
+            MakeNode()
+            .name(f"node-{i:05}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": "110"})
+            .obj()
+        )
+    sched = Scheduler(
+        cs,
+        SchedulerConfig(batch_size=BATCH, solver=ExactSolverConfig(tie_break="random")),
+    )
+
+    t_create0 = time.perf_counter()
+    for i in range(N_PODS):
+        cs.create_pod(
+            MakePod()
+            .name(f"pod-{i:05}")
+            .req({"cpu": "250m", "memory": "512Mi"})
+            .obj()
+        )
+    create_seconds = time.perf_counter() - t_create0
+
+    batch_times: list[float] = []
+    solve_times: list[float] = []
+    scheduled = 0
+    t0 = time.perf_counter()
+    while True:
+        tb = time.perf_counter()
+        r = sched.schedule_batch()
+        n = len(r.scheduled)
+        if n == 0 and not r.unschedulable and not r.bind_failures:
+            break
+        batch_times.append((time.perf_counter() - tb, n))
+        solve_times.append(r.solve_seconds)
+        scheduled += n
+    total = time.perf_counter() - t0
+
+    assert scheduled == N_PODS, f"only {scheduled}/{N_PODS} scheduled"
+
+    # steady state: drop the first batch (carries XLA compilation)
+    steady = batch_times[1:] if len(batch_times) > 1 else batch_times
+    steady_pods = sum(n for _, n in steady)
+    steady_secs = sum(t for t, _ in steady)
+    pods_per_sec = steady_pods / steady_secs if steady_secs else float("inf")
+    # per-pod p99 latency: pods in a batch all land when the batch commits
+    per_pod = sorted(t for t, n in batch_times for _ in range(n))
+    p99 = per_pod[int(0.99 * (len(per_pod) - 1))]
+
+    print(
+        json.dumps(
+            {
+                "metric": "pods scheduled/sec, 5k pods x 1k nodes, Fit+BalancedAllocation (steady-state)",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+                "total_wall_s": round(total, 3),
+                "first_batch_s": round(batch_times[0][0], 3) if batch_times else None,
+                "device_solve_s": round(sum(solve_times), 3),
+                "p99_batch_latency_s": round(p99, 4),
+                "pod_create_s": round(create_seconds, 3),
+                "pods": N_PODS,
+                "nodes": N_NODES,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,11 @@ _GO_INT_RE = re.compile(r"^[+-]?[0-9]+$")
 def _parse_go_int(s: str) -> int | None:
     if not _GO_INT_RE.match(s):
         return None
-    return int(s)
+    v = int(s)
+    # strconv.ParseInt(..., 10, 64) fails with ErrRange outside int64
+    if v > (1 << 63) - 1 or v < -(1 << 63):
+        return None
+    return v
 
 IN = "In"
 NOT_IN = "NotIn"

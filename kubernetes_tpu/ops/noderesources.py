@@ -96,12 +96,18 @@ def rtc_score(
         0,
     )  # [R, N]
 
+    def trunc_div(a, b):
+        # Go int64 division truncates toward zero; jnp // floors. Decreasing
+        # shape segments make the numerator negative, where they differ.
+        q = jnp.abs(a) // jnp.maximum(jnp.abs(b), 1)
+        return jnp.where((a >= 0) == (b >= 0), q, -q)
+
     def interp(u):  # u: [R, N] int
         # piecewise integer interpolation identical to the oracle's _piecewise
         y = jnp.full_like(u, shape_y[0])
         for i in range(1, shape_x.shape[0]):
             x0, y0, x1, y1 = shape_x[i - 1], shape_y[i - 1], shape_x[i], shape_y[i]
-            seg = y0 + (y1 - y0) * (u - x0) // jnp.maximum(x1 - x0, 1)
+            seg = y0 + trunc_div((y1 - y0) * (u - x0), x1 - x0)
             y = jnp.where((u >= x0) & (u < x1), seg, y)
         y = jnp.where(u >= shape_x[-1], shape_y[-1], y)
         return y

@@ -153,16 +153,24 @@ def requested_to_capacity_ratio_score(
     return node_score // weight_sum
 
 
+def _trunc_div(a: int, b: int) -> int:
+    """Go int64 division truncates toward zero; Python // floors. They differ
+    exactly when the quotient is negative and inexact."""
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
+
+
 def _piecewise(shape: Sequence[tuple[int, int]], x: int) -> int:
     """helper/shape_score.go#buildBrokerFunction: linear interpolation between
-    shape points, integer math."""
+    shape points, Go-truncating integer math (decreasing segments produce
+    negative numerators — floor division would score one point low)."""
     if x < shape[0][0]:
         return shape[0][1]
     for i in range(1, len(shape)):
         if x < shape[i][0]:
             x0, y0 = shape[i - 1]
             x1, y1 = shape[i]
-            return y0 + (y1 - y0) * (x - x0) // (x1 - x0)
+            return y0 + _trunc_div((y1 - y0) * (x - x0), x1 - x0)
     return shape[-1][1]
 
 

@@ -1,0 +1,175 @@
+"""The scheduler orchestrator: batch-pop pods, one device solve, bind.
+
+This is the TPU-shaped replacement of the reference's Scheduler object + run
+loop (pkg/scheduler/scheduler.go#Scheduler.Run +
+schedule_one.go#scheduleOne/#schedulingCycle/#bindingCycle):
+
+    watch events ──> cache / queue            (eventhandlers.go semantics)
+    pop_batch(K) ──> snapshot.update(cache)   (UpdateSnapshot, dirty columns)
+              └──> exact solver (lax.scan over the K pods, dense over nodes)
+    per assignment: assume -> bind -> finish_binding
+                    bind failure -> forget + requeue with backoff
+    infeasible    : AddUnschedulableIfNotPresent (+ nominated-node machinery
+                    once preemption lands)
+
+The assume/forget protocol and its crash-safety story carry over unchanged
+(SURVEY §6.3): the solver holds no durable state — cache + snapshot rebuild
+from the state service on restart.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from .api.objects import Pod
+from .solver.exact import ExactSolver, ExactSolverConfig
+from .state.cache import SchedulerCache
+from .state.cluster import ApiError, ClusterState, Event
+from .state.queue import PriorityQueue, QueuedPodInfo
+from .state.snapshot import Snapshot
+from .tensorize.schema import build_pod_batch
+from .utils.clock import Clock
+
+
+@dataclass
+class SchedulerConfig:
+    batch_size: int = 1024  # max pods per device solve
+    solver: ExactSolverConfig = field(default_factory=ExactSolverConfig)
+    assume_ttl: float = 30.0
+
+
+@dataclass
+class BatchResult:
+    scheduled: list[tuple[str, str]] = field(default_factory=list)  # (pod, node)
+    unschedulable: list[str] = field(default_factory=list)
+    bind_failures: list[tuple[str, str]] = field(default_factory=list)  # (pod, err)
+    solve_seconds: float = 0.0
+    host_seconds: float = 0.0
+    # per-pod schedule latency (pop -> bind committed), for the p99 metric
+    latencies: list[float] = field(default_factory=list)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        cluster: ClusterState,
+        config: SchedulerConfig | None = None,
+        clock: Clock | None = None,
+    ):
+        self.cluster = cluster
+        self.config = config or SchedulerConfig()
+        self.clock = clock or Clock()
+        self.cache = SchedulerCache(self.clock, assume_ttl=self.config.assume_ttl)
+        self.queue = PriorityQueue(self.clock)
+        self.snapshot = Snapshot()
+        self.solver = ExactSolver(self.config.solver)
+
+        # initial informer sync (WaitForCacheSync equivalent)
+        for node in cluster.list_nodes():
+            self.cache.add_node(node)
+        for pod in cluster.list_pods():
+            if pod.node_name:
+                self.cache.add_pod(pod)
+            else:
+                self.queue.add(pod)
+        cluster.subscribe(self._on_event)
+
+    # -- eventhandlers.go#addAllEventHandlers routing --
+
+    def _on_event(self, ev: Event) -> None:
+        if ev.kind == "Pod":
+            pod = ev.obj
+            if ev.type == "ADDED":
+                if pod.node_name:
+                    self.cache.add_pod(pod)
+                else:
+                    self.queue.add(pod)
+            elif ev.type == "MODIFIED":
+                if pod.node_name:
+                    # covers our own bind confirmations (assumed -> confirmed)
+                    self.cache.update_pod(pod) if not self.cache.is_assumed(
+                        pod.key
+                    ) else self.cache.add_pod(pod)
+                else:
+                    self.queue.update(pod)
+            else:  # DELETED
+                if pod.node_name:
+                    self.cache.remove_pod(pod.key)
+                    # AssignedPodDelete frees resources: wake parked pods
+                    self.queue.move_all_to_active_or_backoff("AssignedPodDelete")
+                else:
+                    self.queue.delete(pod.key)
+        else:  # Node
+            if ev.type == "ADDED":
+                self.cache.add_node(ev.obj)
+                self.queue.move_all_to_active_or_backoff("NodeAdd")
+            elif ev.type == "MODIFIED":
+                self.cache.update_node(ev.obj)
+                self.queue.move_all_to_active_or_backoff("NodeUpdate")
+            else:
+                self.cache.remove_node(ev.obj.name)
+
+    # -- the scheduling loop --
+
+    def schedule_batch(self) -> BatchResult:
+        """One batched scheduling cycle: K pops -> one solve -> K bindings."""
+        res = BatchResult()
+        t0 = time.perf_counter()
+        infos = self.queue.pop_batch(self.config.batch_size)
+        if not infos:
+            return res
+        base_cycle = self.queue.scheduling_cycle - len(infos)
+
+        batch = self.snapshot.update(self.cache)
+        pods = [i.pod for i in infos]
+        pbatch = build_pod_batch(pods, batch.vocab)
+
+        t1 = time.perf_counter()
+        assignments = self.solver.solve(batch, pbatch)
+        res.solve_seconds = time.perf_counter() - t1
+
+        for idx, (info, a) in enumerate(zip(infos, assignments)):
+            pod = info.pod
+            cycle = base_cycle + idx + 1
+            if a < 0:
+                res.unschedulable.append(pod.key)
+                self.queue.add_unschedulable(info, cycle)
+                continue
+            node_name = self.snapshot.name_of(int(a))
+            try:
+                self.cache.assume_pod(pod, node_name)
+            except Exception as e:  # cache inconsistency: requeue
+                res.bind_failures.append((pod.key, str(e)))
+                self.queue.add_unschedulable(info, cycle)
+                continue
+            try:
+                self.cluster.bind(pod.namespace, pod.name, node_name)
+                self.cache.finish_binding(pod.key)
+                res.scheduled.append((pod.key, node_name))
+                res.latencies.append(time.perf_counter() - t0)
+            except ApiError as e:
+                # bindingCycle failure path: Unreserve -> ForgetPod -> requeue
+                try:
+                    self.cache.forget_pod(pod.key)
+                except Exception:
+                    pass
+                res.bind_failures.append((pod.key, e.reason))
+                self.queue.add_unschedulable(info, cycle)
+
+        res.host_seconds = time.perf_counter() - t0 - res.solve_seconds
+        return res
+
+    def run_until_settled(self, max_batches: int = 10_000) -> list[BatchResult]:
+        """Drain the active queue (benchmark / test driver)."""
+        out = []
+        for _ in range(max_batches):
+            r = self.schedule_batch()
+            if not (r.scheduled or r.unschedulable or r.bind_failures):
+                break
+            out.append(r)
+        return out
+
+    @property
+    def pending(self) -> int:
+        return len(self.queue)

@@ -56,7 +56,7 @@ def _solve_scan(
     req,  # [P, K] int
     req_mask,  # [P, K] bool
     nonzero_req,  # [P, 2] int
-    pod_valid,  # [P] bool
+    pod_valid,  # [P] bool — valid & statically feasible
     key,  # PRNG key
     *,
     tie_break: str,
@@ -125,6 +125,11 @@ class ExactSolver:
     def __init__(self, config: ExactSolverConfig | None = None):
         self.config = config or ExactSolverConfig()
         self._step_count = 0
+        # int64 resource arithmetic is non-negotiable (memory bytes overflow
+        # int32); jax 0.9+axon ignores the JAX_ENABLE_X64 env var, so enable
+        # it here rather than trusting the embedding application.
+        if not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
 
     def solve(self, nodes: NodeBatch, pods: PodBatch) -> np.ndarray:
         """Returns assignments [num_pods] of node indices (-1 = unschedulable)
@@ -144,14 +149,16 @@ class ExactSolver:
             jnp.asarray(pods.req),
             jnp.asarray(pods.req_mask),
             jnp.asarray(pods.nonzero_req),
-            jnp.asarray(pods.valid),
+            jnp.asarray(pods.valid & pods.feasible_static),
             key,
             tie_break=cfg.tie_break,
             fit_weight=cfg.fit_weight,
             balanced_weight=cfg.balanced_weight,
             fdtype=fdtype,
         )
-        nodes.used = np.asarray(used)
-        nodes.nonzero_used = np.asarray(nonzero_used)
-        nodes.pod_count = np.asarray(pod_count)
+        # np.array(copy=True): np.asarray on a jax array yields a READ-ONLY
+        # view, which would freeze the snapshot's dirty-column writes
+        nodes.used = np.array(used)
+        nodes.nonzero_used = np.array(nonzero_used)
+        nodes.pod_count = np.array(pod_count)
         return np.asarray(assignments)[: pods.num_pods]

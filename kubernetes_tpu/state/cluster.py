@@ -1,0 +1,187 @@
+"""In-memory cluster-state service — the [BOUNDARY] stand-in for
+apiserver + etcd (SURVEY.md §8.3).
+
+What it emulates (and what the scheduler actually exercises of the real
+thing):
+- typed Pod/Node storage with a single monotonically-increasing
+  resourceVersion stream (etcd revision equivalent);
+- optimistic concurrency: updates carrying a stale resourceVersion are
+  rejected with Conflict, like apiserver's 409s;
+- watch streams: subscribers receive ADDED/MODIFIED/DELETED events in
+  commit order, like client-go Reflector/informers (delivery is synchronous
+  in-process — the informer layer of SURVEY §3.3 collapses to an event bus);
+- the **pods/{name}/binding subresource**
+  (pkg/registry/core/pod/storage/storage.go#BindingREST.Create): atomically
+  sets spec.nodeName on a still-unbound pod; rejects if the pod is gone,
+  already bound, or the target node doesn't exist — the reject paths the
+  scheduler's assume/forget protocol must survive;
+- fault injection hooks (bind_fault) so tests can simulate conflicts and
+  node disappearance mid-cycle (SURVEY §6.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Literal
+
+from ..api.objects import Node, Pod
+
+EventType = Literal["ADDED", "MODIFIED", "DELETED"]
+
+
+class ApiError(Exception):
+    def __init__(self, reason: str, message: str = ""):
+        self.reason = reason  # Conflict | NotFound | AlreadyExists | Invalid
+        super().__init__(f"{reason}: {message}")
+
+
+@dataclass
+class Event:
+    type: EventType
+    kind: str  # "Pod" | "Node"
+    obj: Pod | Node
+    resource_version: int
+
+
+Watcher = Callable[[Event], None]
+
+
+class ClusterState:
+    """Single-writer in-memory store. All methods are synchronous; the
+    process model is one Python thread (SURVEY §6.2 — the reference's
+    mutex-guarded cache maps to plain single-threaded code here)."""
+
+    def __init__(self) -> None:
+        self._rv = 0
+        self._pods: dict[str, Pod] = {}  # key = ns/name
+        self._nodes: dict[str, Node] = {}
+        self._watchers: list[Watcher] = []
+        # fault injection: called with (pod, node_name) before a bind commits;
+        # raise ApiError to simulate apiserver-side rejection
+        self.bind_fault: Callable[[Pod, str], None] | None = None
+
+    # -- watch plumbing --
+
+    def subscribe(self, w: Watcher) -> None:
+        self._watchers.append(w)
+
+    def _emit(self, etype: EventType, kind: str, obj: Pod | Node) -> None:
+        ev = Event(etype, kind, obj, self._rv)
+        for w in list(self._watchers):
+            w(ev)
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    @property
+    def resource_version(self) -> int:
+        return self._rv
+
+    # -- pods --
+
+    def create_pod(self, pod: Pod) -> Pod:
+        if pod.key in self._pods:
+            raise ApiError("AlreadyExists", pod.key)
+        pod.resource_version = self._next_rv()
+        self._pods[pod.key] = pod
+        self._emit("ADDED", "Pod", pod)
+        return pod
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        key = f"{namespace}/{name}"
+        try:
+            return self._pods[key]
+        except KeyError:
+            raise ApiError("NotFound", key) from None
+
+    def update_pod(self, pod: Pod, expect_rv: int | None = None) -> Pod:
+        cur = self.get_pod(pod.namespace, pod.name)
+        if expect_rv is not None and cur.resource_version != expect_rv:
+            raise ApiError("Conflict", f"{pod.key} rv {cur.resource_version} != {expect_rv}")
+        pod.resource_version = self._next_rv()
+        self._pods[pod.key] = pod
+        self._emit("MODIFIED", "Pod", pod)
+        return pod
+
+    def patch_pod_status(
+        self, namespace: str, name: str, *, nominated_node_name: str | None = None,
+        phase: str | None = None
+    ) -> Pod:
+        pod = self.get_pod(namespace, name)
+        if nominated_node_name is not None:
+            pod.nominated_node_name = nominated_node_name
+        if phase is not None:
+            pod.phase = phase
+        pod.resource_version = self._next_rv()
+        self._emit("MODIFIED", "Pod", pod)
+        return pod
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        pod = self._pods.pop(key, None)
+        if pod is None:
+            raise ApiError("NotFound", key)
+        self._next_rv()
+        self._emit("DELETED", "Pod", pod)
+
+    def list_pods(self) -> list[Pod]:
+        return list(self._pods.values())
+
+    def bind(self, namespace: str, name: str, node_name: str) -> None:
+        """POST pods/{name}/binding — the commit point of a scheduling cycle."""
+        pod = self.get_pod(namespace, name)
+        if pod.node_name:
+            raise ApiError("Conflict", f"{pod.key} already bound to {pod.node_name}")
+        if node_name not in self._nodes:
+            raise ApiError("NotFound", f"node {node_name}")
+        if self.bind_fault is not None:
+            self.bind_fault(pod, node_name)
+        pod.node_name = node_name
+        pod.resource_version = self._next_rv()
+        self._emit("MODIFIED", "Pod", pod)
+
+    # -- nodes --
+
+    def create_node(self, node: Node) -> Node:
+        if node.name in self._nodes:
+            raise ApiError("AlreadyExists", node.name)
+        node.resource_version = self._next_rv()
+        self._nodes[node.name] = node
+        self._emit("ADDED", "Node", node)
+        return node
+
+    def get_node(self, name: str) -> Node:
+        try:
+            return self._nodes[name]
+        except KeyError:
+            raise ApiError("NotFound", name) from None
+
+    def update_node(self, node: Node, expect_rv: int | None = None) -> Node:
+        cur = self.get_node(node.name)
+        if expect_rv is not None and cur.resource_version != expect_rv:
+            raise ApiError("Conflict", f"{node.name} rv {cur.resource_version} != {expect_rv}")
+        node.resource_version = self._next_rv()
+        self._nodes[node.name] = node
+        self._emit("MODIFIED", "Node", node)
+        return node
+
+    def delete_node(self, name: str) -> None:
+        node = self._nodes.pop(name, None)
+        if node is None:
+            raise ApiError("NotFound", name)
+        self._next_rv()
+        self._emit("DELETED", "Node", node)
+
+    def list_nodes(self) -> list[Node]:
+        return list(self._nodes.values())
+
+    # -- bulk helpers for benchmarks --
+
+    def create_nodes(self, nodes: Iterable[Node]) -> None:
+        for n in nodes:
+            self.create_node(n)
+
+    def create_pods(self, pods: Iterable[Pod]) -> None:
+        for p in pods:
+            self.create_pod(p)

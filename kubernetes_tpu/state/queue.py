@@ -1,0 +1,240 @@
+"""Scheduling queue: activeQ / podBackoffQ / unschedulablePods with the
+reference's ordering and retry semantics, plus batch-pop for the TPU solver.
+
+Reference: pkg/scheduler/backend/queue/scheduling_queue.go#PriorityQueue.
+- activeQ heap ordered by the queueSort plugin — PrioritySort.Less: higher
+  .spec.priority first, earlier queue timestamp within a priority
+  (plugins/queuesort/priority_sort.go);
+- podBackoffQ heap by backoff expiry; backoff = initial 1s doubling per
+  attempt, capped at 10s (#calculateBackoffDuration); flushed every 1s
+  (#flushBackoffQCompleted);
+- unschedulablePods map; pods parked there move back on cluster events
+  (#MoveAllToActiveOrBackoffQueue) or after the 5-minute forced flush
+  (#flushUnschedulablePodsLeftover);
+- schedulingCycle / moveRequestCycle bookkeeping closes the lost-wakeup race:
+  a pod rejected in cycle C goes straight to backoff/active (not the
+  unschedulable map) if a move request happened at cycle >= C, because the
+  event that would have woken it may have fired mid-cycle;
+- PreEnqueue gating (plugins/schedulinggates): pods with schedulingGates wait
+  in a gated map and enter the queue only when gates clear.
+
+Divergence from the reference, by design: Pop() becomes pop_batch(K) — the
+solver schedules K pods per device solve. Ordering inside the batch is
+exactly the heap order, and the exact solver preserves it (lax.scan in batch
+order), so batching is observationally equivalent to K sequential Pops.
+QueueingHintFn is simplified to "move everything" for now (hint functions
+land with the plugin kernels that register them).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+
+from ..api.objects import Pod
+from ..utils.clock import Clock
+
+DEFAULT_POD_INITIAL_BACKOFF = 1.0
+DEFAULT_POD_MAX_BACKOFF = 10.0
+UNSCHEDULABLE_FLUSH_INTERVAL = 30.0
+MAX_UNSCHEDULABLE_DURATION = 300.0  # 5 min forced re-activation
+
+
+@dataclass
+class QueuedPodInfo:
+    pod: Pod
+    timestamp: float  # time (re-)entered the queue — PrioritySort tiebreak
+    initial_attempt_timestamp: float
+    attempts: int = 0
+    unschedulable_since: float | None = None
+    gated: bool = False
+
+    @property
+    def key(self) -> str:
+        return self.pod.key
+
+
+class PriorityQueue:
+    def __init__(
+        self,
+        clock: Clock | None = None,
+        pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
+        pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+    ):
+        self._clock = clock or Clock()
+        self._initial_backoff = pod_initial_backoff
+        self._max_backoff = pod_max_backoff
+        self._seq = itertools.count()
+
+        self._active: list[tuple[int, float, int, str]] = []  # (-prio, ts, seq, key)
+        self._backoff: list[tuple[float, int, str]] = []  # (ready_at, seq, key)
+        self._unschedulable: dict[str, QueuedPodInfo] = {}
+        self._gated: dict[str, QueuedPodInfo] = {}
+        self._info: dict[str, QueuedPodInfo] = {}
+        # which structure a pod key lives in: active|backoff|unsched|gated
+        self._where: dict[str, str] = {}
+
+        self.scheduling_cycle = 0
+        self._move_request_cycle = -1
+
+    # -- helpers --
+
+    def __len__(self) -> int:
+        return len(self._info)
+
+    def pending_counts(self) -> dict[str, int]:
+        """pending_pods{queue=...} metric shape."""
+        out = {"active": 0, "backoff": 0, "unschedulable": 0, "gated": 0}
+        for w in self._where.values():
+            out[
+                {
+                    "active": "active",
+                    "backoff": "backoff",
+                    "unsched": "unschedulable",
+                    "gated": "gated",
+                }[w]
+            ] += 1
+        return out
+
+    def _push_active(self, info: QueuedPodInfo) -> None:
+        heapq.heappush(
+            self._active,
+            (-info.pod.effective_priority, info.timestamp, next(self._seq), info.key),
+        )
+        self._where[info.key] = "active"
+
+    def _backoff_duration(self, attempts: int) -> float:
+        """#calculateBackoffDuration: 1s doubling per prior attempt, capped."""
+        d = self._initial_backoff
+        for _ in range(attempts - 1):
+            d *= 2
+            if d >= self._max_backoff:
+                return self._max_backoff
+        return min(d, self._max_backoff)
+
+    def _backoff_ready_at(self, info: QueuedPodInfo) -> float:
+        return info.timestamp + self._backoff_duration(max(info.attempts, 1))
+
+    def _push_backoff(self, info: QueuedPodInfo) -> None:
+        heapq.heappush(
+            self._backoff, (self._backoff_ready_at(info), next(self._seq), info.key)
+        )
+        self._where[info.key] = "backoff"
+
+    # -- add / update / delete (informer handlers) --
+
+    def add(self, pod: Pod) -> None:
+        now = self._clock.now()
+        info = QueuedPodInfo(
+            pod=pod, timestamp=now, initial_attempt_timestamp=now
+        )
+        if pod.scheduling_gates:
+            # PreEnqueue rejection (schedulinggates plugin)
+            info.gated = True
+            self._gated[pod.key] = info
+            self._info[pod.key] = info
+            self._where[pod.key] = "gated"
+            return
+        self._info[pod.key] = info
+        self._push_active(info)
+
+    def update(self, pod: Pod) -> None:
+        info = self._info.get(pod.key)
+        if info is None:
+            self.add(pod)
+            return
+        info.pod = pod
+        where = self._where[pod.key]
+        if where == "gated" and not pod.scheduling_gates:
+            info.gated = False
+            del self._gated[pod.key]
+            info.timestamp = self._clock.now()
+            self._push_active(info)
+        elif where == "unsched":
+            # spec update may make it schedulable: move to active/backoff
+            # (reference: isPodUpdated => move)
+            self._move_one(info)
+
+    def delete(self, pod_key: str) -> None:
+        self._info.pop(pod_key, None)
+        self._gated.pop(pod_key, None)
+        self._unschedulable.pop(pod_key, None)
+        self._where.pop(pod_key, None)
+        # lazy deletion for heap entries: popping skips stale keys
+
+    # -- pop --
+
+    def pop_batch(self, max_pods: int) -> list[QueuedPodInfo]:
+        """K sequential Pops worth of pods, in exact heap order."""
+        self.flush_backoff_completed()
+        out: list[QueuedPodInfo] = []
+        while len(out) < max_pods and self._active:
+            _, _, _, key = heapq.heappop(self._active)
+            if self._where.get(key) != "active":
+                continue  # stale entry
+            info = self._info[key]
+            info.attempts += 1
+            self.scheduling_cycle += 1
+            del self._where[key]
+            del self._info[key]
+            out.append(info)
+        return out
+
+    # -- failure / retry paths --
+
+    def add_unschedulable(self, info: QueuedPodInfo, pod_scheduling_cycle: int) -> None:
+        """#AddUnschedulableIfNotPresent."""
+        now = self._clock.now()
+        info.timestamp = now
+        info.unschedulable_since = now
+        self._info[info.key] = info
+        if self._move_request_cycle >= pod_scheduling_cycle:
+            # an event fired while this pod was in flight: don't park it
+            self._push_backoff(info)
+        else:
+            self._unschedulable[info.key] = info
+            self._where[info.key] = "unsched"
+
+    def _move_one(self, info: QueuedPodInfo) -> None:
+        self._unschedulable.pop(info.key, None)
+        now = self._clock.now()
+        if self._backoff_ready_at(info) > now:
+            self._push_backoff(info)
+        else:
+            info.timestamp = now
+            self._push_active(info)
+
+    def move_all_to_active_or_backoff(self, event: str = "") -> None:
+        """#MoveAllToActiveOrBackoffQueue. QueueingHints reduce the moved set
+        per event type; until hint registration lands, every parked pod moves
+        (strictly more wakeups than the reference — safe, not lossy)."""
+        self._move_request_cycle = self.scheduling_cycle
+        for info in list(self._unschedulable.values()):
+            self._move_one(info)
+
+    def flush_backoff_completed(self) -> None:
+        """#flushBackoffQCompleted (reference runs this every 1s; we run it
+        on every pop_batch as well)."""
+        now = self._clock.now()
+        while self._backoff:
+            ready_at, _, key = self._backoff[0]
+            if self._where.get(key) != "backoff":
+                heapq.heappop(self._backoff)
+                continue
+            if ready_at > now:
+                break
+            heapq.heappop(self._backoff)
+            info = self._info[key]
+            info.timestamp = now
+            self._push_active(info)
+
+    def flush_unschedulable_leftover(self) -> None:
+        """#flushUnschedulablePodsLeftover: pods stuck > 5 min forced back."""
+        now = self._clock.now()
+        for info in list(self._unschedulable.values()):
+            if (
+                info.unschedulable_since is not None
+                and now - info.unschedulable_since > MAX_UNSCHEDULABLE_DURATION
+            ):
+                self._move_one(info)

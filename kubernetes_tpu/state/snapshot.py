@@ -1,0 +1,145 @@
+"""Device snapshot: the double-buffer between the scheduler cache and the
+solver's HBM tensors.
+
+Reference: pkg/scheduler/backend/cache/snapshot.go#Snapshot +
+cache.go#UpdateSnapshot — the incremental O(changed-nodes) contract. Here
+"copying a NodeInfo" becomes rewriting one column of the [K, N] arrays
+(a dirty-column scatter); node add/remove manages slots (removed nodes leave
+invalid slots that are reused) so node indices stay stable between updates —
+important because the solver returns node *indices* and compiled shapes only
+change when capacity grows (pow2 growth to bound XLA recompiles).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api.objects import RESOURCE_PODS, Node
+from ..tensorize.schema import LANE, NodeBatch, ResourceVocab, bucket_pow2
+from .cache import SchedulerCache
+
+
+class Snapshot:
+    def __init__(self) -> None:
+        self.batch: NodeBatch | None = None
+        self.names: list[str] = []  # slot -> node name ("" = free)
+        self._slot_of: dict[str, int] = {}
+        self._free: list[int] = []
+        self._last_generation = -1
+
+    def slot_of(self, name: str) -> int:
+        return self._slot_of[name]
+
+    def name_of(self, slot: int) -> str:
+        return self.names[slot]
+
+    # -- internals --
+
+    def _ensure_capacity(self, n: int, vocab: ResourceVocab) -> None:
+        cap = 0 if self.batch is None else self.batch.padded
+        if n <= cap and self.batch is not None and tuple(vocab.names) == tuple(
+            self.batch.vocab.names
+        ):
+            return
+        # never shrink: existing slot indices must remain valid
+        new_cap = bucket_pow2(max(n, cap, LANE))
+        k = len(vocab)
+        old = self.batch
+        b = NodeBatch(
+            vocab=vocab,
+            names=[],
+            num_nodes=0,
+            padded=new_cap,
+            allocatable=np.zeros((k, new_cap), dtype=np.int64),
+            used=np.zeros((k, new_cap), dtype=np.int64),
+            nonzero_used=np.zeros((2, new_cap), dtype=np.int64),
+            pod_count=np.zeros(new_cap, dtype=np.int32),
+            max_pods=np.zeros(new_cap, dtype=np.int32),
+            valid=np.zeros(new_cap, dtype=bool),
+            schedulable=np.zeros(new_cap, dtype=bool),
+        )
+        if old is not None and tuple(vocab.names) == tuple(old.vocab.names):
+            c = old.padded
+            b.allocatable[:, :c] = old.allocatable
+            b.used[:, :c] = old.used
+            b.nonzero_used[:, :c] = old.nonzero_used
+            b.pod_count[:c] = old.pod_count
+            b.max_pods[:c] = old.max_pods
+            b.valid[:c] = old.valid
+            b.schedulable[:c] = old.schedulable
+            self.batch = b
+        else:
+            self.batch = b
+            if old is not None:
+                # vocab changed: every occupied column must be rewritten
+                self._last_generation = -1
+        self.names.extend([""] * (new_cap - len(self.names)))
+
+    def _required_vocab(self, cache: SchedulerCache) -> ResourceVocab:
+        cur = self.batch.vocab if self.batch is not None else None
+        needed: set[str] = set()
+        for info in cache.nodes.values():
+            if info.node is not None:
+                needed.update(info.node.allocatable.keys())
+            needed.update(k for k, v in info.used.items() if v)
+        needed.discard(RESOURCE_PODS)
+        if cur is not None and needed.issubset(cur.names):
+            return cur
+        from ..tensorize.schema import BASE_RESOURCES
+
+        extended = sorted(needed - set(BASE_RESOURCES))
+        return ResourceVocab(BASE_RESOURCES + tuple(extended))
+
+    def _write_column(self, i: int, info, vocab: ResourceVocab) -> None:
+        b = self.batch
+        node = info.node
+        b.allocatable[:, i] = vocab.vectorize(node.allocatable)
+        b.used[:, i] = vocab.vectorize(info.used)
+        b.nonzero_used[0, i] = info.nonzero_cpu
+        b.nonzero_used[1, i] = info.nonzero_mem
+        b.pod_count[i] = len(info.pods)
+        b.max_pods[i] = node.allocatable.get(RESOURCE_PODS, 0)
+        b.valid[i] = True
+        b.schedulable[i] = not node.unschedulable
+
+    # -- the public incremental update --
+
+    def update(self, cache: SchedulerCache) -> NodeBatch:
+        """cache.go#UpdateSnapshot: refresh only what changed."""
+        vocab = self._required_vocab(cache)
+        live = {
+            name: info
+            for name, info in cache.nodes.items()
+            if info.node is not None
+        }
+        new_count = sum(1 for name in live if name not in self._slot_of)
+        self._ensure_capacity(len(self._slot_of) + new_count, vocab)
+        b = self.batch
+
+        # removals: slots whose node vanished (or became pod-only ghost)
+        for name in list(self._slot_of):
+            if name not in live:
+                i = self._slot_of.pop(name)
+                self.names[i] = ""
+                b.valid[i] = False
+                b.schedulable[i] = False
+                self._free.append(i)
+
+        # additions + dirty rewrites
+        next_slot = max(self._slot_of.values(), default=-1) + 1
+        for name, info in live.items():
+            i = self._slot_of.get(name)
+            if i is None:
+                i = self._free.pop() if self._free else next_slot
+                if i == next_slot:
+                    next_slot += 1
+                self._slot_of[name] = i
+                self.names[i] = name
+                self._write_column(i, info, vocab)
+            elif info.generation > self._last_generation:
+                self._write_column(i, info, vocab)
+
+        self._last_generation = cache.generation
+        b.num_nodes = len(self._slot_of)
+        b.names = [n for n in self.names if n]
+        return b

@@ -29,6 +29,7 @@ an explicit validity mask.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Iterable, Mapping, Sequence
 
@@ -74,8 +75,9 @@ class ResourceVocab:
 
     names: tuple[str, ...]
 
-    @property
+    @functools.cached_property
     def index(self) -> dict[str, int]:
+        # cached: vectorize runs once per node/pod on the host hot path
         return {n: i for i, n in enumerate(self.names)}
 
     def __len__(self) -> int:
@@ -101,6 +103,15 @@ class ResourceVocab:
             if k in idx:
                 out[idx[k]] = v
         return out
+
+    def has_unknown(self, res: Mapping[str, int]) -> bool:
+        """True if ``res`` names a resource outside the vocabulary with a
+        non-zero value. The vocab covers everything any node advertises, so
+        an unknown requested resource can never be satisfied — the pod must
+        be statically infeasible (the reference's Fit filter fails it on
+        every node), NOT silently dropped."""
+        idx = self.index
+        return any(v > 0 and k not in idx and k != RESOURCE_PODS for k, v in res.items())
 
 
 @dataclass
@@ -150,6 +161,7 @@ class PodBatch:
 
     req: np.ndarray  # [Pp, K] int64 — computePodResourceRequest
     req_mask: np.ndarray  # [Pp, K] bool — which resources the pod requests >0
+    feasible_static: np.ndarray  # [Pp] bool — False: requests a resource no node advertises
     nonzero_req: np.ndarray  # [Pp, 2] int64 — scoring requests w/ defaults
     priority: np.ndarray  # [Pp] int32
     valid: np.ndarray  # [Pp] bool
@@ -158,6 +170,7 @@ class PodBatch:
         return {
             "req": self.req,
             "req_mask": self.req_mask,
+            "feasible_static": self.feasible_static,
             "nonzero_req": self.nonzero_req,
             "priority": self.priority,
             "valid": self.valid,
@@ -231,14 +244,18 @@ def build_pod_batch(
 
     req = np.zeros((pp, k), dtype=np.int64)
     req_mask = np.zeros((pp, k), dtype=bool)
+    feasible_static = np.ones(pp, dtype=bool)
     nonzero_req = np.zeros((pp, 2), dtype=np.int64)
     priority = np.zeros(pp, dtype=np.int32)
     valid = np.zeros(pp, dtype=bool)
 
     for i, pod in enumerate(pods):
-        r = vocab.vectorize(pod.resource_request())
+        rr = pod.resource_request()
+        r = vocab.vectorize(rr)
         req[i] = r
         req_mask[i] = r > 0
+        if vocab.has_unknown(rr):
+            feasible_static[i] = False
         nz = pod.non_zero_request()
         nonzero_req[i, 0] = nz[0]
         nonzero_req[i, 1] = nz[1]
@@ -252,6 +269,7 @@ def build_pod_batch(
         padded=pp,
         req=req,
         req_mask=req_mask,
+        feasible_static=feasible_static,
         nonzero_req=nonzero_req,
         priority=priority,
         valid=valid,

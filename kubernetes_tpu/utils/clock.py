@@ -1,0 +1,26 @@
+"""Injectable clock, mirroring k8s.io/utils/clock — the queue/cache tests
+need deterministic time (reference queue tests inject
+k8s.io/utils/clock/testing#FakeClock)."""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    def now(self) -> float:
+        return time.monotonic()
+
+
+class FakeClock(Clock):
+    def __init__(self, start: float = 0.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds
+
+    def set(self, t: float) -> None:
+        self._now = t

@@ -206,3 +206,72 @@ class TestSolverVsOracle:
         assert list(got) == oracle.assignments
         # all three pods fit somewhere only if state tracking works
         assert (np.array(got) >= 0).sum() == 3
+
+
+class TestReviewRegressions:
+    def test_unknown_extended_resource_is_unschedulable(self):
+        # pod requests a resource no node advertises: reference Fit fails it
+        # everywhere; the vocab must not silently drop it
+        node_objs = mk_nodes([(4000, 8 * 1024**3, 10)])
+        batch = build_node_batch(node_objs)
+        gpu_pod = (
+            MakePod().name("gpu").req({"cpu": "100m", "example.com/gpu": "1"}).obj()
+        )
+        pbatch = build_pod_batch([gpu_pod], batch.vocab)
+        assert not pbatch.feasible_static[0]
+        solver = ExactSolver(ExactSolverConfig(tie_break="first"))
+        got = solver.solve(batch, pbatch)
+        assert got[0] == -1
+        oracle = osched.schedule([gpu_pod], osched.make_node_states(node_objs))
+        assert oracle.assignments == [-1]
+
+    def test_known_extended_resource_still_works(self):
+        n = (
+            MakeNode()
+            .name("gpu-node")
+            .capacity({"cpu": "4", "memory": "8Gi", "pods": "10", "example.com/gpu": "2"})
+            .obj()
+        )
+        batch = build_node_batch([n])
+        p1 = MakePod().name("g1").req({"example.com/gpu": "2"}).obj()
+        p2 = MakePod().name("g2").req({"example.com/gpu": "1"}).obj()
+        pbatch = build_pod_batch([p1, p2], batch.vocab)
+        solver = ExactSolver(ExactSolverConfig(tie_break="first"))
+        got = solver.solve(batch, pbatch)
+        assert list(got) == [0, -1]  # second pod: gpus exhausted
+
+    def test_rtc_truncates_toward_zero_like_go(self):
+        from kubernetes_tpu.ops.oracle.noderesources import _piecewise
+
+        shape = [(0, 10), (100, 0)]
+        # utilization 5: Go: 10 + trunc(-50/100) = 10; floor would give 9
+        assert _piecewise(shape, 5) == 10
+        assert _piecewise(shape, 95) == 1  # 10 + trunc(-950/100) = 10-9
+        assert _piecewise(shape, 100) == 0
+
+    def test_rtc_kernel_matches_trunc_semantics(self):
+        node_objs = mk_nodes([(10_000, 10 * 1024**3, 10)])
+        pods_by_node = {"node-0": [mk_pod(1, 500, 512 * 1024**2)]}
+        batch = build_node_batch(node_objs, pods_by_node)
+        states = osched.make_node_states(node_objs, pods_by_node)
+        p = mk_pod(0, 1, 1)  # tiny -> low utilization -> negative-slope interp
+        nz = jnp.asarray(np.array(p.non_zero_request(), dtype=np.int64))
+        requested = nr.scoring_requested(nz, jnp.asarray(batch.nonzero_used))
+        got = np.asarray(
+            nr.rtc_score(
+                requested,
+                jnp.asarray(batch.allocatable[:2]),
+                jnp.ones(2, dtype=jnp.int64),
+                jnp.asarray([0, 100]),
+                jnp.asarray([10, 0]),
+            )
+        )
+        assert got[0] == onr.requested_to_capacity_ratio_score(p, states[0], [(0, 10), (100, 0)])
+
+    def test_gt_int64_range_rejected(self):
+        from kubernetes_tpu.api.labels import Requirement
+
+        big = str(2**63)  # out of int64: Go ParseInt -> ErrRange -> no match
+        assert not Requirement("k", "Gt", ("5",)).matches({"k": big})
+        ok = str(2**63 - 1)
+        assert Requirement("k", "Gt", ("5",)).matches({"k": ok})

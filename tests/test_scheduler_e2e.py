@@ -1,0 +1,144 @@
+"""End-to-end slice: create nodes + pods in the cluster-state service, run the
+batched TPU scheduler, verify all bindings land and match the sequential
+oracle — the integration-test tier of SURVEY.md §5 (real scheduler + in-proc
+'apiserver', bare Node objects, no kubelets)."""
+
+import numpy as np
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.ops.oracle import scheduler as osched
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ApiError, ClusterState
+
+
+def mk_cluster(n_nodes, cpu="4", mem="8Gi", pods="110"):
+    cs = ClusterState()
+    for i in range(n_nodes):
+        cs.create_node(
+            MakeNode().name(f"node-{i:04}").capacity({"cpu": cpu, "memory": mem, "pods": pods}).obj()
+        )
+    return cs
+
+
+def first_tiebreak_config(batch=1024):
+    return SchedulerConfig(
+        batch_size=batch,
+        solver=ExactSolverConfig(tie_break="first", balanced_fdtype="float64"),
+    )
+
+
+class TestEndToEnd:
+    def test_all_pods_bound(self):
+        cs = mk_cluster(8)
+        sched = Scheduler(cs, first_tiebreak_config())
+        for i in range(40):
+            cs.create_pod(MakePod().name(f"p{i:03}").req({"cpu": "200m", "memory": "256Mi"}).obj())
+        results = sched.run_until_settled()
+        scheduled = [x for r in results for x in r.scheduled]
+        assert len(scheduled) == 40
+        assert all(p.node_name for p in cs.list_pods())
+        assert sched.pending == 0
+
+    def test_bindings_match_sequential_oracle(self):
+        cs = mk_cluster(5)
+        node_objs = cs.list_nodes()
+        pods = [
+            MakePod().name(f"p{i:03}").req({"cpu": f"{100 + 70 * (i % 7)}m", "memory": f"{256 + 128 * (i % 3)}Mi"}).obj()
+            for i in range(30)
+        ]
+        sched = Scheduler(cs, first_tiebreak_config())
+        for p in pods:
+            cs.create_pod(p)
+        sched.run_until_settled()
+        # oracle replay in creation order (same as queue order: equal
+        # priority, FIFO timestamps)
+        oracle = osched.schedule(pods, osched.make_node_states(node_objs))
+        name_by_idx = [n.name for n in node_objs]
+        want = {
+            p.key: (name_by_idx[a] if a >= 0 else None)
+            for p, a in zip(pods, oracle.assignments)
+        }
+        got = {p.key: (p.node_name or None) for p in cs.list_pods()}
+        assert got == want
+
+    def test_infeasible_pods_parked_then_rescued_by_node_add(self):
+        cs = mk_cluster(1, cpu="1")
+        sched = Scheduler(cs, first_tiebreak_config())
+        cs.create_pod(MakePod().name("big").req({"cpu": "3"}).obj())
+        results = sched.run_until_settled()
+        assert results[0].unschedulable == ["default/big"]
+        assert cs.get_pod("default", "big").node_name == ""
+        # a big node appears -> queue moves the pod back (after backoff)
+        cs.create_node(MakeNode().name("big-node").capacity({"cpu": "8", "memory": "8Gi", "pods": "10"}).obj())
+        import time as _t
+
+        deadline = _t.monotonic() + 5
+        bound = False
+        while _t.monotonic() < deadline:
+            sched.queue.flush_backoff_completed()
+            rs = sched.run_until_settled()
+            if any(r.scheduled for r in rs):
+                bound = True
+                break
+            _t.sleep(0.2)
+        assert bound
+        assert cs.get_pod("default", "big").node_name == "big-node"
+
+    def test_bind_conflict_forgets_and_requeues(self):
+        cs = mk_cluster(2)
+        sched = Scheduler(cs, first_tiebreak_config())
+        fail_once = {"n": 1}
+
+        def fault(pod, node_name):
+            if fail_once["n"]:
+                fail_once["n"] -= 1
+                raise ApiError("Conflict", "injected bind conflict")
+
+        cs.bind_fault = fault
+        cs.create_pod(MakePod().name("p").req({"cpu": "100m"}).obj())
+        r1 = sched.run_until_settled()
+        assert any(bf for r in r1 for bf in r.bind_failures)
+        # cache must hold no leaked assumption
+        assert sched.cache.nodes["node-0000"].used.get("cpu", 0) == 0
+        assert sched.cache.nodes["node-0001"].used.get("cpu", 0) == 0
+        # retry succeeds after backoff
+        sched.queue.move_all_to_active_or_backoff("test")
+        import time as _t
+
+        _t.sleep(1.1)
+        sched.queue.flush_backoff_completed()
+        r2 = sched.run_until_settled()
+        assert any(r.scheduled for r in r2)
+        assert cs.get_pod("default", "p").node_name != ""
+
+    def test_priority_order_across_batches(self):
+        # higher-priority pods must be placed first even when created later
+        cs = mk_cluster(1, cpu="1", pods="2")
+        sched = Scheduler(cs, first_tiebreak_config(batch=16))
+        cs.create_pod(MakePod().name("low-a").priority(1).req({"cpu": "400m"}).obj())
+        cs.create_pod(MakePod().name("low-b").priority(1).req({"cpu": "400m"}).obj())
+        cs.create_pod(MakePod().name("high").priority(100).req({"cpu": "800m"}).obj())
+        sched.run_until_settled()
+        assert cs.get_pod("default", "high").node_name != ""
+        bound_lows = [
+            n for n in ("low-a", "low-b") if cs.get_pod("default", n).node_name
+        ]
+        assert len(bound_lows) == 0  # 800m + 400m > 1 cpu; pods cap=2 anyway
+
+    def test_two_deployment_waves(self):
+        cs = mk_cluster(4)
+        sched = Scheduler(cs, first_tiebreak_config())
+        for i in range(10):
+            cs.create_pod(MakePod().name(f"a{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        for i in range(10):
+            cs.create_pod(MakePod().name(f"b{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        assert sum(1 for p in cs.list_pods() if p.node_name) == 20
+        # cache bookkeeping matches cluster truth
+        per_node = {}
+        for p in cs.list_pods():
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        for name, info in sched.cache.nodes.items():
+            assert len(info.pods) == per_node.get(name, 0)

@@ -1,0 +1,284 @@
+"""State plane: cluster service (rv/conflicts/watch/binding), scheduler cache
+(assume/forget/expire/generations), snapshot incrementality, queue ordering
+and backoff — semantics from cache.go / scheduling_queue.go, with fake
+clocks as in the reference's queue tests."""
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.state.cache import CacheError, SchedulerCache
+from kubernetes_tpu.state.cluster import ApiError, ClusterState
+from kubernetes_tpu.state.queue import PriorityQueue
+from kubernetes_tpu.state.snapshot import Snapshot
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def node(name, cpu="4", mem="8Gi", pods="10"):
+    return MakeNode().name(name).capacity({"cpu": cpu, "memory": mem, "pods": pods}).obj()
+
+
+def pod(name, cpu="100m", prio=None, ns="default"):
+    mp = MakePod().name(name).namespace(ns).req({"cpu": cpu})
+    if prio is not None:
+        mp = mp.priority(prio)
+    return mp.obj()
+
+
+class TestClusterState:
+    def test_crud_and_rv_monotonic(self):
+        cs = ClusterState()
+        cs.create_node(node("n1"))
+        p = cs.create_pod(pod("p1"))
+        rv1 = p.resource_version
+        cs.bind("default", "p1", "n1")
+        assert cs.get_pod("default", "p1").node_name == "n1"
+        assert cs.get_pod("default", "p1").resource_version > rv1
+
+    def test_bind_rejects_double_and_missing_node(self):
+        cs = ClusterState()
+        cs.create_node(node("n1"))
+        cs.create_pod(pod("p1"))
+        cs.bind("default", "p1", "n1")
+        with pytest.raises(ApiError) as e:
+            cs.bind("default", "p1", "n1")
+        assert e.value.reason == "Conflict"
+        cs.create_pod(pod("p2"))
+        with pytest.raises(ApiError) as e:
+            cs.bind("default", "p2", "ghost")
+        assert e.value.reason == "NotFound"
+
+    def test_optimistic_concurrency(self):
+        cs = ClusterState()
+        n = cs.create_node(node("n1"))
+        stale = n.resource_version
+        cs.update_node(n)  # bumps rv
+        with pytest.raises(ApiError) as e:
+            cs.update_node(n, expect_rv=stale)
+        assert e.value.reason == "Conflict"
+
+    def test_watch_order(self):
+        cs = ClusterState()
+        seen = []
+        cs.subscribe(lambda ev: seen.append((ev.type, ev.kind)))
+        cs.create_node(node("n1"))
+        cs.create_pod(pod("p1"))
+        cs.bind("default", "p1", "n1")
+        cs.delete_pod("default", "p1")
+        assert seen == [
+            ("ADDED", "Node"),
+            ("ADDED", "Pod"),
+            ("MODIFIED", "Pod"),
+            ("DELETED", "Pod"),
+        ]
+
+    def test_bind_fault_injection(self):
+        cs = ClusterState()
+        cs.create_node(node("n1"))
+        cs.create_pod(pod("p1"))
+
+        def boom(pod_, node_name):
+            raise ApiError("Conflict", "injected")
+
+        cs.bind_fault = boom
+        with pytest.raises(ApiError):
+            cs.bind("default", "p1", "n1")
+        assert cs.get_pod("default", "p1").node_name == ""
+
+
+class TestSchedulerCache:
+    def test_assume_confirm_flow(self):
+        clock = FakeClock()
+        c = SchedulerCache(clock)
+        c.add_node(node("n1"))
+        p = pod("p1")
+        c.assume_pod(p, "n1")
+        assert c.is_assumed("default/p1")
+        assert c.nodes["n1"].used["cpu"] == 100
+        c.finish_binding("default/p1")
+        bound = pod("p1")
+        bound.node_name = "n1"
+        c.add_pod(bound)  # watch confirmation
+        assert not c.is_assumed("default/p1")
+        assert c.nodes["n1"].used["cpu"] == 100  # not double-counted
+
+    def test_forget_releases(self):
+        c = SchedulerCache(FakeClock())
+        c.add_node(node("n1"))
+        c.assume_pod(pod("p1"), "n1")
+        c.forget_pod("default/p1")
+        assert c.nodes["n1"].used.get("cpu", 0) == 0
+        assert c.nodes["n1"].pod_count if hasattr(c.nodes["n1"], "pod_count") else True
+
+    def test_assume_expiry(self):
+        clock = FakeClock()
+        c = SchedulerCache(clock, assume_ttl=30)
+        c.add_node(node("n1"))
+        c.assume_pod(pod("p1"), "n1")
+        c.finish_binding("default/p1")
+        clock.advance(31)
+        expired = c.cleanup_expired()
+        assert expired == ["default/p1"]
+        assert c.nodes["n1"].used.get("cpu", 0) == 0
+
+    def test_no_expiry_before_finish_binding(self):
+        clock = FakeClock()
+        c = SchedulerCache(clock, assume_ttl=30)
+        c.add_node(node("n1"))
+        c.assume_pod(pod("p1"), "n1")
+        clock.advance(300)
+        assert c.cleanup_expired() == []  # binding still in flight
+
+    def test_double_assume_rejected(self):
+        c = SchedulerCache(FakeClock())
+        c.add_node(node("n1"))
+        c.assume_pod(pod("p1"), "n1")
+        with pytest.raises(CacheError):
+            c.assume_pod(pod("p1"), "n1")
+
+    def test_node_removed_with_pods_keeps_ghost(self):
+        c = SchedulerCache(FakeClock())
+        c.add_node(node("n1"))
+        bound = pod("p1")
+        bound.node_name = "n1"
+        c.add_pod(bound)
+        c.remove_node("n1")
+        assert c.nodes["n1"].node is None  # ghost holding the pod
+        c.remove_pod("default/p1")
+        assert "n1" not in c.nodes
+
+
+class TestSnapshot:
+    def test_incremental_update(self):
+        c = SchedulerCache(FakeClock())
+        for i in range(3):
+            c.add_node(node(f"n{i}"))
+        snap = Snapshot()
+        b = snap.update(c)
+        assert b.num_nodes == 3
+        assert b.valid.sum() == 3
+        # place a pod; only that column should change
+        bound = pod("p1", cpu="500m")
+        bound.node_name = "n1"
+        c.add_pod(bound)
+        i1 = snap.slot_of("n1")
+        before = b.used.copy()
+        b2 = snap.update(c)
+        assert b2.used[0, i1] == 500
+        unchanged = [snap.slot_of("n0"), snap.slot_of("n2")]
+        for j in unchanged:
+            assert (b2.used[:, j] == before[:, j]).all()
+
+    def test_node_remove_and_slot_reuse(self):
+        c = SchedulerCache(FakeClock())
+        for i in range(3):
+            c.add_node(node(f"n{i}"))
+        snap = Snapshot()
+        snap.update(c)
+        slot = snap.slot_of("n1")
+        c.remove_node("n1")
+        b = snap.update(c)
+        assert not b.valid[slot]
+        c.add_node(node("n9"))
+        b = snap.update(c)
+        assert snap.slot_of("n9") == slot  # reused
+        assert b.valid[slot]
+
+    def test_capacity_growth_preserves_slots(self):
+        c = SchedulerCache(FakeClock())
+        for i in range(100):
+            c.add_node(node(f"n{i:03}"))
+        snap = Snapshot()
+        b = snap.update(c)
+        assert b.padded == 128
+        s50 = snap.slot_of("n050")
+        for i in range(100, 200):
+            c.add_node(node(f"n{i:03}"))
+        b = snap.update(c)
+        assert b.padded == 256
+        assert snap.slot_of("n050") == s50
+        assert b.allocatable[0, s50] == 4000
+
+
+class TestPriorityQueue:
+    def test_priority_then_fifo_order(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock)
+        q.add(pod("low1", prio=1))
+        clock.advance(1)
+        q.add(pod("high", prio=10))
+        clock.advance(1)
+        q.add(pod("low2", prio=1))
+        got = [i.pod.name for i in q.pop_batch(10)]
+        assert got == ["high", "low1", "low2"]
+
+    def test_unschedulable_parks_until_move(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock)
+        q.add(pod("p1"))
+        (info,) = q.pop_batch(1)
+        cycle = q.scheduling_cycle
+        q.add_unschedulable(info, cycle)
+        assert q.pop_batch(1) == []
+        clock.advance(60)  # well past any backoff
+        q.move_all_to_active_or_backoff("NodeAdd")
+        got = q.pop_batch(1)
+        assert [i.pod.name for i in got] == ["p1"]
+
+    def test_backoff_grows_and_caps(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock)
+        q.add(pod("p1"))
+        # attempt 1 -> backoff 1s
+        (info,) = q.pop_batch(1)
+        q.add_unschedulable(info, q.scheduling_cycle)
+        q.move_all_to_active_or_backoff()
+        assert q.pop_batch(1) == []  # still backing off
+        clock.advance(1.01)
+        (info,) = q.pop_batch(1)
+        # attempt 2 -> 2s
+        q.add_unschedulable(info, q.scheduling_cycle)
+        q.move_all_to_active_or_backoff()
+        clock.advance(1.01)
+        assert q.pop_batch(1) == []
+        clock.advance(1.0)
+        (info,) = q.pop_batch(1)
+        assert info.attempts == 3
+
+    def test_move_request_cycle_prevents_lost_wakeup(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock)
+        q.add(pod("p1"))
+        (info,) = q.pop_batch(1)
+        cycle = q.scheduling_cycle
+        # event fires while the pod is mid-cycle
+        q.move_all_to_active_or_backoff("NodeAdd")
+        q.add_unschedulable(info, cycle)
+        # pod must NOT be parked: it goes to backoff and becomes ready
+        clock.advance(1.01)
+        assert [i.pod.name for i in q.pop_batch(1)] == ["p1"]
+
+    def test_five_minute_flush(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock)
+        q.add(pod("p1"))
+        (info,) = q.pop_batch(1)
+        q.add_unschedulable(info, q.scheduling_cycle)
+        clock.advance(301)
+        q.flush_unschedulable_leftover()
+        assert [i.pod.name for i in q.pop_batch(1)] == ["p1"]
+
+    def test_scheduling_gates(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock)
+        gated = MakePod().name("g").scheduling_gates(["wait"]).obj()
+        q.add(gated)
+        assert q.pop_batch(1) == []
+        ungated = MakePod().name("g").obj()
+        q.update(ungated)
+        assert [i.pod.name for i in q.pop_batch(1)] == ["g"]
+
+    def test_delete_pending(self):
+        q = PriorityQueue(FakeClock())
+        q.add(pod("p1"))
+        q.delete("default/p1")
+        assert q.pop_batch(1) == []
