@@ -139,7 +139,7 @@ class MakePod:
         return self
 
     def toleration(
-        self, key: str, value: str = "", operator: str = "Equal", effect: str = ""
+        self, key: str = "", value: str = "", operator: str = "Equal", effect: str = ""
     ) -> "MakePod":
         self._pod.tolerations = self._pod.tolerations + (
             Toleration(key=key, operator=operator, value=value, effect=effect),

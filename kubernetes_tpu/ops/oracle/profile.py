@@ -1,0 +1,178 @@
+"""Full default-profile oracle scheduler: the reference's scheduleOne loop
+with the complete default plugin pipeline, in plain Python.
+
+This extends oracle/scheduler.py (Fit+Balanced only) with the remaining
+static plugins. Mirrors:
+- schedule_one.go#schedulePod: Filter all nodes -> Score feasible ->
+  NormalizeScore per plugin -> x weight -> sum -> selectHost (uniform among
+  max ties; the oracle reports the tie SET, per SURVEY.md §8.8 parity rules)
+- default plugin weights from apis/config/v1/default_plugins.go:
+  TaintToleration 3, NodeAffinity 2, PodTopologySpread 2, InterPodAffinity 2,
+  NodeResourcesFit 1, NodeResourcesBalancedAllocation 1, ImageLocality 1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from ...api.objects import Node, Pod
+from . import plugins as opl
+from .noderesources import (
+    NodeState,
+    balanced_allocation_score,
+    fit_filter,
+    least_allocated_score,
+)
+
+
+@dataclass(frozen=True)
+class ProfileWeights:
+    """Score-plugin weights (default profile)."""
+
+    fit: int = 1
+    balanced: int = 1
+    taint: int = 3
+    node_affinity: int = 2
+    image: int = 1
+
+
+@dataclass
+class OracleNode:
+    """NodeInfo mirror for the full pipeline: resources + node object +
+    placed pods (for ports; later affinity/spread)."""
+
+    node: Node
+    res: NodeState
+    pods: list[Pod] = field(default_factory=list)
+    used_ports: list[tuple[str, str, int]] = field(default_factory=list)
+
+    def add_pod(self, pod: Pod) -> None:
+        self.res.add_pod(pod)
+        self.pods.append(pod)
+        self.used_ports.extend(pod.host_ports())
+
+
+def make_oracle_nodes(
+    nodes: Sequence[Node], pods_by_node: dict[str, list[Pod]] | None = None
+) -> list[OracleNode]:
+    out = []
+    for n in nodes:
+        on = OracleNode(
+            node=n,
+            res=NodeState(
+                name=n.name,
+                allocatable=dict(n.allocatable),
+                max_pods=n.allowed_pod_number,
+                schedulable=not n.unschedulable,
+            ),
+        )
+        for p in (pods_by_node or {}).get(n.name, []):
+            on.add_pod(p)
+        out.append(on)
+    return out
+
+
+class FullOracle:
+    """Sequential ground-truth scheduler over the full static plugin set."""
+
+    def __init__(
+        self,
+        nodes: list[OracleNode],
+        weights: ProfileWeights | None = None,
+    ):
+        self.nodes = nodes
+        self.weights = weights or ProfileWeights()
+        self._refresh_image_states()
+
+    def _refresh_image_states(self) -> None:
+        node_objs = [on.node for on in self.nodes]
+        self.image_states = opl.build_image_states(node_objs)
+        self.total_nodes = len(node_objs)
+
+    def filter_one(self, pod: Pod, on: OracleNode) -> bool:
+        """All Filter plugins, any order (they're independent predicates)."""
+        return (
+            opl.node_name_filter(pod, on.node)
+            and opl.node_unschedulable_filter(pod, on.node)
+            and opl.taint_toleration_filter(pod, on.node)
+            and opl.node_affinity_filter(pod, on.node)
+            and opl.node_ports_filter(pod, on.used_ports)
+            and not fit_filter(pod, on.res)
+        )
+
+    def feasible_and_ties(self, pod: Pod) -> tuple[list[int], list[int]]:
+        feasible = [
+            i for i, on in enumerate(self.nodes) if self.filter_one(pod, on)
+        ]
+        if not feasible:
+            return [], []
+        w = self.weights
+
+        # raw per-plugin scores over the feasible set
+        taint_raw = [
+            opl.taint_toleration_score(pod, self.nodes[i].node) for i in feasible
+        ]
+        na_raw = [
+            opl.node_affinity_score(pod, self.nodes[i].node) for i in feasible
+        ]
+        taint_norm = opl.default_normalize_score(taint_raw, reverse=True)
+        na_norm = opl.default_normalize_score(na_raw, reverse=False)
+
+        totals: dict[int, int] = {}
+        for j, i in enumerate(feasible):
+            on = self.nodes[i]
+            t = w.fit * least_allocated_score(pod, on.res)
+            t += w.balanced * balanced_allocation_score(pod, on.res)
+            t += w.taint * taint_norm[j]
+            t += w.node_affinity * na_norm[j]
+            t += w.image * opl.image_locality_score(
+                pod, on.node, self.image_states, self.total_nodes
+            )
+            totals[i] = t
+        best = max(totals.values())
+        ties = [i for i in feasible if totals[i] == best]
+        return feasible, ties
+
+    def schedule(self, pods: Sequence[Pod]) -> tuple[list[int], list[list[int]]]:
+        """tie_break='first' deterministic run; returns (assignments, tie_sets)."""
+        assignments: list[int] = []
+        tie_sets: list[list[int]] = []
+        for pod in pods:
+            _, ties = self.feasible_and_ties(pod)
+            if not ties:
+                assignments.append(-1)
+                tie_sets.append([])
+                continue
+            pick = ties[0]
+            self.nodes[pick].add_pod(pod)
+            assignments.append(pick)
+            tie_sets.append(ties)
+        return assignments, tie_sets
+
+    def validate_assignments(
+        self, pods: Sequence[Pod], assignments: Sequence[int],
+        names: Sequence[str] | None = None,
+    ) -> list[str]:
+        """Replay solver choices, checking each against the oracle tie set.
+        ``names``: solver's node name per assignment (to map index spaces);
+        defaults to self.nodes order."""
+        index_of = {on.node.name: i for i, on in enumerate(self.nodes)}
+        errors: list[str] = []
+        for step, (pod, pick) in enumerate(zip(pods, assignments)):
+            _, ties = self.feasible_and_ties(pod)
+            if pick == -1:
+                if ties:
+                    errors.append(
+                        f"step {step} pod {pod.key}: solver unschedulable but "
+                        f"oracle ties {ties[:10]}"
+                    )
+                continue
+            oi = index_of[names[step]] if names is not None else pick
+            if oi not in ties:
+                errors.append(
+                    f"step {step} pod {pod.key}: pick {oi} not in tie set "
+                    f"{ties[:10]}{'...' if len(ties) > 10 else ''}"
+                )
+            self.nodes[oi].add_pod(pod)
+        return errors

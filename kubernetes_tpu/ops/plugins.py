@@ -1,0 +1,45 @@
+"""Device kernels for the static plugins' in-scan pieces.
+
+The static plugin *semantics* are precompiled host-side into per-class
+tensors (tensorize/plugins.py); what runs on device per scan step is:
+- a row gather (class -> [N] mask / raw scores),
+- DefaultNormalizeScore over the feasible set (normalize_score), and
+- the NodePorts occupancy test (ports_conflict_mask) + occupancy update.
+
+Reference:
+- helper/normalize_score.go#DefaultNormalizeScore
+- framework/types.go#HostPortInfo.CheckConflict (pairwise conflict relation
+  precompiled into pod_conflict[V]; on device it reduces to "is any
+  conflicting vocab slot occupied", an int matvec that XLA fuses)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100
+
+
+def normalize_score(raw, mask, reverse: bool):
+    """DefaultNormalizeScore over the feasible (masked) set.
+
+    raw: [N] int32 non-negative, mask: [N] bool. Returns [N] int32; values on
+    masked-out lanes are unspecified (caller masks the total).
+    """
+    s = jnp.where(mask, raw, 0).astype(jnp.int32)
+    max_count = jnp.max(s)
+    scaled = MAX_NODE_SCORE * s // jnp.maximum(max_count, 1)
+    if reverse:
+        # maxCount == 0 => all scores become maxPriority
+        return jnp.where(max_count > 0, MAX_NODE_SCORE - scaled, MAX_NODE_SCORE)
+    return jnp.where(max_count > 0, scaled, 0)
+
+
+def ports_conflict_mask(pod_conflict_row, port_used):
+    """True where the node has an occupied port slot conflicting with the pod.
+
+    pod_conflict_row: [V] bool, port_used: [V, N] int32 occupancy counts.
+    """
+    busy = (port_used > 0).astype(jnp.int32)
+    conflicts = pod_conflict_row.astype(jnp.int32) @ busy  # [N]
+    return conflicts > 0

@@ -28,6 +28,11 @@ from .state.cache import SchedulerCache
 from .state.cluster import ApiError, ClusterState, Event
 from .state.queue import PriorityQueue, QueuedPodInfo
 from .state.snapshot import Snapshot
+from .tensorize.plugins import (
+    build_port_tensors,
+    build_static_tensors,
+    trivial_port_tensors,
+)
 from .tensorize.schema import build_pod_batch
 from .utils.clock import Clock
 
@@ -125,8 +130,28 @@ class Scheduler:
         pods = [i.pod for i in infos]
         pbatch = build_pod_batch(pods, batch.vocab)
 
+        # Node objects in snapshot-slot order, for the plugin tensorizers
+        # (share the solver's node index space).
+        slot_nodes = []
+        for name in self.snapshot.names:
+            info = self.cache.nodes.get(name) if name else None
+            slot_nodes.append(info.node if info is not None else None)
+
+        static = build_static_tensors(pods, pbatch, slot_nodes, batch.padded)
+        if any(p.host_ports() for p in pods):
+            placed_by_slot: dict[int, list[Pod]] = {}
+            for slot, name in enumerate(self.snapshot.names):
+                info = self.cache.nodes.get(name) if name else None
+                if info is not None and info.node is not None and info.pods:
+                    placed_by_slot[slot] = list(info.pods.values())
+            ports = build_port_tensors(
+                pods, pbatch, slot_nodes, placed_by_slot, batch.padded
+            )
+        else:
+            ports = trivial_port_tensors(pbatch, batch.padded)
+
         t1 = time.perf_counter()
-        assignments = self.solver.solve(batch, pbatch)
+        assignments = self.solver.solve(batch, pbatch, static, ports)
         res.solve_seconds = time.perf_counter() - t1
 
         for idx, (info, a) in enumerate(zip(infos, assignments)):

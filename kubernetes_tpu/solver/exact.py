@@ -10,6 +10,16 @@ assume-pod state mutation (cache.AssumePod) becomes an in-carry scatter so
 the next step sees updated node state — preserving the reference's strict
 pod-by-pod sequential semantics, which is what "binding parity" means.
 
+Filter pipeline per step (runtime/framework.go#RunFilterPlugins, fused):
+  NodeResourcesFit ∧ static class mask (NodeName ∧ NodeUnschedulable ∧
+  TaintToleration ∧ NodeAffinity, precompiled per pod class) ∧ NodePorts
+  (occupancy matvec over the port vocab).
+
+Score pipeline (runtime/framework.go#RunScorePlugins: score, normalize,
+weight — default-profile weights from apis/config/v1/default_plugins.go):
+  1·LeastAllocated + 1·BalancedAllocation + 3·TaintToleration(norm reverse)
+  + 2·NodeAffinity(norm) + 1·ImageLocality.
+
 selectHost tie-break: the reference reservoir-samples uniformly among
 max-score ties with an unseeded RNG (schedule_one.go#selectHost). Bit-parity
 is impossible; we offer:
@@ -21,7 +31,6 @@ parity definition from SURVEY.md §8.8.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 
 import jax
@@ -29,7 +38,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import noderesources as nr
-from ..tensorize.schema import CPU_IDX, MEM_IDX, NodeBatch, PodBatch
+from ..ops import plugins as pl
+from ..tensorize.plugins import (
+    PortTensors,
+    StaticPluginTensors,
+    trivial_port_tensors,
+    trivial_static_tensors,
+)
+from ..tensorize.schema import MEM_IDX, NodeBatch, PodBatch
 
 TIE_RANDOM = "random"
 TIE_FIRST = "first"
@@ -39,47 +55,79 @@ TIE_FIRST = "first"
 class ExactSolverConfig:
     tie_break: str = TIE_RANDOM
     seed: int = 0
-    # plugin weights (framework runtime multiplies normalized scores by
-    # config weights; defaults are 1 for both of these plugins)
+    # Score-plugin weights; defaults mirror the default profile
+    # (apis/config/v1/default_plugins.go): TaintToleration 3, NodeAffinity 2,
+    # Fit/Balanced/ImageLocality 1.
     fit_weight: int = 1
     balanced_weight: int = 1
+    taint_weight: int = 3
+    node_affinity_weight: int = 2
+    image_weight: int = 1
     balanced_fdtype: str = "float32"  # float64 for bit-parity on CPU tests
 
 
 def _solve_scan(
+    # node tables (read-only in the scan)
     alloc,  # [K, N] int
     max_pods,  # [N] int32
-    node_static_mask,  # [N] bool — valid & schedulable
+    node_valid,  # [N] bool — slot validity only
+    static_mask,  # [C, N] bool — per-class static Filter plugins
+    taint_cnt,  # [C, N] int32
+    nodeaff_pref,  # [C, N] int32
+    image_score,  # [C, N] int32
+    # carried node state
     used0,  # [K, N] int
     nonzero_used0,  # [2, N] int
     pod_count0,  # [N] int32
+    port_used0,  # [V, N] int32
+    # per-pod inputs (scanned)
     req,  # [P, K] int
     req_mask,  # [P, K] bool
     nonzero_req,  # [P, 2] int
     pod_valid,  # [P] bool — valid & statically feasible
+    class_of,  # [P] int32
+    pod_conflict,  # [P, V] bool
+    pod_takes,  # [P, V] int32
     key,  # PRNG key
     *,
     tie_break: str,
-    fit_weight: int,
-    balanced_weight: int,
+    w_fit: int,
+    w_balanced: int,
+    w_taint: int,
+    w_nodeaff: int,
+    w_image: int,
     fdtype,
 ):
     alloc2 = alloc[: MEM_IDX + 1]  # cpu, memory rows for scoring
     weights2 = jnp.ones(2, dtype=alloc.dtype)
 
     def step(carry, xs):
-        used, nonzero_used, pod_count, k = carry
-        r, rmask, nz, pvalid = xs
+        used, nonzero_used, pod_count, port_used, k = carry
+        r, rmask, nz, pvalid, cls, pconf, ptk = xs
 
         mask = (
             nr.fit_mask(r, rmask, alloc, used, pod_count, max_pods)
-            & node_static_mask
+            & static_mask[cls]
+            & node_valid
+            & ~pl.ports_conflict_mask(pconf, port_used)
         )
+
         requested = nr.scoring_requested(nz, nonzero_used)
-        score = fit_weight * nr.least_allocated_score(requested, alloc2, weights2)
-        score = score + balanced_weight * nr.balanced_allocation_score(
+        score = w_fit * nr.least_allocated_score(requested, alloc2, weights2)
+        score = score + w_balanced * nr.balanced_allocation_score(
             requested, alloc2, fdtype=fdtype
         )
+        score = score.astype(jnp.int32)
+        if w_taint:
+            score = score + w_taint * pl.normalize_score(
+                taint_cnt[cls], mask, reverse=True
+            )
+        if w_nodeaff:
+            score = score + w_nodeaff * pl.normalize_score(
+                nodeaff_pref[cls], mask, reverse=False
+            )
+        if w_image:
+            score = score + w_image * image_score[cls]
         score = jnp.where(mask, score, -1)
 
         best = jnp.max(score)
@@ -99,28 +147,37 @@ def _solve_scan(
         used = used.at[:, pick].add(r * d)
         nonzero_used = nonzero_used.at[:, pick].add(nz * d)
         pod_count = pod_count.at[pick].add(found.astype(jnp.int32))
+        port_used = port_used.at[:, pick].add(ptk * found.astype(jnp.int32))
 
         assignment = jnp.where(found, pick, -1).astype(jnp.int32)
-        return (used, nonzero_used, pod_count, k), assignment
+        return (used, nonzero_used, pod_count, port_used, k), assignment
 
-    (used, nonzero_used, pod_count, _), assignments = jax.lax.scan(
+    (used, nonzero_used, pod_count, port_used, _), assignments = jax.lax.scan(
         step,
-        (used0, nonzero_used0, pod_count0, key),
-        (req, req_mask, nonzero_req, pod_valid),
+        (used0, nonzero_used0, pod_count0, port_used0, key),
+        (req, req_mask, nonzero_req, pod_valid, class_of, pod_conflict, pod_takes),
     )
-    return assignments, used, nonzero_used, pod_count
+    return assignments, used, nonzero_used, pod_count, port_used
 
 
 _solve_scan_jit = jax.jit(
     _solve_scan,
-    static_argnames=("tie_break", "fit_weight", "balanced_weight", "fdtype"),
-    donate_argnums=(3, 4, 5),
+    static_argnames=(
+        "tie_break",
+        "w_fit",
+        "w_balanced",
+        "w_taint",
+        "w_nodeaff",
+        "w_image",
+        "fdtype",
+    ),
+    donate_argnums=(7, 8, 9, 10),
 )
 
 
 class ExactSolver:
-    """Host-facing wrapper: NodeBatch/PodBatch in, assignments out, node
-    state written back (the device-side 'assume')."""
+    """Host-facing wrapper: NodeBatch/PodBatch (+ plugin tensors) in,
+    assignments out, node state written back (the device-side 'assume')."""
 
     def __init__(self, config: ExactSolverConfig | None = None):
         self.config = config or ExactSolverConfig()
@@ -131,29 +188,53 @@ class ExactSolver:
         if not jax.config.jax_enable_x64:
             jax.config.update("jax_enable_x64", True)
 
-    def solve(self, nodes: NodeBatch, pods: PodBatch) -> np.ndarray:
+    def solve(
+        self,
+        nodes: NodeBatch,
+        pods: PodBatch,
+        static: StaticPluginTensors | None = None,
+        ports: PortTensors | None = None,
+    ) -> np.ndarray:
         """Returns assignments [num_pods] of node indices (-1 = unschedulable)
-        and updates ``nodes``' used/nonzero_used/pod_count in place."""
+        and updates ``nodes``' used/nonzero_used/pod_count in place.
+
+        Without ``static``/``ports`` tensors, a trivial single-class mask
+        (valid ∧ schedulable) reproduces the resources-only pipeline.
+        """
         cfg = self.config
         fdtype = jnp.float64 if cfg.balanced_fdtype == "float64" else jnp.float32
         key = jax.random.PRNGKey(cfg.seed + self._step_count)
         self._step_count += 1
-        node_static_mask = nodes.valid & nodes.schedulable
-        assignments, used, nonzero_used, pod_count = _solve_scan_jit(
+        if static is None:
+            static = trivial_static_tensors(pods, nodes.padded, nodes.schedulable)
+        if ports is None:
+            ports = trivial_port_tensors(pods, nodes.padded)
+        assignments, used, nonzero_used, pod_count, _ = _solve_scan_jit(
             jnp.asarray(nodes.allocatable),
             jnp.asarray(nodes.max_pods),
-            jnp.asarray(node_static_mask),
+            jnp.asarray(nodes.valid),
+            jnp.asarray(static.mask),
+            jnp.asarray(static.taint_cnt),
+            jnp.asarray(static.nodeaff_pref),
+            jnp.asarray(static.image_score),
             jnp.asarray(nodes.used),
             jnp.asarray(nodes.nonzero_used),
             jnp.asarray(nodes.pod_count),
+            jnp.asarray(ports.used),
             jnp.asarray(pods.req),
             jnp.asarray(pods.req_mask),
             jnp.asarray(pods.nonzero_req),
             jnp.asarray(pods.valid & pods.feasible_static),
+            jnp.asarray(static.class_of),
+            jnp.asarray(ports.pod_conflict),
+            jnp.asarray(ports.pod_takes),
             key,
             tie_break=cfg.tie_break,
-            fit_weight=cfg.fit_weight,
-            balanced_weight=cfg.balanced_weight,
+            w_fit=cfg.fit_weight,
+            w_balanced=cfg.balanced_weight,
+            w_taint=cfg.taint_weight,
+            w_nodeaff=cfg.node_affinity_weight,
+            w_image=cfg.image_weight,
             fdtype=fdtype,
         )
         # np.array(copy=True): np.asarray on a jax array yields a READ-ONLY

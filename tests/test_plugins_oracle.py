@@ -1,0 +1,179 @@
+"""Unit tests for the static-plugin oracles (hand cases derived from the
+reference semantics in SURVEY.md §3.2)."""
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.ops.oracle import plugins as opl
+
+
+# -- NodeName ---------------------------------------------------------------
+
+
+def test_node_name_filter():
+    n = MakeNode().name("a").obj()
+    assert opl.node_name_filter(MakePod().name("p").obj(), n)
+    assert opl.node_name_filter(MakePod().name("p").node("a").obj(), n)
+    assert not opl.node_name_filter(MakePod().name("p").node("b").obj(), n)
+
+
+# -- NodeUnschedulable ------------------------------------------------------
+
+
+def test_node_unschedulable():
+    n = MakeNode().name("a").unschedulable().obj()
+    assert not opl.node_unschedulable_filter(MakePod().obj(), n)
+    tolerating = (
+        MakePod()
+        .toleration(key="node.kubernetes.io/unschedulable", operator="Exists",
+                    effect="NoSchedule")
+        .obj()
+    )
+    assert opl.node_unschedulable_filter(tolerating, n)
+    # an Exists toleration with empty key+effect tolerates everything
+    tolerate_all = MakePod().toleration(operator="Exists").obj()
+    assert opl.node_unschedulable_filter(tolerate_all, n)
+    assert opl.node_unschedulable_filter(MakePod().obj(), MakeNode().name("b").obj())
+
+
+# -- TaintToleration --------------------------------------------------------
+
+
+def test_taint_filter_effects():
+    node = (
+        MakeNode().name("a")
+        .taint("k1", "v1", "NoSchedule")
+        .taint("k2", "v2", "PreferNoSchedule")
+        .obj()
+    )
+    # PreferNoSchedule is not a filter-effect: pod without tolerations passes
+    # only if NoSchedule taints are tolerated
+    assert not opl.taint_toleration_filter(MakePod().obj(), node)
+    p = MakePod().toleration(key="k1", value="v1", effect="NoSchedule").obj()
+    assert opl.taint_toleration_filter(p, node)
+    # value mismatch with default Equal operator
+    p2 = MakePod().toleration(key="k1", value="other", effect="NoSchedule").obj()
+    assert not opl.taint_toleration_filter(p2, node)
+    # empty-effect toleration matches all effects
+    p3 = MakePod().toleration(key="k1", value="v1").obj()
+    assert opl.taint_toleration_filter(p3, node)
+
+
+def test_taint_score_counts_prefer_no_schedule():
+    node = (
+        MakeNode().name("a")
+        .taint("a", "1", "PreferNoSchedule")
+        .taint("b", "2", "PreferNoSchedule")
+        .taint("c", "3", "NoSchedule")
+        .obj()
+    )
+    assert opl.taint_toleration_score(MakePod().obj(), node) == 2
+    p = MakePod().toleration(key="a", operator="Exists").obj()
+    assert opl.taint_toleration_score(p, node) == 1
+
+
+# -- NodeAffinity -----------------------------------------------------------
+
+
+def test_node_selector_and_affinity():
+    node = MakeNode().name("a").label("zone", "z1").label("disk", "ssd").obj()
+    assert opl.node_affinity_filter(MakePod().node_selector({"zone": "z1"}).obj(), node)
+    assert not opl.node_affinity_filter(
+        MakePod().node_selector({"zone": "z2"}).obj(), node
+    )
+    # required affinity: OR of terms
+    p = MakePod().node_affinity_in("zone", ["z2", "z1"]).obj()
+    assert opl.node_affinity_filter(p, node)
+    p2 = MakePod().node_affinity_not_in("disk", ["ssd"]).obj()
+    assert not opl.node_affinity_filter(p2, node)
+    # nodeSelector AND affinity must both hold
+    p3 = (
+        MakePod()
+        .node_selector({"zone": "z1"})
+        .node_affinity_in("disk", ["hdd"])
+        .obj()
+    )
+    assert not opl.node_affinity_filter(p3, node)
+
+
+def test_node_affinity_score_sums_weights():
+    node = MakeNode().name("a").label("zone", "z1").label("disk", "ssd").obj()
+    p = (
+        MakePod()
+        .preferred_node_affinity(10, "zone", ["z1"])
+        .preferred_node_affinity(5, "disk", ["hdd"])
+        .preferred_node_affinity(3, "disk", ["ssd"])
+        .obj()
+    )
+    assert opl.node_affinity_score(p, node) == 13
+    assert opl.node_affinity_score(MakePod().obj(), node) == 0
+
+
+# -- NodePorts --------------------------------------------------------------
+
+
+def test_port_conflicts_wildcard_semantics():
+    # want wildcard conflicts with any ip on same (proto, port)
+    assert opl.port_conflicts(("0.0.0.0", "TCP", 80), [("10.0.0.1", "TCP", 80)])
+    # want specific conflicts with wildcard used
+    assert opl.port_conflicts(("10.0.0.2", "TCP", 80), [("0.0.0.0", "TCP", 80)])
+    # different specific IPs don't conflict
+    assert not opl.port_conflicts(("10.0.0.2", "TCP", 80), [("10.0.0.1", "TCP", 80)])
+    # protocol isolation
+    assert not opl.port_conflicts(("0.0.0.0", "UDP", 80), [("0.0.0.0", "TCP", 80)])
+    # port 0 never conflicts
+    assert not opl.port_conflicts(("0.0.0.0", "TCP", 0), [("0.0.0.0", "TCP", 0)])
+
+
+def test_node_ports_filter():
+    pod = MakePod().host_port(8080).obj()
+    assert opl.node_ports_filter(pod, [])
+    assert not opl.node_ports_filter(pod, [("0.0.0.0", "TCP", 8080)])
+
+
+# -- ImageLocality ----------------------------------------------------------
+
+
+MB = 1024 * 1024
+
+
+def test_normalized_image_name():
+    assert opl.normalized_image_name("nginx") == "nginx:latest"
+    assert opl.normalized_image_name("nginx:1.2") == "nginx:1.2"
+    assert opl.normalized_image_name("reg:5000/img") == "reg:5000/img:latest"
+    assert opl.normalized_image_name("img@sha256:abcd") == "img@sha256:abcd"
+
+
+def test_image_locality_score_scaling():
+    # image on 1 of 2 nodes, size 500MB -> scaled = 500MB * 1/2 = 250MB
+    n1 = MakeNode().name("n1").image("big:latest", 500 * MB).obj()
+    n2 = MakeNode().name("n2").obj()
+    states = opl.build_image_states([n1, n2])
+    assert states["big:latest"] == (500 * MB, 1)
+    pod = MakePod().container_image("big:latest").obj()
+    # sum=250MB, 1 container: (250-23)/(1000-23) * 100 = 23.23 -> 23
+    s1 = opl.image_locality_score(pod, n1, states, 2)
+    assert s1 == 100 * (250 * MB - 23 * MB) // (977 * MB)
+    # node without the image scores 0
+    assert opl.image_locality_score(pod, n2, states, 2) == 0
+
+
+def test_image_locality_thresholds():
+    n = MakeNode().name("n").image("huge:latest", 3000 * MB).obj()
+    states = opl.build_image_states([n])
+    pod = MakePod().container_image("huge:latest").obj()
+    assert opl.image_locality_score(pod, n, states, 1) == 100  # clamped at max
+    n2 = MakeNode().name("n2").image("tiny:latest", MB).obj()
+    states2 = opl.build_image_states([n2])
+    pod2 = MakePod().container_image("tiny:latest").obj()
+    assert opl.image_locality_score(pod2, n2, states2, 1) == 0  # below min
+
+
+# -- DefaultNormalizeScore --------------------------------------------------
+
+
+def test_default_normalize():
+    assert opl.default_normalize_score([1, 2, 4], reverse=False) == [25, 50, 100]
+    assert opl.default_normalize_score([1, 2, 4], reverse=True) == [75, 50, 0]
+    assert opl.default_normalize_score([0, 0], reverse=True) == [100, 100]
+    assert opl.default_normalize_score([0, 0], reverse=False) == [0, 0]

@@ -1,0 +1,254 @@
+"""End-to-end parity: ExactSolver with static plugin tensors vs the
+FullOracle sequential pipeline (SURVEY.md §8.6 — the oracle is the
+sanitizer). Every solver pick must land in the oracle's tie set given
+identical history."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.ops.oracle.profile import FullOracle, make_oracle_nodes
+from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+from kubernetes_tpu.tensorize.plugins import (
+    build_port_tensors,
+    build_static_tensors,
+)
+from kubernetes_tpu.tensorize.schema import (
+    ResourceVocab,
+    build_node_batch,
+    build_pod_batch,
+)
+
+MB = 1024 * 1024
+GB = 1024 * MB
+
+
+def run_solver(nodes, pods, tie_break="first"):
+    vocab = ResourceVocab.build(pods, nodes)
+    nbatch = build_node_batch(nodes, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - len(nodes))
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded)
+    ports = build_port_tensors(pods, pbatch, slot_nodes, {}, nbatch.padded)
+    solver = ExactSolver(ExactSolverConfig(tie_break=tie_break))
+    return solver.solve(nbatch, pbatch, static, ports), nbatch
+
+
+def assert_parity(nodes, pods, tie_break="first"):
+    assignments, nbatch = run_solver(nodes, pods, tie_break)
+    oracle = FullOracle(make_oracle_nodes(nodes))
+    names = [
+        nbatch.names[a] if a >= 0 else "" for a in assignments
+    ]
+    errors = oracle.validate_assignments(
+        pods, list(assignments), names=[n or None for n in names]
+    )
+    assert not errors, "\n".join(errors[:5])
+    return assignments
+
+
+def mk_nodes(n, taint_every=0, zone_count=0, unsched_every=0, image_every=0):
+    nodes = []
+    for i in range(n):
+        b = (
+            MakeNode()
+            .name(f"node-{i:03}")
+            .capacity({"cpu": "8", "memory": "32Gi", "pods": "50"})
+        )
+        if zone_count:
+            b = b.label("zone", f"z{i % zone_count}")
+        if taint_every and i % taint_every == 0:
+            b = b.taint("dedicated", "gpu", "NoSchedule")
+        if unsched_every and i % unsched_every == 0:
+            b = b.unschedulable()
+        if image_every and i % image_every == 0:
+            b = b.image("app:latest", 800 * MB)
+        nodes.append(b.obj())
+    return nodes
+
+
+def test_taints_steer_placement():
+    nodes = mk_nodes(8, taint_every=2)
+    pods = [
+        MakePod().name(f"p{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
+        for i in range(10)
+    ]
+    a = assert_parity(nodes, pods)
+    # untolerated pods must avoid tainted (even) nodes
+    assert all(x % 2 == 1 for x in a if x >= 0)
+
+
+def test_toleration_opens_tainted_nodes():
+    nodes = mk_nodes(4, taint_every=1)
+    pods = [
+        MakePod()
+        .name(f"p{i}")
+        .req({"cpu": "100m"})
+        .toleration(key="dedicated", value="gpu", effect="NoSchedule")
+        .obj()
+        for i in range(4)
+    ]
+    a = assert_parity(nodes, pods)
+    assert all(x >= 0 for x in a)
+
+
+def test_node_selector_and_required_affinity():
+    nodes = mk_nodes(9, zone_count=3)
+    pods = [
+        MakePod().name(f"sel{i}").node_selector({"zone": "z1"}).req({"cpu": "100m"}).obj()
+        for i in range(3)
+    ] + [
+        MakePod().name(f"aff{i}").node_affinity_in("zone", ["z2"]).req({"cpu": "100m"}).obj()
+        for i in range(3)
+    ]
+    a = assert_parity(nodes, pods)
+    assert all(x % 3 == 1 for x in a[:3])  # z1 nodes
+    assert all(x % 3 == 2 for x in a[3:])  # z2 nodes
+
+
+def test_preferred_affinity_scores():
+    nodes = mk_nodes(6, zone_count=2)
+    pods = [
+        MakePod()
+        .name(f"p{i}")
+        .req({"cpu": "100m"})
+        .preferred_node_affinity(50, "zone", ["z0"])
+        .obj()
+        for i in range(4)
+    ]
+    a = assert_parity(nodes, pods)
+    assert all(x % 2 == 0 for x in a if x >= 0)  # prefers z0
+
+
+def test_unschedulable_and_nodename():
+    nodes = mk_nodes(4, unsched_every=2)
+    pods = [
+        MakePod().name("pinned").node("node-002").req({"cpu": "100m"}).obj(),
+        MakePod().name("free").req({"cpu": "100m"}).obj(),
+        MakePod()
+        .name("tolerates-unsched")
+        .toleration(key="node.kubernetes.io/unschedulable", operator="Exists",
+                    effect="NoSchedule")
+        .req({"cpu": "100m"})
+        .obj(),
+    ]
+    a = assert_parity(nodes, pods)
+    # pinned to an unschedulable node -> fails (node-002 is unschedulable)
+    assert a[0] == -1
+    assert a[1] in (1, 3)
+
+
+def test_host_ports_exclude_and_serialize():
+    nodes = mk_nodes(2)
+    pods = [
+        MakePod().name(f"web{i}").host_port(80).req({"cpu": "100m"}).obj()
+        for i in range(3)
+    ]
+    a = assert_parity(nodes, pods)
+    # only 2 nodes => only 2 pods with hostPort 80 can land
+    placed = [x for x in a if x >= 0]
+    assert sorted(placed) == [0, 1]
+    assert list(a).count(-1) == 1
+
+
+def test_host_ports_against_placed_pods():
+    # a pod already on node-000 holds port 80; the new pod must go elsewhere
+    nodes = mk_nodes(2)
+    placed = MakePod().name("old").node("node-000").host_port(80).obj()
+    pods = [MakePod().name("new").host_port(80).req({"cpu": "100m"}).obj()]
+
+    vocab = ResourceVocab.build(pods + [placed], nodes)
+    nbatch = build_node_batch(nodes, {"node-000": [placed]}, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - len(nodes))
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded)
+    ports = build_port_tensors(
+        pods, pbatch, slot_nodes, {0: [placed]}, nbatch.padded
+    )
+    solver = ExactSolver(ExactSolverConfig(tie_break="first"))
+    a = solver.solve(nbatch, pbatch, static, ports)
+    assert a[0] == 1
+
+
+def test_image_locality_prefers_cached_nodes():
+    nodes = mk_nodes(4, image_every=2)
+    pods = [
+        MakePod()
+        .name(f"p{i}")
+        .container_image("app:latest", {"cpu": "100m"})
+        .obj()
+        for i in range(2)
+    ]
+    a = assert_parity(nodes, pods)
+    assert all(x % 2 == 0 for x in a)  # nodes 0,2 have the image
+
+
+def test_randomized_cluster_parity():
+    rng = np.random.default_rng(7)
+    zones = 3
+    nodes = []
+    for i in range(24):
+        b = (
+            MakeNode()
+            .name(f"node-{i:03}")
+            .capacity(
+                {
+                    "cpu": f"{int(rng.integers(4, 17))}",
+                    "memory": f"{int(rng.integers(8, 65))}Gi",
+                    "pods": "30",
+                }
+            )
+            .label("zone", f"z{i % zones}")
+            .label("disk", "ssd" if i % 2 else "hdd")
+        )
+        if rng.random() < 0.25:
+            b = b.taint("team", f"t{int(rng.integers(0, 2))}", "NoSchedule")
+        if rng.random() < 0.2:
+            b = b.taint("soft", "x", "PreferNoSchedule")
+        if rng.random() < 0.1:
+            b = b.unschedulable()
+        if rng.random() < 0.3:
+            b = b.image("cache:latest", int(rng.integers(100, 900)) * MB)
+        nodes.append(b.obj())
+
+    pods = []
+    for i in range(60):
+        b = (
+            MakePod()
+            .name(f"pod-{i:03}")
+            .req(
+                {
+                    "cpu": f"{int(rng.integers(1, 20)) * 100}m",
+                    "memory": f"{int(rng.integers(1, 8))}Gi",
+                }
+            )
+        )
+        r = rng.random()
+        if r < 0.2:
+            b = b.node_selector({"zone": f"z{int(rng.integers(0, zones))}"})
+        elif r < 0.35:
+            b = b.node_affinity_in("disk", ["ssd"])
+        if rng.random() < 0.3:
+            b = b.toleration(key="team", value=f"t{int(rng.integers(0, 2))}",
+                             effect="NoSchedule")
+        if rng.random() < 0.2:
+            b = b.preferred_node_affinity(
+                int(rng.integers(1, 100)), "zone", [f"z{int(rng.integers(0, zones))}"]
+            )
+        if rng.random() < 0.15:
+            b = b.host_port(int(rng.integers(8000, 8004)))
+        if rng.random() < 0.25:
+            b = b.container_image("cache:latest", {"cpu": "100m"})
+        pods.append(b.obj())
+
+    assert_parity(nodes, pods)
+
+
+def test_random_tiebreak_stays_in_tie_set():
+    nodes = mk_nodes(8)
+    pods = [MakePod().name(f"p{i}").req({"cpu": "100m"}).obj() for i in range(16)]
+    assignments, nbatch = run_solver(nodes, pods, tie_break="random")
+    oracle = FullOracle(make_oracle_nodes(nodes))
+    names = [nbatch.names[a] if a >= 0 else None for a in assignments]
+    errors = oracle.validate_assignments(pods, list(assignments), names=names)
+    assert not errors, "\n".join(errors[:5])
