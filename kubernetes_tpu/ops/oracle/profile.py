@@ -18,6 +18,7 @@ from typing import Sequence
 
 from ...api.objects import Node, Pod
 from . import plugins as opl
+from . import spread as osp
 from .noderesources import (
     NodeState,
     balanced_allocation_score,
@@ -35,6 +36,7 @@ class ProfileWeights:
     taint: int = 3
     node_affinity: int = 2
     image: int = 1
+    spread: int = 2
 
 
 @dataclass
@@ -90,8 +92,23 @@ class FullOracle:
         self.image_states = opl.build_image_states(node_objs)
         self.total_nodes = len(node_objs)
 
-    def filter_one(self, pod: Pod, on: OracleNode) -> bool:
-        """All Filter plugins, any order (they're independent predicates)."""
+    def _all_nodes_with_pods(self) -> list[tuple[Node, list[Pod]]]:
+        return [(on.node, on.pods) for on in self.nodes]
+
+    _UNSET = object()
+
+    def filter_one(
+        self,
+        pod: Pod,
+        on: OracleNode,
+        spread_state=_UNSET,
+    ) -> bool:
+        """All Filter plugins, any order (they're independent predicates).
+        ``spread_state`` is the per-pod PreFilter precomputation (None = pod
+        has no hard constraints); omitting it rebuilds per call — fine for
+        single-node probes, hot paths prebuild via feasible_and_ties."""
+        if spread_state is FullOracle._UNSET:
+            spread_state = osp.build_filter_state(pod, self._all_nodes_with_pods())
         return (
             opl.node_name_filter(pod, on.node)
             and opl.node_unschedulable_filter(pod, on.node)
@@ -99,11 +116,15 @@ class FullOracle:
             and opl.node_affinity_filter(pod, on.node)
             and opl.node_ports_filter(pod, on.used_ports)
             and not fit_filter(pod, on.res)
+            and (spread_state is None or spread_state.check(on.node))
         )
 
     def feasible_and_ties(self, pod: Pod) -> tuple[list[int], list[int]]:
+        spread_state = osp.build_filter_state(pod, self._all_nodes_with_pods())
         feasible = [
-            i for i, on in enumerate(self.nodes) if self.filter_one(pod, on)
+            i
+            for i, on in enumerate(self.nodes)
+            if self.filter_one(pod, on, spread_state)
         ]
         if not feasible:
             return [], []
@@ -118,6 +139,11 @@ class FullOracle:
         ]
         taint_norm = opl.default_normalize_score(taint_raw, reverse=True)
         na_norm = opl.default_normalize_score(na_raw, reverse=False)
+        spread_norm = osp.spread_scores(
+            pod,
+            [(self.nodes[i].node, self.nodes[i].pods) for i in feasible],
+            self._all_nodes_with_pods(),
+        )
 
         totals: dict[int, int] = {}
         for j, i in enumerate(feasible):
@@ -129,6 +155,7 @@ class FullOracle:
             t += w.image * opl.image_locality_score(
                 pod, on.node, self.image_states, self.total_nodes
             )
+            t += w.spread * spread_norm[j]
             totals[i] = t
         best = max(totals.values())
         ties = [i for i in feasible if totals[i] == best]

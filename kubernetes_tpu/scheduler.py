@@ -33,6 +33,7 @@ from .tensorize.plugins import (
     build_static_tensors,
     trivial_port_tensors,
 )
+from .tensorize.spread import build_spread_tensors
 from .tensorize.schema import build_pod_batch
 from .utils.clock import Clock
 
@@ -138,20 +139,29 @@ class Scheduler:
             slot_nodes.append(info.node if info is not None else None)
 
         static = build_static_tensors(pods, pbatch, slot_nodes, batch.padded)
-        if any(p.host_ports() for p in pods):
-            placed_by_slot: dict[int, list[Pod]] = {}
+        need_ports = any(p.host_ports() for p in pods)
+        need_spread = any(r.topology_spread_constraints for r in static.reps)
+        placed_by_slot: dict[int, list[Pod]] = {}
+        if need_ports or need_spread:
             for slot, name in enumerate(self.snapshot.names):
                 info = self.cache.nodes.get(name) if name else None
                 if info is not None and info.node is not None and info.pods:
                     placed_by_slot[slot] = list(info.pods.values())
+        if need_ports:
             ports = build_port_tensors(
                 pods, pbatch, slot_nodes, placed_by_slot, batch.padded
             )
         else:
             ports = trivial_port_tensors(pbatch, batch.padded)
+        spread = None
+        if need_spread:
+            spread = build_spread_tensors(
+                pods, static.reps, pbatch, slot_nodes,
+                placed_by_slot, batch.padded, static.c_pad,
+            )
 
         t1 = time.perf_counter()
-        assignments = self.solver.solve(batch, pbatch, static, ports)
+        assignments = self.solver.solve(batch, pbatch, static, ports, spread)
         res.solve_seconds = time.perf_counter() - t1
 
         for idx, (info, a) in enumerate(zip(infos, assignments)):

@@ -13,12 +13,13 @@ pod-by-pod sequential semantics, which is what "binding parity" means.
 Filter pipeline per step (runtime/framework.go#RunFilterPlugins, fused):
   NodeResourcesFit ∧ static class mask (NodeName ∧ NodeUnschedulable ∧
   TaintToleration ∧ NodeAffinity, precompiled per pod class) ∧ NodePorts
-  (occupancy matvec over the port vocab).
+  (occupancy matvec over the port vocab) ∧ PodTopologySpread hard
+  constraints (segment reductions over domain ids).
 
 Score pipeline (runtime/framework.go#RunScorePlugins: score, normalize,
 weight — default-profile weights from apis/config/v1/default_plugins.go):
   1·LeastAllocated + 1·BalancedAllocation + 3·TaintToleration(norm reverse)
-  + 2·NodeAffinity(norm) + 1·ImageLocality.
+  + 2·NodeAffinity(norm) + 1·ImageLocality + 2·PodTopologySpread(norm).
 
 selectHost tie-break: the reference reservoir-samples uniformly among
 max-score ties with an unseeded RNG (schedule_one.go#selectHost). Bit-parity
@@ -39,12 +40,14 @@ import numpy as np
 
 from ..ops import noderesources as nr
 from ..ops import plugins as pl
+from ..ops import spread as sp
 from ..tensorize.plugins import (
     PortTensors,
     StaticPluginTensors,
     trivial_port_tensors,
     trivial_static_tensors,
 )
+from ..tensorize.spread import SpreadTensors, trivial_spread_tensors
 from ..tensorize.schema import MEM_IDX, NodeBatch, PodBatch
 
 TIE_RANDOM = "random"
@@ -57,37 +60,20 @@ class ExactSolverConfig:
     seed: int = 0
     # Score-plugin weights; defaults mirror the default profile
     # (apis/config/v1/default_plugins.go): TaintToleration 3, NodeAffinity 2,
-    # Fit/Balanced/ImageLocality 1.
+    # PodTopologySpread 2, Fit/Balanced/ImageLocality 1.
     fit_weight: int = 1
     balanced_weight: int = 1
     taint_weight: int = 3
     node_affinity_weight: int = 2
     image_weight: int = 1
+    spread_weight: int = 2
     balanced_fdtype: str = "float32"  # float64 for bit-parity on CPU tests
 
 
 def _solve_scan(
-    # node tables (read-only in the scan)
-    alloc,  # [K, N] int
-    max_pods,  # [N] int32
-    node_valid,  # [N] bool — slot validity only
-    static_mask,  # [C, N] bool — per-class static Filter plugins
-    taint_cnt,  # [C, N] int32
-    nodeaff_pref,  # [C, N] int32
-    image_score,  # [C, N] int32
-    # carried node state
-    used0,  # [K, N] int
-    nonzero_used0,  # [2, N] int
-    pod_count0,  # [N] int32
-    port_used0,  # [V, N] int32
-    # per-pod inputs (scanned)
-    req,  # [P, K] int
-    req_mask,  # [P, K] bool
-    nonzero_req,  # [P, 2] int
-    pod_valid,  # [P] bool — valid & statically feasible
-    class_of,  # [P] int32
-    pod_conflict,  # [P, V] bool
-    pod_takes,  # [P, V] int32
+    tables,  # dict of read-only node/class tables (see ExactSolver.solve)
+    state0,  # dict of carried node state (donated)
+    xs,  # dict of per-pod scanned inputs, leading axis P
     key,  # PRNG key
     *,
     tie_break: str,
@@ -96,23 +82,33 @@ def _solve_scan(
     w_taint: int,
     w_nodeaff: int,
     w_image: int,
+    w_spread: int,
+    use_spread: bool,
+    d_pad: int,
     fdtype,
 ):
+    alloc = tables["alloc"]
     alloc2 = alloc[: MEM_IDX + 1]  # cpu, memory rows for scoring
     weights2 = jnp.ones(2, dtype=alloc.dtype)
+    spr = tables.get("spr")
 
-    def step(carry, xs):
-        used, nonzero_used, pod_count, port_used, k = carry
-        r, rmask, nz, pvalid, cls, pconf, ptk = xs
+    def step(carry, x):
+        st, k = carry
+        cls = x["class_of"]
 
         mask = (
-            nr.fit_mask(r, rmask, alloc, used, pod_count, max_pods)
-            & static_mask[cls]
-            & node_valid
-            & ~pl.ports_conflict_mask(pconf, port_used)
+            nr.fit_mask(
+                x["req"], x["req_mask"], alloc, st["used"],
+                st["pod_count"], tables["max_pods"],
+            )
+            & tables["static_mask"][cls]
+            & tables["node_valid"]
+            & ~pl.ports_conflict_mask(x["pod_conflict"], st["port_used"])
         )
+        if use_spread:
+            mask = mask & ~sp.hard_violations(spr, st["spr_cnt"], cls, d_pad)
 
-        requested = nr.scoring_requested(nz, nonzero_used)
+        requested = nr.scoring_requested(x["nonzero_req"], st["nonzero_used"])
         score = w_fit * nr.least_allocated_score(requested, alloc2, weights2)
         score = score + w_balanced * nr.balanced_allocation_score(
             requested, alloc2, fdtype=fdtype
@@ -120,14 +116,18 @@ def _solve_scan(
         score = score.astype(jnp.int32)
         if w_taint:
             score = score + w_taint * pl.normalize_score(
-                taint_cnt[cls], mask, reverse=True
+                tables["taint_cnt"][cls], mask, reverse=True
             )
         if w_nodeaff:
             score = score + w_nodeaff * pl.normalize_score(
-                nodeaff_pref[cls], mask, reverse=False
+                tables["nodeaff_pref"][cls], mask, reverse=False
             )
         if w_image:
-            score = score + w_image * image_score[cls]
+            score = score + w_image * tables["image_score"][cls]
+        if use_spread and w_spread:
+            score = score + w_spread * sp.soft_scores(
+                spr, st["spr_cnt"], cls, mask, d_pad, fdtype=fdtype
+            )
         score = jnp.where(mask, score, -1)
 
         best = jnp.max(score)
@@ -142,22 +142,25 @@ def _solve_scan(
             pick_rank = 0
         pick = jnp.argmax(csum > pick_rank).astype(jnp.int32)
 
-        found = feasible & pvalid
+        found = feasible & x["pod_valid"]
         d = found.astype(alloc.dtype)
-        used = used.at[:, pick].add(r * d)
-        nonzero_used = nonzero_used.at[:, pick].add(nz * d)
-        pod_count = pod_count.at[pick].add(found.astype(jnp.int32))
-        port_used = port_used.at[:, pick].add(ptk * found.astype(jnp.int32))
-
+        di = found.astype(jnp.int32)
+        st = dict(
+            used=st["used"].at[:, pick].add(x["req"] * d),
+            nonzero_used=st["nonzero_used"].at[:, pick].add(x["nonzero_req"] * d),
+            pod_count=st["pod_count"].at[pick].add(di),
+            port_used=st["port_used"].at[:, pick].add(x["pod_takes"] * di),
+            spr_cnt=(
+                st["spr_cnt"].at[:, pick].add(x["spr_placed"].astype(jnp.int32) * di)
+                if use_spread
+                else st["spr_cnt"]
+            ),
+        )
         assignment = jnp.where(found, pick, -1).astype(jnp.int32)
-        return (used, nonzero_used, pod_count, port_used, k), assignment
+        return (st, k), assignment
 
-    (used, nonzero_used, pod_count, port_used, _), assignments = jax.lax.scan(
-        step,
-        (used0, nonzero_used0, pod_count0, port_used0, key),
-        (req, req_mask, nonzero_req, pod_valid, class_of, pod_conflict, pod_takes),
-    )
-    return assignments, used, nonzero_used, pod_count, port_used
+    (state, _), assignments = jax.lax.scan(step, (state0, key), xs)
+    return assignments, state
 
 
 _solve_scan_jit = jax.jit(
@@ -169,9 +172,12 @@ _solve_scan_jit = jax.jit(
         "w_taint",
         "w_nodeaff",
         "w_image",
+        "w_spread",
+        "use_spread",
+        "d_pad",
         "fdtype",
     ),
-    donate_argnums=(7, 8, 9, 10),
+    donate_argnums=(1,),
 )
 
 
@@ -194,12 +200,14 @@ class ExactSolver:
         pods: PodBatch,
         static: StaticPluginTensors | None = None,
         ports: PortTensors | None = None,
+        spread: SpreadTensors | None = None,
     ) -> np.ndarray:
         """Returns assignments [num_pods] of node indices (-1 = unschedulable)
         and updates ``nodes``' used/nonzero_used/pod_count in place.
 
-        Without ``static``/``ports`` tensors, a trivial single-class mask
-        (valid ∧ schedulable) reproduces the resources-only pipeline.
+        Without ``static``/``ports``/``spread`` tensors, a trivial
+        single-class mask (valid ∧ schedulable) reproduces the
+        resources-only pipeline.
         """
         cfg = self.config
         fdtype = jnp.float64 if cfg.balanced_fdtype == "float64" else jnp.float32
@@ -209,25 +217,50 @@ class ExactSolver:
             static = trivial_static_tensors(pods, nodes.padded, nodes.schedulable)
         if ports is None:
             ports = trivial_port_tensors(pods, nodes.padded)
-        assignments, used, nonzero_used, pod_count, _ = _solve_scan_jit(
-            jnp.asarray(nodes.allocatable),
-            jnp.asarray(nodes.max_pods),
-            jnp.asarray(nodes.valid),
-            jnp.asarray(static.mask),
-            jnp.asarray(static.taint_cnt),
-            jnp.asarray(static.nodeaff_pref),
-            jnp.asarray(static.image_score),
-            jnp.asarray(nodes.used),
-            jnp.asarray(nodes.nonzero_used),
-            jnp.asarray(nodes.pod_count),
-            jnp.asarray(ports.used),
-            jnp.asarray(pods.req),
-            jnp.asarray(pods.req_mask),
-            jnp.asarray(pods.nonzero_req),
-            jnp.asarray(pods.valid & pods.feasible_static),
-            jnp.asarray(static.class_of),
-            jnp.asarray(ports.pod_conflict),
-            jnp.asarray(ports.pod_takes),
+        if spread is None:
+            spread = trivial_spread_tensors(pods, nodes.padded, static.c_pad)
+        use_spread = not spread.empty
+
+        tables = {
+            "alloc": jnp.asarray(nodes.allocatable),
+            "max_pods": jnp.asarray(nodes.max_pods),
+            "node_valid": jnp.asarray(nodes.valid),
+            "static_mask": jnp.asarray(static.mask),
+            "taint_cnt": jnp.asarray(static.taint_cnt),
+            "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
+            "image_score": jnp.asarray(static.image_score),
+            "spr": {
+                "dom": jnp.asarray(spread.dom),
+                "elig": jnp.asarray(spread.elig),
+                "max_skew": jnp.asarray(spread.max_skew),
+                "min_domains": jnp.asarray(spread.min_domains),
+                "self_match": jnp.asarray(spread.self_match),
+                "is_hostname": jnp.asarray(spread.is_hostname),
+                "hard": jnp.asarray(spread.hard),
+                "soft": jnp.asarray(spread.soft),
+            },
+        }
+        state0 = {
+            "used": jnp.asarray(nodes.used),
+            "nonzero_used": jnp.asarray(nodes.nonzero_used),
+            "pod_count": jnp.asarray(nodes.pod_count),
+            "port_used": jnp.asarray(ports.used),
+            "spr_cnt": jnp.asarray(spread.cnt0),
+        }
+        xs = {
+            "req": jnp.asarray(pods.req),
+            "req_mask": jnp.asarray(pods.req_mask),
+            "nonzero_req": jnp.asarray(pods.nonzero_req),
+            "pod_valid": jnp.asarray(pods.valid & pods.feasible_static),
+            "class_of": jnp.asarray(static.class_of),
+            "pod_conflict": jnp.asarray(ports.pod_conflict),
+            "pod_takes": jnp.asarray(ports.pod_takes),
+            "spr_placed": jnp.asarray(spread.placed_match),
+        }
+        assignments, state = _solve_scan_jit(
+            tables,
+            state0,
+            xs,
             key,
             tie_break=cfg.tie_break,
             w_fit=cfg.fit_weight,
@@ -235,11 +268,14 @@ class ExactSolver:
             w_taint=cfg.taint_weight,
             w_nodeaff=cfg.node_affinity_weight,
             w_image=cfg.image_weight,
+            w_spread=cfg.spread_weight,
+            use_spread=use_spread,
+            d_pad=spread.d_pad,
             fdtype=fdtype,
         )
         # np.array(copy=True): np.asarray on a jax array yields a READ-ONLY
         # view, which would freeze the snapshot's dirty-column writes
-        nodes.used = np.array(used)
-        nodes.nonzero_used = np.array(nonzero_used)
-        nodes.pod_count = np.array(pod_count)
+        nodes.used = np.array(state["used"])
+        nodes.nonzero_used = np.array(state["nonzero_used"])
+        nodes.pod_count = np.array(state["pod_count"])
         return np.asarray(assignments)[: pods.num_pods]

@@ -49,8 +49,19 @@ def _class_key(pod: Pod, with_images: bool):
     """Everything the static plugins read from the pod spec. Image names only
     matter when some node reports images (image_score is their sole
     consumer); excluding them otherwise keeps C small for image-diverse
-    batches."""
+    batches. PodTopologySpread reads the pod's own labels (selfMatch,
+    matchLabelKeys) and namespace, so those join the key only for pods that
+    carry spread constraints."""
     na = pod.affinity.node_affinity if pod.affinity else None
+    spread = (
+        (
+            pod.topology_spread_constraints,
+            pod.namespace,
+            tuple(sorted(pod.labels.items())),
+        )
+        if pod.topology_spread_constraints
+        else ()
+    )
     return (
         pod.node_name,
         tuple(sorted(pod.node_selector.items())),
@@ -58,6 +69,7 @@ def _class_key(pod: Pod, with_images: bool):
         pod.tolerations,
         tuple(tuple(c.images) for c in pod.containers) if with_images else (),
         len(pod.containers) if with_images else 0,
+        spread,
     )
 
 
@@ -69,6 +81,13 @@ class StaticPluginTensors:
     taint_cnt: np.ndarray  # [Cp, Np] int32
     nodeaff_pref: np.ndarray  # [Cp, Np] int32
     image_score: np.ndarray  # [Cp, Np] int32
+    # representative pod per class, for downstream per-class tensorizers
+    # (spread, interpod affinity); not shipped to device
+    reps: list = None
+
+    @property
+    def c_pad(self) -> int:
+        return self.mask.shape[0]
 
     def device_arrays(self) -> dict[str, np.ndarray]:
         return {
@@ -93,6 +112,7 @@ def trivial_static_tensors(pbatch: PodBatch, padded_n: int, schedulable: np.ndar
         taint_cnt=z,
         nodeaff_pref=z.copy(),
         image_score=z.copy(),
+        reps=[],
     )
 
 
@@ -157,6 +177,7 @@ def build_static_tensors(
         taint_cnt=taint_cnt,
         nodeaff_pref=nodeaff_pref,
         image_score=image_score,
+        reps=reps,
     )
 
 
