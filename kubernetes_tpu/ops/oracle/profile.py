@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Sequence
 
 from ...api.objects import Node, Pod
+from . import interpod as oip
 from . import plugins as opl
 from . import spread as osp
 from .noderesources import (
@@ -37,6 +38,9 @@ class ProfileWeights:
     node_affinity: int = 2
     image: int = 1
     spread: int = 2
+    interpod: int = 2
+    # InterPodAffinityArgs.hardPodAffinityWeight (default 1)
+    hard_pod_affinity: int = 1
 
 
 @dataclass
@@ -102,13 +106,19 @@ class FullOracle:
         pod: Pod,
         on: OracleNode,
         spread_state=_UNSET,
+        interpod_state=_UNSET,
     ) -> bool:
         """All Filter plugins, any order (they're independent predicates).
-        ``spread_state`` is the per-pod PreFilter precomputation (None = pod
-        has no hard constraints); omitting it rebuilds per call — fine for
-        single-node probes, hot paths prebuild via feasible_and_ties."""
+        ``spread_state``/``interpod_state`` are the per-pod PreFilter
+        precomputations (spread: None = pod has no hard constraints);
+        omitting them rebuilds per call — fine for single-node probes, hot
+        paths prebuild via feasible_and_ties."""
         if spread_state is FullOracle._UNSET:
             spread_state = osp.build_filter_state(pod, self._all_nodes_with_pods())
+        if interpod_state is FullOracle._UNSET:
+            interpod_state = oip.build_interpod_state(
+                pod, self._all_nodes_with_pods()
+            )
         return (
             opl.node_name_filter(pod, on.node)
             and opl.node_unschedulable_filter(pod, on.node)
@@ -117,14 +127,17 @@ class FullOracle:
             and opl.node_ports_filter(pod, on.used_ports)
             and not fit_filter(pod, on.res)
             and (spread_state is None or spread_state.check(on.node))
+            and interpod_state.check(on.node)
         )
 
     def feasible_and_ties(self, pod: Pod) -> tuple[list[int], list[int]]:
-        spread_state = osp.build_filter_state(pod, self._all_nodes_with_pods())
+        all_nodes = self._all_nodes_with_pods()
+        spread_state = osp.build_filter_state(pod, all_nodes)
+        interpod_state = oip.build_interpod_state(pod, all_nodes)
         feasible = [
             i
             for i, on in enumerate(self.nodes)
-            if self.filter_one(pod, on, spread_state)
+            if self.filter_one(pod, on, spread_state, interpod_state)
         ]
         if not feasible:
             return [], []
@@ -144,6 +157,12 @@ class FullOracle:
             [(self.nodes[i].node, self.nodes[i].pods) for i in feasible],
             self._all_nodes_with_pods(),
         )
+        interpod_norm = oip.interpod_scores(
+            pod,
+            [self.nodes[i].node for i in feasible],
+            self._all_nodes_with_pods(),
+            w.hard_pod_affinity,
+        )
 
         totals: dict[int, int] = {}
         for j, i in enumerate(feasible):
@@ -156,6 +175,7 @@ class FullOracle:
                 pod, on.node, self.image_states, self.total_nodes
             )
             t += w.spread * spread_norm[j]
+            t += w.interpod * interpod_norm[j]
             totals[i] = t
         best = max(totals.values())
         ties = [i for i in feasible if totals[i] == best]

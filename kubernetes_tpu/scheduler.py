@@ -33,6 +33,7 @@ from .tensorize.plugins import (
     build_static_tensors,
     trivial_port_tensors,
 )
+from .tensorize.interpod import build_interpod_tensors
 from .tensorize.spread import build_spread_tensors
 from .tensorize.schema import build_pod_batch
 from .utils.clock import Clock
@@ -141,8 +142,20 @@ class Scheduler:
         static = build_static_tensors(pods, pbatch, slot_nodes, batch.padded)
         need_ports = any(p.host_ports() for p in pods)
         need_spread = any(r.topology_spread_constraints for r in static.reps)
+
+        def has_pod_affinity(p: Pod) -> bool:
+            return p.affinity is not None and (
+                p.affinity.pod_affinity is not None
+                or p.affinity.pod_anti_affinity is not None
+            )
+
+        need_interpod = any(has_pod_affinity(r) for r in static.reps) or any(
+            info.pods_with_affinity
+            for info in self.cache.nodes.values()
+            if info.node is not None
+        )
         placed_by_slot: dict[int, list[Pod]] = {}
-        if need_ports or need_spread:
+        if need_ports or need_spread or need_interpod:
             for slot, name in enumerate(self.snapshot.names):
                 info = self.cache.nodes.get(name) if name else None
                 if info is not None and info.node is not None and info.pods:
@@ -159,9 +172,18 @@ class Scheduler:
                 pods, static.reps, pbatch, slot_nodes,
                 placed_by_slot, batch.padded, static.c_pad,
             )
+        interpod = None
+        if need_interpod:
+            interpod = build_interpod_tensors(
+                pods, static.reps, pbatch, slot_nodes,
+                placed_by_slot, batch.padded, static.c_pad,
+                hard_pod_affinity_weight=self.config.solver.hard_pod_affinity_weight,
+            )
 
         t1 = time.perf_counter()
-        assignments = self.solver.solve(batch, pbatch, static, ports, spread)
+        assignments = self.solver.solve(
+            batch, pbatch, static, ports, spread, interpod
+        )
         res.solve_seconds = time.perf_counter() - t1
 
         for idx, (info, a) in enumerate(zip(infos, assignments)):

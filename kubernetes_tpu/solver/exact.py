@@ -38,9 +38,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import interpod as ip
 from ..ops import noderesources as nr
 from ..ops import plugins as pl
 from ..ops import spread as sp
+from ..tensorize.interpod import InterpodTensors, trivial_interpod_tensors
 from ..tensorize.plugins import (
     PortTensors,
     StaticPluginTensors,
@@ -67,6 +69,11 @@ class ExactSolverConfig:
     node_affinity_weight: int = 2
     image_weight: int = 1
     spread_weight: int = 2
+    interpod_weight: int = 2
+    # InterPodAffinityArgs.hardPodAffinityWeight (default 1) — consumed by
+    # the interpod tensorizer when building m_w rows (the scheduler passes
+    # it through to build_interpod_tensors)
+    hard_pod_affinity_weight: int = 1
     balanced_fdtype: str = "float32"  # float64 for bit-parity on CPU tests
 
 
@@ -83,14 +90,18 @@ def _solve_scan(
     w_nodeaff: int,
     w_image: int,
     w_spread: int,
+    w_interpod: int,
     use_spread: bool,
+    use_interpod: bool,
     d_pad: int,
+    ipa_d_pad: int,
     fdtype,
 ):
     alloc = tables["alloc"]
     alloc2 = alloc[: MEM_IDX + 1]  # cpu, memory rows for scoring
     weights2 = jnp.ones(2, dtype=alloc.dtype)
     spr = tables.get("spr")
+    ipa = tables.get("ipa")
 
     def step(carry, x):
         st, k = carry
@@ -107,6 +118,12 @@ def _solve_scan(
         )
         if use_spread:
             mask = mask & ~sp.hard_violations(spr, st["spr_cnt"], cls, d_pad)
+        if use_interpod:
+            ipa_allowed, ipa_raw = ip.filter_and_score(
+                ipa, st["ipa_in"], st["ipa_ex"], cls, x, ipa_d_pad,
+                tables["node_valid"],
+            )
+            mask = mask & ipa_allowed
 
         requested = nr.scoring_requested(x["nonzero_req"], st["nonzero_used"])
         score = w_fit * nr.least_allocated_score(requested, alloc2, weights2)
@@ -128,6 +145,8 @@ def _solve_scan(
             score = score + w_spread * sp.soft_scores(
                 spr, st["spr_cnt"], cls, mask, d_pad, fdtype=fdtype
             )
+        if use_interpod and w_interpod:
+            score = score + w_interpod * ip.normalize(ipa_raw, mask)
         score = jnp.where(mask, score, -1)
 
         best = jnp.max(score)
@@ -155,6 +174,16 @@ def _solve_scan(
                 if use_spread
                 else st["spr_cnt"]
             ),
+            ipa_in=(
+                st["ipa_in"].at[:, pick].add(x["ipa_in_match"] * di)
+                if use_interpod
+                else st["ipa_in"]
+            ),
+            ipa_ex=(
+                st["ipa_ex"].at[:, pick].add(x["ipa_ex_owned"] * di)
+                if use_interpod
+                else st["ipa_ex"]
+            ),
         )
         assignment = jnp.where(found, pick, -1).astype(jnp.int32)
         return (st, k), assignment
@@ -173,8 +202,11 @@ _solve_scan_jit = jax.jit(
         "w_nodeaff",
         "w_image",
         "w_spread",
+        "w_interpod",
         "use_spread",
+        "use_interpod",
         "d_pad",
+        "ipa_d_pad",
         "fdtype",
     ),
     donate_argnums=(1,),
@@ -201,12 +233,13 @@ class ExactSolver:
         static: StaticPluginTensors | None = None,
         ports: PortTensors | None = None,
         spread: SpreadTensors | None = None,
+        interpod: InterpodTensors | None = None,
     ) -> np.ndarray:
         """Returns assignments [num_pods] of node indices (-1 = unschedulable)
         and updates ``nodes``' used/nonzero_used/pod_count in place.
 
-        Without ``static``/``ports``/``spread`` tensors, a trivial
-        single-class mask (valid ∧ schedulable) reproduces the
+        Without ``static``/``ports``/``spread``/``interpod`` tensors, a
+        trivial single-class mask (valid ∧ schedulable) reproduces the
         resources-only pipeline.
         """
         cfg = self.config
@@ -219,7 +252,10 @@ class ExactSolver:
             ports = trivial_port_tensors(pods, nodes.padded)
         if spread is None:
             spread = trivial_spread_tensors(pods, nodes.padded, static.c_pad)
+        if interpod is None:
+            interpod = trivial_interpod_tensors(pods, nodes.padded, static.c_pad)
         use_spread = not spread.empty
+        use_interpod = not interpod.empty
 
         tables = {
             "alloc": jnp.asarray(nodes.allocatable),
@@ -239,6 +275,15 @@ class ExactSolver:
                 "hard": jnp.asarray(spread.hard),
                 "soft": jnp.asarray(spread.soft),
             },
+            "ipa": {
+                "in_dom": jnp.asarray(interpod.in_dom),
+                "in_pref_w": jnp.asarray(interpod.in_pref_w),
+                "cls_req_aff": jnp.asarray(interpod.cls_req_aff),
+                "cls_req_anti": jnp.asarray(interpod.cls_req_anti),
+                "cls_pref": jnp.asarray(interpod.cls_pref),
+                "ex_dom": jnp.asarray(interpod.ex_dom),
+                "ex_anti": jnp.asarray(interpod.ex_anti),
+            },
         }
         state0 = {
             "used": jnp.asarray(nodes.used),
@@ -246,6 +291,8 @@ class ExactSolver:
             "pod_count": jnp.asarray(nodes.pod_count),
             "port_used": jnp.asarray(ports.used),
             "spr_cnt": jnp.asarray(spread.cnt0),
+            "ipa_in": jnp.asarray(interpod.in_cnt0),
+            "ipa_ex": jnp.asarray(interpod.ex_cnt0),
         }
         xs = {
             "req": jnp.asarray(pods.req),
@@ -256,6 +303,11 @@ class ExactSolver:
             "pod_conflict": jnp.asarray(ports.pod_conflict),
             "pod_takes": jnp.asarray(ports.pod_takes),
             "spr_placed": jnp.asarray(spread.placed_match),
+            "ipa_in_match": jnp.asarray(interpod.in_match),
+            "ipa_ex_owned": jnp.asarray(interpod.ex_owned),
+            "ipa_m_anti": jnp.asarray(interpod.m_anti),
+            "ipa_m_w": jnp.asarray(interpod.m_w),
+            "ipa_self_aff": jnp.asarray(interpod.self_aff),
         }
         assignments, state = _solve_scan_jit(
             tables,
@@ -269,8 +321,11 @@ class ExactSolver:
             w_nodeaff=cfg.node_affinity_weight,
             w_image=cfg.image_weight,
             w_spread=cfg.spread_weight,
+            w_interpod=cfg.interpod_weight,
             use_spread=use_spread,
+            use_interpod=use_interpod,
             d_pad=spread.d_pad,
+            ipa_d_pad=interpod.d_pad,
             fdtype=fdtype,
         )
         # np.array(copy=True): np.asarray on a jax array yields a READ-ONLY

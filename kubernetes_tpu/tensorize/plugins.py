@@ -62,6 +62,16 @@ def _class_key(pod: Pod, with_images: bool):
         if pod.topology_spread_constraints
         else ()
     )
+    # InterPodAffinity: the incoming-term set depends on the pod's affinity
+    # spec AND its namespace/labels (term namespaces default to the pod's
+    # own; matchLabelKeys and the first-pod self-match read its labels)
+    pa = pod.affinity.pod_affinity if pod.affinity else None
+    paa = pod.affinity.pod_anti_affinity if pod.affinity else None
+    interpod = (
+        (pa, paa, pod.namespace, tuple(sorted(pod.labels.items())))
+        if (pa is not None or paa is not None)
+        else ()
+    )
     return (
         pod.node_name,
         tuple(sorted(pod.node_selector.items())),
@@ -70,6 +80,7 @@ def _class_key(pod: Pod, with_images: bool):
         tuple(tuple(c.images) for c in pod.containers) if with_images else (),
         len(pod.containers) if with_images else 0,
         spread,
+        interpod,
     )
 
 

@@ -1,0 +1,369 @@
+"""InterPodAffinity: oracle unit tests + solver-vs-oracle parity."""
+
+import numpy as np
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.ops.oracle import interpod as oip
+from kubernetes_tpu.ops.oracle.profile import FullOracle, make_oracle_nodes
+from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+from kubernetes_tpu.tensorize.interpod import build_interpod_tensors
+from kubernetes_tpu.tensorize.plugins import (
+    build_port_tensors,
+    build_static_tensors,
+)
+from kubernetes_tpu.tensorize.spread import build_spread_tensors
+from kubernetes_tpu.tensorize.schema import (
+    ResourceVocab,
+    build_node_batch,
+    build_pod_batch,
+)
+
+
+def zone_nodes(n, zones=2):
+    return [
+        MakeNode()
+        .name(f"node-{i:03}")
+        .capacity({"cpu": "8", "memory": "32Gi", "pods": "50"})
+        .label("zone", f"z{i % zones}")
+        .label("kubernetes.io/hostname", f"node-{i:03}")
+        .obj()
+        for i in range(n)
+    ]
+
+
+# -- oracle unit tests ------------------------------------------------------
+
+
+def test_oracle_required_affinity_needs_match_in_domain():
+    nodes = zone_nodes(4, 2)
+    backend = MakePod().name("be").label("app", "backend").obj()
+    all_nodes = [(nodes[0], [backend]), (nodes[1], []), (nodes[2], []), (nodes[3], [])]
+    pod = (
+        MakePod().name("fe").label("app", "frontend")
+        .pod_affinity("zone", match_labels={"app": "backend"})
+        .obj()
+    )
+    # backend in z0 (nodes 0, 2) -> only z0 nodes pass
+    assert oip.interpod_filter(pod, nodes[0], all_nodes)
+    assert oip.interpod_filter(pod, nodes[2], all_nodes)
+    assert not oip.interpod_filter(pod, nodes[1], all_nodes)
+    assert not oip.interpod_filter(pod, nodes[3], all_nodes)
+
+
+def test_oracle_first_pod_exception():
+    nodes = zone_nodes(2, 2)
+    all_nodes = [(n, []) for n in nodes]
+    # self-affine group bootstrap: no match anywhere + self-match -> allowed
+    pod = (
+        MakePod().name("p0").label("app", "grp")
+        .pod_affinity("zone", match_labels={"app": "grp"})
+        .obj()
+    )
+    assert oip.interpod_filter(pod, nodes[0], all_nodes)
+    # pod NOT matching its own selector: blocked everywhere
+    pod2 = (
+        MakePod().name("p1").label("app", "other")
+        .pod_affinity("zone", match_labels={"app": "grp"})
+        .obj()
+    )
+    assert not oip.interpod_filter(pod2, nodes[0], all_nodes)
+
+
+def test_oracle_anti_affinity_blocks_domain():
+    nodes = zone_nodes(4, 2)
+    noisy = MakePod().name("noisy").label("team", "red").obj()
+    all_nodes = [(nodes[0], [noisy]), (nodes[1], []), (nodes[2], []), (nodes[3], [])]
+    pod = (
+        MakePod().name("p").label("x", "y")
+        .pod_anti_affinity("zone", match_labels={"team": "red"})
+        .obj()
+    )
+    assert not oip.interpod_filter(pod, nodes[0], all_nodes)
+    assert not oip.interpod_filter(pod, nodes[2], all_nodes)  # same zone z0
+    assert oip.interpod_filter(pod, nodes[1], all_nodes)
+
+
+def test_oracle_existing_anti_symmetry():
+    nodes = zone_nodes(4, 2)
+    # existing pod REPELS app=web from its zone
+    grump = (
+        MakePod().name("grump").label("team", "solo")
+        .pod_anti_affinity("zone", match_labels={"app": "web"})
+        .obj()
+    )
+    all_nodes = [(nodes[1], [grump]), (nodes[0], []), (nodes[2], []), (nodes[3], [])]
+    web = MakePod().name("w").label("app", "web").obj()
+    assert oip.interpod_filter(web, nodes[0], all_nodes)  # z0 fine
+    assert not oip.interpod_filter(web, nodes[1], all_nodes)  # grump's zone z1
+    assert not oip.interpod_filter(web, nodes[3], all_nodes)  # z1 too
+    # non-matching pod unaffected
+    other = MakePod().name("o").label("app", "db").obj()
+    assert oip.interpod_filter(other, nodes[1], all_nodes)
+
+
+def test_oracle_preferred_scores():
+    nodes = zone_nodes(4, 2)
+    be = MakePod().name("be").label("app", "backend").obj()
+    all_nodes = [(nodes[0], [be]), (nodes[1], []), (nodes[2], []), (nodes[3], [])]
+    pod = (
+        MakePod().name("fe")
+        .preferred_pod_affinity(10, "zone", match_labels={"app": "backend"})
+        .obj()
+    )
+    raw = oip.interpod_raw_scores(pod, nodes, all_nodes)
+    assert raw == [10, 0, 10, 0]
+    norm = oip.normalize_scores(raw)
+    assert norm == [100, 0, 100, 0]
+
+
+# -- solver parity ----------------------------------------------------------
+
+
+def run_solver(nodes, pods, placed_by_node=None, tie_break="first"):
+    placed_by_node = placed_by_node or {}
+    all_pods = pods + [p for ps in placed_by_node.values() for p in ps]
+    vocab = ResourceVocab.build(all_pods, nodes)
+    nbatch = build_node_batch(nodes, placed_by_node, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - len(nodes))
+    placed_by_slot = {
+        i: placed_by_node[n.name]
+        for i, n in enumerate(nodes)
+        if n.name in placed_by_node
+    }
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded)
+    ports = build_port_tensors(pods, pbatch, slot_nodes, placed_by_slot, nbatch.padded)
+    spread = build_spread_tensors(
+        pods, static.reps, pbatch, slot_nodes, placed_by_slot,
+        nbatch.padded, static.c_pad,
+    )
+    interpod = build_interpod_tensors(
+        pods, static.reps, pbatch, slot_nodes, placed_by_slot,
+        nbatch.padded, static.c_pad,
+    )
+    solver = ExactSolver(ExactSolverConfig(tie_break=tie_break))
+    return solver.solve(nbatch, pbatch, static, ports, spread, interpod), nbatch
+
+
+def assert_parity(nodes, pods, placed_by_node=None):
+    assignments, nbatch = run_solver(nodes, pods, placed_by_node)
+    oracle = FullOracle(make_oracle_nodes(nodes, placed_by_node))
+    names = [nbatch.names[a] if a >= 0 else None for a in assignments]
+    errors = oracle.validate_assignments(pods, list(assignments), names=names)
+    assert not errors, "\n".join(errors[:5])
+    return assignments
+
+
+def test_affinity_follows_backend():
+    nodes = zone_nodes(4, 2)
+    be = MakePod().name("be").label("app", "backend").node("node-000").obj()
+    pods = [
+        MakePod().name(f"fe{i}").label("app", "frontend")
+        .req({"cpu": "100m"})
+        .pod_affinity("zone", match_labels={"app": "backend"})
+        .obj()
+        for i in range(3)
+    ]
+    a = assert_parity(nodes, pods, {"node-000": [be]})
+    assert all(x >= 0 and x % 2 == 0 for x in a)  # z0 only
+
+
+def test_anti_affinity_one_per_node():
+    nodes = zone_nodes(4, 2)
+    pods = [
+        MakePod().name(f"s{i}").label("app", "solo")
+        .req({"cpu": "100m"})
+        .pod_anti_affinity("kubernetes.io/hostname", match_labels={"app": "solo"})
+        .obj()
+        for i in range(6)
+    ]
+    a = assert_parity(nodes, pods)
+    placed = [x for x in a if x >= 0]
+    assert len(placed) == 4  # one per node
+    assert len(set(placed)) == 4
+    assert list(a).count(-1) == 2
+
+
+def test_self_affine_group_bootstraps_and_clusters():
+    nodes = zone_nodes(6, 3)
+    pods = [
+        MakePod().name(f"g{i}").label("app", "grp")
+        .req({"cpu": "100m"})
+        .pod_affinity("zone", match_labels={"app": "grp"})
+        .obj()
+        for i in range(4)
+    ]
+    a = assert_parity(nodes, pods)
+    assert all(x >= 0 for x in a)
+    zones = {int(x) % 3 for x in a}
+    assert len(zones) == 1  # the group stays in one zone
+
+
+def test_existing_anti_symmetry_through_solver():
+    nodes = zone_nodes(4, 2)
+    grump = (
+        MakePod().name("grump").label("team", "solo").node("node-001")
+        .pod_anti_affinity("zone", match_labels={"app": "web"})
+        .obj()
+    )
+    pods = [
+        MakePod().name(f"w{i}").label("app", "web").req({"cpu": "100m"}).obj()
+        for i in range(3)
+    ]
+    a = assert_parity(nodes, pods, {"node-001": [grump]})
+    assert all(x >= 0 and x % 2 == 0 for x in a)  # pushed to z0
+
+
+def test_batch_pods_repel_each_other():
+    # anti-affinity among batch pods placed in the SAME scan: the in-batch
+    # symmetry update (ex_owned fold-in) must block later pods
+    nodes = zone_nodes(3, 3)
+    pods = [
+        MakePod().name(f"z{i}").label("app", "zoned")
+        .req({"cpu": "100m"})
+        .pod_anti_affinity("zone", match_labels={"app": "zoned"})
+        .obj()
+        for i in range(5)
+    ]
+    a = assert_parity(nodes, pods)
+    placed = [x for x in a if x >= 0]
+    assert len(placed) == 3  # one per zone
+    assert len(set(x % 3 for x in placed)) == 3
+    assert list(a).count(-1) == 2
+
+
+def test_preferred_affinity_steers():
+    nodes = zone_nodes(4, 2)
+    be = MakePod().name("be").label("app", "backend").node("node-001").obj()
+    pods = [
+        MakePod().name(f"p{i}")
+        .req({"cpu": "100m"})
+        .preferred_pod_affinity(50, "zone", match_labels={"app": "backend"})
+        .obj()
+        for i in range(3)
+    ]
+    a = assert_parity(nodes, pods, {"node-001": [be]})
+    assert all(x % 2 == 1 for x in a)  # z1 preferred
+
+
+def test_hard_pod_affinity_weight_symmetry_scoring():
+    # existing pod with REQUIRED affinity toward app=web: symmetric scoring
+    # nudges web pods toward its zone via hardPodAffinityWeight
+    nodes = zone_nodes(4, 2)
+    lover = (
+        MakePod().name("lover").label("team", "fans").node("node-001")
+        .pod_affinity("zone", match_labels={"app": "web"})
+        .obj()
+    )
+    pods = [
+        MakePod().name(f"w{i}").label("app", "web").req({"cpu": "100m"}).obj()
+        for i in range(2)
+    ]
+    # NB: lover itself violates its own required affinity (no web pods yet)
+    # but it is already placed — the scheduler only checks incoming pods.
+    a = assert_parity(nodes, pods, {"node-001": [lover]})
+    assert all(x >= 0 and x % 2 == 1 for x in a)
+
+
+def test_match_label_keys_interpod():
+    # anti-affinity with matchLabelKeys=[version]: only same-version pods
+    # repel; different versions co-exist per zone
+    from kubernetes_tpu.api.labels import selector_from_match_labels
+    from kubernetes_tpu.api.objects import Affinity, PodAffinity, PodAffinityTerm
+
+    nodes = zone_nodes(4, 2)
+    pods = []
+    for i in range(4):
+        b = (
+            MakePod().name(f"v{i}").label("app", "web")
+            .label("version", f"v{i % 2}").req({"cpu": "100m"})
+        )
+        b._pod.affinity = Affinity(
+            pod_anti_affinity=PodAffinity(
+                required=(
+                    PodAffinityTerm(
+                        label_selector=selector_from_match_labels({"app": "web"}),
+                        topology_key="zone",
+                        match_label_keys=("version",),
+                    ),
+                )
+            )
+        )
+        pods.append(b.obj())
+    a = assert_parity(nodes, pods)
+    assert all(x >= 0 for x in a)
+    # same-version pods must sit in different zones
+    for v in range(2):
+        zs = [int(a[i]) % 2 for i in range(4) if i % 2 == v]
+        assert len(set(zs)) == 2
+
+
+def test_hard_pod_affinity_weight_plumbed():
+    # non-default hardPodAffinityWeight must flow tensorizer<->oracle alike
+    from kubernetes_tpu.ops.oracle.profile import ProfileWeights
+
+    nodes = zone_nodes(4, 2)
+    lover = (
+        MakePod().name("lover").label("team", "fans").node("node-001")
+        .pod_affinity("zone", match_labels={"app": "web"})
+        .obj()
+    )
+    pods = [
+        MakePod().name(f"w{i}").label("app", "web").req({"cpu": "100m"}).obj()
+        for i in range(2)
+    ]
+    placed = {"node-001": [lover]}
+    all_pods = pods + [lover]
+    vocab = ResourceVocab.build(all_pods, nodes)
+    nbatch = build_node_batch(nodes, placed, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - len(nodes))
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded)
+    ports = build_port_tensors(pods, pbatch, slot_nodes, {1: [lover]}, nbatch.padded)
+    spread = build_spread_tensors(
+        pods, static.reps, pbatch, slot_nodes, {1: [lover]},
+        nbatch.padded, static.c_pad,
+    )
+    interpod = build_interpod_tensors(
+        pods, static.reps, pbatch, slot_nodes, {1: [lover]},
+        nbatch.padded, static.c_pad, hard_pod_affinity_weight=7,
+    )
+    solver = ExactSolver(ExactSolverConfig(tie_break="first"))
+    a = solver.solve(nbatch, pbatch, static, ports, spread, interpod)
+    oracle = FullOracle(
+        make_oracle_nodes(nodes, placed),
+        ProfileWeights(hard_pod_affinity=7),
+    )
+    names = [nbatch.names[x] if x >= 0 else None for x in a]
+    errors = oracle.validate_assignments(pods, list(a), names=names)
+    assert not errors, errors[:3]
+    assert all(x % 2 == 1 for x in a)
+
+
+def test_mixed_affinity_cluster_parity():
+    rng = np.random.default_rng(11)
+    nodes = zone_nodes(8, 2)
+    placed = {
+        "node-000": [MakePod().name("be0").label("app", "backend").node("node-000").obj()],
+        "node-003": [MakePod().name("be1").label("app", "backend").node("node-003").obj()],
+    }
+    pods = []
+    for i in range(20):
+        b = MakePod().name(f"m{i:02}").req({"cpu": "200m"})
+        r = rng.random()
+        if r < 0.3:
+            b = b.label("app", "frontend").pod_affinity(
+                "zone", match_labels={"app": "backend"}
+            )
+        elif r < 0.5:
+            b = b.label("app", "solo").pod_anti_affinity(
+                "kubernetes.io/hostname", match_labels={"app": "solo"}
+            )
+        elif r < 0.7:
+            b = b.label("app", "web").preferred_pod_affinity(
+                int(rng.integers(1, 100)), "zone", match_labels={"app": "backend"}
+            )
+        else:
+            b = b.label("app", "plain")
+        pods.append(b.obj())
+    assert_parity(nodes, pods, placed)
