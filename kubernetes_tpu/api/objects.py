@@ -681,6 +681,71 @@ class Pod:
 
 
 # ---------------------------------------------------------------------------
+# PodDisruptionBudget (policy/v1) — the slice preemption reads
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PodDisruptionBudget:
+    """[BOUNDARY] minimal PDB: preemption dry-run reads selector matching
+    and status.disruptionsAllowed (policy/v1#PodDisruptionBudget,
+    preemption.go#filterPodsWithPDBViolation). The controller deriving
+    disruptionsAllowed from minAvailable/maxUnavailable is out of scope —
+    callers set the allowance directly (tests mirror how integration tests
+    seed PDB status)."""
+
+    name: str = ""
+    namespace: str = "default"
+    selector: Selector | None = None
+    disruptions_allowed: int = 0
+    min_available: int | str | None = None  # parsed but not enforced
+    max_unavailable: int | str | None = None
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def matches(self, pod: "Pod") -> bool:
+        return (
+            pod.namespace == self.namespace
+            and self.selector is not None
+            and self.selector.matches(pod.labels)
+        )
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PodDisruptionBudget":
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return PodDisruptionBudget(
+            name=meta.get("name") or "",
+            namespace=meta.get("namespace") or "default",
+            selector=selector_from_label_selector(spec.get("selector")),
+            disruptions_allowed=int(status.get("disruptionsAllowed") or 0),
+            min_available=spec.get("minAvailable"),
+            max_unavailable=spec.get("maxUnavailable"),
+            resource_version=int(meta.get("resourceVersion") or 0),
+        )
+
+    def to_dict(self) -> dict:
+        spec: dict[str, Any] = {}
+        if self.selector is not None:
+            spec["selector"] = label_selector_to_dict(self.selector)
+        if self.min_available is not None:
+            spec["minAvailable"] = self.min_available
+        if self.max_unavailable is not None:
+            spec["maxUnavailable"] = self.max_unavailable
+        return {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": spec,
+            "status": {"disruptionsAllowed": self.disruptions_allowed},
+        }
+
+
+# ---------------------------------------------------------------------------
 # Node
 # ---------------------------------------------------------------------------
 

@@ -61,6 +61,7 @@ def filter_and_score(ipa, in_cnt, ex_cnt, cls, x, d_pad: int, node_valid):
     # 3. incoming required affinity + first-pod special case
     sa = ipa["cls_req_aff"].shape[1]
     all_ok = jnp.ones(n, dtype=bool)
+    has_all_keys = jnp.ones(n, dtype=bool)
     total_any = jnp.int32(0)
     has_aff = ipa["cls_req_aff"][cls, 0] >= 0
     for s in range(sa):
@@ -69,12 +70,15 @@ def filter_and_score(ipa, in_cnt, ex_cnt, cls, x, d_pad: int, node_valid):
         jj = jnp.maximum(j, 0)
         ok_t = in_hk[jj] & (in_counts[jj] > 0)
         all_ok = all_ok & jnp.where(active, ok_t, True)
+        has_all_keys = has_all_keys & jnp.where(active, in_hk[jj], True)
         total_any = total_any + jnp.where(
             active,
             jnp.sum(jnp.where(in_hk[jj] & node_valid, in_cnt[jj], 0)),
             0,
         )
-    first_pod = (total_any == 0) & x["ipa_self_aff"]
+    # first-pod special case never admits a node missing a topology key
+    # (filtering.go#satisfyPodAffinity)
+    first_pod = (total_any == 0) & x["ipa_self_aff"] & has_all_keys
     aff_ok = jnp.where(has_aff, all_ok | first_pod, True)
 
     allowed = ~blocked & ~viol & aff_ok

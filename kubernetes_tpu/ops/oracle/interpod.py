@@ -127,8 +127,15 @@ class InterpodFilterState:
             if v is not None and v in occupied:
                 return False
         if self.aff_terms:
+            # all topology keys must exist on the node — even the first-pod
+            # special case cannot admit a keyless node
+            # (filtering.go#satisfyPodAffinity)
+            if any(
+                labels.get(t.topology_key) is None for t, _ in self.aff_terms
+            ):
+                return False
             all_satisfied = all(
-                labels.get(t.topology_key) in matched
+                labels[t.topology_key] in matched
                 for t, matched in self.aff_terms
             )
             if not all_satisfied:

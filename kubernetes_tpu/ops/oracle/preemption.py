@@ -1,0 +1,157 @@
+"""Scalar oracle for preemption (defaultpreemption PostFilter).
+
+Transcription of pkg/scheduler/framework/preemption/preemption.go#Evaluator
++ plugins/defaultpreemption/default_preemption.go (SURVEY.md §3.1, §8.5):
+
+- SelectVictimsOnNode: clone node state, remove ALL pods with priority <
+  incoming; if the pod still doesn't fit -> node is not a candidate. Then
+  try to reprieve victims: PDB-violating candidates first, then
+  non-violating, each bucket in MoreImportantPod order (priority desc,
+  earlier start first); a reprieved pod is re-added if the incoming pod
+  still fits alongside it. Whatever cannot be reprieved is the victim set.
+- filterPodsWithPDBViolation: a candidate violates if any matching PDB has
+  no disruptions left (counters decrement as non-violating candidates are
+  classified).
+- pickOneNodeForPreemption lexicographic: fewest PDB violations -> lowest
+  highest-victim-priority -> smallest priority sum -> fewest victims ->
+  latest start among highest-priority victims -> first node in list order.
+
+Scope note (shared with the device kernel in solver/preemption.py): the
+re-add feasibility check is NodeResourcesFit + pod count (the reference
+reruns the full filter pipeline per reprieve, RunFilterPluginsWithNominated
+Pods); static per-node feasibility of the incoming pod (taints/affinity/
+nodeName) gates candidacy up front. Ports/affinity/spread interactions
+with victim removal are a documented divergence to be tightened later.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Sequence
+
+from ...api.objects import Node, Pod, PodDisruptionBudget
+
+__all__ = [
+    "PodDisruptionBudget",
+    "more_important",
+    "sort_more_important",
+    "classify_pdb_violations",
+    "NodeVictims",
+    "select_victims_on_node",
+    "pick_one_node",
+]
+
+PREEMPT_NEVER = "Never"
+
+
+def more_important(p1: Pod, p2: Pod) -> bool:
+    """util.MoreImportantPod: higher priority first; tie -> earlier start
+    (longer-running) first."""
+    if p1.effective_priority != p2.effective_priority:
+        return p1.effective_priority > p2.effective_priority
+    return p1.start_time < p2.start_time
+
+
+def sort_more_important(pods: Sequence[Pod]) -> list[Pod]:
+    return sorted(
+        pods, key=lambda p: (-p.effective_priority, p.start_time, p.key)
+    )
+
+
+def classify_pdb_violations(
+    candidates: Sequence[Pod], pdbs: Sequence[PodDisruptionBudget]
+) -> tuple[list[Pod], list[Pod]]:
+    """filterPodsWithPDBViolation: (violating, non_violating); counters
+    decrement as non-violating candidates claim allowance."""
+    allowed = [p.disruptions_allowed for p in pdbs]
+    violating: list[Pod] = []
+    non_violating: list[Pod] = []
+    for pod in candidates:
+        matching = [i for i, pdb in enumerate(pdbs) if pdb.matches(pod)]
+        if any(allowed[i] <= 0 for i in matching):
+            violating.append(pod)
+        else:
+            for i in matching:
+                allowed[i] -= 1
+            non_violating.append(pod)
+    return violating, non_violating
+
+
+@dataclass
+class NodeVictims:
+    victims: list[Pod]
+    num_violating: int
+
+
+def select_victims_on_node(
+    pod: Pod,
+    node_alloc: Mapping[str, int],
+    max_pods: int,
+    pods_on_node: Sequence[Pod],
+    pdbs: Sequence[PodDisruptionBudget] = (),
+) -> NodeVictims | None:
+    """Fit-only dry run. Returns None if even evicting every lower-priority
+    pod cannot make room."""
+    prio = pod.effective_priority
+    keep = [q for q in pods_on_node if q.effective_priority >= prio]
+    potential = [q for q in pods_on_node if q.effective_priority < prio]
+
+    def fits(current: Sequence[Pod]) -> bool:
+        used: dict[str, int] = {}
+        for q in current:
+            for k, v in q.resource_request().items():
+                used[k] = used.get(k, 0) + v
+        for k, v in pod.resource_request().items():
+            if v and used.get(k, 0) + v > node_alloc.get(k, 0):
+                return False
+        return len(current) + 1 <= max_pods
+
+    if not fits(keep):
+        return None
+
+    violating, non_violating = classify_pdb_violations(
+        sort_more_important(potential), pdbs
+    )
+    current = list(keep)
+    victims: list[Pod] = []
+    num_violating = 0
+    for bucket, counts in ((violating, True), (non_violating, False)):
+        for q in sort_more_important(bucket):
+            if fits(current + [q]):
+                current.append(q)  # reprieved
+            else:
+                victims.append(q)
+                if counts:
+                    num_violating += 1
+    return NodeVictims(victims=victims, num_violating=num_violating)
+
+
+def pick_one_node(
+    candidates: Mapping[str, NodeVictims], node_order: Sequence[str]
+) -> str | None:
+    """pickOneNodeForPreemption lexicographic ordering."""
+    if not candidates:
+        return None
+
+    def key(name: str):
+        nv = candidates[name]
+        if not nv.victims:
+            # a no-victim candidate wins immediately upstream
+            return (0, -(1 << 62), 0, 0, float("-inf"))
+        max_prio = max(q.effective_priority for q in nv.victims)
+        sum_prio = sum(q.effective_priority for q in nv.victims)
+        latest_start_of_top = max(
+            q.start_time
+            for q in nv.victims
+            if q.effective_priority == max_prio
+        )
+        return (
+            nv.num_violating,
+            max_prio,
+            sum_prio,
+            len(nv.victims),
+            -latest_start_of_top,
+        )
+
+    ordered = [n for n in node_order if n in candidates]
+    return min(ordered, key=key)

@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 
 from .api.objects import Pod
 from .solver.exact import ExactSolver, ExactSolverConfig
+from .solver.preemption import PreemptionEvaluator
 from .state.cache import SchedulerCache
 from .state.cluster import ApiError, ClusterState, Event
 from .state.queue import PriorityQueue, QueuedPodInfo
@@ -44,6 +45,8 @@ class SchedulerConfig:
     batch_size: int = 1024  # max pods per device solve
     solver: ExactSolverConfig = field(default_factory=ExactSolverConfig)
     assume_ttl: float = 30.0
+    # defaultpreemption: run the PostFilter dry-run for unschedulable pods
+    enable_preemption: bool = True
 
 
 @dataclass
@@ -51,6 +54,8 @@ class BatchResult:
     scheduled: list[tuple[str, str]] = field(default_factory=list)  # (pod, node)
     unschedulable: list[str] = field(default_factory=list)
     bind_failures: list[tuple[str, str]] = field(default_factory=list)  # (pod, err)
+    # (pod, nominated node, victim keys) per successful preemption
+    preemptions: list[tuple[str, str, list[str]]] = field(default_factory=list)
     solve_seconds: float = 0.0
     host_seconds: float = 0.0
     # per-pod schedule latency (pop -> bind committed), for the p99 metric
@@ -71,6 +76,7 @@ class Scheduler:
         self.queue = PriorityQueue(self.clock)
         self.snapshot = Snapshot()
         self.solver = ExactSolver(self.config.solver)
+        self.preemptor = PreemptionEvaluator()
 
         # initial informer sync (WaitForCacheSync equivalent)
         for node in cluster.list_nodes():
@@ -186,10 +192,16 @@ class Scheduler:
         )
         res.solve_seconds = time.perf_counter() - t1
 
+        preempt_placed: dict[int, list[Pod]] | None = None
         for idx, (info, a) in enumerate(zip(infos, assignments)):
             pod = info.pod
             cycle = base_cycle + idx + 1
             if a < 0:
+                # failure path: PostFilter (defaultpreemption) -> park
+                if self.config.enable_preemption:
+                    if preempt_placed is None:
+                        preempt_placed = self._placed_by_slot()
+                    self._try_preempt(pod, static, idx, res, preempt_placed)
                 res.unschedulable.append(pod.key)
                 self.queue.add_unschedulable(info, cycle)
                 continue
@@ -216,6 +228,81 @@ class Scheduler:
 
         res.host_seconds = time.perf_counter() - t0 - res.solve_seconds
         return res
+
+    # -- PostFilter: defaultpreemption (preemption.go#Evaluator.Preempt) --
+
+    def _placed_by_slot(self) -> dict[int, list[Pod]]:
+        out: dict[int, list[Pod]] = {}
+        for slot, name in enumerate(self.snapshot.names):
+            ninfo = self.cache.nodes.get(name) if name else None
+            if ninfo is not None and ninfo.node is not None and ninfo.pods:
+                out[slot] = list(ninfo.pods.values())
+        return out
+
+    def _try_preempt(
+        self,
+        pod: Pod,
+        static,
+        idx: int,
+        res: BatchResult,
+        placed_by_slot: dict[int, list[Pod]],
+    ) -> str | None:
+        if pod.preemption_policy == "Never":
+            return None
+        prio = pod.effective_priority
+        # cheap pre-check: any lower-priority pod anywhere?
+        if not any(
+            q.effective_priority < prio
+            for placed in placed_by_slot.values()
+            for q in placed
+        ):
+            return None
+
+        batch = self.snapshot.batch
+        static_row = static.mask[static.class_of[idx]]
+        result = self.preemptor.evaluate(
+            pod, batch, self.snapshot.names, placed_by_slot, static_row,
+            self.cluster.list_pdbs(),
+        )
+        if result is None:
+            return None
+        # prepareCandidate: API-delete victims; clear lower-priority
+        # nominations on the node; set our nominatedNodeName. Keep the
+        # shared placed_by_slot in sync so later pods in this batch see the
+        # evictions (the cache also updates via the DELETED watch events).
+        victim_keys = {v.key for v in result.victims}
+        for victim in result.victims:
+            try:
+                self.cluster.delete_pod(victim.namespace, victim.name)
+            except ApiError:
+                pass  # already gone — fine
+        for slot, placed in list(placed_by_slot.items()):
+            remaining = [q for q in placed if q.key not in victim_keys]
+            if len(remaining) != len(placed):
+                if remaining:
+                    placed_by_slot[slot] = remaining
+                else:
+                    del placed_by_slot[slot]
+        for other in self.cluster.list_pods():
+            if (
+                not other.node_name
+                and other.nominated_node_name == result.node_name
+                and other.effective_priority < prio
+            ):
+                self.cluster.patch_pod_status(
+                    other.namespace, other.name, nominated_node_name=""
+                )
+        try:
+            self.cluster.patch_pod_status(
+                pod.namespace, pod.name, nominated_node_name=result.node_name
+            )
+        except ApiError:
+            return None  # pod vanished mid-preemption
+        pod.nominated_node_name = result.node_name
+        res.preemptions.append(
+            (pod.key, result.node_name, [v.key for v in result.victims])
+        )
+        return result.node_name
 
     def run_until_settled(self, max_batches: int = 10_000) -> list[BatchResult]:
         """Drain the active queue (benchmark / test driver)."""

@@ -1,0 +1,211 @@
+"""Batched preemption dry-run (SURVEY.md §8.5).
+
+The reference dry-runs SelectVictimsOnNode per candidate node inside a
+16-way parallel-for (preemption.go#DryRunPreemption). Here ONE compiled
+program evaluates every node at once:
+
+- Phase A: remove ALL lower-priority pods per node (their aggregated
+  requests arrive precomputed as ``lower_sum``), assume the incoming pod,
+  check fit -> candidate mask over the whole node axis.
+- Phase B: greedy reprieve as a lax.scan over the per-node victim-slot axis
+  (PDB-violating candidates first, then non-violating, each in
+  MoreImportantPod order — the ordering is precompiled host-side into the
+  slot order, so the device loop is just "does it still fit if I re-add
+  slot s", vectorized over nodes).
+- Phase C: per-node victim statistics for pickOneNodeForPreemption
+  (violations, max/sum victim priority, victim count, latest start among
+  top-priority victims); the final lexicographic argmin runs host-side on
+  [N] arrays.
+
+Candidacy is gated on the pod's static per-node feasibility (taints,
+affinity, nodeName, unschedulable) — preemption cannot resolve those, which
+mirrors the reference skipping UnschedulableAndUnresolvable nodes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.objects import Node, Pod
+from ..ops.oracle.preemption import (
+    PodDisruptionBudget,
+    classify_pdb_violations,
+    sort_more_important,
+)
+from ..tensorize.schema import NodeBatch, bucket_pow2
+
+SLOT_PAD = 8
+NEG = -(1 << 30)
+
+
+def _preempt_scan(
+    alloc,  # [K, N]
+    max_pods,  # [N]
+    keep_used,  # [K, N] — usage by pods that stay (priority >= incoming)
+    keep_cnt,  # [N]
+    static_ok,  # [N] bool
+    req,  # [K]
+    cand_req,  # [S, K, N] — reprieve-ordered victim-candidate requests
+    cand_active,  # [S, N] bool
+    cand_viol,  # [S, N] bool
+    cand_prio,  # [S, N] int32
+    cand_start,  # [S, N] float32
+):
+    base_used = keep_used + req[:, None]
+    fits_all = (
+        jnp.all(base_used <= alloc, axis=0)
+        & (keep_cnt + 1 <= max_pods)
+        & static_ok
+    )
+
+    def step(carry, xs):
+        used_cur, cnt_cur = carry
+        c_req, c_active = xs
+        try_used = used_cur + c_req
+        ok = (
+            jnp.all(try_used <= alloc, axis=0)
+            & (cnt_cur + 1 <= max_pods)
+            & c_active
+        )
+        used_cur = jnp.where(ok[None, :], try_used, used_cur)
+        cnt_cur = cnt_cur + ok.astype(cnt_cur.dtype)
+        victim = c_active & ~ok
+        return (used_cur, cnt_cur), victim
+
+    (_, _), victims = jax.lax.scan(
+        step, (base_used, keep_cnt + 1), (cand_req, cand_active)
+    )  # victims: [S, N]
+
+    n_victims = jnp.sum(victims, axis=0).astype(jnp.int32)
+    n_viol = jnp.sum(victims & cand_viol, axis=0).astype(jnp.int32)
+    vic_prio = jnp.where(victims, cand_prio, NEG)
+    max_prio = jnp.max(vic_prio, axis=0)
+    sum_prio = jnp.sum(jnp.where(victims, cand_prio, 0), axis=0)
+    top = victims & (cand_prio == max_prio[None, :])
+    latest_top_start = jnp.max(
+        jnp.where(top, cand_start, -jnp.inf), axis=0
+    )
+    return fits_all, victims, n_victims, n_viol, max_prio, sum_prio, latest_top_start
+
+
+_preempt_scan_jit = jax.jit(_preempt_scan)
+
+
+@dataclass
+class PreemptionResult:
+    node_name: str
+    victims: list[Pod]
+    num_violating: int
+
+
+class PreemptionEvaluator:
+    """Host driver: builds the per-pod candidate tensors, runs the batched
+    dry-run, applies pickOneNodeForPreemption."""
+
+    def evaluate(
+        self,
+        pod: Pod,
+        nodes: NodeBatch,
+        slot_names: list[str],
+        placed_by_slot: dict[int, list[Pod]],
+        static_row: np.ndarray,  # [Np] bool — pod's static feasibility
+        pdbs: list[PodDisruptionBudget] | None = None,
+    ) -> PreemptionResult | None:
+        if pod.preemption_policy == "Never":
+            return None
+        pdbs = pdbs or []
+        n_pad = nodes.padded
+        k = len(nodes.vocab)
+        prio = pod.effective_priority
+
+        keep_used = np.zeros((k, n_pad), dtype=np.int64)
+        keep_cnt = np.zeros(n_pad, dtype=np.int32)
+        # slot -> (reprieve-ordered candidates, PDB-violating keys)
+        slot_candidates: dict[int, tuple[list[Pod], set]] = {}
+        max_slots = 1
+        for slot, placed in placed_by_slot.items():
+            if slot >= n_pad:
+                continue
+            lower = [q for q in placed if q.effective_priority < prio]
+            for q in placed:
+                if q.effective_priority >= prio:
+                    keep_used[:, slot] += nodes.vocab.vectorize(
+                        q.resource_request()
+                    )
+                    keep_cnt[slot] += 1
+            if lower:
+                violating, non_violating = classify_pdb_violations(
+                    sort_more_important(lower), pdbs
+                )
+                ordered = sort_more_important(violating) + sort_more_important(
+                    non_violating
+                )
+                slot_candidates[slot] = (ordered, {q.key for q in violating})
+                max_slots = max(max_slots, len(ordered))
+        # nodes with no placed pods: keep arrays stay zero
+
+        s_pad = bucket_pow2(max_slots, floor=SLOT_PAD)
+        cand_req = np.zeros((s_pad, k, n_pad), dtype=np.int64)
+        cand_active = np.zeros((s_pad, n_pad), dtype=bool)
+        cand_viol = np.zeros((s_pad, n_pad), dtype=bool)
+        cand_prio = np.zeros((s_pad, n_pad), dtype=np.int32)
+        cand_start = np.zeros((s_pad, n_pad), dtype=np.float32)
+        for slot, (ordered, viol_keys) in slot_candidates.items():
+            for s, q in enumerate(ordered):
+                cand_req[s, :, slot] = nodes.vocab.vectorize(q.resource_request())
+                cand_active[s, slot] = True
+                cand_viol[s, slot] = q.key in viol_keys
+                cand_prio[s, slot] = q.effective_priority
+                cand_start[s, slot] = q.start_time
+
+        req = nodes.vocab.vectorize(pod.resource_request())
+        out = _preempt_scan_jit(
+            jnp.asarray(nodes.allocatable),
+            jnp.asarray(nodes.max_pods),
+            jnp.asarray(keep_used),
+            jnp.asarray(keep_cnt),
+            jnp.asarray(static_row & nodes.valid),
+            jnp.asarray(req),
+            jnp.asarray(cand_req),
+            jnp.asarray(cand_active),
+            jnp.asarray(cand_viol),
+            jnp.asarray(cand_prio),
+            jnp.asarray(cand_start),
+        )
+        fits_all, victims, n_victims, n_viol, max_prio, sum_prio, latest = (
+            np.asarray(x) for x in out
+        )
+
+        # Zero-victim "candidates" mean the pod fits the node without any
+        # eviction — i.e. the solve failed there for a reason this fit-only
+        # dry-run cannot see (ports/affinity/spread). The reference's
+        # DryRunPreemption reruns the full filters and would never offer
+        # such a node; excluding them avoids nominating a node and
+        # "preempting" nothing.
+        cand_idx = np.flatnonzero(fits_all & (n_victims > 0))
+        if cand_idx.size == 0:
+            return None
+        # pickOneNodeForPreemption lexicographic via numpy lexsort
+        # (last key is primary)
+        order = np.lexsort(
+            (
+                cand_idx,  # stable node order last-resort tie-break
+                -latest[cand_idx],
+                n_victims[cand_idx],
+                sum_prio[cand_idx],
+                max_prio[cand_idx],
+                n_viol[cand_idx],
+            )
+        )
+        best = int(cand_idx[order[0]])
+        ordered, _ = slot_candidates.get(best, ([], set()))
+        chosen = [q for s, q in enumerate(ordered) if victims[s, best]]
+        return PreemptionResult(
+            node_name=slot_names[best],
+            victims=chosen,
+            num_violating=int(n_viol[best]),
+        )

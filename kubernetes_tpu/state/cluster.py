@@ -24,7 +24,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Literal
 
-from ..api.objects import Node, Pod
+from ..api.objects import Node, Pod, PodDisruptionBudget
 
 EventType = Literal["ADDED", "MODIFIED", "DELETED"]
 
@@ -55,6 +55,7 @@ class ClusterState:
         self._rv = 0
         self._pods: dict[str, Pod] = {}  # key = ns/name
         self._nodes: dict[str, Node] = {}
+        self._pdbs: dict[str, PodDisruptionBudget] = {}
         self._watchers: list[Watcher] = []
         # fault injection: called with (pod, node_name) before a bind commits;
         # raise ApiError to simulate apiserver-side rejection
@@ -175,6 +176,24 @@ class ClusterState:
 
     def list_nodes(self) -> list[Node]:
         return list(self._nodes.values())
+
+    # -- PodDisruptionBudgets (policy/v1 slice preemption reads) --
+
+    def create_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        if pdb.key in self._pdbs:
+            raise ApiError("AlreadyExists", pdb.key)
+        pdb.resource_version = self._next_rv()
+        self._pdbs[pdb.key] = pdb
+        return pdb
+
+    def delete_pdb(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        if self._pdbs.pop(key, None) is None:
+            raise ApiError("NotFound", key)
+        self._next_rv()
+
+    def list_pdbs(self) -> list[PodDisruptionBudget]:
+        return list(self._pdbs.values())
 
     # -- bulk helpers for benchmarks --
 
