@@ -1,0 +1,117 @@
+"""Scheduler metrics with the reference's metric names
+(pkg/scheduler/metrics/metrics.go, SURVEY.md §6.5) so existing dashboards
+port, plus TPU-solve-specific series.
+
+Uses prometheus_client against a dedicated registry (the [BOUNDARY]
+equivalent of component-base metrics/legacyregistry); `render()` emits the
+exposition text the /metrics endpoint serves.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+REGISTRY = CollectorRegistry()
+
+_BUCKETS = (
+    0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0,
+    10.0, 20.0,
+)
+
+# -- reference names (pkg/scheduler/metrics) --
+
+schedule_attempts_total = Counter(
+    "scheduler_schedule_attempts_total",
+    "Number of attempts to schedule pods, by result.",
+    ["result", "profile"],
+    registry=REGISTRY,
+)
+scheduling_attempt_duration_seconds = Histogram(
+    "scheduler_scheduling_attempt_duration_seconds",
+    "Scheduling attempt latency (scheduling algorithm + binding).",
+    ["result", "profile"],
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+pod_scheduling_attempts = Histogram(
+    "scheduler_pod_scheduling_attempts",
+    "Number of attempts to successfully schedule a pod.",
+    buckets=(1, 2, 4, 8, 16),
+    registry=REGISTRY,
+)
+pod_scheduling_sli_duration_seconds = Histogram(
+    "scheduler_pod_scheduling_sli_duration_seconds",
+    "E2e latency for a pod being scheduled, from first queue add.",
+    ["attempts"],
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+framework_extension_point_duration_seconds = Histogram(
+    "scheduler_framework_extension_point_duration_seconds",
+    "Latency for running all plugins of an extension point.",
+    ["extension_point", "status", "profile"],
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+plugin_execution_duration_seconds = Histogram(
+    "scheduler_plugin_execution_duration_seconds",
+    "Duration for running a plugin at a specific extension point.",
+    ["plugin", "extension_point", "status"],
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+pending_pods = Gauge(
+    "scheduler_pending_pods",
+    "Pending pods, by queue (active|backoff|unschedulable|gated).",
+    ["queue"],
+    registry=REGISTRY,
+)
+queue_incoming_pods_total = Counter(
+    "scheduler_queue_incoming_pods_total",
+    "Number of pods added to scheduling queues by event and queue type.",
+    ["queue", "event"],
+    registry=REGISTRY,
+)
+preemption_attempts_total = Counter(
+    "scheduler_preemption_attempts_total",
+    "Total preemption attempts in the cluster.",
+    registry=REGISTRY,
+)
+preemption_victims = Histogram(
+    "scheduler_preemption_victims",
+    "Number of selected preemption victims.",
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+    registry=REGISTRY,
+)
+
+# -- TPU-solve specific (SURVEY §6.5 additions) --
+
+solve_latency_seconds = Histogram(
+    "scheduler_tpu_solve_latency_seconds",
+    "Device solve wall time per batch.",
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+solve_batch_size = Histogram(
+    "scheduler_tpu_solve_batch_size",
+    "Pods per device solve.",
+    buckets=(1, 8, 32, 128, 512, 1024, 4096, 16384, 65536),
+    registry=REGISTRY,
+)
+tensorize_seconds = Histogram(
+    "scheduler_tpu_tensorize_seconds",
+    "Host-side tensorization time per batch.",
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+
+
+def render() -> bytes:
+    """Prometheus exposition text for the /metrics endpoint."""
+    return generate_latest(REGISTRY)

@@ -130,20 +130,10 @@ class FullOracle:
             and interpod_state.check(on.node)
         )
 
-    def feasible_and_ties(self, pod: Pod) -> tuple[list[int], list[int]]:
-        all_nodes = self._all_nodes_with_pods()
-        spread_state = osp.build_filter_state(pod, all_nodes)
-        interpod_state = oip.build_interpod_state(pod, all_nodes)
-        feasible = [
-            i
-            for i, on in enumerate(self.nodes)
-            if self.filter_one(pod, on, spread_state, interpod_state)
-        ]
-        if not feasible:
-            return [], []
+    def score_totals(self, pod: Pod, feasible: list[int]) -> dict[int, int]:
+        """Weighted, per-plugin-normalized totals over the feasible set
+        (RunScorePlugins + NormalizeScore + weights)."""
         w = self.weights
-
-        # raw per-plugin scores over the feasible set
         taint_raw = [
             opl.taint_toleration_score(pod, self.nodes[i].node) for i in feasible
         ]
@@ -177,6 +167,23 @@ class FullOracle:
             t += w.spread * spread_norm[j]
             t += w.interpod * interpod_norm[j]
             totals[i] = t
+        return totals
+
+    def feasible_set(self, pod: Pod) -> list[int]:
+        all_nodes = self._all_nodes_with_pods()
+        spread_state = osp.build_filter_state(pod, all_nodes)
+        interpod_state = oip.build_interpod_state(pod, all_nodes)
+        return [
+            i
+            for i, on in enumerate(self.nodes)
+            if self.filter_one(pod, on, spread_state, interpod_state)
+        ]
+
+    def feasible_and_ties(self, pod: Pod) -> tuple[list[int], list[int]]:
+        feasible = self.feasible_set(pod)
+        if not feasible:
+            return [], []
+        totals = self.score_totals(pod, feasible)
         best = max(totals.values())
         ties = [i for i in feasible if totals[i] == best]
         return feasible, ties

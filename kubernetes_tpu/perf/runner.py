@@ -1,0 +1,245 @@
+"""scheduler_perf-compatible YAML workload runner (SURVEY.md §4.5, §8.6).
+
+Parses the same testCase/workload shape as
+test/integration/scheduler_perf/config/performance-config.yaml:
+
+    - name: SchedulingBasic
+      workloadTemplate:
+        - opcode: createNodes
+          countParam: $initNodes
+          nodeTemplatePath: config/node-default.yaml   # or nodeTemplate: {}
+        - opcode: createPods
+          countParam: $initPods
+        - opcode: barrier
+        - opcode: createPods
+          countParam: $measurePods
+          collectMetrics: true
+        - opcode: barrier
+      workloads:
+        - name: 500Nodes
+          params: {initNodes: 500, initPods: 500, measurePods: 1000}
+
+Supported opcodes: createNodes, createPods, createNamespaces, barrier,
+sleep, churn (create/delete pods at a rate between scheduling batches).
+Templates load from nodeTemplatePath/podTemplatePath (YAML manifests parsed
+through the same wire decoders the extender uses) or inline
+nodeTemplate/podTemplate maps; absent both, a default 32-core node /
+1-core pod is used. $param indirection and {{.Index}}-style name suffixes
+are handled ({{.Index}} is replaced; other template actions are not).
+
+Measurement mirrors scheduler_perf's SchedulingThroughput collector:
+pods/s sampled per scheduling batch over the collectMetrics phases, with
+avg/p50/p90/p99 summary, plus the per-batch device-solve seconds.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+import yaml
+
+from ..api.objects import Node, Pod
+from ..scheduler import Scheduler, SchedulerConfig
+from ..state.cluster import ClusterState
+
+DEFAULT_NODE = {
+    "metadata": {"name": "node-{{.Index}}"},
+    "status": {
+        "allocatable": {"cpu": "32", "memory": "128Gi", "pods": "110"},
+        "capacity": {"cpu": "32", "memory": "128Gi", "pods": "110"},
+    },
+}
+DEFAULT_POD = {
+    "metadata": {"name": "pod-{{.Index}}"},
+    "spec": {
+        "containers": [
+            {
+                "name": "c",
+                "image": "registry.k8s.io/pause:3.9",
+                "resources": {"requests": {"cpu": "1", "memory": "500Mi"}},
+            }
+        ]
+    },
+}
+
+
+@dataclass
+class WorkloadResult:
+    test_case: str
+    workload: str
+    scheduled: int = 0
+    unschedulable: int = 0
+    measured_pods: int = 0
+    measure_seconds: float = 0.0
+    solve_seconds: float = 0.0
+    samples: list[float] = field(default_factory=list)  # pods/s per batch
+
+    def throughput_summary(self) -> dict[str, float]:
+        if not self.samples:
+            return {"avg": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
+        a = np.asarray(self.samples)
+        return {
+            "avg": float(
+                self.measured_pods / self.measure_seconds
+                if self.measure_seconds
+                else a.mean()
+            ),
+            "p50": float(np.percentile(a, 50)),
+            "p90": float(np.percentile(a, 90)),
+            "p99": float(np.percentile(a, 99)),
+        }
+
+
+def _resolve_count(op: Mapping, params: Mapping) -> int:
+    if "countParam" in op:
+        return int(params[op["countParam"].lstrip("$")])
+    return int(op.get("count") or 0)
+
+
+def _load_template(
+    op: Mapping, key: str, base_dir: Path, default: Mapping
+) -> Mapping:
+    inline = op.get(f"{key}Template")
+    if inline:
+        return inline
+    path = op.get(f"{key}TemplatePath")
+    if path:
+        with open(base_dir / path) as f:
+            return yaml.safe_load(f)
+    return default
+
+
+def _instantiate(template: Mapping, index: int, prefix: str) -> dict:
+    import json
+
+    d = json.loads(json.dumps(template).replace("{{.Index}}", str(index)))
+    meta = d.setdefault("metadata", {})
+    if meta.get("generateName"):
+        meta["name"] = f"{meta['generateName']}{index}"
+    elif not meta.get("name"):
+        meta["name"] = f"{prefix}-{index}"
+    elif "{{.Index}}" not in ((template.get("metadata") or {}).get("name") or ""):
+        # fixed template name: suffix the index so objects stay unique
+        meta["name"] = f"{meta['name']}-{index}"
+    return d
+
+
+class PerfRunner:
+    def __init__(
+        self,
+        config: SchedulerConfig | None = None,
+        base_dir: str | Path = ".",
+    ):
+        self.config = config or SchedulerConfig()
+        self.base_dir = Path(base_dir)
+
+    def run_file(
+        self, path: str | Path, workload_filter: str | None = None
+    ) -> list[WorkloadResult]:
+        with open(path) as f:
+            cases = yaml.safe_load(f)
+        base = Path(path).parent
+        out = []
+        for case in cases:
+            for wl in case.get("workloads") or [{"name": "default", "params": {}}]:
+                if workload_filter and wl["name"] != workload_filter:
+                    continue
+                out.append(
+                    self.run_workload(
+                        case["name"],
+                        wl["name"],
+                        case.get("workloadTemplate") or [],
+                        wl.get("params") or {},
+                        base,
+                    )
+                )
+        return out
+
+    def run_workload(
+        self,
+        case_name: str,
+        wl_name: str,
+        ops: list[Mapping],
+        params: Mapping[str, Any],
+        base_dir: Path | None = None,
+    ) -> WorkloadResult:
+        base_dir = base_dir or self.base_dir
+        cluster = ClusterState()
+        sched = Scheduler(cluster, self.config)
+        res = WorkloadResult(test_case=case_name, workload=wl_name)
+        node_seq = 0
+        pod_seq = 0
+
+        def drain(measure: bool) -> None:
+            t0 = time.perf_counter()
+            while True:
+                tb = time.perf_counter()
+                r = sched.schedule_batch()
+                n = len(r.scheduled)
+                if not (r.scheduled or r.unschedulable or r.bind_failures):
+                    break
+                dt = time.perf_counter() - tb
+                res.scheduled += n
+                res.unschedulable += len(r.unschedulable)
+                res.solve_seconds += r.solve_seconds
+                if measure and n:
+                    res.samples.append(n / dt)
+                    res.measured_pods += n
+                if r.unschedulable and not r.scheduled:
+                    break  # only stuck pods remain
+            if measure:
+                res.measure_seconds += time.perf_counter() - t0
+
+        for op in ops:
+            opcode = op.get("opcode")
+            if opcode == "createNodes":
+                count = _resolve_count(op, params)
+                tpl = _load_template(op, "node", base_dir, DEFAULT_NODE)
+                for _ in range(count):
+                    cluster.create_node(
+                        Node.from_dict(_instantiate(tpl, node_seq, "node"))
+                    )
+                    node_seq += 1
+            elif opcode == "createPods":
+                count = _resolve_count(op, params)
+                tpl = _load_template(op, "pod", base_dir, DEFAULT_POD)
+                ns = op.get("namespace")
+                measure = bool(op.get("collectMetrics"))
+                for _ in range(count):
+                    d = _instantiate(tpl, pod_seq, "pod")
+                    if ns:
+                        d.setdefault("metadata", {})["namespace"] = ns
+                    cluster.create_pod(Pod.from_dict(d))
+                    pod_seq += 1
+                drain(measure)
+            elif opcode == "createNamespaces":
+                pass  # namespaces are implicit in this state service
+            elif opcode == "barrier":
+                drain(False)
+            elif opcode == "sleep":
+                time.sleep(float(op.get("duration") or 0))
+            elif opcode == "churn":
+                # background create/delete between batches; the interleaved
+                # batches may also bind earlier pending pods, so their
+                # results count toward the workload totals
+                number = int(op.get("number") or 1)
+                tpl = _load_template(op, "pod", base_dir, DEFAULT_POD)
+                for _ in range(number):
+                    d = _instantiate(tpl, pod_seq, "churn")
+                    pod_seq += 1
+                    created = cluster.create_pod(Pod.from_dict(d))
+                    r = sched.schedule_batch()
+                    res.scheduled += len(r.scheduled)
+                    res.unschedulable += len(r.unschedulable)
+                    res.solve_seconds += r.solve_seconds
+                    try:
+                        cluster.delete_pod(created.namespace, created.name)
+                    except Exception:
+                        pass
+            else:
+                raise ValueError(f"unsupported opcode {opcode!r}")
+        return res

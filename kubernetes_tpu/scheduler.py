@@ -22,6 +22,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+from . import metrics
 from .api.objects import Pod
 from .solver.exact import ExactSolver, ExactSolverConfig
 from .solver.preemption import PreemptionEvaluator
@@ -227,6 +228,33 @@ class Scheduler:
                 self.queue.add_unschedulable(info, cycle)
 
         res.host_seconds = time.perf_counter() - t0 - res.solve_seconds
+
+        # -- metrics (reference names; SURVEY §6.5) --
+        profile = "default-scheduler"
+        metrics.solve_latency_seconds.observe(res.solve_seconds)
+        metrics.solve_batch_size.observe(len(infos))
+        metrics.tensorize_seconds.observe(max(t1 - t0, 0.0))
+        attempt_avg = (time.perf_counter() - t0) / max(len(infos), 1)
+        if res.scheduled:
+            metrics.schedule_attempts_total.labels("scheduled", profile).inc(
+                len(res.scheduled)
+            )
+            metrics.scheduling_attempt_duration_seconds.labels(
+                "scheduled", profile
+            ).observe(attempt_avg)
+        if res.unschedulable:
+            metrics.schedule_attempts_total.labels("unschedulable", profile).inc(
+                len(res.unschedulable)
+            )
+        if res.bind_failures:
+            metrics.schedule_attempts_total.labels("error", profile).inc(
+                len(res.bind_failures)
+            )
+        for _, _, victims in res.preemptions:
+            metrics.preemption_attempts_total.inc()
+            metrics.preemption_victims.observe(len(victims))
+        for queue_name, count in self.queue.pending_counts().items():
+            metrics.pending_pods.labels(queue_name).set(count)
         return res
 
     # -- PostFilter: defaultpreemption (preemption.go#Evaluator.Preempt) --

@@ -1,0 +1,239 @@
+"""Scheduler-extender webhook server — the delivery boundary of SURVEY.md
+§8.2: a kube-scheduler configured with this extender sends its
+filter/prioritize/preempt/bind verbs here and the TPU framework answers.
+
+Wire shapes are byte-compatible with
+staging/src/k8s.io/kube-scheduler/extender/v1/types.go:
+- POST /filter     ExtenderArgs{pod, nodes|nodenames} ->
+                   ExtenderFilterResult{nodes|nodenames, failedNodes,
+                   failedAndUnresolvableNodes, error}
+- POST /prioritize ExtenderArgs -> HostPriorityList [{host, score 0..10}]
+                   (MaxExtenderPriority; the caller multiplies by the
+                   extender weight and rescales vs MaxNodeScore)
+- POST /preempt    ExtenderPreemptionArgs{pod, nodeNameToVictims|
+                   nodeNameToMetaVictims} -> ExtenderPreemptionResult
+                   {nodeNameToMetaVictims: {node: {pods: [{uid}],
+                   numPDBViolations}}}
+- POST /bind       ExtenderBindingArgs{podName, podNamespace, podUID, node}
+                   -> ExtenderBindingResult{error}
+- GET  /metrics    prometheus exposition (reference names)
+- GET  /healthz /livez /readyz
+
+Handlers are pure dict->dict functions (golden-JSON testable, SURVEY §8.6)
+wrapped by a thin aiohttp app. The server holds a ClusterState for the pod
+side of NodeInfo (an extender keeps its own watch-fed view in the reference
+deployment; ExtenderArgs only carries Node objects). nodeCacheCapable mode
+accepts/returns bare node names resolved against that state.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from ..api.objects import Node, Pod
+from ..ops.oracle import preemption as opr
+from ..ops.oracle.profile import FullOracle, make_oracle_nodes
+from ..state.cluster import ApiError, ClusterState
+from .. import metrics
+
+MAX_EXTENDER_PRIORITY = 10
+
+
+class ExtenderCore:
+    """Verb implementations as pure dict->dict handlers."""
+
+    def __init__(self, cluster: ClusterState, node_cache_capable: bool = False):
+        self.cluster = cluster
+        self.node_cache_capable = node_cache_capable
+
+    # -- helpers --
+
+    def _pods_by_node(self) -> dict[str, list[Pod]]:
+        out: dict[str, list[Pod]] = {}
+        for p in self.cluster.list_pods():
+            if p.node_name:
+                out.setdefault(p.node_name, []).append(p)
+        return out
+
+    def _resolve_nodes(self, args: Mapping) -> tuple[list[Node], bool, list[str]]:
+        """(nodes, by_name, unknown_names): honor nodes vs nodenames
+        (nodeCacheCapable). Unknown names fail per-node, not per-request —
+        the extender's watch-fed view may lag the scheduler's."""
+        if args.get("nodenames") is not None:
+            nodes, unknown = [], []
+            for n in args["nodenames"]:
+                try:
+                    nodes.append(self.cluster.get_node(n))
+                except ApiError:
+                    unknown.append(n)
+            return nodes, True, unknown
+        items = (args.get("nodes") or {}).get("items") or []
+        return [Node.from_dict(d) for d in items], False, []
+
+    def _oracle(self, nodes: list[Node]) -> FullOracle:
+        pods_by_node = self._pods_by_node()
+        return FullOracle(make_oracle_nodes(nodes, pods_by_node))
+
+    # -- verbs --
+
+    def filter(self, args: Mapping) -> dict:
+        try:
+            pod = Pod.from_dict(args["pod"])
+            nodes, by_name, unknown = self._resolve_nodes(args)
+        except KeyError as e:
+            return {"error": str(e)}
+        from ..ops.oracle import interpod as oip
+        from ..ops.oracle import spread as osp
+
+        oracle = self._oracle(nodes)
+        all_nodes = oracle._all_nodes_with_pods()
+        spread_state = osp.build_filter_state(pod, all_nodes)
+        interpod_state = oip.build_interpod_state(pod, all_nodes)
+        passed: list[Node] = []
+        failed: dict[str, str] = {}
+        for on in oracle.nodes:
+            if oracle.filter_one(pod, on, spread_state, interpod_state):
+                passed.append(on.node)
+            else:
+                failed[on.node.name] = "node did not satisfy filters"
+        unresolvable = {n: "node not found" for n in unknown}
+        out: dict = {
+            "failedNodes": failed,
+            "failedAndUnresolvableNodes": unresolvable,
+        }
+        if by_name:
+            out["nodenames"] = [n.name for n in passed]
+        else:
+            out["nodes"] = {"items": [n.to_dict() for n in passed]}
+        return out
+
+    def prioritize(self, args: Mapping) -> list[dict]:
+        """HostPriorityList: full-pipeline totals rescaled into the 0..10
+        extender score range (MaxExtenderPriority). Decode errors raise —
+        the HTTP layer turns them into a 500 so the caller sees the failure
+        instead of silently dropping this extender's scores."""
+        pod = Pod.from_dict(args["pod"])
+        nodes, _, _ = self._resolve_nodes(args)
+        oracle = self._oracle(nodes)
+        feasible = oracle.feasible_set(pod)
+        scores: dict[str, int] = {}
+        if feasible:
+            totals = oracle.score_totals(pod, feasible)
+            mx = max(totals.values(), default=0)
+            for i, t in totals.items():
+                name = oracle.nodes[i].node.name
+                scores[name] = (
+                    MAX_EXTENDER_PRIORITY * t // mx if mx > 0 else 0
+                )
+        return [
+            {"host": n.name, "score": scores.get(n.name, 0)} for n in nodes
+        ]
+
+    def preempt(self, args: Mapping) -> dict:
+        try:
+            pod = Pod.from_dict(args["pod"])
+        except KeyError as e:
+            return {"error": str(e)}
+        from ..ops.oracle import plugins as opl
+
+        pods_by_node = self._pods_by_node()
+        pdbs = self.cluster.list_pdbs()
+        candidates = args.get("nodeNameToVictims") or args.get(
+            "nodeNameToMetaVictims"
+        ) or {}
+        out: dict[str, dict] = {}
+        for node_name in candidates:
+            try:
+                node = self.cluster.get_node(node_name)
+            except ApiError:
+                continue
+            # static gate: preemption cannot resolve taints/affinity/
+            # nodeName/unschedulable failures (select_victims_on_node is
+            # fit-only; see its docstring) — never offer such nodes
+            if not (
+                opl.node_name_filter(pod, node)
+                and opl.node_unschedulable_filter(pod, node)
+                and opl.taint_toleration_filter(pod, node)
+                and opl.node_affinity_filter(pod, node)
+            ):
+                continue
+            nv = opr.select_victims_on_node(
+                pod,
+                node.allocatable,
+                node.allowed_pod_number,
+                pods_by_node.get(node_name, []),
+                pdbs,
+            )
+            if nv is None:
+                continue  # node dropped from the result = not a candidate
+            out[node_name] = {
+                "pods": [{"uid": v.uid or v.key} for v in nv.victims],
+                "numPDBViolations": nv.num_violating,
+            }
+        return {"nodeNameToMetaVictims": out}
+
+    def bind(self, args: Mapping) -> dict:
+        try:
+            self.cluster.bind(
+                args.get("podNamespace") or "default",
+                args["podName"],
+                args["node"],
+            )
+            return {}
+        except (KeyError, ApiError) as e:
+            return {"error": str(e)}
+
+
+def make_app(core: ExtenderCore):
+    """aiohttp application wiring the pure handlers to the wire."""
+    from aiohttp import web
+
+    async def _json(request):
+        return await request.json()
+
+    async def filter_(request):
+        return web.json_response(core.filter(await _json(request)))
+
+    async def prioritize(request):
+        try:
+            return web.json_response(core.prioritize(await _json(request)))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def preempt(request):
+        return web.json_response(core.preempt(await _json(request)))
+
+    async def bind(request):
+        return web.json_response(core.bind(await _json(request)))
+
+    async def metrics_(request):
+        return web.Response(
+            body=metrics.render(), content_type="text/plain"
+        )
+
+    async def healthz(request):
+        return web.Response(text="ok")
+
+    app = web.Application()
+    app.router.add_post("/filter", filter_)
+    app.router.add_post("/prioritize", prioritize)
+    app.router.add_post("/preempt", preempt)
+    app.router.add_post("/bind", bind)
+    app.router.add_get("/metrics", metrics_)
+    for route in ("/healthz", "/livez", "/readyz"):
+        app.router.add_get(route, healthz)
+    return app
+
+
+def run_server(
+    cluster: ClusterState,
+    host: str = "127.0.0.1",
+    port: int = 10259,
+    node_cache_capable: bool = False,
+) -> None:
+    """Blocking server entry (the cmd/kube-scheduler#Run analog serves
+    healthz+metrics on 10259)."""
+    from aiohttp import web
+
+    app = make_app(ExtenderCore(cluster, node_cache_capable))
+    web.run_app(app, host=host, port=port)

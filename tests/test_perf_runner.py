@@ -1,0 +1,119 @@
+"""scheduler_perf-compatible YAML runner tests."""
+
+import textwrap
+
+from kubernetes_tpu.perf.runner import PerfRunner
+from kubernetes_tpu.scheduler import SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+
+
+def write_config(tmp_path, text):
+    p = tmp_path / "perf.yaml"
+    p.write_text(textwrap.dedent(text))
+    return p
+
+
+def runner():
+    return PerfRunner(
+        SchedulerConfig(batch_size=256, solver=ExactSolverConfig(tie_break="first"))
+    )
+
+
+def test_scheduling_basic_shape(tmp_path):
+    cfg = write_config(
+        tmp_path,
+        """
+        - name: SchedulingBasic
+          workloadTemplate:
+            - opcode: createNodes
+              countParam: $initNodes
+            - opcode: createPods
+              countParam: $initPods
+            - opcode: barrier
+            - opcode: createPods
+              countParam: $measurePods
+              collectMetrics: true
+            - opcode: barrier
+          workloads:
+            - name: 50Nodes
+              params: {initNodes: 50, initPods: 50, measurePods: 100}
+        """,
+    )
+    results = runner().run_file(cfg)
+    assert len(results) == 1
+    r = results[0]
+    assert r.test_case == "SchedulingBasic"
+    assert r.workload == "50Nodes"
+    assert r.scheduled == 150
+    assert r.measured_pods == 100
+    assert r.unschedulable == 0
+    s = r.throughput_summary()
+    assert s["avg"] > 0 and s["p50"] > 0
+
+
+def test_custom_templates_and_params(tmp_path):
+    (tmp_path / "node.yaml").write_text(
+        textwrap.dedent(
+            """
+            metadata:
+              name: big-{{.Index}}
+              labels: {zone: z0}
+            status:
+              allocatable: {cpu: "64", memory: 256Gi, pods: "200"}
+            """
+        )
+    )
+    cfg = write_config(
+        tmp_path,
+        """
+        - name: CustomTemplates
+          workloadTemplate:
+            - opcode: createNodes
+              count: 3
+              nodeTemplatePath: node.yaml
+            - opcode: createPods
+              count: 10
+              podTemplate:
+                metadata:
+                  generateName: app-
+                spec:
+                  containers:
+                    - name: c
+                      resources:
+                        requests: {cpu: 500m}
+              collectMetrics: true
+            - opcode: barrier
+          workloads:
+            - name: only
+              params: {}
+        """,
+    )
+    results = runner().run_file(cfg)
+    assert results[0].scheduled == 10
+    assert results[0].unschedulable == 0
+
+
+def test_unschedulable_counted(tmp_path):
+    cfg = write_config(
+        tmp_path,
+        """
+        - name: Overload
+          workloadTemplate:
+            - opcode: createNodes
+              count: 1
+              nodeTemplate:
+                metadata: {name: "tiny-{{.Index}}"}
+                status:
+                  allocatable: {cpu: "2", memory: 8Gi, pods: "110"}
+            - opcode: createPods
+              count: 4
+            - opcode: barrier
+          workloads:
+            - name: only
+              params: {}
+        """,
+    )
+    r = runner().run_file(cfg)[0]
+    # default pods want 1 cpu: only 2 fit on the tiny node
+    assert r.scheduled == 2
+    assert r.unschedulable >= 2
