@@ -1,12 +1,17 @@
 """Benchmark entry point (driver-run on real TPU hardware).
 
-Runs BASELINE.json config #2 — 5k homogeneous pods onto 1k nodes through the
-full stack (state service -> queue -> snapshot -> exact TPU solve -> bind),
-the batched equivalent of scheduler_perf's SchedulingBasic-style throughput
-measurement (test/integration/scheduler_perf, SURVEY.md §4.5).
+Two measurements:
+1. BASELINE.json config #2 — 5k homogeneous pods onto 1k nodes through the
+   full stack (state service -> queue -> snapshot -> exact TPU solve ->
+   bind), the batched equivalent of scheduler_perf's SchedulingBasic-style
+   throughput measurement (test/integration/scheduler_perf, SURVEY.md §4.5).
+2. The NORTH STAR (BASELINE.md): 50k pods x 10k nodes batch-solved via the
+   single-shot auction solver; target < 1 s device time.
 
 Prints ONE JSON line:
   {"metric": ..., "value": pods/s, "unit": "pods/s", "vs_baseline": ...}
+with the north-star numbers as extra fields
+(north_star_*: solve seconds + x-vs-1s-target).
 
 vs_baseline compares against the reference default scheduler's ~300 pods/s
 sustained upper bound from BASELINE.md (API-bound 5k-node density tests).
@@ -24,6 +29,73 @@ N_NODES = 1_000
 N_PODS = 5_000
 BATCH = 1_024
 BASELINE_PODS_PER_SEC = 300.0
+
+NS_NODES = 10_240
+NS_PODS = 51_200
+NS_TARGET_S = 1.0
+
+
+def north_star() -> dict:
+    """50k x 10k single-shot rebalance: device solve time, steady state."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.solver.single_shot import (
+        SingleShotConfig,
+        _single_shot_jit,
+    )
+
+    rng = np.random.default_rng(0)
+    k, c, rc = 3, 8, 8
+    alloc = np.zeros((k, NS_NODES), dtype=np.int64)
+    alloc[0] = 16_000
+    alloc[1] = 64 * 1024**3
+    rc_req = np.zeros((rc, k), dtype=np.int64)
+    rc_req[:, 0] = rng.integers(1, 9, rc) * 250
+    rc_req[:, 1] = rng.integers(1, 5, rc) * 1024**3
+    rc_static = (np.arange(rc) % c).astype(np.int32)
+    rc_of = rng.integers(0, rc, NS_PODS).astype(np.int32)
+    priority = rng.integers(0, 10, NS_PODS).astype(np.int32)
+    cfg = SingleShotConfig()
+
+    def fresh():
+        return [
+            jnp.asarray(x)
+            for x in (
+                alloc,
+                np.zeros((k, NS_NODES), np.int64),
+                np.zeros(NS_NODES, np.int32),
+                np.full(NS_NODES, 110, np.int32),
+                np.ones(NS_NODES, bool),
+                np.ones((c, NS_NODES), bool),
+                rc_req,
+                rc_static,
+                rc_of,
+                priority,
+                np.ones(NS_PODS, bool),
+            )
+        ]
+
+    kw = dict(
+        max_rounds=cfg.max_rounds, price_step=cfg.price_step, top_t=cfg.top_t
+    )
+    t0 = time.perf_counter()
+    out = _single_shot_jit(*fresh(), **kw)
+    out[0].block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = _single_shot_jit(*fresh(), **kw)
+    out[0].block_until_ready()
+    solve_s = time.perf_counter() - t0
+    placed = int((np.asarray(out[0]) >= 0).sum())
+    return {
+        "north_star_pods": NS_PODS,
+        "north_star_nodes": NS_NODES,
+        "north_star_solve_s": round(solve_s, 4),
+        "north_star_compile_s": round(compile_s, 2),
+        "north_star_placed": placed,
+        "north_star_vs_1s_target": round(NS_TARGET_S / solve_s, 2),
+    }
 
 
 def main() -> None:
@@ -87,10 +159,11 @@ def main() -> None:
     per_pod = sorted(t for t, n in batch_times for _ in range(n))
     p99 = per_pod[int(0.99 * (len(per_pod) - 1))]
 
+    ns = north_star()
     print(
         json.dumps(
             {
-                "metric": "pods scheduled/sec, 5k pods x 1k nodes, Fit+BalancedAllocation (steady-state)",
+                "metric": "pods scheduled/sec, 5k pods x 1k nodes, full default plugin pipeline (steady-state)",
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/s",
                 "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
@@ -101,6 +174,7 @@ def main() -> None:
                 "pod_create_s": round(create_seconds, 3),
                 "pods": N_PODS,
                 "nodes": N_NODES,
+                **ns,
             }
         )
     )

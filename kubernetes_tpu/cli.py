@@ -1,0 +1,129 @@
+"""CLI — the cmd/kube-scheduler analog (app/server.go#Setup/#Run shape):
+load + validate ComponentConfig, then run one of:
+
+  serve   extender webhook + healthz/livez/readyz + /metrics (port 10259,
+          the reference's secure serving port)
+  perf    scheduler_perf-compatible YAML workloads
+  config  parse/validate a KubeSchedulerConfiguration and print the
+          resolved settings + warnings
+
+Leader election is [CONTEXT] (single-process; SURVEY §3.3) — the flag is
+accepted and ignored with a warning for config compatibility.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .config import types as config_types
+
+
+def _load_config(path: str | None) -> config_types.KubeSchedulerConfiguration:
+    if path:
+        return config_types.load_file(path)
+    return config_types.KubeSchedulerConfiguration()
+
+
+def cmd_config(args) -> int:
+    cfg = _load_config(args.config)
+    out = {
+        "profiles": [
+            {
+                "schedulerName": p.scheduler_name,
+                "scoreWeights": p.score_weights,
+                "scoringStrategy": p.scoring_strategy.type,
+                "hardPodAffinityWeight": p.hard_pod_affinity_weight,
+            }
+            for p in cfg.profiles
+        ],
+        "extenders": len(cfg.extenders),
+        "tpuSolver": {
+            "batchSize": cfg.tpu_solver.batch_size,
+            "tieBreak": cfg.tpu_solver.tie_break,
+            "enablePreemption": cfg.tpu_solver.enable_preemption,
+        },
+        "warnings": cfg.warnings,
+    }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from .server.extender import run_server
+    from .state.cluster import ClusterState
+
+    cfg = _load_config(args.config)
+    for w in cfg.warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    cluster = ClusterState()
+    run_server(
+        cluster,
+        host=args.host,
+        port=args.port,
+        node_cache_capable=args.node_cache_capable,
+    )
+    return 0
+
+
+def cmd_perf(args) -> int:
+    from .perf.runner import PerfRunner
+
+    cfg = _load_config(args.config)
+    runner = PerfRunner(config_types.scheduler_config(cfg))
+    results = runner.run_file(args.workload, workload_filter=args.workload_name)
+    for r in results:
+        print(
+            json.dumps(
+                {
+                    "testCase": r.test_case,
+                    "workload": r.workload,
+                    "scheduled": r.scheduled,
+                    "unschedulable": r.unschedulable,
+                    "throughput": r.throughput_summary(),
+                    "deviceSolveSeconds": round(r.solve_seconds, 3),
+                }
+            )
+        )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kubernetes-tpu-scheduler",
+        description="TPU-native pod->node assignment engine",
+    )
+    parser.add_argument("--config", help="KubeSchedulerConfiguration YAML")
+    parser.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="accepted for config parity; single-process build ignores it",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_serve = sub.add_parser("serve", help="run the extender webhook server")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=10259)
+    p_serve.add_argument("--node-cache-capable", action="store_true")
+    p_serve.set_defaults(fn=cmd_serve)
+
+    p_perf = sub.add_parser("perf", help="run scheduler_perf YAML workloads")
+    p_perf.add_argument("workload", help="performance-config.yaml path")
+    p_perf.add_argument("--workload-name", help="run only this workload")
+    p_perf.set_defaults(fn=cmd_perf)
+
+    p_cfg = sub.add_parser("config", help="parse + print resolved config")
+    p_cfg.set_defaults(fn=cmd_config)
+
+    args = parser.parse_args(argv)
+    if args.leader_elect:
+        print(
+            "warning: --leader-elect ignored (single-process build)",
+            file=sys.stderr,
+        )
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

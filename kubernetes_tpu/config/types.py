@@ -1,0 +1,307 @@
+"""KubeSchedulerConfiguration — typed config mirroring the reference's
+component-config field names so reference YAML mostly parses unchanged
+(pkg/scheduler/apis/config/types.go + v1/defaults.go + validation/,
+SURVEY.md §6.6), plus the TPU solver section.
+
+Covered surface:
+- top level: parallelism, percentageOfNodesToScore, podInitialBackoffSeconds,
+  podMaxBackoffSeconds, profiles[], extenders[]
+- per profile: schedulerName, plugins{score.enabled[{name,weight}],
+  filter/score disabled[...]} (the subset that changes solver behavior),
+  pluginConfig[{name,args}] for NodeResourcesFitArgs.scoringStrategy
+  (LeastAllocated | MostAllocated | RequestedToCapacityRatio),
+  InterPodAffinityArgs.hardPodAffinityWeight,
+  PodTopologySpreadArgs.defaultingType, NodeAffinityArgs.addedAffinity
+- extenders[]: urlPrefix, filterVerb/prioritizeVerb/preemptVerb/bindVerb,
+  weight, nodeCacheCapable, ignorable, managedResources
+- tpuSolver (ours): batchSize, tieBreak, seed, balancedFdtype, singleShot
+  {maxRounds, priceStep, topT}, enablePreemption
+
+Unknown plugin names and unsupported pluginConfig args are collected into
+`warnings` rather than rejected — the validation posture of a scheduler that
+must accept configs written for the full reference plugin set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+import yaml
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+# default score weights: apis/config/v1/default_plugins.go
+DEFAULT_WEIGHTS = {
+    "NodeResourcesFit": 1,
+    "NodeResourcesBalancedAllocation": 1,
+    "TaintToleration": 3,
+    "NodeAffinity": 2,
+    "PodTopologySpread": 2,
+    "InterPodAffinity": 2,
+    "ImageLocality": 1,
+}
+
+KNOWN_PLUGINS = set(DEFAULT_WEIGHTS) | {
+    "NodeName",
+    "NodePorts",
+    "NodeUnschedulable",
+    "SchedulingGates",
+    "PrioritySort",
+    "DefaultPreemption",
+    "DefaultBinder",
+    "VolumeBinding",
+    "VolumeRestrictions",
+    "VolumeZone",
+    "NodeVolumeLimits",
+}
+
+
+@dataclass
+class ScoringStrategy:
+    type: str = "LeastAllocated"  # | MostAllocated | RequestedToCapacityRatio
+    resources: list[dict] = field(
+        default_factory=lambda: [
+            {"name": "cpu", "weight": 1},
+            {"name": "memory", "weight": 1},
+        ]
+    )
+    # RequestedToCapacityRatio shape points [{utilization, score}]
+    shape: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class Profile:
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    score_weights: dict[str, int] = field(
+        default_factory=lambda: dict(DEFAULT_WEIGHTS)
+    )
+    disabled_filters: set[str] = field(default_factory=set)
+    scoring_strategy: ScoringStrategy = field(default_factory=ScoringStrategy)
+    hard_pod_affinity_weight: int = 1
+    spread_defaulting_type: str = "System"  # System | List
+    added_affinity: dict | None = None  # NodeAffinityArgs.addedAffinity
+
+
+@dataclass
+class Extender:
+    url_prefix: str = ""
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    preempt_verb: str = ""
+    bind_verb: str = ""
+    weight: int = 1
+    node_cache_capable: bool = False
+    ignorable: bool = False
+    managed_resources: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class SingleShotSection:
+    max_rounds: int = 32
+    price_step: int = 8
+    top_t: int = 1024
+
+
+@dataclass
+class TpuSolverSection:
+    batch_size: int = 1024
+    tie_break: str = "random"  # random | first
+    seed: int = 0
+    balanced_fdtype: str = "float32"
+    enable_preemption: bool = True
+    single_shot: SingleShotSection = field(default_factory=SingleShotSection)
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    parallelism: int = 16  # accepted for parity; the TPU solve is dense
+    percentage_of_nodes_to_score: int = 0  # 0 = all (we always score all)
+    pod_initial_backoff_seconds: float = 1.0
+    pod_max_backoff_seconds: float = 10.0
+    profiles: list[Profile] = field(default_factory=lambda: [Profile()])
+    extenders: list[Extender] = field(default_factory=list)
+    tpu_solver: TpuSolverSection = field(default_factory=TpuSolverSection)
+    warnings: list[str] = field(default_factory=list)
+
+    def profile_for(self, scheduler_name: str) -> Profile | None:
+        for p in self.profiles:
+            if p.scheduler_name == scheduler_name:
+                return p
+        return None
+
+
+def _parse_plugin_config(profile: Profile, items, warnings: list[str]) -> None:
+    for pc in items or ():
+        name = pc.get("name")
+        args = pc.get("args") or {}
+        if name == "NodeResourcesFit":
+            strat = (args.get("scoringStrategy") or {})
+            if strat:
+                profile.scoring_strategy = ScoringStrategy(
+                    type=strat.get("type") or "LeastAllocated",
+                    resources=strat.get("resources")
+                    or ScoringStrategy().resources,
+                    shape=(
+                        (strat.get("requestedToCapacityRatio") or {}).get(
+                            "shape"
+                        )
+                        or []
+                    ),
+                )
+                if profile.scoring_strategy.type == "RequestedToCapacityRatio":
+                    warnings.append(
+                        "scoringStrategy RequestedToCapacityRatio: kernel + "
+                        "oracle exist (ops/noderesources) but the solver "
+                        "falls back to LeastAllocated until shape plumbing "
+                        "lands"
+                    )
+        elif name == "InterPodAffinity":
+            if "hardPodAffinityWeight" in args:
+                profile.hard_pod_affinity_weight = int(
+                    args["hardPodAffinityWeight"]
+                )
+        elif name == "PodTopologySpread":
+            if "defaultingType" in args:
+                profile.spread_defaulting_type = args["defaultingType"]
+        elif name == "NodeAffinity":
+            if "addedAffinity" in args:
+                profile.added_affinity = args["addedAffinity"]
+        elif name in ("DefaultPreemption", "VolumeBinding"):
+            pass  # accepted, defaults apply
+        else:
+            warnings.append(f"pluginConfig for {name!r} not consumed")
+
+
+def _parse_profile(d: Mapping, warnings: list[str]) -> Profile:
+    profile = Profile(
+        scheduler_name=d.get("schedulerName") or DEFAULT_SCHEDULER_NAME
+    )
+    plugins = d.get("plugins") or {}
+    for point in ("score", "multiPoint"):
+        sec = plugins.get(point) or {}
+        for e in sec.get("enabled") or ():
+            name = e.get("name")
+            if name not in KNOWN_PLUGINS:
+                warnings.append(f"unknown plugin {name!r} enabled")
+                continue
+            if "weight" in e and name in DEFAULT_WEIGHTS:
+                profile.score_weights[name] = int(e["weight"])
+        for e in sec.get("disabled") or ():
+            name = e.get("name")
+            if name == "*":
+                profile.score_weights = {k: 0 for k in profile.score_weights}
+            elif name in DEFAULT_WEIGHTS:
+                profile.score_weights[name] = 0
+    for e in (plugins.get("filter") or {}).get("disabled") or ():
+        name = e.get("name")
+        if name:
+            profile.disabled_filters.add(name)
+    _parse_plugin_config(profile, d.get("pluginConfig"), warnings)
+    return profile
+
+
+def load(data: Mapping | str) -> KubeSchedulerConfiguration:
+    """Parse a KubeSchedulerConfiguration YAML document (string or mapping)."""
+    if isinstance(data, str):
+        data = yaml.safe_load(data) or {}
+    cfg = KubeSchedulerConfiguration()
+    warnings = cfg.warnings
+
+    api_version = data.get("apiVersion", "")
+    if api_version and not api_version.startswith("kubescheduler.config.k8s.io/"):
+        warnings.append(f"unexpected apiVersion {api_version!r}")
+
+    if "parallelism" in data:
+        cfg.parallelism = int(data["parallelism"])
+    if "percentageOfNodesToScore" in data:
+        cfg.percentage_of_nodes_to_score = int(data["percentageOfNodesToScore"])
+        if cfg.percentage_of_nodes_to_score not in (0, 100):
+            warnings.append(
+                "percentageOfNodesToScore: the TPU solve always scores all "
+                "nodes (dense is free); sampling is parsed but not applied"
+            )
+    if "podInitialBackoffSeconds" in data:
+        cfg.pod_initial_backoff_seconds = float(data["podInitialBackoffSeconds"])
+    if "podMaxBackoffSeconds" in data:
+        cfg.pod_max_backoff_seconds = float(data["podMaxBackoffSeconds"])
+
+    if data.get("profiles"):
+        cfg.profiles = [_parse_profile(p, warnings) for p in data["profiles"]]
+    names = [p.scheduler_name for p in cfg.profiles]
+    if len(names) != len(set(names)):
+        raise ValueError(f"duplicate profile schedulerName in {names}")
+
+    for e in data.get("extenders") or ():
+        cfg.extenders.append(
+            Extender(
+                url_prefix=e.get("urlPrefix") or "",
+                filter_verb=e.get("filterVerb") or "",
+                prioritize_verb=e.get("prioritizeVerb") or "",
+                preempt_verb=e.get("preemptVerb") or "",
+                bind_verb=e.get("bindVerb") or "",
+                weight=int(e.get("weight") or 1),
+                node_cache_capable=bool(e.get("nodeCacheCapable")),
+                ignorable=bool(e.get("ignorable")),
+                managed_resources=list(e.get("managedResources") or ()),
+            )
+        )
+
+    ts = data.get("tpuSolver") or {}
+    ss = ts.get("singleShot") or {}
+    cfg.tpu_solver = TpuSolverSection(
+        batch_size=int(ts.get("batchSize") or 1024),
+        tie_break=ts.get("tieBreak") or "random",
+        seed=int(ts.get("seed") or 0),
+        balanced_fdtype=ts.get("balancedFdtype") or "float32",
+        enable_preemption=bool(ts.get("enablePreemption", True)),
+        single_shot=SingleShotSection(
+            max_rounds=int(ss.get("maxRounds") or 32),
+            price_step=int(ss.get("priceStep") or 8),
+            top_t=int(ss.get("topT") or 1024),
+        ),
+    )
+    if cfg.tpu_solver.tie_break not in ("random", "first"):
+        raise ValueError(f"tpuSolver.tieBreak: {cfg.tpu_solver.tie_break!r}")
+    return cfg
+
+
+def load_file(path: str) -> KubeSchedulerConfiguration:
+    with open(path) as f:
+        return load(yaml.safe_load(f) or {})
+
+
+def _solver_config(cfg: KubeSchedulerConfiguration, p: Profile):
+    from ..solver.exact import ExactSolverConfig
+
+    w = p.score_weights
+    return ExactSolverConfig(
+        tie_break=cfg.tpu_solver.tie_break,
+        seed=cfg.tpu_solver.seed,
+        balanced_fdtype=cfg.tpu_solver.balanced_fdtype,
+        scoring_strategy=p.scoring_strategy.type,
+        fit_weight=w.get("NodeResourcesFit", 1),
+        balanced_weight=w.get("NodeResourcesBalancedAllocation", 1),
+        taint_weight=w.get("TaintToleration", 3),
+        node_affinity_weight=w.get("NodeAffinity", 2),
+        image_weight=w.get("ImageLocality", 1),
+        spread_weight=w.get("PodTopologySpread", 2),
+        interpod_weight=w.get("InterPodAffinity", 2),
+        hard_pod_affinity_weight=p.hard_pod_affinity_weight,
+    )
+
+
+def scheduler_config(cfg: KubeSchedulerConfiguration):
+    """Build the runtime SchedulerConfig — ALL profiles become solver
+    entries so pods route by spec.schedulerName (profile.NewMap)."""
+    from ..scheduler import SchedulerConfig
+
+    profiles = {
+        p.scheduler_name: _solver_config(cfg, p) for p in cfg.profiles
+    }
+    return SchedulerConfig(
+        batch_size=cfg.tpu_solver.batch_size,
+        enable_preemption=cfg.tpu_solver.enable_preemption,
+        solver=profiles[cfg.profiles[0].scheduler_name],
+        profiles=profiles,
+    )

@@ -25,6 +25,7 @@ from .noderesources import (
     balanced_allocation_score,
     fit_filter,
     least_allocated_score,
+    most_allocated_score,
 )
 
 
@@ -41,6 +42,8 @@ class ProfileWeights:
     interpod: int = 2
     # InterPodAffinityArgs.hardPodAffinityWeight (default 1)
     hard_pod_affinity: int = 1
+    # NodeResourcesFitArgs.scoringStrategy.type
+    scoring_strategy: str = "LeastAllocated"
 
 
 @dataclass
@@ -154,10 +157,15 @@ class FullOracle:
             w.hard_pod_affinity,
         )
 
+        fit_scorer = (
+            most_allocated_score
+            if w.scoring_strategy == "MostAllocated"
+            else least_allocated_score
+        )
         totals: dict[int, int] = {}
         for j, i in enumerate(feasible):
             on = self.nodes[i]
-            t = w.fit * least_allocated_score(pod, on.res)
+            t = w.fit * fit_scorer(pod, on.res)
             t += w.balanced * balanced_allocation_score(pod, on.res)
             t += w.taint * taint_norm[j]
             t += w.node_affinity * na_norm[j]

@@ -48,6 +48,23 @@ class SchedulerConfig:
     assume_ttl: float = 30.0
     # defaultpreemption: run the PostFilter dry-run for unschedulable pods
     enable_preemption: bool = True
+    # multi-profile (profile.NewMap): schedulerName -> solver config for
+    # that profile; pods whose schedulerName matches no profile are ignored
+    # at queue-add, like the reference's frameworkForPod miss. None = the
+    # single default profile using `solver`.
+    profiles: dict[str, ExactSolverConfig] | None = None
+
+
+def _node_change_could_help(old, new) -> bool:
+    """eventhandlers.go#nodeSchedulingPropertiesChange: allocatable, labels,
+    taints, or spec.unschedulable changes can unblock parked pods; pure
+    status-heartbeat updates cannot."""
+    return (
+        old.allocatable != new.allocatable
+        or old.labels != new.labels
+        or old.taints != new.taints
+        or old.unschedulable != new.unschedulable
+    )
 
 
 @dataclass
@@ -76,7 +93,16 @@ class Scheduler:
         self.cache = SchedulerCache(self.clock, assume_ttl=self.config.assume_ttl)
         self.queue = PriorityQueue(self.clock)
         self.snapshot = Snapshot()
-        self.solver = ExactSolver(self.config.solver)
+        # profile map: schedulerName -> solver (profile/profile.go#NewMap)
+        from .api.objects import DEFAULT_SCHEDULER_NAME
+
+        profile_cfgs = self.config.profiles or {
+            DEFAULT_SCHEDULER_NAME: self.config.solver
+        }
+        self.solvers = {
+            name: ExactSolver(cfg) for name, cfg in profile_cfgs.items()
+        }
+        self.solver = next(iter(self.solvers.values()))
         self.preemptor = PreemptionEvaluator()
 
         # initial informer sync (WaitForCacheSync equivalent)
@@ -85,7 +111,7 @@ class Scheduler:
         for pod in cluster.list_pods():
             if pod.node_name:
                 self.cache.add_pod(pod)
-            else:
+            elif pod.scheduler_name in self.solvers:
                 self.queue.add(pod)
         cluster.subscribe(self._on_event)
 
@@ -97,7 +123,7 @@ class Scheduler:
             if ev.type == "ADDED":
                 if pod.node_name:
                     self.cache.add_pod(pod)
-                else:
+                elif pod.scheduler_name in self.solvers:
                     self.queue.add(pod)
             elif ev.type == "MODIFIED":
                 if pod.node_name:
@@ -105,7 +131,7 @@ class Scheduler:
                     self.cache.update_pod(pod) if not self.cache.is_assumed(
                         pod.key
                     ) else self.cache.add_pod(pod)
-                else:
+                elif pod.scheduler_name in self.solvers:
                     self.queue.update(pod)
             else:  # DELETED
                 if pod.node_name:
@@ -119,15 +145,25 @@ class Scheduler:
                 self.cache.add_node(ev.obj)
                 self.queue.move_all_to_active_or_backoff("NodeAdd")
             elif ev.type == "MODIFIED":
+                old = self.cache.nodes.get(ev.obj.name)
+                old_node = old.node if old is not None else None
                 self.cache.update_node(ev.obj)
-                self.queue.move_all_to_active_or_backoff("NodeUpdate")
+                # queueing-hint precheck (eventhandlers.go
+                # #nodeSchedulingPropertiesChange): only wake parked pods for
+                # node changes that could make one schedulable
+                if old_node is None or _node_change_could_help(old_node, ev.obj):
+                    self.queue.move_all_to_active_or_backoff("NodeUpdate")
             else:
                 self.cache.remove_node(ev.obj.name)
 
     # -- the scheduling loop --
 
     def schedule_batch(self) -> BatchResult:
-        """One batched scheduling cycle: K pops -> one solve -> K bindings."""
+        """One batched scheduling cycle: K pops -> one solve per profile ->
+        K bindings. With a single profile (the common case) this is exactly
+        one device solve; with multiple, pods route by spec.schedulerName
+        (schedule_one.go#frameworkForPod) and sub-batches solve in pop
+        order."""
         res = BatchResult()
         t0 = time.perf_counter()
         infos = self.queue.pop_batch(self.config.batch_size)
@@ -135,6 +171,49 @@ class Scheduler:
             return res
         base_cycle = self.queue.scheduling_cycle - len(infos)
 
+        if len(self.solvers) == 1:
+            only = next(iter(self.solvers))
+            groups = [(only, infos, list(range(len(infos))))]
+        else:
+            by_profile: dict[str, list] = {}
+            order: list[str] = []
+            for off, info in enumerate(infos):
+                name = info.pod.scheduler_name
+                if name not in by_profile:
+                    by_profile[name] = []
+                    order.append(name)
+                by_profile[name].append((off, info))
+            groups = [
+                (
+                    name,
+                    [i for _, i in by_profile[name]],
+                    [off for off, _ in by_profile[name]],
+                )
+                for name in order
+            ]
+        for name, group_infos, cycle_offsets in groups:
+            self._solve_group(
+                name, group_infos, cycle_offsets, base_cycle, res, t0
+            )
+
+        res.host_seconds = time.perf_counter() - t0 - res.solve_seconds
+        self._record_metrics(res, len(infos))
+        return res
+
+    def _solve_group(
+        self,
+        profile: str,
+        infos: list[QueuedPodInfo],
+        cycle_offsets: list[int],
+        base_cycle: int,
+        res: BatchResult,
+        t0: float,
+    ) -> None:
+        solver = self.solvers[profile]
+        gs = time.perf_counter()
+        scheduled_before = len(res.scheduled)
+        unsched_before = len(res.unschedulable)
+        failures_before = len(res.bind_failures)
         batch = self.snapshot.update(self.cache)
         pods = [i.pod for i in infos]
         pbatch = build_pod_batch(pods, batch.vocab)
@@ -184,19 +263,18 @@ class Scheduler:
             interpod = build_interpod_tensors(
                 pods, static.reps, pbatch, slot_nodes,
                 placed_by_slot, batch.padded, static.c_pad,
-                hard_pod_affinity_weight=self.config.solver.hard_pod_affinity_weight,
+                hard_pod_affinity_weight=solver.config.hard_pod_affinity_weight,
             )
 
         t1 = time.perf_counter()
-        assignments = self.solver.solve(
-            batch, pbatch, static, ports, spread, interpod
-        )
-        res.solve_seconds = time.perf_counter() - t1
+        assignments = solver.solve(batch, pbatch, static, ports, spread, interpod)
+        res.solve_seconds += time.perf_counter() - t1
+        metrics.tensorize_seconds.observe(max(t1 - gs, 0.0))
 
         preempt_placed: dict[int, list[Pod]] | None = None
         for idx, (info, a) in enumerate(zip(infos, assignments)):
             pod = info.pod
-            cycle = base_cycle + idx + 1
+            cycle = base_cycle + cycle_offsets[idx] + 1
             if a < 0:
                 # failure path: PostFilter (defaultpreemption) -> park
                 if self.config.enable_preemption:
@@ -227,35 +305,33 @@ class Scheduler:
                 res.bind_failures.append((pod.key, e.reason))
                 self.queue.add_unschedulable(info, cycle)
 
-        res.host_seconds = time.perf_counter() - t0 - res.solve_seconds
-
-        # -- metrics (reference names; SURVEY §6.5) --
-        profile = "default-scheduler"
-        metrics.solve_latency_seconds.observe(res.solve_seconds)
-        metrics.solve_batch_size.observe(len(infos))
-        metrics.tensorize_seconds.observe(max(t1 - t0, 0.0))
-        attempt_avg = (time.perf_counter() - t0) / max(len(infos), 1)
-        if res.scheduled:
-            metrics.schedule_attempts_total.labels("scheduled", profile).inc(
-                len(res.scheduled)
-            )
+        # per-profile attempt metrics (this group's own wall time)
+        attempt_avg = (time.perf_counter() - gs) / max(len(infos), 1)
+        n_sched = len(res.scheduled) - scheduled_before
+        n_unsched = len(res.unschedulable) - unsched_before
+        n_fail = len(res.bind_failures) - failures_before
+        if n_sched:
+            metrics.schedule_attempts_total.labels("scheduled", profile).inc(n_sched)
             metrics.scheduling_attempt_duration_seconds.labels(
                 "scheduled", profile
             ).observe(attempt_avg)
-        if res.unschedulable:
+        if n_unsched:
             metrics.schedule_attempts_total.labels("unschedulable", profile).inc(
-                len(res.unschedulable)
+                n_unsched
             )
-        if res.bind_failures:
-            metrics.schedule_attempts_total.labels("error", profile).inc(
-                len(res.bind_failures)
-            )
+        if n_fail:
+            metrics.schedule_attempts_total.labels("error", profile).inc(n_fail)
+
+    def _record_metrics(self, res: BatchResult, n_pods: int) -> None:
+        """Batch-level metrics (per-profile attempt counters record in
+        _solve_group); reference names, SURVEY §6.5."""
+        metrics.solve_latency_seconds.observe(res.solve_seconds)
+        metrics.solve_batch_size.observe(n_pods)
         for _, _, victims in res.preemptions:
             metrics.preemption_attempts_total.inc()
             metrics.preemption_victims.observe(len(victims))
         for queue_name, count in self.queue.pending_counts().items():
             metrics.pending_pods.labels(queue_name).set(count)
-        return res
 
     # -- PostFilter: defaultpreemption (preemption.go#Evaluator.Preempt) --
 

@@ -82,17 +82,12 @@ class ExtenderCore:
             nodes, by_name, unknown = self._resolve_nodes(args)
         except KeyError as e:
             return {"error": str(e)}
-        from ..ops.oracle import interpod as oip
-        from ..ops.oracle import spread as osp
-
         oracle = self._oracle(nodes)
-        all_nodes = oracle._all_nodes_with_pods()
-        spread_state = osp.build_filter_state(pod, all_nodes)
-        interpod_state = oip.build_interpod_state(pod, all_nodes)
+        feasible = set(oracle.feasible_set(pod))
         passed: list[Node] = []
         failed: dict[str, str] = {}
-        for on in oracle.nodes:
-            if oracle.filter_one(pod, on, spread_state, interpod_state):
+        for i, on in enumerate(oracle.nodes):
+            if i in feasible:
                 passed.append(on.node)
             else:
                 failed[on.node.name] = "node did not satisfy filters"
@@ -166,11 +161,21 @@ class ExtenderCore:
             )
             if nv is None:
                 continue  # node dropped from the result = not a candidate
-            out[node_name] = {
-                "pods": [{"uid": v.uid or v.key} for v in nv.victims],
-                "numPDBViolations": nv.num_violating,
-            }
-        return {"nodeNameToMetaVictims": out}
+            if self.node_cache_capable:
+                out[node_name] = {
+                    "pods": [{"uid": v.uid or v.key} for v in nv.victims],
+                    "numPDBViolations": nv.num_violating,
+                }
+            else:
+                out[node_name] = {
+                    "pods": [v.to_dict() for v in nv.victims],
+                    "numPDBViolations": nv.num_violating,
+                }
+        # extender.go#ProcessPreemption reads NodeNameToMetaVictims only for
+        # nodeCacheCapable extenders, NodeNameToVictims (full pods) otherwise
+        if self.node_cache_capable:
+            return {"nodeNameToMetaVictims": out}
+        return {"nodeNameToVictims": out}
 
     def bind(self, args: Mapping) -> dict:
         try:

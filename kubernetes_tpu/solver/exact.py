@@ -65,6 +65,10 @@ class ExactSolverConfig:
     # PodTopologySpread 2, Fit/Balanced/ImageLocality 1.
     fit_weight: int = 1
     balanced_weight: int = 1
+    # NodeResourcesFitArgs.scoringStrategy.type: LeastAllocated (default) |
+    # MostAllocated (RequestedToCapacityRatio has kernel+oracle support in
+    # ops/noderesources; shape plumbing lands with per-resource weights)
+    scoring_strategy: str = "LeastAllocated"
     taint_weight: int = 3
     node_affinity_weight: int = 2
     image_weight: int = 1
@@ -84,6 +88,7 @@ def _solve_scan(
     key,  # PRNG key
     *,
     tie_break: str,
+    scoring_strategy: str,
     w_fit: int,
     w_balanced: int,
     w_taint: int,
@@ -126,7 +131,12 @@ def _solve_scan(
             mask = mask & ipa_allowed
 
         requested = nr.scoring_requested(x["nonzero_req"], st["nonzero_used"])
-        score = w_fit * nr.least_allocated_score(requested, alloc2, weights2)
+        fit_scorer = (
+            nr.most_allocated_score
+            if scoring_strategy == "MostAllocated"
+            else nr.least_allocated_score
+        )
+        score = w_fit * fit_scorer(requested, alloc2, weights2)
         score = score + w_balanced * nr.balanced_allocation_score(
             requested, alloc2, fdtype=fdtype
         )
@@ -196,6 +206,7 @@ _solve_scan_jit = jax.jit(
     _solve_scan,
     static_argnames=(
         "tie_break",
+        "scoring_strategy",
         "w_fit",
         "w_balanced",
         "w_taint",
@@ -315,6 +326,7 @@ class ExactSolver:
             xs,
             key,
             tie_break=cfg.tie_break,
+            scoring_strategy=cfg.scoring_strategy,
             w_fit=cfg.fit_weight,
             w_balanced=cfg.balanced_weight,
             w_taint=cfg.taint_weight,

@@ -1,0 +1,311 @@
+"""Single-shot assignment solver — SURVEY.md §8.4 mode 2, the engine for
+the 50k-pods x 10k-nodes rebalance target (BASELINE.md north star).
+
+The exact scan preserves pod-by-pod sequential semantics but pays one
+scan-step of latency per pod; at 50k pods that serial chain dominates. The
+single-shot mode trades sequential parity for parallelism (the documented
+divergence from SURVEY §8.4): an auction-style capacity-constrained
+assignment where every round is dense work over ALL pods at once:
+
+  1. pods dedup into REQUEST CLASSES (static-plugin class + request
+     vector); feasibility and scoring are [RC, N] tables, never [P, N] —
+     the memory move that makes 50k x 10k fit in HBM;
+  2. each class bids on its top-T feasible nodes by
+     score - price (price = congestion penalty raised on rejection, the
+     Bertsekas-auction analog); pods of a class fan out round-robin over
+     the class's top-T so one round can fill many nodes in parallel;
+  3. claimants are admitted per node in priority order under the node's
+     remaining resources: sort by (node, -priority), per-resource segment
+     prefix sums admit the largest feasible prefix — the dense equivalent
+     of the reference's one-at-a-time assume loop;
+  4. admitted pods commit via scatter-add; the rest re-bid next round.
+
+Rounds run inside one jitted lax.scan (fixed max_rounds; converged rounds
+are no-ops): sort + segment reductions + gathers, no host round-trips.
+
+Scope: NodeResourcesFit + the static per-class plugin mask (taints,
+affinity, nodeName, unschedulable) + headroom scoring vs the snapshot.
+Ports/spread/interpod route through the exact scan path instead.
+
+Validated properties (tests): feasibility of every placement, work
+conservation (unplaced only when nothing feasible remains), and priority
+dominance under scarcity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensorize.plugins import StaticPluginTensors, trivial_static_tensors
+from ..tensorize.schema import CPU_IDX, MEM_IDX, NodeBatch, PodBatch
+
+NEG = jnp.int32(-(1 << 30))
+
+CUMSUM_BLOCK = 512
+
+
+def _cumsum0(x, block: int = CUMSUM_BLOCK):
+    """Two-level cumsum along axis 0. XLA lowers a monolithic cumsum over a
+    50k axis to one giant reduce-window whose scoped VMEM blows the 16M
+    limit on v5e; blocking it (intra-block cumsum + block-offset cumsum)
+    keeps every window small."""
+    p = x.shape[0]
+    if p <= block:
+        return jnp.cumsum(x, axis=0)
+    pb = ((p + block - 1) // block) * block
+    pad = pb - p
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad, *x.shape[1:]), x.dtype)], axis=0
+        )
+    xb = x.reshape(pb // block, block, *x.shape[1:])
+    within = jnp.cumsum(xb, axis=1)
+    row_tot = within[:, -1]
+    offs = jnp.cumsum(row_tot, axis=0) - row_tot
+    out = within + offs[:, None]
+    return out.reshape(pb, *x.shape[1:])[:p]
+
+
+@dataclass(frozen=True)
+class SingleShotConfig:
+    max_rounds: int = 32
+    # price escalation per rejection round, in score points
+    price_step: int = 8
+    # nodes each request-class fans out over per round (clamped to N);
+    # wider = fewer rounds: 1024 measured 189ms vs 320ms at 256 for the
+    # 51.2k x 10.24k north-star config on v5e
+    top_t: int = 1024
+
+
+def _segmented_prefix(x, seg_start, seg_id, num_segments):
+    """Inclusive prefix sum of ``x`` within segments of a sorted key.
+    x: [P] or [P, K]; seg_start: [P] bool; seg_id: [P] int32."""
+    csum = _cumsum0(x)
+    base_at_start = jnp.where(
+        seg_start if x.ndim == 1 else seg_start[:, None], csum - x, 0
+    )
+    seg_base = jax.ops.segment_max(
+        base_at_start, seg_id, num_segments=num_segments
+    )
+    return csum - seg_base[seg_id]
+
+
+def _single_shot(
+    alloc,  # [K, N] int
+    used0,  # [K, N] int
+    pod_count0,  # [N] int32
+    max_pods,  # [N] int32
+    node_valid,  # [N] bool
+    static_mask,  # [C, N] bool
+    rc_req,  # [RC, K] int — request per request-class
+    rc_static,  # [RC] int32 — static-plugin class of the request-class
+    rc_of,  # [P] int32
+    priority,  # [P] int32
+    pod_valid,  # [P] bool
+    *,
+    max_rounds: int,
+    price_step: int,
+    top_t: int,
+):
+    p = rc_of.shape[0]
+    n = alloc.shape[1]
+    k = alloc.shape[0]
+    rc = rc_req.shape[0]
+    t = min(top_t, n)
+
+    alloc2 = alloc[: MEM_IDX + 1].astype(jnp.float32)
+    used2 = used0[: MEM_IDX + 1].astype(jnp.float32)
+    free_frac = jnp.where(
+        alloc2 > 0, (alloc2 - used2) / jnp.maximum(alloc2, 1.0), 0.0
+    )
+    base_score = (
+        100.0 * (free_frac[CPU_IDX] + free_frac[MEM_IDX]) / 2.0
+    ).astype(jnp.int32)  # [N] headroom at snapshot
+
+    pod_idx = jnp.arange(p, dtype=jnp.int32)
+
+    def round_step(carry, _):
+        used, pod_count, price, assigned_to = carry
+        unassigned = (assigned_to < 0) & pod_valid
+
+        # 1. class-level feasibility on REMAINING capacity: [RC, N]
+        free = alloc - used
+        fit = jnp.all(
+            rc_req[:, :, None] <= free[None, :, :], axis=1
+        )  # [RC, K, N] -> [RC, N]; RC is small by construction
+        ok = (
+            fit
+            & static_mask[rc_static]
+            & node_valid[None, :]
+            & (pod_count + 1 <= max_pods)[None, :]
+        )
+        score = jnp.where(ok, base_score[None, :] - price[None, :], NEG)
+
+        # 2. top-T nodes per class + round-robin fan-out of the class's
+        # unassigned pods across them
+        top_scores, top_nodes = jax.lax.top_k(score, t)  # [RC, T]
+        top_ok = top_scores > NEG
+        # feasible entries sort to the front; fan out only across them so a
+        # class with few feasible nodes still bids every round
+        n_ok = jnp.sum(top_ok.astype(jnp.int32), axis=1)  # [RC]
+
+        # rank of each unassigned pod within its class (stable)
+        key = jnp.where(
+            unassigned, rc_of.astype(jnp.int64) * p + pod_idx, (1 << 62)
+        )
+        order_rc = jnp.argsort(key)
+        rc_sorted = rc_of[order_rc]
+        seg_start_rc = jnp.concatenate(
+            [jnp.array([True]), rc_sorted[1:] != rc_sorted[:-1]]
+        )
+        seg_id_rc = _cumsum0(seg_start_rc.astype(jnp.int32)) - 1
+        rank_sorted = (
+            _segmented_prefix(
+                jnp.ones(p, dtype=jnp.int32), seg_start_rc, seg_id_rc, p
+            )
+            - 1
+        )
+        rank = jnp.zeros(p, dtype=jnp.int32).at[order_rc].set(rank_sorted)
+
+        slot = rank % jnp.maximum(n_ok[rc_of], 1)
+        target = top_nodes[rc_of, slot].astype(jnp.int32)
+        has_node = n_ok[rc_of] > 0
+        bidding = unassigned & has_node
+        target = jnp.where(bidding, target, n)  # park at virtual node n
+
+        # 3. admission: sort claimants by (node, -priority), segmented
+        # prefix sums against the node's remaining resources
+        sort_key = target.astype(jnp.int64) * (1 << 31) + (
+            (1 << 30) - priority.astype(jnp.int64)
+        )
+        order = jnp.argsort(sort_key)
+        t_sorted = target[order]
+        bidding_sorted = bidding[order]
+        req_sorted = jnp.where(
+            bidding_sorted[:, None], rc_req[rc_of[order]], 0
+        )  # [P, K]
+
+        seg_start = jnp.concatenate(
+            [jnp.array([True]), t_sorted[1:] != t_sorted[:-1]]
+        )
+        seg_id = _cumsum0(seg_start.astype(jnp.int32)) - 1
+        prefix = _segmented_prefix(req_sorted, seg_start, seg_id, p)
+        cnt_prefix = _segmented_prefix(
+            bidding_sorted.astype(jnp.int32), seg_start, seg_id, p
+        )
+
+        free_t = jnp.concatenate([free, jnp.zeros((k, 1), free.dtype)], axis=1)
+        cnt_free = jnp.concatenate(
+            [(max_pods - pod_count).astype(jnp.int32), jnp.zeros(1, jnp.int32)]
+        )
+        fits_res = jnp.all(prefix <= free_t[:, t_sorted].T, axis=1)
+        fits_cnt = cnt_prefix <= cnt_free[t_sorted]
+        admit_sorted = bidding_sorted & fits_res & fits_cnt
+        admit = jnp.zeros(p, dtype=bool).at[order].set(admit_sorted)
+
+        # 4. commit + price escalation on rejection
+        assigned_to = jnp.where(admit, target, assigned_to)
+        tgt_or_park = jnp.where(admit, target, n)
+        used = used + jax.ops.segment_sum(
+            jnp.where(admit[:, None], rc_req[rc_of], 0),
+            tgt_or_park,
+            num_segments=n + 1,
+        )[:n].T
+        pod_count = pod_count + jax.ops.segment_sum(
+            admit.astype(jnp.int32), tgt_or_park, num_segments=n + 1
+        )[:n]
+        rejected = bidding & ~admit
+        rej_per_node = jax.ops.segment_sum(
+            rejected.astype(jnp.int32), jnp.where(rejected, target, n),
+            num_segments=n + 1,
+        )[:n]
+        price = price + jnp.where(rej_per_node > 0, price_step, 0)
+
+        return (used, pod_count, price, assigned_to), admit.sum()
+
+    assigned0 = jnp.full(p, -1, dtype=jnp.int32)
+    price0 = jnp.zeros(n, dtype=jnp.int32)
+
+    # while_loop with early exit: converged solves stop paying for the
+    # remaining round budget (placed==0 means no further progress possible
+    # this configuration — every still-unassigned pod found no feasible
+    # node or lost admission AND prices already escalated)
+    def cond(state):
+        rounds, last_placed, _ = state
+        return (rounds < max_rounds) & (last_placed > 0)
+
+    def body(state):
+        rounds, _, carry = state
+        carry, placed = round_step(carry, None)
+        return rounds + 1, placed.astype(jnp.int32), carry
+
+    init_placed = jnp.int32(1)
+    _, _, (used, pod_count, _, assigned_to) = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), init_placed, (used0, pod_count0, price0, assigned0))
+    )
+    placed_total = jnp.sum((assigned_to >= 0).astype(jnp.int32))
+    return assigned_to, used, pod_count, placed_total
+
+
+_single_shot_jit = jax.jit(
+    _single_shot,
+    static_argnames=("max_rounds", "price_step", "top_t"),
+    donate_argnums=(1, 2),
+)
+
+
+def request_classes(
+    pods: PodBatch, static: StaticPluginTensors
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Dedup (static class, request vector) -> (rc_req [RC, K],
+    rc_static [RC], rc_of [Pp])."""
+    keyed = np.concatenate(
+        [static.class_of[:, None].astype(np.int64), pods.req], axis=1
+    )
+    uniq, inverse = np.unique(keyed, axis=0, return_inverse=True)
+    rc_static = uniq[:, 0].astype(np.int32)
+    rc_req = uniq[:, 1:].astype(pods.req.dtype)
+    return rc_req, rc_static, inverse.astype(np.int32)
+
+
+class SingleShotSolver:
+    """Host wrapper mirroring ExactSolver.solve's contract (fit + static
+    mask scope)."""
+
+    def __init__(self, config: SingleShotConfig | None = None):
+        self.config = config or SingleShotConfig()
+        if not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+
+    def solve(
+        self,
+        nodes: NodeBatch,
+        pods: PodBatch,
+        static: StaticPluginTensors | None = None,
+    ) -> np.ndarray:
+        if static is None:
+            static = trivial_static_tensors(pods, nodes.padded, nodes.schedulable)
+        rc_req, rc_static, rc_of = request_classes(pods, static)
+        assigned, used, pod_count, _ = _single_shot_jit(
+            jnp.asarray(nodes.allocatable),
+            jnp.asarray(nodes.used),
+            jnp.asarray(nodes.pod_count),
+            jnp.asarray(nodes.max_pods),
+            jnp.asarray(nodes.valid),
+            jnp.asarray(static.mask),
+            jnp.asarray(rc_req),
+            jnp.asarray(rc_static),
+            jnp.asarray(rc_of),
+            jnp.asarray(pods.priority),
+            jnp.asarray(pods.valid & pods.feasible_static),
+            max_rounds=self.config.max_rounds,
+            price_step=self.config.price_step,
+            top_t=self.config.top_t,
+        )
+        nodes.used = np.array(used)
+        nodes.pod_count = np.array(pod_count)
+        return np.asarray(assigned)[: pods.num_pods]

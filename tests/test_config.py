@@ -1,0 +1,229 @@
+"""KubeSchedulerConfiguration parsing, multi-profile routing, CLI."""
+
+import json
+import textwrap
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.config import types as ct
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+
+REFERENCE_STYLE_YAML = """
+apiVersion: kubescheduler.config.k8s.io/v1
+kind: KubeSchedulerConfiguration
+parallelism: 8
+percentageOfNodesToScore: 50
+podInitialBackoffSeconds: 2
+podMaxBackoffSeconds: 20
+profiles:
+  - schedulerName: default-scheduler
+    pluginConfig:
+      - name: NodeResourcesFit
+        args:
+          scoringStrategy:
+            type: MostAllocated
+            resources:
+              - name: cpu
+                weight: 2
+              - name: memory
+                weight: 1
+      - name: InterPodAffinity
+        args:
+          hardPodAffinityWeight: 10
+  - schedulerName: batch-scheduler
+    plugins:
+      score:
+        enabled:
+          - name: TaintToleration
+            weight: 5
+        disabled:
+          - name: ImageLocality
+extenders:
+  - urlPrefix: http://127.0.0.1:10259
+    filterVerb: filter
+    prioritizeVerb: prioritize
+    weight: 2
+    nodeCacheCapable: true
+    ignorable: true
+tpuSolver:
+  batchSize: 2048
+  tieBreak: first
+"""
+
+
+def test_reference_style_yaml_parses():
+    cfg = ct.load(REFERENCE_STYLE_YAML)
+    assert cfg.parallelism == 8
+    assert cfg.pod_initial_backoff_seconds == 2
+    # percentageOfNodesToScore != 0/100 -> parsed with a warning
+    assert any("percentageOfNodesToScore" in w for w in cfg.warnings)
+    assert len(cfg.profiles) == 2
+    p0 = cfg.profile_for("default-scheduler")
+    assert p0.scoring_strategy.type == "MostAllocated"
+    assert p0.hard_pod_affinity_weight == 10
+    p1 = cfg.profile_for("batch-scheduler")
+    assert p1.score_weights["TaintToleration"] == 5
+    assert p1.score_weights["ImageLocality"] == 0
+    assert cfg.extenders[0].node_cache_capable
+    assert cfg.tpu_solver.batch_size == 2048
+    assert cfg.tpu_solver.tie_break == "first"
+
+
+def test_duplicate_profile_rejected():
+    import pytest
+
+    bad = {
+        "profiles": [
+            {"schedulerName": "x"},
+            {"schedulerName": "x"},
+        ]
+    }
+    with pytest.raises(ValueError):
+        ct.load(bad)
+
+
+def test_scheduler_config_bridge():
+    cfg = ct.load(REFERENCE_STYLE_YAML)
+    sc = ct.scheduler_config(cfg)
+    assert sc.batch_size == 2048
+    # every profile becomes a routing entry
+    assert set(sc.profiles) == {"default-scheduler", "batch-scheduler"}
+    batch = sc.profiles["batch-scheduler"]
+    assert batch.taint_weight == 5
+    assert batch.image_weight == 0
+    assert batch.tie_break == "first"
+    assert sc.profiles["default-scheduler"].scoring_strategy == "MostAllocated"
+
+
+def test_multi_profile_routing():
+    cs = ClusterState()
+    for i in range(4):
+        cs.create_node(
+            MakeNode().name(f"n{i}").capacity(
+                {"cpu": "8", "memory": "32Gi", "pods": "20"}
+            ).obj()
+        )
+    sched = Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=16,
+            profiles={
+                "default-scheduler": ExactSolverConfig(tie_break="first"),
+                "batch-scheduler": ExactSolverConfig(tie_break="first"),
+            },
+        ),
+    )
+    cs.create_pod(MakePod().name("a").req({"cpu": "1"}).obj())
+    cs.create_pod(
+        MakePod().name("b").scheduler_name("batch-scheduler").req({"cpu": "1"}).obj()
+    )
+    # a pod for an unknown scheduler is ignored entirely
+    cs.create_pod(
+        MakePod().name("ghost").scheduler_name("other").req({"cpu": "1"}).obj()
+    )
+    r = sched.schedule_batch()
+    scheduled = {k for k, _ in r.scheduled}
+    assert scheduled == {"default/a", "default/b"}
+    assert sched.pending == 0  # ghost never queued
+
+
+def test_node_update_precheck_gates_wakeups():
+    cs = ClusterState()
+    node = MakeNode().name("n0").capacity({"cpu": "1", "memory": "4Gi", "pods": "10"}).obj()
+    cs.create_node(node)
+    sched = Scheduler(cs, SchedulerConfig(batch_size=4))
+    cs.create_pod(MakePod().name("big").req({"cpu": "4"}).obj())
+    r = sched.schedule_batch()
+    assert r.unschedulable == ["default/big"]
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+
+    # irrelevant node update (no allocatable/label/taint change): stays parked
+    cs.update_node(cs.get_node("n0"))
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+
+    # allocatable grows: pod moves to backoff/active
+    bigger = MakeNode().name("n0").capacity({"cpu": "8", "memory": "4Gi", "pods": "10"}).obj()
+    cs.update_node(bigger)
+    counts = sched.queue.pending_counts()
+    assert counts["unschedulable"] == 0
+    assert counts["active"] + counts["backoff"] == 1
+
+
+def test_most_allocated_strategy_parity():
+    """MostAllocated (bin-packing) through solver + oracle: pods pile onto
+    the already-loaded node instead of spreading."""
+    from kubernetes_tpu.ops.oracle.profile import (
+        FullOracle,
+        ProfileWeights,
+        make_oracle_nodes,
+    )
+    from kubernetes_tpu.tensorize.schema import (
+        ResourceVocab,
+        build_node_batch,
+        build_pod_batch,
+    )
+    from kubernetes_tpu.solver.exact import ExactSolver
+
+    nodes = [
+        MakeNode().name(f"n{i}").capacity(
+            {"cpu": "8", "memory": "32Gi", "pods": "20"}
+        ).obj()
+        for i in range(3)
+    ]
+    seed = MakePod().name("seed").node("n0").req({"cpu": "2", "memory": "4Gi"}).obj()
+    pods = [
+        MakePod().name(f"p{i}").req({"cpu": "1", "memory": "2Gi"}).obj()
+        for i in range(4)
+    ]
+    vocab = ResourceVocab.build(pods + [seed], nodes)
+    nbatch = build_node_batch(nodes, {"n0": [seed]}, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    solver = ExactSolver(
+        ExactSolverConfig(tie_break="first", scoring_strategy="MostAllocated")
+    )
+    a = solver.solve(nbatch, pbatch)
+    assert all(x == 0 for x in a)  # packs onto the loaded node
+    oracle = FullOracle(
+        make_oracle_nodes(nodes, {"n0": [seed]}),
+        ProfileWeights(scoring_strategy="MostAllocated"),
+    )
+    names = [nbatch.names[x] for x in a]
+    errors = oracle.validate_assignments(pods, list(a), names=names)
+    assert not errors, errors[:3]
+
+
+def test_cli_config_command(tmp_path, capsys):
+    from kubernetes_tpu.cli import main
+
+    p = tmp_path / "cfg.yaml"
+    p.write_text(REFERENCE_STYLE_YAML)
+    rc = main(["--config", str(p), "config"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["profiles"][0]["scoringStrategy"] == "MostAllocated"
+    assert out["tpuSolver"]["batchSize"] == 2048
+
+
+def test_cli_perf_command(tmp_path, capsys):
+    from kubernetes_tpu.cli import main
+
+    wl = tmp_path / "wl.yaml"
+    wl.write_text(
+        textwrap.dedent(
+            """
+            - name: Mini
+              workloadTemplate:
+                - {opcode: createNodes, count: 4}
+                - {opcode: createPods, count: 8, collectMetrics: true}
+                - {opcode: barrier}
+              workloads:
+                - name: only
+                  params: {}
+            """
+        )
+    )
+    rc = main(["perf", str(wl)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["scheduled"] == 8

@@ -102,13 +102,21 @@ def test_preempt_wire_shape():
             "nodeNameToVictims": {"node-1": {"pods": []}, "node-2": {"pods": []}},
         }
     )
-    meta = out["nodeNameToMetaVictims"]
-    # node-1 needs the low pod evicted; node-2 fits with zero victims and is
-    # also reported (the caller re-checks), with an empty victim list
-    assert meta["node-1"]["pods"] == [{"uid": "low-uid"}]
-    assert meta["node-1"]["numPDBViolations"] == 0
-    assert meta["node-2"]["pods"] == []
+    # non-nodeCacheCapable extenders answer with FULL pod objects under
+    # nodeNameToVictims (extender.go#ProcessPreemption reads that field)
+    assert "nodeNameToMetaVictims" not in out
+    victims = out["nodeNameToVictims"]
+    assert [p["metadata"]["name"] for p in victims["node-1"]["pods"]] == ["low"]
+    assert victims["node-1"]["numPDBViolations"] == 0
+    assert victims["node-2"]["pods"] == []
     json.dumps(out)
+
+    # nodeCacheCapable mode: MetaVictims with bare uids
+    core_nc = ExtenderCore(cs, node_cache_capable=True)
+    out2 = core_nc.preempt(
+        {"pod": vip.to_dict(), "nodeNameToVictims": {"node-1": {"pods": []}}}
+    )
+    assert out2["nodeNameToMetaVictims"]["node-1"]["pods"] == [{"uid": "low-uid"}]
 
 
 def test_filter_unknown_name_fails_per_node():
@@ -136,7 +144,7 @@ def test_preempt_respects_static_filters():
     out = core.preempt(
         {"pod": vip.to_dict(), "nodeNameToVictims": {"node-3": {"pods": []}}}
     )
-    assert out["nodeNameToMetaVictims"] == {}
+    assert out["nodeNameToVictims"] == {}
 
 
 def test_live_http_round_trip():
