@@ -516,6 +516,8 @@ class Pod:
     init_containers: tuple[Container, ...] = ()
     overhead: dict[str, int] = field(default_factory=dict)  # canonical ints
     host_network: bool = False
+    # PVC names referenced by spec.volumes[].persistentVolumeClaim.claimName
+    pvc_names: tuple[str, ...] = ()
 
     # status
     phase: str = "Pending"
@@ -627,6 +629,11 @@ class Pod:
             ),
             overhead=canonical_requests(spec.get("overhead")),
             host_network=bool(spec.get("hostNetwork") or False),
+            pvc_names=tuple(
+                v["persistentVolumeClaim"]["claimName"]
+                for v in spec.get("volumes") or ()
+                if v.get("persistentVolumeClaim", {}).get("claimName")
+            ),
             phase=status.get("phase") or "Pending",
             nominated_node_name=status.get("nominatedNodeName") or "",
             resource_version=int(meta.get("resourceVersion") or 0),
@@ -665,6 +672,14 @@ class Pod:
             }
         if self.host_network:
             spec["hostNetwork"] = True
+        if self.pvc_names:
+            spec["volumes"] = [
+                {
+                    "name": f"vol{i}",
+                    "persistentVolumeClaim": {"claimName": c},
+                }
+                for i, c in enumerate(self.pvc_names)
+            ]
         status: dict[str, Any] = {"phase": self.phase}
         if self.nominated_node_name:
             status["nominatedNodeName"] = self.nominated_node_name
@@ -678,6 +693,173 @@ class Pod:
         if self.resource_version:
             meta["resourceVersion"] = str(self.resource_version)
         return {"apiVersion": "v1", "kind": "Pod", "metadata": meta, "spec": spec, "status": status}
+
+
+# ---------------------------------------------------------------------------
+# PersistentVolume / PersistentVolumeClaim — the slice the volume plugins
+# read ([BOUNDARY], SURVEY.md §3.2: static F-stage checks; dynamic
+# provisioning and the PV controller are out of scope)
+# ---------------------------------------------------------------------------
+
+ACCESS_RWO = "ReadWriteOnce"
+
+ZONE_LABELS = ("topology.kubernetes.io/zone", "failure-domain.beta.kubernetes.io/zone")
+
+
+@dataclass
+class PersistentVolume:
+    """core/v1#PersistentVolume: capacity, zone labels, node affinity, the
+    CSI driver name (for nodevolumelimits counting), access modes."""
+
+    name: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    capacity_bytes: int = 0
+    access_modes: tuple[str, ...] = (ACCESS_RWO,)
+    storage_class: str = ""
+    csi_driver: str = ""
+    claim_ref: str = ""  # ns/name of the bound PVC ("" = available)
+    node_affinity: "NodeAffinity | None" = None  # required terms only
+    resource_version: int = 0
+
+    def matches_node(self, node: "Node") -> bool:
+        """volume_zone.go + the PV nodeAffinity check in volumebinding:
+        zone labels (if present) and spec.nodeAffinity must match."""
+        for zl in ZONE_LABELS:
+            want = self.labels.get(zl)
+            if want is not None:
+                # zone label values may be a __-separated set (GCE legacy)
+                if node.labels.get(zl) not in want.split("__"):
+                    return False
+        if self.node_affinity is not None and self.node_affinity.required is not None:
+            fields = node.field_labels()
+            if not any(
+                t.matches(node.labels, fields) for t in self.node_affinity.required
+            ):
+                return False
+        return True
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PersistentVolume":
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        cap = canonical_requests((spec.get("capacity") or {}))
+        csi = spec.get("csi") or {}
+        na = spec.get("nodeAffinity") or {}
+        required = na.get("required")
+        node_affinity = None
+        if required is not None:
+            node_affinity = NodeAffinity.from_dict(
+                {"requiredDuringSchedulingIgnoredDuringExecution": required}
+            )
+        claim = spec.get("claimRef") or {}
+        claim_ref = (
+            f"{claim.get('namespace', 'default')}/{claim['name']}"
+            if claim.get("name")
+            else ""
+        )
+        return PersistentVolume(
+            name=meta.get("name") or "",
+            labels=dict(meta.get("labels") or {}),
+            capacity_bytes=cap.get("storage", 0),
+            access_modes=tuple(spec.get("accessModes") or (ACCESS_RWO,)),
+            storage_class=spec.get("storageClassName") or "",
+            csi_driver=csi.get("driver") or "",
+            claim_ref=claim_ref,
+            node_affinity=node_affinity,
+            resource_version=int(meta.get("resourceVersion") or 0),
+        )
+
+    def to_dict(self) -> dict:
+        spec: dict[str, Any] = {
+            "capacity": {"storage": format_canonical("storage", self.capacity_bytes)},
+            "accessModes": list(self.access_modes),
+        }
+        if self.storage_class:
+            spec["storageClassName"] = self.storage_class
+        if self.csi_driver:
+            spec["csi"] = {"driver": self.csi_driver}
+        if self.claim_ref:
+            ns, name = self.claim_ref.split("/", 1)
+            spec["claimRef"] = {"namespace": ns, "name": name}
+        if self.node_affinity is not None:
+            na = self.node_affinity.to_dict()
+            req = na.get("requiredDuringSchedulingIgnoredDuringExecution")
+            if req:
+                spec["nodeAffinity"] = {"required": req}
+        meta: dict[str, Any] = {"name": self.name}
+        if self.labels:
+            meta["labels"] = dict(self.labels)
+        return {
+            "apiVersion": "v1",
+            "kind": "PersistentVolume",
+            "metadata": meta,
+            "spec": spec,
+        }
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """core/v1#PersistentVolumeClaim: the scheduler reads the bound volume
+    name, requested size, class, and the binding mode of its class
+    (WaitForFirstConsumer => defer to scheduling)."""
+
+    name: str = ""
+    namespace: str = "default"
+    volume_name: str = ""  # bound PV ("" = unbound)
+    storage_class: str = ""
+    request_bytes: int = 0
+    access_modes: tuple[str, ...] = (ACCESS_RWO,)
+    # StorageClass.volumeBindingMode collapsed onto the claim [BOUNDARY]
+    wait_for_first_consumer: bool = False
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PersistentVolumeClaim":
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        req = canonical_requests(
+            ((spec.get("resources") or {}).get("requests") or {})
+        )
+        return PersistentVolumeClaim(
+            name=meta.get("name") or "",
+            namespace=meta.get("namespace") or "default",
+            volume_name=spec.get("volumeName") or "",
+            storage_class=spec.get("storageClassName") or "",
+            request_bytes=req.get("storage", 0),
+            access_modes=tuple(spec.get("accessModes") or (ACCESS_RWO,)),
+            wait_for_first_consumer=bool(
+                (d.get("metadata") or {})
+                .get("annotations", {})
+                .get("volume.kubernetes.io/wait-for-first-consumer")
+            )
+            or bool(spec.get("waitForFirstConsumer")),
+            resource_version=int(meta.get("resourceVersion") or 0),
+        )
+
+    def to_dict(self) -> dict:
+        spec: dict[str, Any] = {"accessModes": list(self.access_modes)}
+        if self.volume_name:
+            spec["volumeName"] = self.volume_name
+        if self.storage_class:
+            spec["storageClassName"] = self.storage_class
+        if self.request_bytes:
+            spec["resources"] = {
+                "requests": {
+                    "storage": format_canonical("storage", self.request_bytes)
+                }
+            }
+        if self.wait_for_first_consumer:
+            spec["waitForFirstConsumer"] = True
+        return {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": spec,
+        }
 
 
 # ---------------------------------------------------------------------------

@@ -280,6 +280,10 @@ class MakePod:
         )
         return self
 
+    def pvc(self, claim_name: str) -> "MakePod":
+        self._pod.pvc_names = self._pod.pvc_names + (claim_name,)
+        return self
+
     def nominated_node_name(self, n: str) -> "MakePod":
         self._pod.nominated_node_name = n
         return self

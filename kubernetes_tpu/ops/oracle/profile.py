@@ -83,15 +83,19 @@ def make_oracle_nodes(
 
 
 class FullOracle:
-    """Sequential ground-truth scheduler over the full static plugin set."""
+    """Sequential ground-truth scheduler over the full static plugin set.
+    ``volume_ctx`` (ops.oracle.volumes.VolumeContext) enables the volume
+    plugin family's filters."""
 
     def __init__(
         self,
         nodes: list[OracleNode],
         weights: ProfileWeights | None = None,
+        volume_ctx=None,
     ):
         self.nodes = nodes
         self.weights = weights or ProfileWeights()
+        self.volume_ctx = volume_ctx
         self._refresh_image_states()
 
     def _refresh_image_states(self) -> None:
@@ -122,6 +126,8 @@ class FullOracle:
             interpod_state = oip.build_interpod_state(
                 pod, self._all_nodes_with_pods()
             )
+        from . import volumes as ovol
+
         return (
             opl.node_name_filter(pod, on.node)
             and opl.node_unschedulable_filter(pod, on.node)
@@ -131,6 +137,11 @@ class FullOracle:
             and not fit_filter(pod, on.res)
             and (spread_state is None or spread_state.check(on.node))
             and interpod_state.check(on.node)
+            and (
+                self.volume_ctx is None
+                or not pod.pvc_names
+                or ovol.volume_filter(pod, on.node, self.volume_ctx)
+            )
         )
 
     def score_totals(self, pod: Pod, feasible: list[int]) -> dict[int, int]:

@@ -1,0 +1,97 @@
+"""Pallas TPU kernels — the native-code tier of this framework.
+
+SURVEY.md §3.4: the reference implements its hot loops in pure Go; the
+"native equivalent" obligation here maps to Pallas TPU kernels with jax.lax
+reference implementations for parity (the parity tests ARE the sanitizer).
+First kernel: the (term, domain) count aggregation that PodTopologySpread
+and InterPodAffinity run every scan step (ops/spread.py#_domain_aggregate,
+ops/interpod.py#domain_counts currently lower it through
+jax.ops.segment_sum).
+
+domain_counts_pallas computes, for T term rows at once,
+
+    out[t, d] = sum_n  cnt[t, n] * (dom[t, n] == d)
+
+by materializing the one-hot domain matrix PER TILE in VMEM and contracting
+it on the MXU: each (t, n-tile) grid step does a [1, NT] x [NT, D] matmul
+accumulated into the [T, D] output block — the blockwise-attention trick
+applied to scatter-free segment reduction (guide §4, §7). Grid iterates the
+n-tile axis innermost so the output block stays resident and accumulates
+(@pl.when zero-init on the first tile).
+
+Works in interpret mode on CPU (tests) and compiled on the axon TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+N_TILE = 512  # lanes per grid step (multiple of 128)
+T_TILE = 8  # term rows per grid step (sublane quantum for int32-as-f32)
+
+
+def _domain_counts_kernel(dom_ref, cnt_ref, out_ref, *, d_pad: int):
+    j = pl.program_id(1)  # n-tile index (innermost)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    dom = dom_ref[...]  # [T_TILE, NT] int32
+    cnt = cnt_ref[...]  # [T_TILE, NT] int32
+    masked = jnp.where(dom >= 0, cnt, 0).astype(jnp.float32)
+    iota_d = jax.lax.broadcasted_iota(jnp.int32, (N_TILE, d_pad), 1)
+    rows = []
+    for s in range(T_TILE):  # static unroll: each row has its own one-hot
+        onehot = (dom[s].reshape(N_TILE, 1) == iota_d).astype(jnp.float32)
+        rows.append(
+            jax.lax.dot_general(
+                masked[s].reshape(1, N_TILE),
+                onehot,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [1, D]
+        )
+    out_ref[...] += jnp.concatenate(rows, axis=0).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("d_pad", "interpret"))
+def domain_counts_pallas(dom, cnt, d_pad: int, interpret: bool = False):
+    """[T, D] domain totals from per-node counts.
+
+    dom: [T, N] int32 domain ids (-1 = node lacks the key, excluded);
+    cnt: [T, N] int32. T must be a multiple of T_TILE and N of N_TILE (the
+    tensorizers pad instance axes to 8s and the node axis to 128s; callers
+    pad up to these tiles).
+    """
+    t, n = dom.shape
+    assert n % N_TILE == 0, f"node axis {n} not a multiple of {N_TILE}"
+    assert t % T_TILE == 0, f"term axis {t} not a multiple of {T_TILE}"
+    grid = (t // T_TILE, n // N_TILE)
+    return pl.pallas_call(
+        functools.partial(_domain_counts_kernel, d_pad=d_pad),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((T_TILE, N_TILE), lambda i, j: (i, j)),
+            pl.BlockSpec((T_TILE, N_TILE), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((T_TILE, d_pad), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((t, d_pad), jnp.int32),
+        interpret=interpret,
+    )(dom, cnt)
+
+
+def domain_counts_reference(dom, cnt, d_pad: int):
+    """jax.lax reference implementation (parity anchor): the segment_sum
+    formulation the solver currently uses."""
+    t = dom.shape[0]
+    hk = dom >= 0
+    dd = jnp.where(hk, dom, 0)
+    seg_ids = (dd + jnp.arange(t, dtype=jnp.int32)[:, None] * d_pad).reshape(-1)
+    return jax.ops.segment_sum(
+        jnp.where(hk, cnt, 0).reshape(-1), seg_ids, num_segments=t * d_pad
+    ).reshape(t, d_pad)

@@ -225,7 +225,22 @@ class Scheduler:
             info = self.cache.nodes.get(name) if name else None
             slot_nodes.append(info.node if info is not None else None)
 
-        static = build_static_tensors(pods, pbatch, slot_nodes, batch.padded)
+        volume_ctx = None
+        if any(p.pvc_names for p in pods):
+            from .ops.oracle.volumes import VolumeContext
+
+            volume_ctx = VolumeContext.build(
+                self.cluster.list_pvs(),
+                self.cluster.list_pvcs(),
+                {
+                    info.node.name: list(info.pods.values())
+                    for info in self.cache.nodes.values()
+                    if info.node is not None and info.pods
+                },
+            )
+        static = build_static_tensors(
+            pods, pbatch, slot_nodes, batch.padded, volume_ctx
+        )
         need_ports = any(p.host_ports() for p in pods)
         need_spread = any(r.topology_spread_constraints for r in static.reps)
 

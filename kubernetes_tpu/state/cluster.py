@@ -24,7 +24,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Literal
 
-from ..api.objects import Node, Pod, PodDisruptionBudget
+from ..api.objects import (
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodDisruptionBudget,
+)
 
 EventType = Literal["ADDED", "MODIFIED", "DELETED"]
 
@@ -56,6 +62,8 @@ class ClusterState:
         self._pods: dict[str, Pod] = {}  # key = ns/name
         self._nodes: dict[str, Node] = {}
         self._pdbs: dict[str, PodDisruptionBudget] = {}
+        self._pvs: dict[str, PersistentVolume] = {}
+        self._pvcs: dict[str, PersistentVolumeClaim] = {}
         self._watchers: list[Watcher] = []
         # fault injection: called with (pod, node_name) before a bind commits;
         # raise ApiError to simulate apiserver-side rejection
@@ -194,6 +202,28 @@ class ClusterState:
 
     def list_pdbs(self) -> list[PodDisruptionBudget]:
         return list(self._pdbs.values())
+
+    # -- PersistentVolumes / Claims (volume plugin inputs) --
+
+    def create_pv(self, pv: PersistentVolume) -> PersistentVolume:
+        if pv.name in self._pvs:
+            raise ApiError("AlreadyExists", pv.name)
+        pv.resource_version = self._next_rv()
+        self._pvs[pv.name] = pv
+        return pv
+
+    def list_pvs(self) -> list[PersistentVolume]:
+        return list(self._pvs.values())
+
+    def create_pvc(self, pvc: PersistentVolumeClaim) -> PersistentVolumeClaim:
+        if pvc.key in self._pvcs:
+            raise ApiError("AlreadyExists", pvc.key)
+        pvc.resource_version = self._next_rv()
+        self._pvcs[pvc.key] = pvc
+        return pvc
+
+    def list_pvcs(self) -> list[PersistentVolumeClaim]:
+        return list(self._pvcs.values())
 
     # -- bulk helpers for benchmarks --
 

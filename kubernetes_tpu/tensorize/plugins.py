@@ -39,6 +39,7 @@ import numpy as np
 
 from ..api.objects import Node, Pod
 from ..ops.oracle import plugins as opl
+from ..ops.oracle import volumes as ovol
 from .schema import PodBatch, bucket_pow2
 
 CLASS_PAD = 8  # pad the class axis to multiples of this (sublane-ish quantum)
@@ -81,6 +82,8 @@ def _class_key(pod: Pod, with_images: bool):
         len(pod.containers) if with_images else 0,
         spread,
         interpod,
+        # volume plugins resolve PVCs by (namespace, claim name)
+        (pod.namespace, pod.pvc_names) if pod.pvc_names else (),
     )
 
 
@@ -132,9 +135,12 @@ def build_static_tensors(
     pbatch: PodBatch,
     slot_nodes: Sequence[Node | None],
     padded_n: int,
+    volume_ctx=None,
 ) -> StaticPluginTensors:
     """slot_nodes: Node per snapshot slot (None = free/invalid slot), so the
-    class tensors share the solver's node index space."""
+    class tensors share the solver's node index space. ``volume_ctx`` (an
+    ops.oracle.volumes.VolumeContext) folds the volume plugin family's
+    static checks into the mask."""
     live_nodes = [n for n in slot_nodes if n is not None]
     image_states = opl.build_image_states(live_nodes)
     total_nodes = len(live_nodes)
@@ -167,6 +173,11 @@ def build_static_tensors(
                 and opl.node_unschedulable_filter(rep, node)
                 and opl.taint_toleration_filter(rep, node)
                 and opl.node_affinity_filter(rep, node)
+                and (
+                    volume_ctx is None
+                    or not rep.pvc_names
+                    or ovol.volume_filter(rep, node, volume_ctx)
+                )
             )
             mask[c, j] = ok
             if not ok:

@@ -1,0 +1,174 @@
+"""Volume plugin family: oracle unit tests + solver parity + e2e."""
+
+from kubernetes_tpu.api.objects import (
+    NodeAffinity,
+    PersistentVolume,
+    PersistentVolumeClaim,
+)
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.ops.oracle.profile import FullOracle, make_oracle_nodes
+from kubernetes_tpu.ops.oracle.volumes import (
+    VolumeContext,
+    csi_limit_key,
+    volume_filter,
+)
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+from kubernetes_tpu.tensorize.plugins import build_static_tensors
+from kubernetes_tpu.tensorize.schema import (
+    ResourceVocab,
+    build_node_batch,
+    build_pod_batch,
+)
+
+GB = 1024**3
+
+
+def zone_node(name, zone):
+    return (
+        MakeNode().name(name)
+        .capacity({"cpu": "8", "memory": "32Gi", "pods": "20"})
+        .label("topology.kubernetes.io/zone", zone)
+        .obj()
+    )
+
+
+def pv(name, zone=None, size=10 * GB, claim_ref="", sc="", driver="", modes=("ReadWriteOnce",)):
+    labels = {"topology.kubernetes.io/zone": zone} if zone else {}
+    return PersistentVolume(
+        name=name, labels=labels, capacity_bytes=size, claim_ref=claim_ref,
+        storage_class=sc, csi_driver=driver, access_modes=tuple(modes),
+    )
+
+
+def pvc(name, volume="", size=5 * GB, sc="", wffc=False, ns="default"):
+    return PersistentVolumeClaim(
+        name=name, namespace=ns, volume_name=volume, request_bytes=size,
+        storage_class=sc, wait_for_first_consumer=wffc,
+    )
+
+
+# -- oracle unit tests ------------------------------------------------------
+
+
+def test_bound_claim_zone_check():
+    ctx = VolumeContext.build([pv("pv1", zone="z0")], [pvc("c1", volume="pv1")], {})
+    pod = MakePod().name("p").pvc("c1").obj()
+    assert volume_filter(pod, zone_node("a", "z0"), ctx)
+    assert not volume_filter(pod, zone_node("b", "z1"), ctx)
+
+
+def test_missing_claim_or_pv_fails():
+    ctx = VolumeContext.build([], [], {})
+    pod = MakePod().name("p").pvc("ghost").obj()
+    assert not volume_filter(pod, zone_node("a", "z0"), ctx)
+    ctx2 = VolumeContext.build([], [pvc("c1", volume="gone")], {})
+    pod2 = MakePod().name("p").pvc("c1").obj()
+    assert not volume_filter(pod2, zone_node("a", "z0"), ctx2)
+
+
+def test_wait_for_first_consumer_defers():
+    ctx = VolumeContext.build([], [pvc("c1", wffc=True)], {})
+    pod = MakePod().name("p").pvc("c1").obj()
+    assert volume_filter(pod, zone_node("a", "z0"), ctx)
+
+
+def test_unbound_immediate_needs_matching_pv():
+    # available PV only in z0, big enough, same class
+    ctx = VolumeContext.build(
+        [pv("pv1", zone="z0", size=10 * GB, sc="fast")],
+        [pvc("c1", size=5 * GB, sc="fast")],
+        {},
+    )
+    pod = MakePod().name("p").pvc("c1").obj()
+    assert volume_filter(pod, zone_node("a", "z0"), ctx)
+    assert not volume_filter(pod, zone_node("b", "z1"), ctx)
+    # too-small PV fails
+    ctx2 = VolumeContext.build(
+        [pv("pv1", zone="z0", size=1 * GB, sc="fast")],
+        [pvc("c1", size=5 * GB, sc="fast")],
+        {},
+    )
+    assert not volume_filter(pod, zone_node("a", "z0"), ctx2)
+
+
+def test_rwo_follows_holder():
+    holder = MakePod().name("holder").node("a").pvc("c1").obj()
+    ctx = VolumeContext.build(
+        [pv("pv1")], [pvc("c1", volume="pv1")], {"a": [holder]}
+    )
+    pod = MakePod().name("p").pvc("c1").obj()
+    assert volume_filter(pod, zone_node("a", "z0"), ctx)
+    assert not volume_filter(pod, zone_node("b", "z0"), ctx)
+
+
+def test_csi_volume_limits():
+    n = (
+        MakeNode().name("a")
+        .capacity({
+            "cpu": "8", "memory": "32Gi", "pods": "20",
+            csi_limit_key("ebs.csi.aws.com"): "2",
+        })
+        .obj()
+    )
+    attached = [
+        MakePod().name(f"e{i}").node("a").pvc(f"c{i}").obj() for i in range(2)
+    ]
+    pvs = [pv(f"pv{i}", driver="ebs.csi.aws.com") for i in range(3)]
+    pvcs = [pvc(f"c{i}", volume=f"pv{i}") for i in range(3)]
+    ctx = VolumeContext.build(pvs, pvcs, {"a": attached})
+    pod = MakePod().name("p").pvc("c2").obj()
+    assert not volume_filter(pod, n, ctx)  # 2 attached + 1 new > limit 2
+    # node without the limit key accepts
+    free = MakeNode().name("b").capacity({"cpu": "8", "pods": "20"}).obj()
+    ctx2 = VolumeContext.build(pvs, pvcs, {})
+    assert volume_filter(pod, free, ctx2)
+
+
+# -- solver parity ----------------------------------------------------------
+
+
+def test_solver_parity_with_volumes():
+    nodes = [zone_node(f"n{i}", f"z{i % 2}") for i in range(4)]
+    pvs = [pv("pv-a", zone="z0"), pv("pv-b", zone="z1")]
+    pvcs = [pvc("claim-a", volume="pv-a"), pvc("claim-b", volume="pv-b")]
+    pods = [
+        MakePod().name("pa").pvc("claim-a").req({"cpu": "1"}).obj(),
+        MakePod().name("pb").pvc("claim-b").req({"cpu": "1"}).obj(),
+        MakePod().name("free").req({"cpu": "1"}).obj(),
+    ]
+    ctx = VolumeContext.build(pvs, pvcs, {})
+    vocab = ResourceVocab.build(pods, nodes)
+    nbatch = build_node_batch(nodes, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - 4)
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded, ctx)
+    a = ExactSolver(ExactSolverConfig(tie_break="first")).solve(
+        nbatch, pbatch, static
+    )
+    assert int(a[0]) % 2 == 0  # z0
+    assert int(a[1]) % 2 == 1  # z1
+    oracle = FullOracle(make_oracle_nodes(nodes), volume_ctx=ctx)
+    names = [nbatch.names[x] if x >= 0 else None for x in a]
+    errors = oracle.validate_assignments(pods, list(a), names=names)
+    assert not errors, errors[:3]
+
+
+# -- e2e --------------------------------------------------------------------
+
+
+def test_e2e_zonal_volume_scheduling():
+    cs = ClusterState()
+    for i in range(4):
+        cs.create_node(zone_node(f"node-{i}", f"z{i % 2}"))
+    cs.create_pv(pv("data-pv", zone="z1", size=20 * GB))
+    cs.create_pvc(pvc("data", volume="data-pv"))
+    sched = Scheduler(
+        cs, SchedulerConfig(batch_size=8, solver=ExactSolverConfig(tie_break="first"))
+    )
+    cs.create_pod(MakePod().name("db").pvc("data").req({"cpu": "2"}).obj())
+    r = sched.schedule_batch()
+    assert len(r.scheduled) == 1
+    _, node = r.scheduled[0]
+    assert int(node.split("-")[1]) % 2 == 1  # z1 only
