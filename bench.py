@@ -27,7 +27,7 @@ import time
 
 N_NODES = 1_000
 N_PODS = 5_000
-BATCH = 1_024
+BATCH = 4_096
 BASELINE_PODS_PER_SEC = 300.0
 
 NS_NODES = 10_240
@@ -98,17 +98,61 @@ def north_star() -> dict:
     }
 
 
+def _warmup(n_nodes: int, n_pods: int, batch: int) -> float:
+    """Compile the exact-scan pipeline on the shapes the timed run will use
+    (VERDICT r1 #2: startup warmup on bucketed shapes). A throwaway
+    cluster of identical shape triggers the same executable; with the
+    persistent compilation cache it deserializes from disk on restarts."""
+    from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.solver.exact import ExactSolverConfig
+    from kubernetes_tpu.state.cluster import ClusterState
+
+    t0 = time.perf_counter()
+    cs = ClusterState()
+    for i in range(n_nodes):
+        cs.create_node(
+            MakeNode()
+            .name(f"warm-node-{i:05}")
+            .capacity({"cpu": "16", "memory": "64Gi", "pods": "110"})
+            .obj()
+        )
+    sched = Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=batch, solver=ExactSolverConfig(tie_break="random")
+        ),
+    )
+    for i in range(min(n_pods, batch + batch // 2)):
+        cs.create_pod(
+            MakePod()
+            .name(f"warm-pod-{i:05}")
+            .req({"cpu": "250m", "memory": "512Mi"})
+            .obj()
+        )
+    # two batches: the second exercises the device-session heal path
+    # (dirty-column scatter) so its executable is also warm before timing
+    sched.schedule_batch()
+    sched.schedule_batch()
+    return time.perf_counter() - t0
+
+
 def main() -> None:
     import jax
 
     # jax 0.9 + axon ignores the JAX_ENABLE_X64 env var; resource arithmetic
     # is int64 (memory bytes overflow int32), so set it via config.
     jax.config.update("jax_enable_x64", True)
+    from kubernetes_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     from kubernetes_tpu.api.wrappers import MakeNode, MakePod
     from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
     from kubernetes_tpu.solver.exact import ExactSolverConfig
     from kubernetes_tpu.state.cluster import ClusterState
+
+    warmup_s = _warmup(N_NODES, N_PODS, BATCH)
 
     cs = ClusterState()
     for i in range(N_NODES):
@@ -150,11 +194,10 @@ def main() -> None:
 
     assert scheduled == N_PODS, f"only {scheduled}/{N_PODS} scheduled"
 
-    # steady state: drop the first batch (carries XLA compilation)
-    steady = batch_times[1:] if len(batch_times) > 1 else batch_times
-    steady_pods = sum(n for _, n in steady)
-    steady_secs = sum(t for t, _ in steady)
-    pods_per_sec = steady_pods / steady_secs if steady_secs else float("inf")
+    # warm-start throughput over the whole workload: compilation happened in
+    # _warmup (persistent cache + device session), so every timed batch runs
+    # the production path
+    pods_per_sec = scheduled / total if total else float("inf")
     # per-pod p99 latency: pods in a batch all land when the batch commits
     per_pod = sorted(t for t, n in batch_times for _ in range(n))
     p99 = per_pod[int(0.99 * (len(per_pod) - 1))]
@@ -163,7 +206,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "pods scheduled/sec, 5k pods x 1k nodes, full default plugin pipeline (steady-state)",
+                "metric": "pods scheduled/sec, 5k pods x 1k nodes, full default plugin pipeline (warm start, end-to-end)",
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/s",
                 "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
@@ -171,6 +214,7 @@ def main() -> None:
                 "first_batch_s": round(batch_times[0][0], 3) if batch_times else None,
                 "device_solve_s": round(sum(solve_times), 3),
                 "p99_batch_latency_s": round(p99, 4),
+                "warmup_s": round(warmup_s, 3),
                 "pod_create_s": round(create_seconds, 3),
                 "pods": N_PODS,
                 "nodes": N_NODES,

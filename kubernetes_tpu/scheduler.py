@@ -216,7 +216,41 @@ class Scheduler:
         failures_before = len(res.bind_failures)
         batch = self.snapshot.update(self.cache)
         pods = [i.pod for i in infos]
-        pbatch = build_pod_batch(pods, batch.vocab)
+
+        def has_pod_affinity(p: Pod) -> bool:
+            return p.affinity is not None and (
+                p.affinity.pod_affinity is not None
+                or p.affinity.pod_anti_affinity is not None
+            )
+
+        need_ports = any(p.host_ports() for p in pods)
+        need_spread = any(p.topology_spread_constraints for p in pods)
+        need_interpod = any(has_pod_affinity(p) for p in pods) or any(
+            info.pods_with_affinity
+            for info in self.cache.nodes.values()
+            if info.node is not None
+        )
+        # Pad the pod axis to the configured batch size so every cycle —
+        # including the final partial batch — reuses ONE compiled shape
+        # (§8.8 recompile storms). All-padding chunks are near-free in the
+        # grouped solver's fast path, so the fixed bucket only pays off when
+        # that path can engage (mirror of the solver's dispatch condition);
+        # otherwise the per-pod scan would walk every padding step, so keep
+        # the tight pow2 bucket.
+        group = solver.config.group_size
+        grouped_ok = (
+            group > 1
+            and self.config.batch_size % group == 0
+            and batch.padded >= group
+            and not need_spread
+            and not need_interpod
+        )
+        pod_pad = (
+            self.config.batch_size
+            if grouped_ok and len(pods) <= self.config.batch_size
+            else None
+        )
+        pbatch = build_pod_batch(pods, batch.vocab, pad=pod_pad)
 
         # Node objects in snapshot-slot order, for the plugin tensorizers
         # (share the solver's node index space).
@@ -240,20 +274,6 @@ class Scheduler:
             )
         static = build_static_tensors(
             pods, pbatch, slot_nodes, batch.padded, volume_ctx
-        )
-        need_ports = any(p.host_ports() for p in pods)
-        need_spread = any(r.topology_spread_constraints for r in static.reps)
-
-        def has_pod_affinity(p: Pod) -> bool:
-            return p.affinity is not None and (
-                p.affinity.pod_affinity is not None
-                or p.affinity.pod_anti_affinity is not None
-            )
-
-        need_interpod = any(has_pod_affinity(r) for r in static.reps) or any(
-            info.pods_with_affinity
-            for info in self.cache.nodes.values()
-            if info.node is not None
         )
         placed_by_slot: dict[int, list[Pod]] = {}
         if need_ports or need_spread or need_interpod:
@@ -282,7 +302,12 @@ class Scheduler:
             )
 
         t1 = time.perf_counter()
-        assignments = solver.solve(batch, pbatch, static, ports, spread, interpod)
+        # session mode: node tables + carried state stay device-resident;
+        # dirty snapshot columns heal by version; only assignments download
+        assignments = solver.solve(
+            batch, pbatch, static, ports, spread, interpod,
+            col_versions=self.snapshot.col_versions,
+        )
         res.solve_seconds += time.perf_counter() - t1
         metrics.tensorize_seconds.observe(max(t1 - gs, 0.0))
 
@@ -303,6 +328,9 @@ class Scheduler:
             try:
                 self.cache.assume_pod(pod, node_name)
             except Exception as e:  # cache inconsistency: requeue
+                # the device-resident solve DID place the pod; mark the
+                # column dirty so the session re-heals it from cache truth
+                self.snapshot.touch(int(a))
                 res.bind_failures.append((pod.key, str(e)))
                 self.queue.add_unschedulable(info, cycle)
                 continue

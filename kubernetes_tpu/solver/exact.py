@@ -79,13 +79,14 @@ class ExactSolverConfig:
     # it through to build_interpod_tensors)
     hard_pod_affinity_weight: int = 1
     balanced_fdtype: str = "float32"  # float64 for bit-parity on CPU tests
+    # Grouped fast path (§8.4 batched variant): chunk size for runs of
+    # identical pods; 0/1 disables. Only engages when spread/interpod are
+    # inactive for the batch (those couple scores across nodes).
+    group_size: int = 64
 
 
-def _solve_scan(
-    tables,  # dict of read-only node/class tables (see ExactSolver.solve)
-    state0,  # dict of carried node state (donated)
-    xs,  # dict of per-pod scanned inputs, leading axis P
-    key,  # PRNG key
+def _make_step(
+    tables,
     *,
     tie_break: str,
     scoring_strategy: str,
@@ -102,6 +103,9 @@ def _solve_scan(
     ipa_d_pad: int,
     fdtype,
 ):
+    """Builds the per-pod scan step (one full filter+score pipeline over all
+    nodes + assume scatter). Shared by the per-pod scan and the grouped
+    solver's non-uniform fallback branch."""
     alloc = tables["alloc"]
     alloc2 = alloc[: MEM_IDX + 1]  # cpu, memory rows for scoring
     weights2 = jnp.ones(2, dtype=alloc.dtype)
@@ -198,13 +202,328 @@ def _solve_scan(
         assignment = jnp.where(found, pick, -1).astype(jnp.int32)
         return (st, k), assignment
 
+    return step
+
+
+def _solve_scan(
+    tables,  # dict of read-only node/class tables (see ExactSolver.solve)
+    state0,  # dict of carried node state (donated)
+    xs,  # dict of per-pod scanned inputs, leading axis P
+    key,  # PRNG key
+    **kw,  # pipeline shape/weight params, see _make_step
+):
+    step = _make_step(tables, **kw)
     (state, _), assignments = jax.lax.scan(step, (state0, key), xs)
     return assignments, state
 
 
-_solve_scan_jit = jax.jit(
-    _solve_scan,
+def _solve_grouped(
+    tables,
+    state0,
+    xs,  # per-pod scanned inputs, leading axis P (P % group == 0)
+    uniform,  # [P // group] bool — chunk g holds `group` identical valid pods
+    key,
+    *,
+    group: int,
+    **kw,
+):
+    """Grouped exact scan (SURVEY §8.4 'batched variant').
+
+    The pod axis is cut into chunks of ``group`` consecutive pods. A chunk
+    whose pods are identical (same scheduling class, requests, and port
+    rows — the deployment-replicas case detected host-side) takes a fast
+    path that reproduces sequential greedy placement exactly but with G
+    cheap frontier steps instead of G full pipelines:
+
+    - placing one pod only changes the *chosen node's* fit/score column
+      (resources, pod count, ports are node-local), so per-node placement
+      capacities ``cap[n]`` and the score-after-j-placements table
+      ``S[j, n]`` are precomputed dense once per chunk;
+    - the cross-node coupling (DefaultNormalizeScore over the feasible set
+      for TaintToleration/NodeAffinity) is recomputed each iteration from
+      the current mask, which is exactly what the per-pod pipeline does;
+    - an infeasible pod leaves state untouched, so later identical pods
+      are infeasible too — matching the sequential scan's fixpoint.
+
+    Chunks that are not uniform (mixed classes, partial final chunk) fall
+    back to an inner per-pod scan with the full pipeline — bit-identical
+    to the ungrouped solver. Only valid when spread/interpod are inactive
+    for the batch: those plugins couple scores across nodes through domain
+    counts, which the fast path does not model.
+    """
+    tie_break = kw["tie_break"]
+    w_fit = kw["w_fit"]
+    w_balanced = kw["w_balanced"]
+    w_taint = kw["w_taint"]
+    w_nodeaff = kw["w_nodeaff"]
+    w_image = kw["w_image"]
+    fdtype = kw["fdtype"]
+    scoring_strategy = kw["scoring_strategy"]
+
+    alloc = tables["alloc"]
+    alloc2 = alloc[: MEM_IDX + 1]
+    weights2 = jnp.ones(2, dtype=alloc.dtype)
+    n = alloc.shape[1]
+    step = _make_step(tables, **kw)
+
+    def slow_chunk(st, k, cxs):
+        (st, k), asg = jax.lax.scan(step, (st, k), cxs)
+        return st, k, asg
+
+    def fast_chunk(st, k, cxs):
+        req = cxs["req"][0]  # [K] int64
+        req_mask = cxs["req_mask"][0]
+        nz = cxs["nonzero_req"][0]  # [2] int64
+        takes = cxs["pod_takes"][0]
+        conflict_row = cxs["pod_conflict"][0]
+        cls = cxs["class_of"][0]
+        # number of pods to place: `group` for a uniform chunk, 0 for an
+        # all-padding chunk (uniformity marks both; this makes fixed-bucket
+        # pod padding nearly free instead of G full pipeline steps)
+        vcnt = jnp.sum(cxs["pod_valid"].astype(jnp.int32)).astype(jnp.int32)
+
+        # capacity: how many MORE identical pods each node can take
+        free = alloc - st["used"]
+        cap_res = jnp.where(
+            req_mask[:, None], free // jnp.maximum(req, 1)[:, None], group
+        )
+        cap = jnp.min(cap_res, axis=0)
+        cap = jnp.minimum(
+            cap, (tables["max_pods"] - st["pod_count"]).astype(cap.dtype)
+        )
+        conflict_now = pl.ports_conflict_mask(conflict_row, st["port_used"])
+        has_ports = jnp.any(takes > 0)
+        self_conf = jnp.any((takes > 0) & conflict_row)
+        cap = jnp.where(conflict_now & has_ports, 0, cap)
+        cap = jnp.where(self_conf & ~conflict_now, jnp.minimum(cap, 1), cap)
+        base_mask = tables["static_mask"][cls] & tables["node_valid"]
+        cap = jnp.clip(jnp.where(base_mask, cap, 0), 0, group).astype(jnp.int32)
+
+        # S[j-1, n]: fit+balanced (+static image) score for placing the j-th
+        # identical pod on node n, j = 1..group — same kernels as the
+        # per-pod pipeline, evaluated on the [2, G*N] flattened grid
+        j = jnp.arange(1, group + 1, dtype=alloc.dtype)
+        req_g = (
+            st["nonzero_used"][:, None, :] + nz[:, None, None] * j[None, :, None]
+        ).reshape(2, group * n)
+        alloc_g = jnp.broadcast_to(alloc2[:, None, :], (2, group, n)).reshape(
+            2, group * n
+        )
+        fit_scorer = (
+            nr.most_allocated_score
+            if scoring_strategy == "MostAllocated"
+            else nr.least_allocated_score
+        )
+        s = w_fit * fit_scorer(req_g, alloc_g, weights2)
+        s = s + w_balanced * nr.balanced_allocation_score(
+            req_g, alloc_g, fdtype=fdtype
+        )
+        s_table = s.astype(jnp.int32).reshape(group, n)
+        if w_image:
+            s_table = s_table + w_image * tables["image_score"][cls][None, :]
+
+        taint_row = tables["taint_cnt"][cls]
+        nodeaff_row = tables["nodeaff_pref"][cls]
+
+        def scores_at(m):
+            mask_t = m < cap
+            f = jnp.take_along_axis(
+                s_table, jnp.clip(m, 0, group - 1)[None, :], axis=0
+            )[0]
+            total = f
+            # same DefaultNormalizeScore helper as the per-pod pipeline —
+            # recomputed per iteration because the feasible mask shifts as
+            # nodes saturate
+            if w_taint:
+                total = total + w_taint * pl.normalize_score(
+                    taint_row, mask_t, reverse=True
+                )
+            if w_nodeaff:
+                total = total + w_nodeaff * pl.normalize_score(
+                    nodeaff_row, mask_t, reverse=False
+                )
+            return jnp.where(mask_t, total, -1), mask_t
+
+        m0 = jnp.zeros(n, dtype=jnp.int32)
+        asg0 = jnp.full(group, -1, dtype=jnp.int32)
+        iota_g = jnp.arange(group, dtype=jnp.int32)
+
+        if tie_break == TIE_RANDOM:
+            # Multi-placement: in one iteration place up to q identical pods
+            # on q DISTINCT tie-set nodes. Sequentially valid because a
+            # placement only changes its own node's column, so every not-yet-
+            # chosen tie node is still in the (random) tie set when its pod
+            # arrives; nodes that would saturate (leave the feasible mask and
+            # so shift DefaultNormalizeScore for later pods) are excluded
+            # and handled by a single fallback placement. Terminates: each
+            # iteration places >= 1 pod or proves infeasibility.
+            def cond(state):
+                m, asg, placed, k = state
+                return placed < vcnt
+
+            def body(state):
+                m, asg, placed, k = state
+                total, mask_t = scores_at(m)
+                best = jnp.max(total)
+                feasible = best >= 0
+                tie = (total == best) & mask_t
+                # a node is multi-place eligible only if its placement
+                # cannot perturb later pods in this iteration: it must not
+                # saturate (mask/normalization would shift), and its frontier
+                # score must not INCREASE (BalancedAllocation can rise as a
+                # node fills; the node would become a strict max and the
+                # sequential process would be forced to re-pick it). The
+                # normalization terms are per-node constants while the mask
+                # is stable, so comparing raw frontier rows suffices.
+                f_now = jnp.take_along_axis(
+                    s_table, jnp.clip(m, 0, group - 1)[None, :], axis=0
+                )[0]
+                next_f = jnp.take_along_axis(
+                    s_table, jnp.clip(m + 1, 0, group - 1)[None, :], axis=0
+                )[0]
+                eligible = tie & ((m + 1) < cap) & (next_f <= f_now)
+
+                k, s1, s2 = jax.random.split(k, 3)
+                r = jax.random.uniform(s1, (n,))
+                order = jnp.argsort(jnp.where(eligible, r, 2.0)).astype(
+                    jnp.int32
+                )  # [N]
+                n_elig = jnp.sum(eligible.astype(jnp.int32))
+                q = jnp.minimum(n_elig, vcnt - placed)
+
+                # q == 0 but feasible: single placement on one tie node
+                # (possibly saturating — next iteration re-normalizes)
+                csum = jnp.cumsum(tie)
+                pick_rank = (
+                    jax.random.randint(s2, (), 0, 1 << 30)
+                    % jnp.maximum(csum[-1], 1)
+                )
+                pick = jnp.argmax(csum > pick_rank).astype(jnp.int32)
+
+                multi = q > 0
+                chosen = jnp.where(
+                    multi,
+                    jnp.where(iota_g < q, order[:group], -1),
+                    jnp.where(iota_g < 1, pick, -1),
+                )  # [G] node ids for this iteration's pods, -1 pad
+                chosen = jnp.where(feasible, chosen, -1)
+                n_placed = jnp.where(
+                    feasible, jnp.where(multi, q, 1), 0
+                ).astype(jnp.int32)
+
+                pos = jnp.where(chosen >= 0, placed + iota_g, group)
+                asg = asg.at[pos].set(chosen, mode="drop")
+                m = m.at[jnp.where(chosen >= 0, chosen, n)].add(
+                    jnp.int32(1), mode="drop"
+                )
+                placed = jnp.where(feasible, placed + n_placed, vcnt)
+                return m, asg, placed, k
+
+            m, asg, _, k = jax.lax.while_loop(
+                cond, body, (m0, asg0, jnp.int32(0), k)
+            )
+        else:
+            # Deterministic lowest-index tie-break: one placement per
+            # iteration, exactly the per-pod pipeline's argmax.
+            def body(t, acc):
+                m, asg = acc
+                total, _ = scores_at(m)
+                best = jnp.max(total)
+                feasible = (best >= 0) & (t < vcnt)
+                pick = jnp.argmax(total).astype(jnp.int32)
+                m = m.at[pick].add(feasible.astype(jnp.int32))
+                asg = asg.at[t].set(jnp.where(feasible, pick, -1))
+                return m, asg
+
+            m, asg = jax.lax.fori_loop(0, group, body, (m0, asg0))
+
+        d = m.astype(alloc.dtype)
+        st = dict(
+            st,
+            used=st["used"] + req[:, None] * d[None, :],
+            nonzero_used=st["nonzero_used"] + nz[:, None] * d[None, :],
+            pod_count=st["pod_count"] + m,
+            port_used=st["port_used"] + takes[:, None] * m[None, :],
+        )
+        return st, k, asg
+
+    def chunk_step(carry, x):
+        st, k = carry
+        cxs, uni = x
+        st, k, asg = jax.lax.cond(uni, fast_chunk, slow_chunk, st, k, cxs)
+        return (st, k), asg
+
+    p = next(iter(xs.values())).shape[0]
+    cxs_all = jax.tree.map(
+        lambda a: a.reshape((p // group, group) + a.shape[1:]), xs
+    )
+    (state, _), assignments = jax.lax.scan(
+        chunk_step, (state0, key), (cxs_all, uniform)
+    )
+    return assignments.reshape(p), state
+
+
+# -- packed transfer layer ---------------------------------------------------
+#
+# The `axon` PJRT tunnel on this box has millisecond-class latency per
+# host<->device transfer and per fresh-content buffer, so the per-solve wire
+# protocol is collapsed to a handful of arrays:
+#   xi64 / xi32 / xbool — per-pod inputs concatenated along the trailing axis
+#                         per dtype class, unpacked by a static slice spec
+#                         inside the compiled program (free on device);
+#   bstate              — per-batch node-state rows (ports/spread/interpod
+#                         occupancy) stacked into one int32 [B, N], uploaded
+#                         fresh each batch (its dims differ per batch, so
+#                         donation would never reuse the buffer);
+#   persist             — used/nonzero_used/pod_count, DEVICE-RESIDENT between
+#                         batches in session mode (donated through each call);
+#   assignments         — the only per-batch download in session mode.
+
+
+def _run_packed(
+    nt,  # node tables {alloc, max_pods, node_valid}
+    ct,  # class tables {static_mask, taint_cnt, nodeaff_pref, image_score, spr, ipa}
+    persist,  # {used, nonzero_used, pod_count} — donated
+    bstate,  # [B, N] int32 packed per-batch state
+    xi64,  # [P, *] int64 packed per-pod inputs
+    xi32,  # [P, *] int32
+    xbool,  # [P, *] bool
+    uniform,  # [P // group] bool (grouped) or [1] dummy
+    key,
+    *,
+    bspec,  # tuple of (name, start, width)
+    xspec,  # tuple of (name, src, start, width, squeeze)
+    grouped: bool,
+    group: int,
+    **kw,
+):
+    tables = {**nt, **ct}
+    state0 = dict(persist)
+    for name, s, w in bspec:
+        state0[name] = bstate[s : s + w]
+    srcs = {"i64": xi64, "i32": xi32, "bool": xbool}
+    xs = {}
+    for name, src, s, w, squeeze in xspec:
+        a = srcs[src][:, s : s + w]
+        xs[name] = a[:, 0] if squeeze else a
+    if grouped:
+        assignments, state = _solve_grouped(
+            tables, state0, xs, uniform, key, group=group, **kw
+        )
+    else:
+        assignments, state = _solve_scan(tables, state0, xs, key, **kw)
+    return assignments, {
+        k: state[k] for k in ("used", "nonzero_used", "pod_count")
+    }
+
+
+_run_packed_jit = jax.jit(
+    _run_packed,
     static_argnames=(
+        "bspec",
+        "xspec",
+        "grouped",
+        "group",
         "tie_break",
         "scoring_strategy",
         "w_fit",
@@ -220,8 +539,156 @@ _solve_scan_jit = jax.jit(
         "ipa_d_pad",
         "fdtype",
     ),
-    donate_argnums=(1,),
+    donate_argnums=(2,),
 )
+
+
+def _heal(nt, persist, cols_i64, cols_i32, cols_bool, idx):
+    """Scatter dirty snapshot columns onto the device-resident node tables
+    and carried state (cache.go#UpdateSnapshot's O(changed) contract, device
+    side). idx may contain repeats (shape bucketing pads with idx[0]) —
+    set-scatter with identical payload is idempotent."""
+    k = nt["alloc"].shape[0]
+    nt = dict(
+        nt,
+        alloc=nt["alloc"].at[:, idx].set(cols_i64[:k]),
+        max_pods=nt["max_pods"].at[idx].set(cols_i32[0]),
+        node_valid=nt["node_valid"].at[idx].set(cols_bool[0]),
+    )
+    persist = dict(
+        persist,
+        used=persist["used"].at[:, idx].set(cols_i64[k : 2 * k]),
+        nonzero_used=persist["nonzero_used"].at[:, idx].set(
+            cols_i64[2 * k : 2 * k + 2]
+        ),
+        pod_count=persist["pod_count"].at[idx].set(cols_i32[1]),
+    )
+    return nt, persist
+
+
+_heal_jit = jax.jit(_heal, donate_argnums=(0, 1))
+
+
+def _pack_cols(arrs: list[np.ndarray]) -> np.ndarray:
+    """Stack row-blocks (each [*, D] or [D]) into one array for upload."""
+    rows = [a[None, :] if a.ndim == 1 else a for a in arrs]
+    return np.concatenate(rows, axis=0)
+
+
+class _DeviceSession:
+    """Device-resident mirror of one snapshot's node tensors (SURVEY §8.3).
+
+    Engaged by Scheduler-driven solves (col_versions provided): node tables
+    and the carried used/nonzero_used/pod_count live in HBM across batches;
+    dirty snapshot columns heal by scatter; class-table uploads dedupe by
+    content hash. Standalone solves (tests, one-shot callers) bypass it.
+    """
+
+    def __init__(self) -> None:
+        self.padded = -1
+        self.k = -1
+        self.nt = None
+        self.persist = None
+        self.seen_versions: np.ndarray | None = None
+        self.class_cache: dict[bytes, object] = {}
+
+    def sync(self, nodes: NodeBatch, col_versions: np.ndarray):
+        """Bring resident node tables/state up to date with the snapshot."""
+        if self.padded != nodes.padded or self.k != nodes.allocatable.shape[0]:
+            self.padded = nodes.padded
+            self.k = nodes.allocatable.shape[0]
+            self.nt = {
+                "alloc": jnp.asarray(nodes.allocatable),
+                "max_pods": jnp.asarray(nodes.max_pods),
+                "node_valid": jnp.asarray(nodes.valid),
+            }
+            self.persist = {
+                "used": jnp.asarray(nodes.used),
+                "nonzero_used": jnp.asarray(nodes.nonzero_used),
+                "pod_count": jnp.asarray(nodes.pod_count),
+            }
+            self.seen_versions = col_versions[: nodes.padded].copy()
+            return
+        dirty = np.nonzero(
+            col_versions[: self.padded] > self.seen_versions
+        )[0]
+        if dirty.size:
+            d_pad = 1
+            while d_pad < dirty.size:
+                d_pad *= 2
+            idx = np.full(d_pad, dirty[0], dtype=np.int32)
+            idx[: dirty.size] = dirty
+            cols_i64 = _pack_cols(
+                [
+                    nodes.allocatable[:, idx],
+                    nodes.used[:, idx],
+                    nodes.nonzero_used[:, idx],
+                ]
+            )
+            cols_i32 = _pack_cols(
+                [nodes.max_pods[idx], nodes.pod_count[idx]]
+            )
+            cols_bool = _pack_cols([nodes.valid[idx]])
+            self.nt, self.persist = _heal_jit(
+                self.nt,
+                self.persist,
+                jnp.asarray(cols_i64),
+                jnp.asarray(cols_i32),
+                jnp.asarray(cols_bool),
+                jnp.asarray(idx),
+            )
+        self.seen_versions = col_versions[: self.padded].copy()
+
+    def class_tables(self, static, spread, interpod):
+        """Content-addressed device cache of the per-batch class tables."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        for a in (
+            static.mask, static.taint_cnt, static.nodeaff_pref,
+            static.image_score, spread.dom, spread.elig, spread.max_skew,
+            spread.min_domains, spread.self_match, spread.is_hostname,
+            spread.hard, spread.soft, interpod.in_dom, interpod.in_pref_w,
+            interpod.cls_req_aff, interpod.cls_req_anti, interpod.cls_pref,
+            interpod.ex_dom, interpod.ex_anti,
+        ):
+            arr = np.ascontiguousarray(a)
+            h.update(str(arr.shape).encode())
+            h.update(arr.tobytes())
+        key = h.digest()
+        ct = self.class_cache.pop(key, None)
+        if ct is not None:
+            self.class_cache[key] = ct  # re-insert: LRU refresh on hit
+        else:
+            ct = {
+                "static_mask": jnp.asarray(static.mask),
+                "taint_cnt": jnp.asarray(static.taint_cnt),
+                "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
+                "image_score": jnp.asarray(static.image_score),
+                "spr": {
+                    "dom": jnp.asarray(spread.dom),
+                    "elig": jnp.asarray(spread.elig),
+                    "max_skew": jnp.asarray(spread.max_skew),
+                    "min_domains": jnp.asarray(spread.min_domains),
+                    "self_match": jnp.asarray(spread.self_match),
+                    "is_hostname": jnp.asarray(spread.is_hostname),
+                    "hard": jnp.asarray(spread.hard),
+                    "soft": jnp.asarray(spread.soft),
+                },
+                "ipa": {
+                    "in_dom": jnp.asarray(interpod.in_dom),
+                    "in_pref_w": jnp.asarray(interpod.in_pref_w),
+                    "cls_req_aff": jnp.asarray(interpod.cls_req_aff),
+                    "cls_req_anti": jnp.asarray(interpod.cls_req_anti),
+                    "cls_pref": jnp.asarray(interpod.cls_pref),
+                    "ex_dom": jnp.asarray(interpod.ex_dom),
+                    "ex_anti": jnp.asarray(interpod.ex_anti),
+                },
+            }
+            if len(self.class_cache) >= 8:
+                self.class_cache.pop(next(iter(self.class_cache)))
+            self.class_cache[key] = ct
+        return ct
 
 
 class ExactSolver:
@@ -231,11 +698,17 @@ class ExactSolver:
     def __init__(self, config: ExactSolverConfig | None = None):
         self.config = config or ExactSolverConfig()
         self._step_count = 0
+        self._session = _DeviceSession()
         # int64 resource arithmetic is non-negotiable (memory bytes overflow
         # int32); jax 0.9+axon ignores the JAX_ENABLE_X64 env var, so enable
         # it here rather than trusting the embedding application.
         if not jax.config.jax_enable_x64:
             jax.config.update("jax_enable_x64", True)
+        # SURVEY §6.4: the XLA executable cache is the solver's only durable
+        # warm state — restarts deserialize instead of recompiling.
+        from ..utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()
 
     def solve(
         self,
@@ -245,9 +718,18 @@ class ExactSolver:
         ports: PortTensors | None = None,
         spread: SpreadTensors | None = None,
         interpod: InterpodTensors | None = None,
+        col_versions: np.ndarray | None = None,
     ) -> np.ndarray:
-        """Returns assignments [num_pods] of node indices (-1 = unschedulable)
-        and updates ``nodes``' used/nonzero_used/pod_count in place.
+        """Returns assignments [num_pods] of node indices (-1 = unschedulable).
+
+        Standalone mode (col_versions=None): uploads everything, downloads
+        the updated node state and writes it back into ``nodes`` in place.
+
+        Session mode (col_versions from a Snapshot): node tables and the
+        carried used/nonzero_used/pod_count stay device-resident between
+        calls; only columns whose snapshot version advanced re-upload, and
+        ONLY the assignments download — ``nodes`` is NOT written back (the
+        cache/snapshot generation path is authoritative host-side).
 
         Without ``static``/``ports``/``spread``/``interpod`` tensors, a
         trivial single-class mask (valid ∧ schedulable) reproduces the
@@ -267,64 +749,108 @@ class ExactSolver:
             interpod = trivial_interpod_tensors(pods, nodes.padded, static.c_pad)
         use_spread = not spread.empty
         use_interpod = not interpod.empty
+        session = col_versions is not None
 
-        tables = {
-            "alloc": jnp.asarray(nodes.allocatable),
-            "max_pods": jnp.asarray(nodes.max_pods),
-            "node_valid": jnp.asarray(nodes.valid),
-            "static_mask": jnp.asarray(static.mask),
-            "taint_cnt": jnp.asarray(static.taint_cnt),
-            "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
-            "image_score": jnp.asarray(static.image_score),
-            "spr": {
-                "dom": jnp.asarray(spread.dom),
-                "elig": jnp.asarray(spread.elig),
-                "max_skew": jnp.asarray(spread.max_skew),
-                "min_domains": jnp.asarray(spread.min_domains),
-                "self_match": jnp.asarray(spread.self_match),
-                "is_hostname": jnp.asarray(spread.is_hostname),
-                "hard": jnp.asarray(spread.hard),
-                "soft": jnp.asarray(spread.soft),
-            },
-            "ipa": {
-                "in_dom": jnp.asarray(interpod.in_dom),
-                "in_pref_w": jnp.asarray(interpod.in_pref_w),
-                "cls_req_aff": jnp.asarray(interpod.cls_req_aff),
-                "cls_req_anti": jnp.asarray(interpod.cls_req_anti),
-                "cls_pref": jnp.asarray(interpod.cls_pref),
-                "ex_dom": jnp.asarray(interpod.ex_dom),
-                "ex_anti": jnp.asarray(interpod.ex_anti),
-            },
-        }
-        state0 = {
-            "used": jnp.asarray(nodes.used),
-            "nonzero_used": jnp.asarray(nodes.nonzero_used),
-            "pod_count": jnp.asarray(nodes.pod_count),
-            "port_used": jnp.asarray(ports.used),
-            "spr_cnt": jnp.asarray(spread.cnt0),
-            "ipa_in": jnp.asarray(interpod.in_cnt0),
-            "ipa_ex": jnp.asarray(interpod.ex_cnt0),
-        }
-        xs = {
-            "req": jnp.asarray(pods.req),
-            "req_mask": jnp.asarray(pods.req_mask),
-            "nonzero_req": jnp.asarray(pods.nonzero_req),
-            "pod_valid": jnp.asarray(pods.valid & pods.feasible_static),
-            "class_of": jnp.asarray(static.class_of),
-            "pod_conflict": jnp.asarray(ports.pod_conflict),
-            "pod_takes": jnp.asarray(ports.pod_takes),
-            "spr_placed": jnp.asarray(spread.placed_match),
-            "ipa_in_match": jnp.asarray(interpod.in_match),
-            "ipa_ex_owned": jnp.asarray(interpod.ex_owned),
-            "ipa_m_anti": jnp.asarray(interpod.m_anti),
-            "ipa_m_w": jnp.asarray(interpod.m_w),
-            "ipa_self_aff": jnp.asarray(interpod.self_aff),
-        }
-        assignments, state = _solve_scan_jit(
-            tables,
-            state0,
-            xs,
-            key,
+        if session:
+            self._session.sync(nodes, col_versions)
+            nt = self._session.nt
+            persist = self._session.persist
+            ct = self._session.class_tables(static, spread, interpod)
+        else:
+            nt = {
+                "alloc": jnp.asarray(nodes.allocatable),
+                "max_pods": jnp.asarray(nodes.max_pods),
+                "node_valid": jnp.asarray(nodes.valid),
+            }
+            persist = {
+                "used": jnp.asarray(nodes.used),
+                "nonzero_used": jnp.asarray(nodes.nonzero_used),
+                "pod_count": jnp.asarray(nodes.pod_count),
+            }
+            ct = {
+                "static_mask": jnp.asarray(static.mask),
+                "taint_cnt": jnp.asarray(static.taint_cnt),
+                "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
+                "image_score": jnp.asarray(static.image_score),
+                "spr": {
+                    "dom": jnp.asarray(spread.dom),
+                    "elig": jnp.asarray(spread.elig),
+                    "max_skew": jnp.asarray(spread.max_skew),
+                    "min_domains": jnp.asarray(spread.min_domains),
+                    "self_match": jnp.asarray(spread.self_match),
+                    "is_hostname": jnp.asarray(spread.is_hostname),
+                    "hard": jnp.asarray(spread.hard),
+                    "soft": jnp.asarray(spread.soft),
+                },
+                "ipa": {
+                    "in_dom": jnp.asarray(interpod.in_dom),
+                    "in_pref_w": jnp.asarray(interpod.in_pref_w),
+                    "cls_req_aff": jnp.asarray(interpod.cls_req_aff),
+                    "cls_req_anti": jnp.asarray(interpod.cls_req_anti),
+                    "cls_pref": jnp.asarray(interpod.cls_pref),
+                    "ex_dom": jnp.asarray(interpod.ex_dom),
+                    "ex_anti": jnp.asarray(interpod.ex_anti),
+                },
+            }
+
+        # per-batch node-state rows, one int32 upload
+        b_arrs = [ports.used]
+        bspec = [("port_used", 0, ports.used.shape[0])]
+        off = ports.used.shape[0]
+        for name, arr in (
+            ("spr_cnt", spread.cnt0),
+            ("ipa_in", interpod.in_cnt0),
+            ("ipa_ex", interpod.ex_cnt0),
+        ):
+            b_arrs.append(arr)
+            bspec.append((name, off, arr.shape[0]))
+            off += arr.shape[0]
+        bstate = np.concatenate(b_arrs, axis=0)
+
+        # per-pod inputs, one upload per dtype class
+        pod_valid = (pods.valid & pods.feasible_static)[:, None]
+        i64_cols = [("req", pods.req), ("nonzero_req", pods.nonzero_req)]
+        i32_cols = [
+            ("class_of", np.asarray(static.class_of)[:, None]),
+            ("pod_takes", np.asarray(ports.pod_takes)),
+        ]
+        bool_cols = [
+            ("req_mask", pods.req_mask),
+            ("pod_valid", pod_valid),
+            ("pod_conflict", np.asarray(ports.pod_conflict)),
+        ]
+        if use_spread:
+            bool_cols.append(("spr_placed", np.asarray(spread.placed_match)))
+        if use_interpod:
+            i32_cols += [
+                ("ipa_in_match", np.asarray(interpod.in_match)),
+                ("ipa_ex_owned", np.asarray(interpod.ex_owned)),
+                ("ipa_m_w", np.asarray(interpod.m_w)),
+            ]
+            bool_cols += [
+                ("ipa_m_anti", np.asarray(interpod.m_anti)),
+                ("ipa_self_aff", np.asarray(interpod.self_aff)[:, None]),
+            ]
+        squeeze_names = {"class_of", "pod_valid", "ipa_self_aff"}
+
+        def pack_x(cols):
+            spec = []
+            off = 0
+            for name, arr in cols:
+                spec.append((name, off, arr.shape[1], name in squeeze_names))
+                off += arr.shape[1]
+            return np.concatenate([a for _, a in cols], axis=1), spec
+
+        xi64, spec64 = pack_x(i64_cols)
+        xi32, spec32 = pack_x(i32_cols)
+        xbool, specb = pack_x(bool_cols)
+        xspec = tuple(
+            [(n, "i64", s, w, sq) for n, s, w, sq in spec64]
+            + [(n, "i32", s, w, sq) for n, s, w, sq in spec32]
+            + [(n, "bool", s, w, sq) for n, s, w, sq in specb]
+        )
+
+        kw = dict(
             tie_break=cfg.tie_break,
             scoring_strategy=cfg.scoring_strategy,
             w_fit=cfg.fit_weight,
@@ -340,9 +866,75 @@ class ExactSolver:
             ipa_d_pad=interpod.d_pad,
             fdtype=fdtype,
         )
-        # np.array(copy=True): np.asarray on a jax array yields a READ-ONLY
-        # view, which would freeze the snapshot's dirty-column writes
-        nodes.used = np.array(state["used"])
-        nodes.nonzero_used = np.array(state["nonzero_used"])
-        nodes.pod_count = np.array(state["pod_count"])
+        group = cfg.group_size
+        grouped = (
+            group > 1
+            and not use_spread
+            and not use_interpod
+            and pods.padded % group == 0
+            and nodes.padded >= group  # order[:group] gather needs N >= G
+        )
+        if grouped:
+            uniform = jnp.asarray(
+                self._uniform_chunks(pods, static, ports, group)
+            )
+        else:
+            group = 1
+            uniform = jnp.zeros(1, dtype=bool)
+
+        assignments, new_persist = _run_packed_jit(
+            nt,
+            ct,
+            persist,
+            jnp.asarray(bstate),
+            jnp.asarray(xi64),
+            jnp.asarray(xi32),
+            jnp.asarray(xbool),
+            uniform,
+            key,
+            bspec=tuple(bspec),
+            xspec=xspec,
+            grouped=grouped,
+            group=group,
+            **kw,
+        )
+        if session:
+            self._session.persist = new_persist
+        else:
+            # np.array(copy=True): np.asarray on a jax array yields a
+            # READ-ONLY view, which would freeze later dirty-column writes
+            nodes.used = np.array(new_persist["used"])
+            nodes.nonzero_used = np.array(new_persist["nonzero_used"])
+            nodes.pod_count = np.array(new_persist["pod_count"])
         return np.asarray(assignments)[: pods.num_pods]
+
+    @staticmethod
+    def _uniform_chunks(
+        pods: PodBatch, static: StaticPluginTensors, ports: PortTensors,
+        group: int,
+    ) -> np.ndarray:
+        """[P // group] bool — chunk g consists of `group` consecutive pods
+        that are identical for scheduling purposes (same class, requests,
+        port rows) and all valid. Vectorized host-side; the device fast
+        path relies on this exactly."""
+        gn = pods.padded // group
+
+        def same(arr: np.ndarray) -> np.ndarray:
+            a = arr.reshape(gn, group, -1)
+            return (a == a[:, :1]).all(axis=(1, 2))
+
+        valid = pods.valid & pods.feasible_static
+        vchunk = valid.reshape(gn, group)
+        uniform = vchunk.all(axis=1)
+        for arr in (
+            np.asarray(static.class_of),
+            pods.req,
+            pods.req_mask,
+            pods.nonzero_req,
+            np.asarray(ports.pod_conflict),
+            np.asarray(ports.pod_takes),
+        ):
+            uniform &= same(arr)
+        # all-padding chunks (fixed-bucket pod padding) are trivially
+        # "uniform": the fast path sees vcnt == 0 and places nothing
+        return uniform | ~vchunk.any(axis=1)

@@ -178,10 +178,12 @@ def _single_shot(
         target = jnp.where(bidding, target, n)  # park at virtual node n
 
         # 3. admission: sort claimants by (node, -priority), segmented
-        # prefix sums against the node's remaining resources
-        sort_key = target.astype(jnp.int64) * (1 << 31) + (
-            (1 << 30) - priority.astype(jnp.int64)
-        )
+        # prefix sums against the node's remaining resources. The inverted
+        # priority is biased into [0, 2^32) so the full legal int32 priority
+        # range (system-critical 2e9 down to very negative user values)
+        # packs below the node id without interleaving adjacent nodes.
+        inv_prio = jnp.int64((1 << 31) - 1) - priority.astype(jnp.int64)
+        sort_key = target.astype(jnp.int64) * (1 << 32) + inv_prio
         order = jnp.argsort(sort_key)
         t_sorted = target[order]
         bidding_sorted = bidding[order]

@@ -26,6 +26,23 @@ class Snapshot:
         self._slot_of: dict[str, int] = {}
         self._free: list[int] = []
         self._last_generation = -1
+        # per-column write versions: every column (re)write bumps its entry
+        # from a monotonic counter. Device-resident solver sessions compare
+        # against the version they last uploaded and re-heal only columns
+        # written since — the device-side analog of the generation-based
+        # incremental UpdateSnapshot contract.
+        self.col_versions: np.ndarray = np.zeros(0, dtype=np.int64)
+        self._col_counter = 0
+
+    def _bump_col(self, i: int) -> None:
+        self._col_counter += 1
+        self.col_versions[i] = self._col_counter
+
+    def touch(self, slot: int) -> None:
+        """Force-mark a column dirty for device sessions. Used when host-side
+        bookkeeping for a solver-made placement failed (e.g. assume rejected)
+        so the device state may hold a placement the cache never saw."""
+        self._bump_col(slot)
 
     def slot_of(self, name: str) -> int:
         return self._slot_of[name]
@@ -74,6 +91,10 @@ class Snapshot:
                 # vocab changed: every occupied column must be rewritten
                 self._last_generation = -1
         self.names.extend([""] * (new_cap - len(self.names)))
+        if len(self.col_versions) < new_cap:
+            grown = np.zeros(new_cap, dtype=np.int64)
+            grown[: len(self.col_versions)] = self.col_versions
+            self.col_versions = grown
 
     def _required_vocab(self, cache: SchedulerCache) -> ResourceVocab:
         cur = self.batch.vocab if self.batch is not None else None
@@ -101,6 +122,7 @@ class Snapshot:
         b.max_pods[i] = node.allocatable.get(RESOURCE_PODS, 0)
         b.valid[i] = True
         b.schedulable[i] = not node.unschedulable
+        self._bump_col(i)
 
     # -- the public incremental update --
 
@@ -124,6 +146,7 @@ class Snapshot:
                 b.valid[i] = False
                 b.schedulable[i] = False
                 self._free.append(i)
+                self._bump_col(i)
 
         # additions + dirty rewrites
         next_slot = max(self._slot_of.values(), default=-1) + 1

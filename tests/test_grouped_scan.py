@@ -1,0 +1,203 @@
+"""Grouped fast-path solver (§8.4 batched variant) ≡ per-pod sequential scan.
+
+The grouped solver must be indistinguishable from the ungrouped scan with
+tie_break="first" (deterministic): same assignments pod-for-pod, on
+workloads mixing uniform runs (deployment replicas) with odd one-off pods,
+taints, node affinity, host ports, and near-capacity nodes — the cases
+that stress the fast path's cap precomputation and its per-iteration
+re-normalization of TaintToleration/NodeAffinity scores.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+from kubernetes_tpu.tensorize.plugins import (
+    build_port_tensors,
+    build_static_tensors,
+)
+from kubernetes_tpu.tensorize.schema import (
+    ResourceVocab,
+    build_node_batch,
+    build_pod_batch,
+)
+
+MB = 1024 * 1024
+GB = 1024 * MB
+
+
+def solve(nodes, pods, group_size):
+    vocab = ResourceVocab.build(pods, nodes)
+    nbatch = build_node_batch(nodes, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - len(nodes))
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded)
+    ports = build_port_tensors(pods, pbatch, slot_nodes, {}, nbatch.padded)
+    solver = ExactSolver(
+        ExactSolverConfig(tie_break="first", group_size=group_size)
+    )
+    return solver.solve(nbatch, pbatch, static, ports)
+
+
+def mk_nodes(n, rng, taint_every=0, label_every=0):
+    nodes = []
+    for i in range(n):
+        b = (
+            MakeNode()
+            .name(f"node-{i:03}")
+            .capacity(
+                {
+                    "cpu": str(int(rng.integers(2, 9))),
+                    "memory": f"{int(rng.integers(4, 33))}Gi",
+                    "pods": str(int(rng.integers(3, 20))),
+                }
+            )
+        )
+        if taint_every and i % taint_every == 0:
+            b = b.taint("dedicated", "gpu", "NoSchedule")
+        if label_every and i % label_every == 0:
+            b = b.label("disk", "ssd")
+        nodes.append(b.obj())
+    return nodes
+
+
+def mk_replica_run(name, count, cpu_m, mem_mb, *, port=0, affinity=False,
+                   tolerate=False):
+    pods = []
+    for i in range(count):
+        b = MakePod().name(f"{name}-{i:03}").req(
+            {"cpu": f"{cpu_m}m", "memory": f"{mem_mb}Mi"}
+        )
+        if port:
+            b = b.host_port(port)
+        if affinity:
+            b = b.node_affinity_in("disk", ["ssd"])
+        if tolerate:
+            b = b.toleration("dedicated", "gpu", "NoSchedule")
+        pods.append(b.obj())
+    return pods
+
+
+@pytest.mark.parametrize("group", [4, 8])
+def test_uniform_runs_match_sequential(group):
+    rng = np.random.default_rng(7)
+    nodes = mk_nodes(24, rng, taint_every=5, label_every=3)
+    pods = (
+        mk_replica_run("web", 40, 250, 512)
+        + mk_replica_run("db", 17, 1000, 2048, affinity=True)
+        + mk_replica_run("agent", 23, 100, 128, tolerate=True)
+    )
+    seq = solve(nodes, pods, group_size=0)
+    grp = solve(nodes, pods, group_size=group)
+    np.testing.assert_array_equal(seq, grp)
+
+
+@pytest.mark.parametrize("group", [4, 8])
+def test_mixed_and_oneoff_pods(group):
+    """Interleave uniform runs with distinct pods so chunks alternate
+    between the fast and fallback branches."""
+    rng = np.random.default_rng(11)
+    nodes = mk_nodes(16, rng, label_every=4)
+    pods = []
+    for i in range(60):
+        if i % 7 == 0:
+            pods.append(
+                MakePod()
+                .name(f"odd-{i:03}")
+                .req(
+                    {
+                        "cpu": f"{int(rng.integers(1, 16)) * 50}m",
+                        "memory": f"{int(rng.integers(1, 9)) * 256}Mi",
+                    }
+                )
+                .obj()
+            )
+        else:
+            pods.append(
+                MakePod().name(f"run-{i:03}").req(
+                    {"cpu": "200m", "memory": "256Mi"}
+                ).obj()
+            )
+    seq = solve(nodes, pods, group_size=0)
+    grp = solve(nodes, pods, group_size=group)
+    np.testing.assert_array_equal(seq, grp)
+
+
+def test_host_ports_cap_one_per_node():
+    """Identical pods with a host port: at most one per node, and the fast
+    path's cap logic must agree with sequential port-occupancy updates."""
+    rng = np.random.default_rng(3)
+    nodes = mk_nodes(6, rng)
+    pods = mk_replica_run("lb", 10, 100, 128, port=8080)
+    seq = solve(nodes, pods, group_size=0)
+    grp = solve(nodes, pods, group_size=4)
+    np.testing.assert_array_equal(seq, grp)
+    placed = [a for a in grp if a >= 0]
+    assert len(placed) == len(set(placed)) == 6  # one per node, 4 overflow
+
+
+def test_capacity_saturation_tail_unschedulable():
+    """More identical pods than total capacity: the tail must come back -1
+    in both paths (an infeasible identical pod stays infeasible)."""
+    rng = np.random.default_rng(5)
+    nodes = [
+        MakeNode().name(f"n-{i}").capacity(
+            {"cpu": "1", "memory": "1Gi", "pods": "3"}
+        ).obj()
+        for i in range(3)
+    ]
+    pods = mk_replica_run("big", 20, 300, 200)
+    seq = solve(nodes, pods, group_size=0)
+    grp = solve(nodes, pods, group_size=4)
+    np.testing.assert_array_equal(seq, grp)
+    assert (np.asarray(grp) == -1).sum() > 0
+
+
+def test_random_tiebreak_multiplace_is_sequentially_valid():
+    """tie_break=random engages the multi-placement path; its picks must
+    each lie in the oracle's tie set given the pods placed before them —
+    the §8.8 parity definition for the randomized tie-break."""
+    from kubernetes_tpu.ops.oracle.profile import FullOracle, make_oracle_nodes
+
+    rng = np.random.default_rng(21)
+    nodes = mk_nodes(20, rng, taint_every=4, label_every=3)
+    pods = (
+        mk_replica_run("a", 48, 250, 512)
+        + mk_replica_run("b", 30, 500, 1024, tolerate=True)
+    )
+    vocab = ResourceVocab.build(pods, nodes)
+    nbatch = build_node_batch(nodes, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - len(nodes))
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded)
+    ports = build_port_tensors(pods, pbatch, slot_nodes, {}, nbatch.padded)
+    solver = ExactSolver(
+        ExactSolverConfig(tie_break="random", group_size=8)
+    )
+    assignments = solver.solve(nbatch, pbatch, static, ports)
+    oracle = FullOracle(make_oracle_nodes(nodes))
+    names = [nbatch.names[a] if a >= 0 else None for a in assignments]
+    errors = oracle.validate_assignments(pods, list(assignments), names=names)
+    assert not errors, "\n".join(errors[:5])
+
+
+def test_random_fuzz_many_seeds():
+    for seed in range(6):
+        rng = np.random.default_rng(100 + seed)
+        nodes = mk_nodes(int(rng.integers(4, 20)), rng,
+                         taint_every=int(rng.integers(0, 4)),
+                         label_every=int(rng.integers(0, 4)))
+        pods = []
+        n_runs = int(rng.integers(1, 5))
+        for r in range(n_runs):
+            cnt = int(rng.integers(1, 25))
+            pods += mk_replica_run(
+                f"r{seed}-{r}", cnt,
+                int(rng.integers(1, 10)) * 100,
+                int(rng.integers(1, 8)) * 256,
+                tolerate=bool(rng.integers(0, 2)),
+            )
+        seq = solve(nodes, pods, group_size=0)
+        grp = solve(nodes, pods, group_size=8)
+        np.testing.assert_array_equal(seq, grp)
