@@ -868,6 +868,52 @@ class PersistentVolumeClaim:
 
 
 @dataclass
+class Service:
+    """[BOUNDARY] minimal core/v1 Service: name/namespace + spec.selector
+    (plain label equality map). Consumed by PodTopologySpread's
+    defaultingType=System path, where helper.DefaultSelector unions the
+    selectors of services matching the pod (helper/spread.go#DefaultSelector;
+    ReplicaSet/StatefulSet owner lookup is [CONTEXT] — documented out)."""
+
+    name: str = ""
+    namespace: str = "default"
+    selector: dict = field(default_factory=dict)
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def selects(self, pod: "Pod") -> bool:
+        return (
+            pod.namespace == self.namespace
+            and bool(self.selector)
+            and all(pod.labels.get(k) == v for k, v in self.selector.items())
+        )
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Service":
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        return Service(
+            name=meta.get("name") or "",
+            namespace=meta.get("namespace") or "default",
+            selector=dict(spec.get("selector") or {}),
+            resource_version=int(meta.get("resourceVersion") or 0),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "resourceVersion": str(self.resource_version),
+            },
+            "spec": {"selector": dict(self.selector)},
+        }
+
+
+@dataclass
 class PodDisruptionBudget:
     """[BOUNDARY] minimal PDB: preemption dry-run reads selector matching
     and status.disruptionsAllowed (policy/v1#PodDisruptionBudget,

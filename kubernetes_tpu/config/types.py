@@ -149,13 +149,6 @@ def _parse_plugin_config(profile: Profile, items, warnings: list[str]) -> None:
                         or []
                     ),
                 )
-                if profile.scoring_strategy.type == "RequestedToCapacityRatio":
-                    warnings.append(
-                        "scoringStrategy RequestedToCapacityRatio: kernel + "
-                        "oracle exist (ops/noderesources) but the solver "
-                        "falls back to LeastAllocated until shape plumbing "
-                        "lands"
-                    )
         elif name == "InterPodAffinity":
             if "hardPodAffinityWeight" in args:
                 profile.hard_pod_affinity_weight = int(
@@ -271,15 +264,67 @@ def load_file(path: str) -> KubeSchedulerConfiguration:
         return load(yaml.safe_load(f) or {})
 
 
+from ..tensorize.plugins import VOLUME_PLUGINS as VOLUME_FILTER_PLUGINS
+
+# filter-point plugin names the solver/tensorizer can actually disable
+DISABLEABLE_FILTERS = VOLUME_FILTER_PLUGINS | {
+    "NodeResourcesFit", "NodePorts", "NodeName", "NodeUnschedulable",
+    "TaintToleration", "NodeAffinity", "PodTopologySpread",
+    "InterPodAffinity",
+}
+
+
 def _solver_config(cfg: KubeSchedulerConfiguration, p: Profile):
     from ..solver.exact import ExactSolverConfig
 
     w = p.score_weights
+    # scoringStrategy.resources -> cpu/memory weights (the NonZero scoring
+    # pipeline tracks exactly those two; anything else is warned away)
+    res_weights = {"cpu": 1, "memory": 1}
+    for r in p.scoring_strategy.resources:
+        name = r.get("name")
+        if name in res_weights:
+            res_weights[name] = int(r.get("weight") or 1)
+        else:
+            cfg.warnings.append(
+                f"scoringStrategy resource {name!r}: only cpu/memory are "
+                "tracked by the NonZero scoring pipeline; ignored"
+            )
+    rtc_shape = tuple(
+        (int(s["utilization"]), int(s["score"]))
+        for s in p.scoring_strategy.shape
+    )
+    if p.scoring_strategy.type == "RequestedToCapacityRatio" and not rtc_shape:
+        cfg.warnings.append(
+            "scoringStrategy RequestedToCapacityRatio without a "
+            "requestedToCapacityRatio.shape (upstream validation rejects "
+            "this); falling back to LeastAllocated"
+        )
+    disabled = []
+    for name in sorted(p.disabled_filters):
+        if name in DISABLEABLE_FILTERS:
+            disabled.append(name)
+            if name in VOLUME_FILTER_PLUGINS:
+                cfg.warnings.append(
+                    f"filter {name!r} disabled: the volume plugin family is "
+                    "fused in the static mask, so all four volume filters "
+                    "are disabled together"
+                )
+        else:
+            cfg.warnings.append(f"cannot disable filter {name!r}; ignored")
+    added = None
+    if p.added_affinity is not None:
+        from ..api.objects import NodeAffinity
+
+        added = NodeAffinity.from_dict(p.added_affinity)
     return ExactSolverConfig(
         tie_break=cfg.tpu_solver.tie_break,
         seed=cfg.tpu_solver.seed,
         balanced_fdtype=cfg.tpu_solver.balanced_fdtype,
         scoring_strategy=p.scoring_strategy.type,
+        cpu_weight=res_weights["cpu"],
+        mem_weight=res_weights["memory"],
+        rtc_shape=rtc_shape,
         fit_weight=w.get("NodeResourcesFit", 1),
         balanced_weight=w.get("NodeResourcesBalancedAllocation", 1),
         taint_weight=w.get("TaintToleration", 3),
@@ -288,6 +333,9 @@ def _solver_config(cfg: KubeSchedulerConfiguration, p: Profile):
         spread_weight=w.get("PodTopologySpread", 2),
         interpod_weight=w.get("InterPodAffinity", 2),
         hard_pod_affinity_weight=p.hard_pod_affinity_weight,
+        disabled_filters=tuple(disabled),
+        added_affinity=added,
+        spread_defaulting=p.spread_defaulting_type,
     )
 
 

@@ -117,6 +117,27 @@ def node_affinity_filter(pod: Pod, node: Node) -> bool:
     return True
 
 
+def added_affinity_filter(added, node: Node) -> bool:
+    """NodeAffinityArgs.addedAffinity required terms (node_affinity.go: the
+    scheduler-level selector is ANDed with the pod's own)."""
+    if added is None or added.required is None:
+        return True
+    fields = node.field_labels()
+    return any(t.matches(node.labels, fields) for t in added.required)
+
+
+def added_affinity_score(added, node: Node) -> int:
+    """Sum of matching addedAffinity preferred-term weights."""
+    if added is None:
+        return 0
+    fields = node.field_labels()
+    return sum(
+        p.weight
+        for p in added.preferred
+        if p.weight and p.preference.matches(node.labels, fields)
+    )
+
+
 def node_affinity_score(pod: Pod, node: Node) -> int:
     """Sum of weights of matching preferredDuringScheduling terms."""
     na = pod.affinity.node_affinity if pod.affinity else None

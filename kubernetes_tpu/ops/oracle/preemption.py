@@ -16,12 +16,17 @@ Transcription of pkg/scheduler/framework/preemption/preemption.go#Evaluator
   highest-victim-priority -> smallest priority sum -> fewest victims ->
   latest start among highest-priority victims -> first node in list order.
 
-Scope note (shared with the device kernel in solver/preemption.py): the
-re-add feasibility check is NodeResourcesFit + pod count (the reference
-reruns the full filter pipeline per reprieve, RunFilterPluginsWithNominated
-Pods); static per-node feasibility of the incoming pod (taints/affinity/
-nodeName) gates candidacy up front. Ports/affinity/spread interactions
-with victim removal are a documented divergence to be tightened later.
+Two dry-run depths:
+- select_victims_on_node: fit-only (NodeResourcesFit + pod count) — the
+  cheap pre-screen matching the device kernel in solver/preemption.py.
+- select_victims_on_node_full: the reference semantics — every candidacy
+  and reprieve decision re-runs the FULL Filter pipeline
+  (RunFilterPluginsWithNominatedPods per re-add), so pods blocked by
+  NodePorts/PodTopologySpread/InterPodAffinity can preempt, and victims
+  are never evicted for a pod that still could not schedule. Remaining
+  divergence: the CSI volume-limit filter evaluates against the live
+  volume context (victim evictions do not free attachment slots in the
+  hypothesis), matching the [BOUNDARY] depth of volumebinding.
 """
 
 from __future__ import annotations
@@ -38,6 +43,7 @@ __all__ = [
     "classify_pdb_violations",
     "NodeVictims",
     "select_victims_on_node",
+    "select_victims_on_node_full",
     "pick_one_node",
 ]
 
@@ -119,6 +125,107 @@ def select_victims_on_node(
         for q in sort_more_important(bucket):
             if fits(current + [q]):
                 current.append(q)  # reprieved
+            else:
+                victims.append(q)
+                if counts:
+                    num_violating += 1
+    return NodeVictims(victims=victims, num_violating=num_violating)
+
+
+def select_victims_on_node_full(
+    pod: Pod,
+    cand_idx: int,
+    oracle,  # FullOracle over the current cluster truth
+    pdbs: Sequence[PodDisruptionBudget] = (),
+) -> NodeVictims | None:
+    """preemption.go#SelectVictimsOnNode with the full Filter pipeline.
+
+    Clone the candidate's state minus ALL lower-priority pods; if the
+    incoming pod still fails any Filter plugin there, the node is not a
+    candidate. Then reprieve victims (PDB-violating bucket first, then
+    non-violating, MoreImportantPod order) — each re-add keeps the pod only
+    if the full filters still pass, exactly the reference's per-re-add
+    RunFilterPluginsWithNominatedPods.
+
+    The spread/interpod PreFilter states are pod-level precomputations over
+    the WHOLE cluster; they are rebuilt only for re-adds that can actually
+    perturb them (the re-added pod matches a spread selector, owns required
+    anti-affinity that selects the incoming pod, or matches one of the
+    incoming pod's terms) — everything else reuses the current states.
+    """
+    from .interpod import (
+        _required_aff_terms,
+        _required_anti_terms,
+        build_interpod_state,
+        term_matches_pod,
+    )
+    from .noderesources import NodeState
+    from .profile import OracleNode
+    from .spread import build_filter_state, effective_constraints
+
+    on = oracle.nodes[cand_idx]
+    prio = pod.effective_priority
+    keep = [q for q in on.pods if q.effective_priority >= prio]
+    lower = [q for q in on.pods if q.effective_priority < prio]
+
+    def build_states(current: list[Pod]):
+        all_nodes = [
+            (m.node, current if j == cand_idx else m.pods)
+            for j, m in enumerate(oracle.nodes)
+        ]
+        return (
+            build_filter_state(pod, all_nodes),
+            build_interpod_state(pod, all_nodes),
+        )
+
+    def test(current: list[Pod], states) -> bool:
+        node_test = OracleNode(
+            node=on.node,
+            res=NodeState(
+                name=on.node.name,
+                allocatable=dict(on.node.allocatable),
+                max_pods=on.node.allowed_pod_number,
+                schedulable=not on.node.unschedulable,
+            ),
+        )
+        for q in current:
+            node_test.add_pod(q)
+        sp_state, ip_state = states
+        return oracle.filter_one(pod, node_test, sp_state, ip_state)
+
+    spread_cs = effective_constraints(pod, hard=True)
+    anti_t = _required_anti_terms(pod)
+    aff_t = _required_aff_terms(pod)
+
+    def affects_states(q: Pod) -> bool:
+        if spread_cs and q.namespace == pod.namespace and any(
+            c.selector is not None and c.selector.matches(q.labels)
+            for c in spread_cs
+        ):
+            return True
+        if any(
+            term_matches_pod(t, q, pod) for t in _required_anti_terms(q)
+        ):
+            return True
+        return any(term_matches_pod(t, pod, q) for t in anti_t + aff_t)
+
+    states = build_states(keep)
+    if not test(keep, states):
+        return None
+
+    violating, non_violating = classify_pdb_violations(
+        sort_more_important(lower), pdbs
+    )
+    current = list(keep)
+    victims: list[Pod] = []
+    num_violating = 0
+    for bucket, counts in ((violating, True), (non_violating, False)):
+        for q in sort_more_important(bucket):
+            trial = current + [q]
+            trial_states = build_states(trial) if affects_states(q) else states
+            if test(trial, trial_states):
+                current = trial
+                states = trial_states
             else:
                 victims.append(q)
                 if counts:

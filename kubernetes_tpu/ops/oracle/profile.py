@@ -26,6 +26,7 @@ from .noderesources import (
     fit_filter,
     least_allocated_score,
     most_allocated_score,
+    requested_to_capacity_ratio_score,
 )
 
 
@@ -44,6 +45,10 @@ class ProfileWeights:
     hard_pod_affinity: int = 1
     # NodeResourcesFitArgs.scoringStrategy.type
     scoring_strategy: str = "LeastAllocated"
+    # scoringStrategy.resources: ((name, weight), ...); default cpu/mem 1/1
+    fit_resources: tuple = (("cpu", 1), ("memory", 1))
+    # RequestedToCapacityRatio shape: ((utilization, score), ...)
+    rtc_shape: tuple = ()
 
 
 @dataclass
@@ -92,11 +97,24 @@ class FullOracle:
         nodes: list[OracleNode],
         weights: ProfileWeights | None = None,
         volume_ctx=None,
+        services=(),
+        spread_defaulting: str = "System",
+        disabled: frozenset = frozenset(),
     ):
         self.nodes = nodes
         self.weights = weights or ProfileWeights()
         self.volume_ctx = volume_ctx
+        self.services = list(services)
+        self.spread_defaulting = spread_defaulting
+        # plugins.filter.disabled for the profile — honored so config-driven
+        # callers (preemption refinement) agree with the solver pipeline
+        self.disabled = frozenset(disabled)
         self._refresh_image_states()
+
+    def _spread_defaults(self, pod: Pod):
+        if self.spread_defaulting != "System" or not self.services:
+            return ()
+        return osp.system_default_constraints(pod, self.services)
 
     def _refresh_image_states(self) -> None:
         node_objs = [on.node for on in self.nodes]
@@ -127,19 +145,35 @@ class FullOracle:
                 pod, self._all_nodes_with_pods()
             )
         from . import volumes as ovol
+        from ...tensorize.plugins import VOLUME_PLUGINS
 
+        dis = self.disabled
         return (
-            opl.node_name_filter(pod, on.node)
-            and opl.node_unschedulable_filter(pod, on.node)
-            and opl.taint_toleration_filter(pod, on.node)
-            and opl.node_affinity_filter(pod, on.node)
-            and opl.node_ports_filter(pod, on.used_ports)
-            and not fit_filter(pod, on.res)
-            and (spread_state is None or spread_state.check(on.node))
-            and interpod_state.check(on.node)
+            ("NodeName" in dis or opl.node_name_filter(pod, on.node))
+            and (
+                "NodeUnschedulable" in dis
+                or opl.node_unschedulable_filter(pod, on.node)
+            )
+            and (
+                "TaintToleration" in dis
+                or opl.taint_toleration_filter(pod, on.node)
+            )
+            and (
+                "NodeAffinity" in dis
+                or opl.node_affinity_filter(pod, on.node)
+            )
+            and ("NodePorts" in dis or opl.node_ports_filter(pod, on.used_ports))
+            and ("NodeResourcesFit" in dis or not fit_filter(pod, on.res))
+            and (
+                "PodTopologySpread" in dis
+                or spread_state is None
+                or spread_state.check(on.node)
+            )
+            and ("InterPodAffinity" in dis or interpod_state.check(on.node))
             and (
                 self.volume_ctx is None
                 or not pod.pvc_names
+                or bool(VOLUME_PLUGINS & dis)
                 or ovol.volume_filter(pod, on.node, self.volume_ctx)
             )
         )
@@ -160,6 +194,7 @@ class FullOracle:
             pod,
             [(self.nodes[i].node, self.nodes[i].pods) for i in feasible],
             self._all_nodes_with_pods(),
+            defaults=self._spread_defaults(pod),
         )
         interpod_norm = oip.interpod_scores(
             pod,
@@ -168,11 +203,25 @@ class FullOracle:
             w.hard_pod_affinity,
         )
 
-        fit_scorer = (
-            most_allocated_score
-            if w.scoring_strategy == "MostAllocated"
-            else least_allocated_score
-        )
+        resources = [
+            {"name": n, "weight": wt} for n, wt in w.fit_resources
+        ]
+        if w.scoring_strategy == "RequestedToCapacityRatio" and w.rtc_shape:
+            shape = [tuple(p) for p in w.rtc_shape]
+
+            def fit_scorer(pod, res):
+                return requested_to_capacity_ratio_score(
+                    pod, res, shape, resources
+                )
+
+        elif w.scoring_strategy == "MostAllocated":
+            def fit_scorer(pod, res):
+                return most_allocated_score(pod, res, resources)
+
+        else:
+            def fit_scorer(pod, res):
+                return least_allocated_score(pod, res, resources)
+
         totals: dict[int, int] = {}
         for j, i in enumerate(feasible):
             on = self.nodes[i]

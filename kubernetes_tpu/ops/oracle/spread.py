@@ -57,7 +57,78 @@ class EffectiveConstraint:
     node_taints_policy: str  # Honor | Ignore
 
 
-def effective_constraints(pod: Pod, hard: bool) -> list[EffectiveConstraint]:
+# defaults.go#systemDefaultConstraints: soft zone/hostname spreading applied
+# when defaultingType=System and the pod declares no constraints of its own
+SYSTEM_DEFAULT_CONSTRAINTS = (
+    ("topology.kubernetes.io/zone", 3),
+    ("kubernetes.io/hostname", 5),
+)
+
+
+def default_selector(pod: Pod, services) -> Selector | None:
+    """helper/spread.go#DefaultSelector restricted to Services (RS/SS owner
+    lookup is [CONTEXT]): union of matchLabels of every service selecting
+    the pod; None when no service matches (upstream: empty selector =>
+    buildDefaultConstraints returns nothing)."""
+    merged: dict = {}
+    found = False
+    for svc in services or ():
+        if svc.selects(pod):
+            merged.update(svc.selector)
+            found = True
+    if not found:
+        return None
+    from ...api.labels import selector_from_match_labels
+
+    return selector_from_match_labels(merged)
+
+
+def default_selector_key(pod: Pod, services) -> tuple | None:
+    """Canonical identity of the pod's service-derived default selector —
+    pods with different keys must not share a scheduling class (their
+    System default constraints differ). None = no service selects the pod."""
+    merged: dict = {}
+    found = False
+    for svc in services or ():
+        if svc.selects(pod):
+            merged.update(svc.selector)
+            found = True
+    if not found:
+        return None
+    return (pod.namespace, tuple(sorted(merged.items())))
+
+
+def system_default_constraints(pod: Pod, services) -> list[EffectiveConstraint]:
+    """common.go#buildDefaultConstraints for defaultingType=System: two soft
+    constraints (zone maxSkew 3, hostname maxSkew 5) with the service-derived
+    selector; empty when the pod has its own constraints or no service
+    selects it."""
+    if pod.topology_spread_constraints:
+        return []
+    sel = default_selector(pod, services)
+    if sel is None:
+        return []
+    return [
+        EffectiveConstraint(
+            topology_key=key,
+            max_skew=skew,
+            selector=sel,
+            min_domains=None,
+            node_affinity_policy="Honor",
+            node_taints_policy="Ignore",
+        )
+        for key, skew in SYSTEM_DEFAULT_CONSTRAINTS
+    ]
+
+
+def effective_constraints(
+    pod: Pod, hard: bool, defaults: Sequence[EffectiveConstraint] = ()
+) -> list[EffectiveConstraint]:
+    """``defaults`` (from system_default_constraints) apply only when the
+    pod declares no constraints; system defaults are ScheduleAnyway, so the
+    hard path never sees them."""
+    if not pod.topology_spread_constraints:
+        return [] if hard else list(defaults)
     want = "DoNotSchedule" if hard else "ScheduleAnyway"
     out = []
     for c in pod.topology_spread_constraints:
@@ -194,9 +265,10 @@ def spread_scores(
     pod: Pod,
     feasible: Sequence[tuple[Node, Sequence[Pod]]],
     all_nodes: Sequence[tuple[Node, Sequence[Pod]]],
+    defaults: Sequence[EffectiveConstraint] = (),
 ) -> list[int]:
     """Normalized 0-100 PodTopologySpread score for each feasible node."""
-    constraints = effective_constraints(pod, hard=False)
+    constraints = effective_constraints(pod, hard=False, defaults=defaults)
     if not constraints:
         return [0 for _ in feasible]
     counted = [

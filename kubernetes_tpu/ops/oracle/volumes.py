@@ -42,8 +42,13 @@ class VolumeContext:
     pvcs: dict[str, PersistentVolumeClaim] = field(default_factory=dict)
     # pv name -> node name currently holding an attached RWO claimant
     rwo_attached: dict[str, str] = field(default_factory=dict)
-    # node -> csi driver -> attached volume count
-    node_csi_counts: dict[str, dict[str, int]] = field(default_factory=dict)
+    # node -> csi driver -> attached UNIQUE volume names (upstream
+    # nodevolumelimits counts distinct volume handles: two pods sharing one
+    # PV consume ONE attachment slot, csi.go#filterAttachableVolumes)
+    node_csi_volumes: dict[str, dict[str, set]] = field(default_factory=dict)
+
+    def csi_count(self, node_name: str, driver: str) -> int:
+        return len(self.node_csi_volumes.get(node_name, {}).get(driver, ()))
 
     @staticmethod
     def build(
@@ -67,8 +72,8 @@ class VolumeContext:
                     if ACCESS_RWO in pv.access_modes:
                         ctx.rwo_attached[pv.name] = node_name
                     if pv.csi_driver:
-                        drv = ctx.node_csi_counts.setdefault(node_name, {})
-                        drv[pv.csi_driver] = drv.get(pv.csi_driver, 0) + 1
+                        drv = ctx.node_csi_volumes.setdefault(node_name, {})
+                        drv.setdefault(pv.csi_driver, set()).add(pv.name)
         return ctx
 
 
@@ -101,7 +106,7 @@ def csi_limit_key(driver: str) -> str:
 
 def volume_filter(pod: Pod, node: Node, ctx: VolumeContext) -> bool:
     """All four volume plugins' Filter stages, fused."""
-    new_csi: dict[str, int] = {}
+    new_csi: dict[str, set] = {}  # driver -> new unique volume names
     for claim in pod.pvc_names:
         pvc = ctx.pvcs.get(f"{pod.namespace}/{claim}")
         if pvc is None:
@@ -122,7 +127,7 @@ def volume_filter(pod: Pod, node: Node, ctx: VolumeContext) -> bool:
             ):
                 return False
             if pv.csi_driver:
-                new_csi[pv.csi_driver] = new_csi.get(pv.csi_driver, 0) + 1
+                new_csi.setdefault(pv.csi_driver, set()).add(pv.name)
         elif pvc.wait_for_first_consumer:
             continue  # defer to Reserve/PreBind
         else:
@@ -130,15 +135,18 @@ def volume_filter(pod: Pod, node: Node, ctx: VolumeContext) -> bool:
             if pv is None:
                 return False  # no static match, no dynamic provisioning
             if pv.csi_driver:
-                new_csi[pv.csi_driver] = new_csi.get(pv.csi_driver, 0) + 1
+                new_csi.setdefault(pv.csi_driver, set()).add(pv.name)
 
-    # nodevolumelimits: existing + new per driver within allocatable limit
+    # nodevolumelimits: unique existing + unique NEW volumes per driver must
+    # stay within the allocatable limit; a volume already attached on this
+    # node consumes no extra slot (csi.go counts distinct volume handles)
     if new_csi:
-        existing = ctx.node_csi_counts.get(node.name, {})
-        for driver, n_new in new_csi.items():
+        attached = ctx.node_csi_volumes.get(node.name, {})
+        for driver, names in new_csi.items():
             limit = node.allocatable.get(csi_limit_key(driver))
             if limit is None:
                 continue  # no limit advertised
-            if existing.get(driver, 0) + n_new > limit:
+            have = attached.get(driver, set())
+            if len(have | names) > limit:
                 return False
     return True

@@ -225,6 +225,21 @@ class Scheduler:
 
         need_ports = any(p.host_ports() for p in pods)
         need_spread = any(p.topology_spread_constraints for p in pods)
+        # PodTopologySpread defaultingType=System: service-selected pods
+        # without explicit constraints get soft cluster defaults
+        services = (
+            self.cluster.list_services()
+            if solver.config.spread_defaulting == "System"
+            else []
+        )
+        if services and not need_spread:
+            from .ops.oracle.spread import default_selector
+
+            need_spread = any(
+                not p.topology_spread_constraints
+                and default_selector(p, services) is not None
+                for p in pods
+            )
         need_interpod = any(has_pod_affinity(p) for p in pods) or any(
             info.pods_with_affinity
             for info in self.cache.nodes.values()
@@ -237,13 +252,11 @@ class Scheduler:
         # that path can engage (mirror of the solver's dispatch condition);
         # otherwise the per-pod scan would walk every padding step, so keep
         # the tight pow2 bucket.
-        group = solver.config.group_size
-        grouped_ok = (
-            group > 1
-            and self.config.batch_size % group == 0
-            and batch.padded >= group
-            and not need_spread
-            and not need_interpod
+        from .solver.exact import grouped_eligible
+
+        grouped_ok = grouped_eligible(
+            solver.config, self.config.batch_size, batch.padded,
+            need_spread, need_interpod,
         )
         pod_pad = (
             self.config.batch_size
@@ -272,8 +285,20 @@ class Scheduler:
                     if info.node is not None and info.pods
                 },
             )
+        class_key_extra = None
+        if services:
+            from .ops.oracle.spread import default_selector_key
+
+            def class_key_extra(p):
+                if p.topology_spread_constraints:
+                    return None
+                return default_selector_key(p, services)
+
         static = build_static_tensors(
-            pods, pbatch, slot_nodes, batch.padded, volume_ctx
+            pods, pbatch, slot_nodes, batch.padded, volume_ctx,
+            disabled=frozenset(solver.config.disabled_filters),
+            added_affinity=solver.config.added_affinity,
+            class_key_extra=class_key_extra,
         )
         placed_by_slot: dict[int, list[Pod]] = {}
         if need_ports or need_spread or need_interpod:
@@ -292,6 +317,8 @@ class Scheduler:
             spread = build_spread_tensors(
                 pods, static.reps, pbatch, slot_nodes,
                 placed_by_slot, batch.padded, static.c_pad,
+                services=services,
+                defaulting=solver.config.spread_defaulting,
             )
         interpod = None
         if need_interpod:
@@ -312,6 +339,8 @@ class Scheduler:
         metrics.tensorize_seconds.observe(max(t1 - gs, 0.0))
 
         preempt_placed: dict[int, list[Pod]] | None = None
+        preempt_pdbs: list = []
+        cluster_has_affinity = False
         for idx, (info, a) in enumerate(zip(infos, assignments)):
             pod = info.pod
             cycle = base_cycle + cycle_offsets[idx] + 1
@@ -319,8 +348,20 @@ class Scheduler:
                 # failure path: PostFilter (defaultpreemption) -> park
                 if self.config.enable_preemption:
                     if preempt_placed is None:
+                        # shared across this batch's failures: occupancy
+                        # snapshot, PDB list, and the cluster-wide
+                        # pods-with-affinity flag (avoid per-pod rescans)
                         preempt_placed = self._placed_by_slot()
-                    self._try_preempt(pod, static, idx, res, preempt_placed)
+                        preempt_pdbs = self.cluster.list_pdbs()
+                        cluster_has_affinity = any(
+                            i2.pods_with_affinity
+                            for i2 in self.cache.nodes.values()
+                            if i2.node is not None
+                        )
+                    self._try_preempt(
+                        pod, static, idx, res, preempt_placed, slot_nodes,
+                        preempt_pdbs, cluster_has_affinity, solver,
+                    )
                 res.unschedulable.append(pod.key)
                 self.queue.add_unschedulable(info, cycle)
                 continue
@@ -339,6 +380,11 @@ class Scheduler:
                 self.cache.finish_binding(pod.key)
                 res.scheduled.append((pod.key, node_name))
                 res.latencies.append(time.perf_counter() - t0)
+                # keep the lazily-snapshotted preemption view in sync with
+                # binds made later in this batch, so a subsequent failing
+                # pod's dry-run sees current node occupancy
+                if preempt_placed is not None:
+                    preempt_placed.setdefault(int(a), []).append(pod)
             except ApiError as e:
                 # bindingCycle failure path: Unreserve -> ForgetPod -> requeue
                 try:
@@ -393,6 +439,10 @@ class Scheduler:
         idx: int,
         res: BatchResult,
         placed_by_slot: dict[int, list[Pod]],
+        slot_nodes: list | None,
+        pdbs: list,
+        cluster_has_affinity: bool,
+        solver: ExactSolver,
     ) -> str | None:
         if pod.preemption_policy == "Never":
             return None
@@ -407,9 +457,26 @@ class Scheduler:
 
         batch = self.snapshot.batch
         static_row = static.mask[static.class_of[idx]]
+        # the pod's failure can involve beyond-fit filters when it carries
+        # ports/spread constraints or pod (anti-)affinity is in play — then
+        # the dry-run must re-run the full pipeline per candidate/re-add
+        beyond_fit = bool(
+            pod.host_ports()
+            or pod.topology_spread_constraints
+            or (
+                pod.affinity is not None
+                and (
+                    pod.affinity.pod_affinity is not None
+                    or pod.affinity.pod_anti_affinity is not None
+                )
+            )
+            or cluster_has_affinity
+        )
         result = self.preemptor.evaluate(
             pod, batch, self.snapshot.names, placed_by_slot, static_row,
-            self.cluster.list_pdbs(),
+            pdbs,
+            slot_nodes=slot_nodes, beyond_fit=beyond_fit,
+            disabled=frozenset(solver.config.disabled_filters),
         )
         if result is None:
             return None

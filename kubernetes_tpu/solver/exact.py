@@ -66,9 +66,17 @@ class ExactSolverConfig:
     fit_weight: int = 1
     balanced_weight: int = 1
     # NodeResourcesFitArgs.scoringStrategy.type: LeastAllocated (default) |
-    # MostAllocated (RequestedToCapacityRatio has kernel+oracle support in
-    # ops/noderesources; shape plumbing lands with per-resource weights)
+    # MostAllocated | RequestedToCapacityRatio (shape + per-resource
+    # weights below)
     scoring_strategy: str = "LeastAllocated"
+    # NodeResourcesFitArgs.scoringStrategy.resources weights for the two
+    # scoring resources the NonZero pipeline tracks (cpu milli, memory
+    # bytes); other resources are rejected with a config warning
+    cpu_weight: int = 1
+    mem_weight: int = 1
+    # RequestedToCapacityRatio shape: ((utilization, score), ...) ascending,
+    # scores 0..10 (requested_to_capacity_ratio.go)
+    rtc_shape: tuple = ()
     taint_weight: int = 3
     node_affinity_weight: int = 2
     image_weight: int = 1
@@ -83,6 +91,54 @@ class ExactSolverConfig:
     # identical pods; 0/1 disables. Only engages when spread/interpod are
     # inactive for the batch (those couple scores across nodes).
     group_size: int = 64
+    # plugins.filter.disabled for this profile (runtime/framework.go):
+    # names whose Filter stage is skipped. Static-mask plugins are handled
+    # by the tensorizer; these flags gate the in-scan filters. A non-empty
+    # set also disables the grouped fast path (rare config; keep it exact).
+    disabled_filters: tuple = ()
+    # NodeAffinityArgs.addedAffinity, parsed into an api.objects.NodeAffinity
+    # (consumed by the tensorizer via the scheduler; kept here so profile
+    # construction is one object)
+    added_affinity: object = None
+    # PodTopologySpreadArgs.defaultingType: System (upstream default —
+    # service-selected pods without explicit constraints get soft
+    # zone/hostname spreading) | List (no cluster defaults)
+    spread_defaulting: str = "System"
+
+
+def grouped_eligible(
+    cfg: "ExactSolverConfig",
+    pod_pad: int,
+    node_pad: int,
+    use_spread: bool,
+    use_interpod: bool,
+) -> bool:
+    """Single source of truth for the grouped fast path's dispatch
+    condition — the scheduler consults it when choosing the pod-axis
+    padding bucket, and ExactSolver.solve when picking the executable, so
+    the two can never drift into padding-without-grouping."""
+    return (
+        cfg.group_size > 1
+        and not cfg.disabled_filters
+        and not use_spread
+        and not use_interpod
+        and pod_pad % cfg.group_size == 0
+        and node_pad >= cfg.group_size  # order[:group] gather needs N >= G
+    )
+
+
+def _fit_scorer(scoring_strategy, rtc_shape):
+    """Scoring-strategy dispatch shared by the per-pod pipeline and the
+    grouped fast path (resource_allocation.go scorer selection)."""
+    if scoring_strategy == "RequestedToCapacityRatio" and rtc_shape:
+        sx = jnp.asarray([int(p[0]) for p in rtc_shape], dtype=jnp.int64)
+        sy = jnp.asarray([int(p[1]) for p in rtc_shape], dtype=jnp.int64)
+        return lambda requested, alloc, w: nr.rtc_score(
+            requested, alloc, w, sx, sy
+        )
+    if scoring_strategy == "MostAllocated":
+        return nr.most_allocated_score
+    return nr.least_allocated_score
 
 
 def _make_step(
@@ -90,6 +146,10 @@ def _make_step(
     *,
     tie_break: str,
     scoring_strategy: str,
+    w_cpu: int,
+    w_mem: int,
+    rtc_shape: tuple,
+    disabled: tuple,
     w_fit: int,
     w_balanced: int,
     w_taint: int,
@@ -108,7 +168,8 @@ def _make_step(
     solver's non-uniform fallback branch."""
     alloc = tables["alloc"]
     alloc2 = alloc[: MEM_IDX + 1]  # cpu, memory rows for scoring
-    weights2 = jnp.ones(2, dtype=alloc.dtype)
+    weights2 = jnp.asarray([w_cpu, w_mem], dtype=alloc.dtype)
+    fit_scorer = _fit_scorer(scoring_strategy, rtc_shape)
     spr = tables.get("spr")
     ipa = tables.get("ipa")
 
@@ -116,30 +177,27 @@ def _make_step(
         st, k = carry
         cls = x["class_of"]
 
-        mask = (
-            nr.fit_mask(
+        mask = tables["static_mask"][cls] & tables["node_valid"]
+        if "NodeResourcesFit" not in disabled:
+            mask = mask & nr.fit_mask(
                 x["req"], x["req_mask"], alloc, st["used"],
                 st["pod_count"], tables["max_pods"],
             )
-            & tables["static_mask"][cls]
-            & tables["node_valid"]
-            & ~pl.ports_conflict_mask(x["pod_conflict"], st["port_used"])
-        )
-        if use_spread:
+        if "NodePorts" not in disabled:
+            mask = mask & ~pl.ports_conflict_mask(
+                x["pod_conflict"], st["port_used"]
+            )
+        if use_spread and "PodTopologySpread" not in disabled:
             mask = mask & ~sp.hard_violations(spr, st["spr_cnt"], cls, d_pad)
         if use_interpod:
             ipa_allowed, ipa_raw = ip.filter_and_score(
                 ipa, st["ipa_in"], st["ipa_ex"], cls, x, ipa_d_pad,
                 tables["node_valid"],
             )
-            mask = mask & ipa_allowed
+            if "InterPodAffinity" not in disabled:
+                mask = mask & ipa_allowed
 
         requested = nr.scoring_requested(x["nonzero_req"], st["nonzero_used"])
-        fit_scorer = (
-            nr.most_allocated_score
-            if scoring_strategy == "MostAllocated"
-            else nr.least_allocated_score
-        )
         score = w_fit * fit_scorer(requested, alloc2, weights2)
         score = score + w_balanced * nr.balanced_allocation_score(
             requested, alloc2, fdtype=fdtype
@@ -252,6 +310,9 @@ def _solve_grouped(
     counts, which the fast path does not model.
     """
     tie_break = kw["tie_break"]
+    w_cpu = kw["w_cpu"]
+    w_mem = kw["w_mem"]
+    rtc_shape = kw["rtc_shape"]
     w_fit = kw["w_fit"]
     w_balanced = kw["w_balanced"]
     w_taint = kw["w_taint"]
@@ -262,7 +323,8 @@ def _solve_grouped(
 
     alloc = tables["alloc"]
     alloc2 = alloc[: MEM_IDX + 1]
-    weights2 = jnp.ones(2, dtype=alloc.dtype)
+    weights2 = jnp.asarray([w_cpu, w_mem], dtype=alloc.dtype)
+    fit_scorer = _fit_scorer(scoring_strategy, rtc_shape)
     n = alloc.shape[1]
     step = _make_step(tables, **kw)
 
@@ -308,11 +370,6 @@ def _solve_grouped(
         ).reshape(2, group * n)
         alloc_g = jnp.broadcast_to(alloc2[:, None, :], (2, group, n)).reshape(
             2, group * n
-        )
-        fit_scorer = (
-            nr.most_allocated_score
-            if scoring_strategy == "MostAllocated"
-            else nr.least_allocated_score
         )
         s = w_fit * fit_scorer(req_g, alloc_g, weights2)
         s = s + w_balanced * nr.balanced_allocation_score(
@@ -526,6 +583,10 @@ _run_packed_jit = jax.jit(
         "group",
         "tie_break",
         "scoring_strategy",
+        "w_cpu",
+        "w_mem",
+        "rtc_shape",
+        "disabled",
         "w_fit",
         "w_balanced",
         "w_taint",
@@ -853,6 +914,10 @@ class ExactSolver:
         kw = dict(
             tie_break=cfg.tie_break,
             scoring_strategy=cfg.scoring_strategy,
+            w_cpu=cfg.cpu_weight,
+            w_mem=cfg.mem_weight,
+            rtc_shape=tuple(tuple(p) for p in cfg.rtc_shape),
+            disabled=tuple(sorted(cfg.disabled_filters)),
             w_fit=cfg.fit_weight,
             w_balanced=cfg.balanced_weight,
             w_taint=cfg.taint_weight,
@@ -867,12 +932,8 @@ class ExactSolver:
             fdtype=fdtype,
         )
         group = cfg.group_size
-        grouped = (
-            group > 1
-            and not use_spread
-            and not use_interpod
-            and pods.padded % group == 0
-            and nodes.padded >= group  # order[:group] gather needs N >= G
+        grouped = grouped_eligible(
+            cfg, pods.padded, nodes.padded, use_spread, use_interpod
         )
         if grouped:
             uniform = jnp.asarray(

@@ -104,7 +104,21 @@ class PreemptionResult:
 
 class PreemptionEvaluator:
     """Host driver: builds the per-pod candidate tensors, runs the batched
-    dry-run, applies pickOneNodeForPreemption."""
+    dry-run, applies pickOneNodeForPreemption.
+
+    Two-phase design (SURVEY §8.5 + reference SelectVictimsOnNode):
+    the batched device dry-run is a fit-only pre-screen + ranking over ALL
+    nodes at once; when the pod's failure can involve beyond-fit filters
+    (ports/spread/interpod), the top ``refine_k`` ranked candidates are
+    re-evaluated with the full-filter scalar oracle
+    (select_victims_on_node_full), which also computes the exact victim set
+    under per-re-add filter re-runs. When no beyond-fit filter is in play,
+    fit-only IS the full pipeline (static per-node feasibility is already
+    gated), so the device result commits directly.
+    """
+
+    def __init__(self, refine_k: int = 8):
+        self.refine_k = refine_k
 
     def evaluate(
         self,
@@ -114,6 +128,9 @@ class PreemptionEvaluator:
         placed_by_slot: dict[int, list[Pod]],
         static_row: np.ndarray,  # [Np] bool — pod's static feasibility
         pdbs: list[PodDisruptionBudget] | None = None,
+        slot_nodes: list | None = None,  # [Np] Node|None, for full filters
+        beyond_fit: bool = False,
+        disabled: frozenset = frozenset(),  # profile's disabled filters
     ) -> PreemptionResult | None:
         if pod.preemption_policy == "Never":
             return None
@@ -180,13 +197,21 @@ class PreemptionEvaluator:
             np.asarray(x) for x in out
         )
 
-        # Zero-victim "candidates" mean the pod fits the node without any
-        # eviction — i.e. the solve failed there for a reason this fit-only
-        # dry-run cannot see (ports/affinity/spread). The reference's
-        # DryRunPreemption reruns the full filters and would never offer
-        # such a node; excluding them avoids nominating a node and
-        # "preempting" nothing.
-        cand_idx = np.flatnonzero(fits_all & (n_victims > 0))
+        if beyond_fit and slot_nodes is not None:
+            # Beyond-fit filters in play: a node where the pod fits with
+            # ZERO fit-victims can still be the right candidate (evictions
+            # may free ports / relax spread / remove anti-affinity owners),
+            # so keep every fit-feasible node with at least one lower-
+            # priority pod and let the full-filter oracle decide.
+            has_lower = np.zeros(n_pad, dtype=bool)
+            for slot in slot_candidates:
+                has_lower[slot] = True
+            cand_idx = np.flatnonzero(fits_all & has_lower)
+        else:
+            # Fit-only world: zero-victim "candidates" mean the pod fits
+            # without eviction, so the solve failure was elsewhere — never
+            # nominate a node and "preempt" nothing.
+            cand_idx = np.flatnonzero(fits_all & (n_victims > 0))
         if cand_idx.size == 0:
             return None
         # pickOneNodeForPreemption lexicographic via numpy lexsort
@@ -201,11 +226,63 @@ class PreemptionEvaluator:
                 n_viol[cand_idx],
             )
         )
-        best = int(cand_idx[order[0]])
-        ordered, _ = slot_candidates.get(best, ([], set()))
-        chosen = [q for s, q in enumerate(ordered) if victims[s, best]]
+        if not (beyond_fit and slot_nodes is not None):
+            best = int(cand_idx[order[0]])
+            ordered, _ = slot_candidates.get(best, ([], set()))
+            chosen = [q for s, q in enumerate(ordered) if victims[s, best]]
+            return PreemptionResult(
+                node_name=slot_names[best],
+                victims=chosen,
+                num_violating=int(n_viol[best]),
+            )
+
+        # Full-filter refinement (reference SelectVictimsOnNode semantics)
+        # over the top-ranked candidates. Ranking comes from the fit
+        # approximation; the victim sets and the final pickOneNode run on
+        # exact full-filter results. refine_k bounds host cost the way the
+        # reference bounds DryRunPreemption by candidate sampling.
+        from ..ops.oracle.preemption import (
+            pick_one_node,
+            select_victims_on_node_full,
+        )
+        from ..ops.oracle.profile import FullOracle, make_oracle_nodes
+
+        live = [
+            (slot, slot_nodes[slot])
+            for slot in range(min(len(slot_nodes), n_pad))
+            if slot_nodes[slot] is not None
+        ]
+        oracle_idx = {slot: j for j, (slot, _) in enumerate(live)}
+        oracle = FullOracle(
+            make_oracle_nodes(
+                [nd for _, nd in live],
+                {
+                    nd.name: list(placed_by_slot.get(slot, []))
+                    for slot, nd in live
+                },
+            ),
+            disabled=disabled,
+        )
+        refined: dict[str, object] = {}
+        names_in_order: list[str] = []
+        for rank in order[: self.refine_k]:
+            slot = int(cand_idx[rank])
+            if slot not in oracle_idx:
+                continue
+            nv = select_victims_on_node_full(
+                pod, oracle_idx[slot], oracle, pdbs
+            )
+            if nv is None or not nv.victims:
+                continue
+            name = slot_names[slot]
+            refined[name] = nv
+            names_in_order.append(name)
+        best_name = pick_one_node(refined, names_in_order)
+        if best_name is None:
+            return None
+        nv = refined[best_name]
         return PreemptionResult(
-            node_name=slot_names[best],
-            victims=chosen,
-            num_violating=int(n_viol[best]),
+            node_name=best_name,
+            victims=list(nv.victims),
+            num_violating=nv.num_violating,
         )

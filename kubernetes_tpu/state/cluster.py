@@ -64,6 +64,7 @@ class ClusterState:
         self._pdbs: dict[str, PodDisruptionBudget] = {}
         self._pvs: dict[str, PersistentVolume] = {}
         self._pvcs: dict[str, PersistentVolumeClaim] = {}
+        self._services: dict[str, object] = {}
         self._watchers: list[Watcher] = []
         # fault injection: called with (pod, node_name) before a bind commits;
         # raise ApiError to simulate apiserver-side rejection
@@ -202,6 +203,24 @@ class ClusterState:
 
     def list_pdbs(self) -> list[PodDisruptionBudget]:
         return list(self._pdbs.values())
+
+    # -- Services (PodTopologySpread System-defaulting input) --
+
+    def create_service(self, svc) -> object:
+        if svc.key in self._services:
+            raise ApiError("AlreadyExists", svc.key)
+        svc.resource_version = self._next_rv()
+        self._services[svc.key] = svc
+        return svc
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        if self._services.pop(key, None) is None:
+            raise ApiError("NotFound", key)
+        self._next_rv()
+
+    def list_services(self) -> list:
+        return list(self._services.values())
 
     # -- PersistentVolumes / Claims (volume plugin inputs) --
 

@@ -130,17 +130,36 @@ def trivial_static_tensors(pbatch: PodBatch, padded_n: int, schedulable: np.ndar
     )
 
 
+VOLUME_PLUGINS = frozenset(
+    {"VolumeBinding", "VolumeZone", "VolumeRestrictions", "NodeVolumeLimits"}
+)
+
+
 def build_static_tensors(
     pods: Sequence[Pod],
     pbatch: PodBatch,
     slot_nodes: Sequence[Node | None],
     padded_n: int,
     volume_ctx=None,
+    disabled: frozenset = frozenset(),
+    added_affinity=None,
+    class_key_extra=None,
 ) -> StaticPluginTensors:
     """slot_nodes: Node per snapshot slot (None = free/invalid slot), so the
     class tensors share the solver's node index space. ``volume_ctx`` (an
     ops.oracle.volumes.VolumeContext) folds the volume plugin family's
-    static checks into the mask."""
+    static checks into the mask.
+
+    ``disabled``: filter-point plugin names disabled by the profile
+    (runtime/framework.go honors plugins.filter.disabled); the volume
+    family is fused, so disabling any one of its four names disables the
+    fused check (the config loader warns about the coarseness).
+    ``added_affinity``: NodeAffinityArgs.addedAffinity — required terms AND
+    into every class mask, preferred weights add to the NodeAffinity score.
+    ``class_key_extra``: optional callable(pod) mixed into the class key —
+    used for identity the base key cannot see (e.g. the service-derived
+    System spread-default selector).
+    """
     live_nodes = [n for n in slot_nodes if n is not None]
     image_states = opl.build_image_states(live_nodes)
     total_nodes = len(live_nodes)
@@ -151,6 +170,8 @@ def build_static_tensors(
     index: dict = {}
     for i, pod in enumerate(pods):
         key = _class_key(pod, with_images=any_images)
+        if class_key_extra is not None:
+            key = (key, class_key_extra(pod))
         c = index.get(key)
         if c is None:
             c = len(reps)
@@ -169,13 +190,26 @@ def build_static_tensors(
             if node is None or j >= padded_n:
                 continue
             ok = (
-                opl.node_name_filter(rep, node)
-                and opl.node_unschedulable_filter(rep, node)
-                and opl.taint_toleration_filter(rep, node)
-                and opl.node_affinity_filter(rep, node)
+                ("NodeName" in disabled or opl.node_name_filter(rep, node))
+                and (
+                    "NodeUnschedulable" in disabled
+                    or opl.node_unschedulable_filter(rep, node)
+                )
+                and (
+                    "TaintToleration" in disabled
+                    or opl.taint_toleration_filter(rep, node)
+                )
+                and (
+                    "NodeAffinity" in disabled
+                    or (
+                        opl.node_affinity_filter(rep, node)
+                        and opl.added_affinity_filter(added_affinity, node)
+                    )
+                )
                 and (
                     volume_ctx is None
                     or not rep.pvc_names
+                    or bool(VOLUME_PLUGINS & disabled)
                     or ovol.volume_filter(rep, node, volume_ctx)
                 )
             )
@@ -187,6 +221,10 @@ def build_static_tensors(
             aff = rep.affinity.node_affinity if rep.affinity else None
             if aff is not None and aff.preferred:
                 nodeaff_pref[c, j] = opl.node_affinity_score(rep, node)
+            if added_affinity is not None and added_affinity.preferred:
+                nodeaff_pref[c, j] += opl.added_affinity_score(
+                    added_affinity, node
+                )
             if any_images:
                 image_score[c, j] = opl.image_locality_score(
                     rep, node, image_states, total_nodes

@@ -81,15 +81,26 @@ def build_spread_tensors(
     placed_by_slot: Mapping[int, Sequence[Pod]],
     padded_n: int,
     c_pad: int,
+    services: Sequence | None = None,
+    defaulting: str = "System",
 ) -> SpreadTensors:
     """class_reps comes from the static tensorizer so all per-class tables
-    share one class id space (xs carries class_of for the gather)."""
+    share one class id space (xs carries class_of for the gather).
+
+    ``services`` + ``defaulting`` feed PodTopologySpreadArgs.defaultingType
+    =System: classes with no explicit constraints get the soft
+    zone/hostname system defaults when a service selects them."""
     # collect instances per class
     per_class: list[tuple[list, list]] = []  # (hard ECs, soft ECs)
     insts: list[tuple[int, osp.EffectiveConstraint, bool, Pod]] = []
     for c, rep in enumerate(class_reps):
+        defaults = (
+            osp.system_default_constraints(rep, services)
+            if defaulting == "System" and services
+            else ()
+        )
         hard = osp.effective_constraints(rep, hard=True)
-        soft = osp.effective_constraints(rep, hard=False)
+        soft = osp.effective_constraints(rep, hard=False, defaults=defaults)
         per_class.append((hard, soft))
         for ec in hard:
             insts.append((c, ec, True, rep))

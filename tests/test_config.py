@@ -227,3 +227,241 @@ def test_cli_perf_command(tmp_path, capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["scheduled"] == 8
+
+
+# -- config pipeline actually honored (VERDICT r1 #7) -----------------------
+
+
+def _sched_from_yaml(yaml_text, cs):
+    cfg = ct.load(textwrap.dedent(yaml_text))
+    return Scheduler(cs, ct.scheduler_config(cfg)), cfg
+
+
+def test_disabled_filter_stops_filtering():
+    """plugins.filter.disabled: [TaintToleration] — tainted nodes admit
+    intolerant pods under that profile."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("tainted")
+        .capacity({"cpu": "4", "memory": "8Gi", "pods": "10"})
+        .taint("dedicated", "gpu", "NoSchedule").obj()
+    )
+    sched, cfg = _sched_from_yaml(
+        """
+        apiVersion: kubescheduler.config.k8s.io/v1
+        profiles:
+          - schedulerName: default-scheduler
+            plugins:
+              filter:
+                disabled:
+                  - name: TaintToleration
+        """,
+        cs,
+    )
+    cs.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+    r = sched.schedule_batch()
+    assert ("default/p", "tainted") in r.scheduled
+
+    # control: same cluster, default config -> unschedulable
+    cs2 = ClusterState()
+    cs2.create_node(
+        MakeNode().name("tainted")
+        .capacity({"cpu": "4", "memory": "8Gi", "pods": "10"})
+        .taint("dedicated", "gpu", "NoSchedule").obj()
+    )
+    sched2 = Scheduler(cs2, SchedulerConfig(batch_size=4))
+    cs2.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+    r2 = sched2.schedule_batch()
+    assert r2.unschedulable == ["default/p"]
+
+
+def test_disabled_fit_filter_overcommits():
+    """Disabling NodeResourcesFit admits pods beyond allocatable."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("tiny").capacity({"cpu": "1", "memory": "1Gi", "pods": "10"}).obj()
+    )
+    sched, _ = _sched_from_yaml(
+        """
+        apiVersion: kubescheduler.config.k8s.io/v1
+        profiles:
+          - schedulerName: default-scheduler
+            plugins:
+              filter:
+                disabled:
+                  - name: NodeResourcesFit
+        """,
+        cs,
+    )
+    cs.create_pod(MakePod().name("big").req({"cpu": "8"}).obj())
+    r = sched.schedule_batch()
+    assert ("default/big", "tiny") in r.scheduled
+
+
+def test_rtc_scoring_changes_placement():
+    """RequestedToCapacityRatio with an increasing shape prefers the MORE
+    utilized node (bin-packing), the opposite of default LeastAllocated."""
+    def build_cluster():
+        cs = ClusterState()
+        for name, used_cpu in (("empty", 0), ("busy", 6)):
+            cs.create_node(
+                MakeNode().name(name)
+                .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"}).obj()
+            )
+            if used_cpu:
+                cs.create_pod(
+                    MakePod().name(f"filler-{name}").node(name)
+                    .req({"cpu": str(used_cpu), "memory": "4Gi"}).obj()
+                )
+        return cs
+
+    rtc_yaml = """
+        apiVersion: kubescheduler.config.k8s.io/v1
+        profiles:
+          - schedulerName: default-scheduler
+            plugins:
+              score:
+                disabled:
+                  - name: NodeResourcesBalancedAllocation
+            pluginConfig:
+              - name: NodeResourcesFit
+                args:
+                  scoringStrategy:
+                    type: RequestedToCapacityRatio
+                    resources:
+                      - name: cpu
+                        weight: 1
+                      - name: memory
+                        weight: 1
+                    requestedToCapacityRatio:
+                      shape:
+                        - utilization: 0
+                          score: 0
+                        - utilization: 100
+                          score: 10
+        """
+    cs = build_cluster()
+    sched, cfg = _sched_from_yaml(rtc_yaml, cs)
+    assert not any("RequestedToCapacityRatio" in w for w in cfg.warnings)
+    cs.create_pod(MakePod().name("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+    r = sched.schedule_batch()
+    assert ("default/p", "busy") in r.scheduled
+
+    # control: default LeastAllocated prefers the empty node
+    cs2 = build_cluster()
+    sched2 = Scheduler(
+        cs2,
+        SchedulerConfig(batch_size=4, solver=ExactSolverConfig(
+            tie_break="first", balanced_weight=0)),
+    )
+    cs2.create_pod(MakePod().name("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+    r2 = sched2.schedule_batch()
+    assert ("default/p", "empty") in r2.scheduled
+
+
+def test_added_affinity_enforced():
+    """NodeAffinityArgs.addedAffinity is a hard Filter for every pod of the
+    profile (ADVICE r1: was parsed but silently unenforced)."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("blue").capacity({"cpu": "4", "memory": "8Gi", "pods": "10"})
+        .label("team", "blue").obj()
+    )
+    cs.create_node(
+        MakeNode().name("red").capacity({"cpu": "4", "memory": "8Gi", "pods": "10"})
+        .label("team", "red").obj()
+    )
+    sched, _ = _sched_from_yaml(
+        """
+        apiVersion: kubescheduler.config.k8s.io/v1
+        profiles:
+          - schedulerName: default-scheduler
+            pluginConfig:
+              - name: NodeAffinity
+                args:
+                  addedAffinity:
+                    requiredDuringSchedulingIgnoredDuringExecution:
+                      nodeSelectorTerms:
+                        - matchExpressions:
+                            - key: team
+                              operator: In
+                              values: ["blue"]
+        """,
+        cs,
+    )
+    for i in range(4):
+        cs.create_pod(MakePod().name(f"p-{i}").req({"cpu": "1"}).obj())
+    r = sched.schedule_batch()
+    assert len(r.scheduled) == 4
+    assert all(node == "blue" for _, node in r.scheduled)
+
+
+def test_fit_resource_weights_change_scoring():
+    """scoringStrategy.resources weights shift LeastAllocated preferences:
+    with cpu weight dominant, the cpu-idle node wins even though it is
+    memory-loaded."""
+    def build_cluster():
+        cs = ClusterState()
+        cs.create_node(
+            MakeNode().name("cpu-idle")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"}).obj()
+        )
+        cs.create_node(
+            MakeNode().name("mem-idle")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"}).obj()
+        )
+        # cpu-idle: memory mostly used; mem-idle: cpu mostly used
+        cs.create_pod(
+            MakePod().name("mem-hog").node("cpu-idle").req({"memory": "12Gi"}).obj()
+        )
+        cs.create_pod(
+            MakePod().name("cpu-hog").node("mem-idle").req({"cpu": "6"}).obj()
+        )
+        return cs
+
+    yaml_w = """
+        apiVersion: kubescheduler.config.k8s.io/v1
+        profiles:
+          - schedulerName: default-scheduler
+            plugins:
+              score:
+                disabled:
+                  - name: NodeResourcesBalancedAllocation
+            pluginConfig:
+              - name: NodeResourcesFit
+                args:
+                  scoringStrategy:
+                    type: LeastAllocated
+                    resources:
+                      - name: cpu
+                        weight: 9
+                      - name: memory
+                        weight: 1
+        """
+    cs = build_cluster()
+    sched, _ = _sched_from_yaml(yaml_w, cs)
+    cs.create_pod(MakePod().name("p").req({"cpu": "1", "memory": "1Gi"}).obj())
+    r = sched.schedule_batch()
+    assert ("default/p", "cpu-idle") in r.scheduled
+
+
+def test_unsupported_scoring_resource_warns():
+    cfg = ct.load(
+        textwrap.dedent(
+            """
+            apiVersion: kubescheduler.config.k8s.io/v1
+            profiles:
+              - schedulerName: default-scheduler
+                pluginConfig:
+                  - name: NodeResourcesFit
+                    args:
+                      scoringStrategy:
+                        type: LeastAllocated
+                        resources:
+                          - name: nvidia.com/gpu
+                            weight: 3
+            """
+        )
+    )
+    ct.scheduler_config(cfg)
+    assert any("nvidia.com/gpu" in w for w in cfg.warnings)

@@ -297,3 +297,125 @@ def test_e2e_preemption_never_policy():
     assert r.unschedulable == ["default/polite"]
     assert not r.preemptions
     assert len(cs.list_pods()) == 2  # nothing evicted
+
+
+# -- full-filter dry-run (ports/spread/interpod-blocked preemptors) ---------
+
+
+def test_preemption_evicts_anti_affinity_owner():
+    """A pod blocked ONLY by pod anti-affinity (resources fine) preempts the
+    lower-priority pod that owns the conflicting labels — possible only with
+    the full-filter dry-run (the fit-only screen sees zero victims)."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("node-0").capacity({"cpu": "8", "memory": "16Gi", "pods": "10"})
+        .label("zone", "z0").obj()
+    )
+    cs.create_pod(
+        MakePod().name("king").node("node-0").req({"cpu": "1"}).priority(1)
+        .label("app", "king").obj()
+    )
+    clock = FakeClock()
+    sched = Scheduler(cs, SchedulerConfig(batch_size=4), clock=clock)
+    cs.create_pod(
+        MakePod().name("vip").req({"cpu": "1"}).priority(100)
+        .pod_anti_affinity("zone", match_labels={"app": "king"}).obj()
+    )
+    r1 = sched.schedule_batch()
+    assert r1.unschedulable == ["default/vip"]
+    assert len(r1.preemptions) == 1
+    _, node, victims = r1.preemptions[0]
+    assert node == "node-0" and victims == ["default/king"]
+    clock.advance(2.0)
+    r2 = sched.schedule_batch()
+    assert ("default/vip", "node-0") in r2.scheduled
+
+
+def test_preemption_evicts_spread_violators():
+    """A pod blocked by a DoNotSchedule spread constraint preempts enough
+    selector-matching pods to bring the skew within bounds; the reprieve
+    re-runs the spread filter per re-add."""
+    cs = ClusterState()
+    for z in (0, 1):
+        cs.create_node(
+            MakeNode().name(f"node-{z}").capacity({"cpu": "8", "memory": "16Gi", "pods": "10"})
+            .label("zone", f"z{z}").obj()
+        )
+    # two web pods on z0 (low priority), z1 fully blocked by a high-prio pod
+    for i in range(2):
+        cs.create_pod(
+            MakePod().name(f"web-{i}").node("node-0").req({"cpu": "1"})
+            .priority(1).start_time(float(i)).label("app", "web").obj()
+        )
+    cs.create_pod(
+        MakePod().name("fort").node("node-1").req({"cpu": "8"}).priority(1000).obj()
+    )
+    clock = FakeClock()
+    sched = Scheduler(cs, SchedulerConfig(batch_size=4), clock=clock)
+    cs.create_pod(
+        MakePod().name("vip").req({"cpu": "1"}).priority(100).label("app", "web")
+        .spread_constraint(1, "zone", "DoNotSchedule", {"app": "web"}).obj()
+    )
+    r1 = sched.schedule_batch()
+    assert r1.unschedulable == ["default/vip"]
+    assert len(r1.preemptions) == 1
+    _, node, victims = r1.preemptions[0]
+    # both web pods must go: evicting just one leaves skew 1+1-0 = 2 > 1
+    assert node == "node-0"
+    assert sorted(victims) == ["default/web-0", "default/web-1"]
+    clock.advance(2.0)
+    r2 = sched.schedule_batch()
+    assert ("default/vip", "node-0") in r2.scheduled
+
+
+def test_preemption_evicts_host_port_owner():
+    """A pod blocked only by a host-port conflict preempts the lower-priority
+    port owner (fit-only dry-run cannot see freed ports)."""
+    cs = ClusterState()
+    cs.create_node(mk_node("node-0", cpu="8"))
+    cs.create_pod(
+        MakePod().name("old-lb").node("node-0").req({"cpu": "1"}).priority(1)
+        .host_port(8080).obj()
+    )
+    clock = FakeClock()
+    sched = Scheduler(cs, SchedulerConfig(batch_size=4), clock=clock)
+    cs.create_pod(
+        MakePod().name("new-lb").req({"cpu": "1"}).priority(100)
+        .host_port(8080).obj()
+    )
+    r1 = sched.schedule_batch()
+    assert r1.unschedulable == ["default/new-lb"]
+    assert len(r1.preemptions) == 1
+    _, node, victims = r1.preemptions[0]
+    assert node == "node-0" and victims == ["default/old-lb"]
+    clock.advance(2.0)
+    r2 = sched.schedule_batch()
+    assert ("default/new-lb", "node-0") in r2.scheduled
+
+
+def test_full_dry_run_never_evicts_uselessly():
+    """If the blocker is an un-evictable higher-priority pod, the full
+    dry-run must refuse to nominate even though lower-priority pods exist
+    on the node (they would die for nothing)."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("node-0").capacity({"cpu": "8", "memory": "16Gi", "pods": "10"})
+        .label("zone", "z0").obj()
+    )
+    cs.create_pod(
+        MakePod().name("king").node("node-0").req({"cpu": "1"}).priority(1000)
+        .label("app", "king").obj()
+    )
+    cs.create_pod(
+        MakePod().name("bystander").node("node-0").req({"cpu": "1"}).priority(1).obj()
+    )
+    clock = FakeClock()
+    sched = Scheduler(cs, SchedulerConfig(batch_size=4), clock=clock)
+    cs.create_pod(
+        MakePod().name("vip").req({"cpu": "1"}).priority(100)
+        .pod_anti_affinity("zone", match_labels={"app": "king"}).obj()
+    )
+    r1 = sched.schedule_batch()
+    assert r1.unschedulable == ["default/vip"]
+    assert not r1.preemptions
+    assert len(cs.list_pods()) == 3  # nothing evicted

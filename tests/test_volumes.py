@@ -126,6 +126,40 @@ def test_csi_volume_limits():
     assert volume_filter(pod, free, ctx2)
 
 
+def test_csi_limit_counts_unique_volumes():
+    """Two pods sharing one PV consume ONE attachment slot (upstream counts
+    distinct volume handles), and a pod referencing an already-attached
+    volume adds no new slot."""
+    n = (
+        MakeNode().name("a")
+        .capacity({
+            "cpu": "8", "memory": "32Gi", "pods": "20",
+            csi_limit_key("ebs.csi.aws.com"): "2",
+        })
+        .obj()
+    )
+    shared_pv = pv("pv-shared", driver="ebs.csi.aws.com", modes=("ReadWriteMany",))
+    other_pv = pv("pv-other", driver="ebs.csi.aws.com")
+    pvcs = [
+        pvc("c-shared", volume="pv-shared"),
+        pvc("c-shared2", volume="pv-shared"),
+        pvc("c-other", volume="pv-other"),
+    ]
+    # two pods both using the shared PV: unique count on the node is 1
+    attached = [
+        MakePod().name("e0").node("a").pvc("c-shared").obj(),
+        MakePod().name("e1").node("a").pvc("c-shared2").obj(),
+    ]
+    ctx = VolumeContext.build([shared_pv, other_pv], pvcs, {"a": attached})
+    assert ctx.csi_count("a", "ebs.csi.aws.com") == 1
+    # a new pod with a second distinct volume fits: 1 + 1 <= 2
+    pod = MakePod().name("p").pvc("c-other").obj()
+    assert volume_filter(pod, n, ctx)
+    # a new pod re-referencing the ALREADY-ATTACHED volume adds nothing
+    pod2 = MakePod().name("p2").pvc("c-shared").obj()
+    assert volume_filter(pod2, n, ctx)
+
+
 # -- solver parity ----------------------------------------------------------
 
 
