@@ -28,6 +28,10 @@ def _load_config(path: str | None) -> config_types.KubeSchedulerConfiguration:
 
 def cmd_config(args) -> int:
     cfg = _load_config(args.config)
+    # building the runtime config runs the per-profile solver validation
+    # (scoring strategy shapes, disableable filters, resource weights) so
+    # its warnings surface here too, not only at serve/perf time
+    config_types.scheduler_config(cfg)
     out = {
         "profiles": [
             {

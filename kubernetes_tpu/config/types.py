@@ -290,13 +290,34 @@ def _solver_config(cfg: KubeSchedulerConfiguration, p: Profile):
                 f"scoringStrategy resource {name!r}: only cpu/memory are "
                 "tracked by the NonZero scoring pipeline; ignored"
             )
-    rtc_shape = tuple(
-        (int(s["utilization"]), int(s["score"]))
-        for s in p.scoring_strategy.shape
-    )
+    # requestedToCapacityRatio.shape validation
+    # (apis/config/validation#validateFunctionShape semantics): every point
+    # needs utilization+score, utilization strictly ascending; a malformed
+    # shape warns and falls back to LeastAllocated instead of raising, the
+    # same degradation already used for the empty-shape case.
+    rtc_shape: tuple = ()
+    try:
+        rtc_shape = tuple(
+            (int(s["utilization"]), int(s["score"]))
+            for s in p.scoring_strategy.shape
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        cfg.warnings.append(
+            "scoringStrategy requestedToCapacityRatio.shape entry is "
+            f"malformed ({e!r}); falling back to LeastAllocated"
+        )
+    if rtc_shape and any(
+        b[0] <= a[0] for a, b in zip(rtc_shape, rtc_shape[1:])
+    ):
+        cfg.warnings.append(
+            "scoringStrategy requestedToCapacityRatio.shape utilization "
+            "breakpoints must be strictly ascending; falling back to "
+            "LeastAllocated"
+        )
+        rtc_shape = ()
     if p.scoring_strategy.type == "RequestedToCapacityRatio" and not rtc_shape:
         cfg.warnings.append(
-            "scoringStrategy RequestedToCapacityRatio without a "
+            "scoringStrategy RequestedToCapacityRatio without a valid "
             "requestedToCapacityRatio.shape (upstream validation rejects "
             "this); falling back to LeastAllocated"
         )

@@ -90,6 +90,13 @@ class ExactSolverConfig:
     # Grouped fast path (§8.4 batched variant): chunk size for runs of
     # identical pods; 0/1 disables. Only engages when spread/interpod are
     # inactive for the batch (those couple scores across nodes).
+    # With tie_break="random" the grouped path samples q DISTINCT tie-set
+    # nodes per iteration (without replacement) while the per-pod scan
+    # samples ties with replacement: every grouped result is a sequentially
+    # valid outcome, but the placement DISTRIBUTION differs from the
+    # ungrouped solver for the same seed, so random-mode runs are not
+    # reproducible across group_size settings. tie_break="first" is
+    # bit-identical either way.
     group_size: int = 64
     # plugins.filter.disabled for this profile (runtime/framework.go):
     # names whose Filter stage is skipped. Static-mask plugins are handled

@@ -109,15 +109,23 @@ class PreemptionEvaluator:
     Two-phase design (SURVEY §8.5 + reference SelectVictimsOnNode):
     the batched device dry-run is a fit-only pre-screen + ranking over ALL
     nodes at once; when the pod's failure can involve beyond-fit filters
-    (ports/spread/interpod), the top ``refine_k`` ranked candidates are
-    re-evaluated with the full-filter scalar oracle
+    (ports/spread/interpod), at least the top ``refine_k`` ranked candidates
+    (and more until one yields victims) are re-evaluated with the
+    full-filter scalar oracle
     (select_victims_on_node_full), which also computes the exact victim set
     under per-re-add filter re-runs. When no beyond-fit filter is in play,
     fit-only IS the full pipeline (static per-node feasibility is already
     gated), so the device result commits directly.
     """
 
-    def __init__(self, refine_k: int = 8):
+    def __init__(self, refine_k: int = 100):
+        # Floor mirrors the reference's candidate sampling
+        # (preemption.go#GetOffsetAndNumCandidates: minCandidateNodesAbsolute
+        # = 100): at least this many fit-ranked candidates get the exact
+        # full-filter dry-run. If none of them yields victims, refinement
+        # keeps walking the remaining ranked candidates until one does (the
+        # fit-only ranking is a heuristic; a feasible candidate must never be
+        # lost to the cutoff).
         self.refine_k = refine_k
 
     def evaluate(
@@ -265,7 +273,9 @@ class PreemptionEvaluator:
         )
         refined: dict[str, object] = {}
         names_in_order: list[str] = []
-        for rank in order[: self.refine_k]:
+        for n_tried, rank in enumerate(order):
+            if n_tried >= self.refine_k and refined:
+                break  # past the floor with at least one exact candidate
             slot = int(cand_idx[rank])
             if slot not in oracle_idx:
                 continue

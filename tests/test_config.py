@@ -465,3 +465,86 @@ def test_unsupported_scoring_resource_warns():
     )
     ct.scheduler_config(cfg)
     assert any("nvidia.com/gpu" in w for w in cfg.warnings)
+
+
+def test_rtc_shape_malformed_entry_warns_and_falls_back():
+    """A shape point missing utilization/score degrades to LeastAllocated
+    with a warning instead of raising KeyError at config load (ADVICE r2)."""
+    cfg = ct.load(
+        textwrap.dedent(
+            """
+            apiVersion: kubescheduler.config.k8s.io/v1
+            profiles:
+              - schedulerName: default-scheduler
+                pluginConfig:
+                  - name: NodeResourcesFit
+                    args:
+                      scoringStrategy:
+                        type: RequestedToCapacityRatio
+                        requestedToCapacityRatio:
+                          shape:
+                            - utilization: 0
+                            - score: 10
+            """
+        )
+    )
+    scfg = ct.scheduler_config(cfg)
+    assert any("malformed" in w for w in cfg.warnings)
+    assert scfg.solver.rtc_shape == ()
+    # the solver's scorer dispatch with no shape is LeastAllocated
+    assert scfg.solver.scoring_strategy == "RequestedToCapacityRatio"
+
+
+def test_rtc_shape_non_ascending_warns_and_falls_back():
+    """Non-ascending utilization breakpoints break the piecewise
+    interpolation's assumptions; validation warns + falls back (ADVICE r2)."""
+    cfg = ct.load(
+        textwrap.dedent(
+            """
+            apiVersion: kubescheduler.config.k8s.io/v1
+            profiles:
+              - schedulerName: default-scheduler
+                pluginConfig:
+                  - name: NodeResourcesFit
+                    args:
+                      scoringStrategy:
+                        type: RequestedToCapacityRatio
+                        requestedToCapacityRatio:
+                          shape:
+                            - utilization: 50
+                              score: 5
+                            - utilization: 50
+                              score: 10
+            """
+        )
+    )
+    scfg = ct.scheduler_config(cfg)
+    assert any("ascending" in w for w in cfg.warnings)
+    assert scfg.solver.rtc_shape == ()
+
+
+def test_score_disable_independent_of_filter_disable():
+    """plugins.score.disabled and plugins.filter.disabled are separate lists
+    (runtime/framework.go builds per-extension-point pipelines): disabling
+    InterPodAffinity's Filter keeps its Score weight, and vice versa."""
+    cfg = ct.load(
+        textwrap.dedent(
+            """
+            apiVersion: kubescheduler.config.k8s.io/v1
+            profiles:
+              - schedulerName: default-scheduler
+                plugins:
+                  filter:
+                    disabled:
+                      - name: InterPodAffinity
+                  score:
+                    disabled:
+                      - name: TaintToleration
+            """
+        )
+    )
+    scfg = ct.scheduler_config(cfg)
+    assert "InterPodAffinity" in scfg.solver.disabled_filters
+    assert scfg.solver.interpod_weight == 2  # score stage still enabled
+    assert scfg.solver.taint_weight == 0  # score disabled
+    assert "TaintToleration" not in scfg.solver.disabled_filters
