@@ -62,11 +62,17 @@ def cmd_serve(args) -> int:
     for w in cfg.warnings:
         print(f"warning: {w}", file=sys.stderr)
     cluster = ClusterState()
+    sched_cfg = config_types.scheduler_config(cfg)
     run_server(
         cluster,
         host=args.host,
         port=args.port,
         node_cache_capable=args.node_cache_capable,
+        mode=args.mode,
+        state_file=args.state,
+        solver_config=sched_cfg.solver,
+        grpc_port=args.grpc_port,
+        scheduler_config=sched_cfg,
     )
     return 0
 
@@ -110,6 +116,23 @@ def main(argv: list[str] | None = None) -> int:
     p_serve.add_argument("--host", default="127.0.0.1")
     p_serve.add_argument("--port", type=int, default=10259)
     p_serve.add_argument("--node-cache-capable", action="store_true")
+    p_serve.add_argument(
+        "--mode",
+        choices=("extender", "scheduler"),
+        default="extender",
+        help="extender: answer webhook verbs only; scheduler: also run the "
+        "batching scheduler loop over the ingested state",
+    )
+    p_serve.add_argument(
+        "--state",
+        help="initial cluster state file (JSON/YAML: nodes, pods, services, pdbs)",
+    )
+    p_serve.add_argument(
+        "--grpc-port",
+        type=int,
+        default=0,
+        help="also serve the bulk tensor gRPC path on this port (0 = off)",
+    )
     p_serve.set_defaults(fn=cmd_serve)
 
     p_perf = sub.add_parser("perf", help="run scheduler_perf YAML workloads")

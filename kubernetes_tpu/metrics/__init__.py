@@ -110,6 +110,18 @@ tensorize_seconds = Histogram(
     buckets=_BUCKETS,
     registry=REGISTRY,
 )
+extender_batch_size = Histogram(
+    "scheduler_tpu_extender_batch_size",
+    "Webhook requests coalesced per device evaluation (micro-batching).",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+    registry=REGISTRY,
+)
+extender_request_seconds = Histogram(
+    "scheduler_tpu_extender_request_seconds",
+    "Wall time of one micro-batched extender evaluation.",
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
 
 
 def render() -> bytes:

@@ -1,0 +1,357 @@
+"""Bulk tensor gRPC service (SURVEY §6.8): the wide-pipe companion to the
+per-pod JSON webhook, for workloads where per-pod JSON would dominate —
+the 50k-pod single-shot rebalance.
+
+Service ``kubernetestpu.Bulk``, methods (all unary, payloads framed by
+server/tensorcodec.py — columnar arrays + one JSON header):
+
+- ``SyncNodes``: upsert a node set from columnar arrays
+  (names in meta; cpu_milli/mem_bytes/max_pods arrays; optional labels in
+  meta). The node-delta path: only changed nodes need re-sending.
+- ``Solve``: schedule a columnar pod batch (cpu_milli/mem_bytes/priority
+  arrays) against the current node state.
+  meta.mode = "exact" (sequential-parity scan, grouped fast path when
+  eligible) | "single_shot" (auction; the rebalance engine).
+  meta.commit = true writes bindings into the cluster state (pods must
+  carry names in meta); default is advisory — assignments return but no
+  state changes, mirroring the webhook's advisory filter/prioritize.
+  Response: assignments int32 [P] (index into meta.nodes of the reply,
+  -1 = unschedulable).
+- ``Evaluate``: score a columnar pod batch -> scores int32 [P, N]
+  (-1 = infeasible), the bulk analog of /filter + /prioritize in one call.
+
+Columnar pods deliberately carry only resources + priority: richer pods
+(affinity, spread, ports) flow through the JSON ingest + webhook path where
+the full object model applies. This mirrors the north-star workload shape
+(BASELINE.json ladder #5: resource rebalance at 50k x 10k).
+
+Uses grpc.method_handlers_generic_handler with identity serializers —
+the wire is opaque bytes (tensorcodec framing); no protoc codegen exists
+in this image (grpc_tools is absent), and none is needed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..api.objects import (
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    Node,
+    Pod,
+)
+from ..state.cluster import ApiError, ClusterState
+from ..tensorize.schema import (
+    CPU_IDX,
+    MEM_IDX,
+    PodBatch,
+    ResourceVocab,
+    bucket_pow2,
+    build_node_batch,
+)
+from . import tensorcodec
+
+SERVICE = "kubernetestpu.Bulk"
+
+
+def columnar_pod_batch(
+    cpu_milli: np.ndarray,
+    mem_bytes: np.ndarray,
+    priority: np.ndarray | None,
+    vocab: ResourceVocab,
+    keys: list[str] | None = None,
+) -> PodBatch:
+    """Build a PodBatch straight from columnar arrays — no per-pod Python
+    objects on the bulk path (SURVEY §8.8: 1-vCPU host discipline).
+
+    NonZeroRequested defaults (100 mCPU / 200 MB, noderesources/
+    resource_allocation.go) apply where a request is zero, matching
+    Pod.non_zero_request()."""
+    p = int(cpu_milli.shape[0])
+    pp = bucket_pow2(p)
+    k = len(vocab)
+    req = np.zeros((pp, k), dtype=np.int64)
+    req[:p, CPU_IDX] = cpu_milli
+    req[:p, MEM_IDX] = mem_bytes
+    nonzero = np.zeros((pp, 2), dtype=np.int64)
+    nonzero[:p, 0] = np.where(cpu_milli > 0, cpu_milli, 100)
+    nonzero[:p, 1] = np.where(mem_bytes > 0, mem_bytes, 200 * 1024 * 1024)
+    prio = np.zeros(pp, dtype=np.int32)
+    if priority is not None:
+        prio[:p] = priority
+    valid = np.zeros(pp, dtype=bool)
+    valid[:p] = True
+    return PodBatch(
+        vocab=vocab,
+        keys=keys if keys is not None else [f"default/bulk-{i}" for i in range(p)],
+        num_pods=p,
+        padded=pp,
+        req=req,
+        req_mask=req > 0,
+        feasible_static=np.ones(pp, dtype=bool),
+        nonzero_req=nonzero,
+        priority=prio,
+        valid=valid,
+    )
+
+
+class BulkCore:
+    """Method implementations as bytes -> bytes functions (testable without
+    a socket, like ExtenderCore's dict -> dict handlers)."""
+
+    def __init__(self, cluster: ClusterState, scheduler=None, solver_config=None):
+        self.cluster = cluster
+        self.scheduler = scheduler
+        self._lock = threading.Lock()
+        from ..solver.evaluate import BatchEvaluator
+        from ..solver.exact import ExactSolver
+        from ..solver.single_shot import SingleShotSolver
+
+        self.exact = ExactSolver(solver_config)
+        self.evaluator = BatchEvaluator(solver_config)
+        self.single_shot = SingleShotSolver()
+
+    # -- helpers --
+
+    def _node_view(self):
+        nodes = self.cluster.list_nodes()
+        pods_by_node: dict[str, list[Pod]] = {}
+        for p in self.cluster.list_pods():
+            if p.node_name:
+                pods_by_node.setdefault(p.node_name, []).append(p)
+        return nodes, pods_by_node
+
+    # -- methods --
+
+    def sync_nodes(self, data: bytes) -> bytes:
+        meta, arrays = tensorcodec.decode(data)
+        names = meta.get("names") or []
+        labels = meta.get("labels") or [{}] * len(names)
+        cpu = arrays["cpu_milli"]
+        mem = arrays["mem_bytes"]
+        max_pods = arrays.get("max_pods")
+        applied = 0
+        with self._lock:
+            for i, name in enumerate(names):
+                node = Node(
+                    name=name,
+                    labels=dict(labels[i]) if i < len(labels) else {},
+                    allocatable={
+                        RESOURCE_CPU: int(cpu[i]),
+                        RESOURCE_MEMORY: int(mem[i]),
+                        RESOURCE_PODS: (
+                            int(max_pods[i]) if max_pods is not None else 110
+                        ),
+                    },
+                )
+                try:
+                    self.cluster.create_node(node)
+                except ApiError:
+                    self.cluster.update_node(node)
+                applied += 1
+        return tensorcodec.encode({"applied": applied})
+
+    def solve(self, data: bytes) -> bytes:
+        meta, arrays = tensorcodec.decode(data)
+        mode = meta.get("mode") or "exact"
+        commit = bool(meta.get("commit"))
+        names = meta.get("names")
+        with self._lock:
+            nodes, pods_by_node = self._node_view()
+            if not nodes:
+                return tensorcodec.encode({"error": "no nodes ingested"})
+            batch = build_node_batch(nodes, pods_by_node)
+            pbatch = columnar_pod_batch(
+                arrays["cpu_milli"],
+                arrays["mem_bytes"],
+                arrays.get("priority"),
+                batch.vocab,
+                keys=names,
+            )
+            if mode == "single_shot":
+                assignments = self.single_shot.solve(batch, pbatch)
+            else:
+                assignments = self.exact.solve(batch, pbatch)
+            if commit and names:
+                from ..api.objects import Container
+
+                ns = meta.get("namespace") or "default"
+                for i, (key, a) in enumerate(zip(names, assignments)):
+                    if a < 0:
+                        continue
+                    pod_name = key.split("/", 1)[-1]
+                    # one create+bind per placed pod; advisory callers skip
+                    try:
+                        self.cluster.create_pod(
+                            Pod(
+                                name=pod_name,
+                                namespace=ns,
+                                containers=(
+                                    Container(
+                                        name="c",
+                                        requests={
+                                            RESOURCE_CPU: int(
+                                                arrays["cpu_milli"][i]
+                                            ),
+                                            RESOURCE_MEMORY: int(
+                                                arrays["mem_bytes"][i]
+                                            ),
+                                        },
+                                    ),
+                                ),
+                            )
+                        )
+                        self.cluster.bind(ns, pod_name, batch.names[int(a)])
+                    except ApiError:
+                        pass
+        return tensorcodec.encode(
+            {"nodes": batch.names, "mode": mode},
+            {"assignments": np.asarray(assignments, dtype=np.int32)},
+        )
+
+    def evaluate(self, data: bytes) -> bytes:
+        meta, arrays = tensorcodec.decode(data)
+        from ..tensorize.interpod import trivial_interpod_tensors
+        from ..tensorize.plugins import (
+            trivial_port_tensors,
+            trivial_static_tensors,
+        )
+        from ..tensorize.spread import trivial_spread_tensors
+
+        with self._lock:
+            nodes, pods_by_node = self._node_view()
+            if not nodes:
+                return tensorcodec.encode({"error": "no nodes ingested"})
+            batch = build_node_batch(nodes, pods_by_node)
+            pbatch = columnar_pod_batch(
+                arrays["cpu_milli"],
+                arrays["mem_bytes"],
+                arrays.get("priority"),
+                batch.vocab,
+            )
+            static = trivial_static_tensors(
+                pbatch, batch.padded, batch.schedulable
+            )
+            ports = trivial_port_tensors(pbatch, batch.padded)
+            spread = trivial_spread_tensors(pbatch, batch.padded, static.c_pad)
+            interpod = trivial_interpod_tensors(
+                pbatch, batch.padded, static.c_pad
+            )
+            out = self.evaluator.evaluate_tensors(
+                batch, pbatch, static, ports, spread, interpod
+            )[:, : batch.num_nodes]
+        return tensorcodec.encode(
+            {"nodes": batch.names},
+            {"scores": np.ascontiguousarray(out, dtype=np.int32)},
+        )
+
+
+def make_grpc_server(core: BulkCore, port: int = 0, host: str = "127.0.0.1"):
+    """Returns (server, bound_port). Identity serializers: the tensorcodec
+    framing IS the message format."""
+    import grpc
+    from concurrent import futures
+
+    ident = lambda b: b  # noqa: E731
+
+    def unary(fn):
+        return grpc.unary_unary_rpc_method_handler(
+            lambda req, ctx: fn(req),
+            request_deserializer=ident,
+            response_serializer=ident,
+        )
+
+    handler = grpc.method_handlers_generic_handler(
+        SERVICE,
+        {
+            "SyncNodes": unary(core.sync_nodes),
+            "Solve": unary(core.solve),
+            "Evaluate": unary(core.evaluate),
+        },
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    return server, bound
+
+
+def serve_bulk(
+    cluster: ClusterState,
+    port: int,
+    host: str = "127.0.0.1",
+    scheduler=None,
+    solver_config=None,
+):
+    """Start the bulk gRPC server (non-blocking); returns the grpc server."""
+    core = BulkCore(cluster, scheduler=scheduler, solver_config=solver_config)
+    server, bound = make_grpc_server(core, port=port, host=host)
+    server.start()
+    return server
+
+
+class BulkClient:
+    """Thin client for tests/benchmarks: columnar in, columnar out."""
+
+    def __init__(self, target: str):
+        import grpc
+
+        ident = lambda b: b  # noqa: E731
+        self._channel = grpc.insecure_channel(target)
+        self._solve = self._channel.unary_unary(
+            f"/{SERVICE}/Solve",
+            request_serializer=ident,
+            response_deserializer=ident,
+        )
+        self._sync = self._channel.unary_unary(
+            f"/{SERVICE}/SyncNodes",
+            request_serializer=ident,
+            response_deserializer=ident,
+        )
+        self._eval = self._channel.unary_unary(
+            f"/{SERVICE}/Evaluate",
+            request_serializer=ident,
+            response_deserializer=ident,
+        )
+
+    def sync_nodes(self, names, cpu_milli, mem_bytes, max_pods=None, labels=None):
+        arrays = {
+            "cpu_milli": np.asarray(cpu_milli, dtype=np.int64),
+            "mem_bytes": np.asarray(mem_bytes, dtype=np.int64),
+        }
+        if max_pods is not None:
+            arrays["max_pods"] = np.asarray(max_pods, dtype=np.int32)
+        meta = {"names": list(names)}
+        if labels is not None:
+            meta["labels"] = list(labels)
+        reply = self._sync(tensorcodec.encode(meta, arrays))
+        return tensorcodec.decode(reply)[0]
+
+    def solve(self, cpu_milli, mem_bytes, priority=None, mode="exact",
+              names=None, commit=False):
+        arrays = {
+            "cpu_milli": np.asarray(cpu_milli, dtype=np.int64),
+            "mem_bytes": np.asarray(mem_bytes, dtype=np.int64),
+        }
+        if priority is not None:
+            arrays["priority"] = np.asarray(priority, dtype=np.int32)
+        meta = {"mode": mode, "commit": commit}
+        if names is not None:
+            meta["names"] = list(names)
+        reply = self._solve(tensorcodec.encode(meta, arrays))
+        return tensorcodec.decode(reply)
+
+    def evaluate(self, cpu_milli, mem_bytes, priority=None):
+        arrays = {
+            "cpu_milli": np.asarray(cpu_milli, dtype=np.int64),
+            "mem_bytes": np.asarray(mem_bytes, dtype=np.int64),
+        }
+        if priority is not None:
+            arrays["priority"] = np.asarray(priority, dtype=np.int32)
+        reply = self._eval(tensorcodec.encode({}, arrays))
+        return tensorcodec.decode(reply)
+
+    def close(self):
+        self._channel.close()

@@ -19,6 +19,23 @@ staging/src/k8s.io/kube-scheduler/extender/v1/types.go:
 - GET  /metrics    prometheus exposition (reference names)
 - GET  /healthz /livez /readyz
 
+Filter and prioritize answer from the DEVICE by default: concurrent webhook
+requests micro-batch into one vmapped filter+score evaluation
+(solver/evaluate.py) whose pipeline is shared with the exact solver, so the
+served verdicts are bit-identical to an in-process solve over the same
+snapshot. ``backend="oracle"`` retains the scalar NumPy path for parity
+tests. The server also exposes an ingest surface (the apiserver-shaped
+CRUD the extender's watch connection would provide in a reference
+deployment) so `cli.py serve` is an operable component:
+- POST   /api/nodes           Node dict or {"items": [...]} (create/update)
+- DELETE /api/nodes/{name}
+- POST   /api/pods            Pod dict or {"items": [...]}
+- DELETE /api/pods/{ns}/{name}
+- GET    /api/state           {"nodes": N, "pods": P, "unscheduled": U}
+In ``--mode scheduler`` a full Scheduler drains the queue in the
+background: ingested pods get bound by device solves without any external
+kube-scheduler (the cmd/kube-scheduler#Run analog).
+
 Handlers are pure dict->dict functions (golden-JSON testable, SURVEY §8.6)
 wrapped by a thin aiohttp app. The server holds a ClusterState for the pod
 side of NodeInfo (an extender keeps its own watch-fed view in the reference
@@ -28,7 +45,10 @@ accepts/returns bare node names resolved against that state.
 
 from __future__ import annotations
 
-from typing import Mapping
+import time
+from typing import Mapping, Sequence
+
+import numpy as np
 
 from ..api.objects import Node, Pod
 from ..ops.oracle import preemption as opr
@@ -39,12 +59,35 @@ from .. import metrics
 MAX_EXTENDER_PRIORITY = 10
 
 
-class ExtenderCore:
-    """Verb implementations as pure dict->dict handlers."""
+class DecodeError(Exception):
+    """Per-request decode failure inside a micro-batch: the HTTP layer maps
+    it to a 500 for that one request without failing its batch-mates."""
 
-    def __init__(self, cluster: ClusterState, node_cache_capable: bool = False):
+
+class ExtenderCore:
+    """Verb implementations as pure dict->dict handlers.
+
+    backend="device" (default): filter/prioritize scores come from one
+    vmapped device evaluation per request group. backend="oracle": scalar
+    NumPy reference path (the sanitizer, SURVEY §8.6).
+    """
+
+    def __init__(
+        self,
+        cluster: ClusterState,
+        node_cache_capable: bool = False,
+        backend: str = "device",
+        solver_config=None,
+    ):
         self.cluster = cluster
         self.node_cache_capable = node_cache_capable
+        self.backend = backend
+        if backend == "device":
+            from ..solver.evaluate import BatchEvaluator
+
+            self.evaluator = BatchEvaluator(solver_config)
+        else:
+            self.evaluator = None
 
     # -- helpers --
 
@@ -74,27 +117,120 @@ class ExtenderCore:
         pods_by_node = self._pods_by_node()
         return FullOracle(make_oracle_nodes(nodes, pods_by_node))
 
+    def _score_rows(
+        self, pods: Sequence[Pod], nodes: list[Node]
+    ) -> np.ndarray:
+        """[len(pods), len(nodes)] int32 full-pipeline totals, -1 =
+        infeasible — one device call for the whole pod group."""
+        if self.backend == "device":
+            return self.evaluator.evaluate(
+                list(pods),
+                nodes,
+                self._pods_by_node(),
+                services=self.cluster.list_services(),
+                pvs=self.cluster.list_pvs(),
+                pvcs=self.cluster.list_pvcs(),
+            )
+        oracle = self._oracle(nodes)
+        rows = np.full((len(pods), len(nodes)), -1, dtype=np.int32)
+        for pi, pod in enumerate(pods):
+            feasible = oracle.feasible_set(pod)
+            totals = oracle.score_totals(pod, feasible)
+            for i in feasible:
+                rows[pi, i] = totals[i]
+        return rows
+
     # -- verbs --
 
     def filter(self, args: Mapping) -> dict:
-        try:
-            pod = Pod.from_dict(args["pod"])
-            nodes, by_name, unknown = self._resolve_nodes(args)
-        except KeyError as e:
-            return {"error": str(e)}
-        oracle = self._oracle(nodes)
-        feasible = set(oracle.feasible_set(pod))
+        return self.run_many([("filter", args)])[0]
+
+    def prioritize(self, args: Mapping) -> list[dict]:
+        """HostPriorityList: full-pipeline totals rescaled into the 0..10
+        extender score range (MaxExtenderPriority). Decode errors raise —
+        the HTTP layer turns them into a 500 so the caller sees the failure
+        instead of silently dropping this extender's scores."""
+        out = self.run_many([("prioritize", args)])[0]
+        if isinstance(out, DecodeError):
+            raise KeyError(str(out))
+        return out
+
+    def run_many(self, requests: list[tuple[str, Mapping]]) -> list:
+        """Evaluate a micro-batch of filter/prioritize requests. Requests
+        sharing one node list (the common case: kube-scheduler fans a batch
+        of pods over the same snapshot) share a single device evaluation —
+        the pod axis of the vmap. Responses keep request order. A request
+        that fails to decode gets a per-request error (filter: the wire's
+        {"error"} shape; prioritize: a DecodeError the HTTP layer turns
+        into a 500 for that request alone) — it never poisons the batch."""
+        import hashlib
+        import json
+
+        results: list = [None] * len(requests)
+        # group key -> [(req_idx, verb, pod)]; key captures everything the
+        # evaluation depends on: mode, resolved names, per-request unknown
+        # names, and (full-node mode) the node payload itself — two requests
+        # naming the same nodes with different capacities must not share
+        groups: dict[tuple, list] = {}
+        meta: dict[tuple, tuple] = {}
+        for ri, (verb, args) in enumerate(requests):
+            try:
+                pod = Pod.from_dict(args["pod"])
+                nodes, by_name, unknown = self._resolve_nodes(args)
+            except KeyError as e:
+                if verb == "filter":
+                    results[ri] = {"error": str(e)}
+                else:
+                    results[ri] = DecodeError(str(e))
+                continue
+            if by_name:
+                payload_key = ""
+            else:
+                payload_key = hashlib.blake2b(
+                    json.dumps(
+                        (args.get("nodes") or {}).get("items") or [],
+                        sort_keys=True,
+                    ).encode(),
+                    digest_size=16,
+                ).hexdigest()
+            key = (
+                by_name,
+                tuple(n.name for n in nodes),
+                tuple(unknown),
+                payload_key,
+            )
+            if key not in groups:
+                groups[key] = []
+                meta[key] = (nodes, by_name, unknown)
+            groups[key].append((ri, verb, pod))
+        for key, members in groups.items():
+            nodes, by_name, unknown = meta[key]
+            rows = self._score_rows([pod for _, _, pod in members], nodes)
+            for (ri, verb, pod), row in zip(members, rows):
+                if verb == "filter":
+                    results[ri] = self._filter_result(
+                        row, nodes, by_name, unknown
+                    )
+                else:
+                    results[ri] = self._prioritize_result(row, nodes)
+        return results
+
+    def _filter_result(
+        self, row: np.ndarray, nodes: list[Node], by_name: bool,
+        unknown: list[str],
+    ) -> dict:
         passed: list[Node] = []
         failed: dict[str, str] = {}
-        for i, on in enumerate(oracle.nodes):
-            if i in feasible:
-                passed.append(on.node)
+        for i, node in enumerate(nodes):
+            if row[i] >= 0:
+                passed.append(node)
             else:
-                failed[on.node.name] = "node did not satisfy filters"
-        unresolvable = {n: "node not found" for n in unknown}
+                failed[node.name] = "node did not satisfy filters"
         out: dict = {
             "failedNodes": failed,
-            "failedAndUnresolvableNodes": unresolvable,
+            "failedAndUnresolvableNodes": {
+                n: "node not found" for n in unknown
+            },
         }
         if by_name:
             out["nodenames"] = [n.name for n in passed]
@@ -102,26 +238,20 @@ class ExtenderCore:
             out["nodes"] = {"items": [n.to_dict() for n in passed]}
         return out
 
-    def prioritize(self, args: Mapping) -> list[dict]:
-        """HostPriorityList: full-pipeline totals rescaled into the 0..10
-        extender score range (MaxExtenderPriority). Decode errors raise —
-        the HTTP layer turns them into a 500 so the caller sees the failure
-        instead of silently dropping this extender's scores."""
-        pod = Pod.from_dict(args["pod"])
-        nodes, _, _ = self._resolve_nodes(args)
-        oracle = self._oracle(nodes)
-        feasible = oracle.feasible_set(pod)
-        scores: dict[str, int] = {}
-        if feasible:
-            totals = oracle.score_totals(pod, feasible)
-            mx = max(totals.values(), default=0)
-            for i, t in totals.items():
-                name = oracle.nodes[i].node.name
-                scores[name] = (
-                    MAX_EXTENDER_PRIORITY * t // mx if mx > 0 else 0
-                )
+    def _prioritize_result(
+        self, row: np.ndarray, nodes: list[Node]
+    ) -> list[dict]:
+        mx = int(row.max()) if row.size else -1
         return [
-            {"host": n.name, "score": scores.get(n.name, 0)} for n in nodes
+            {
+                "host": n.name,
+                "score": (
+                    MAX_EXTENDER_PRIORITY * int(row[i]) // mx
+                    if mx > 0 and row[i] >= 0
+                    else 0
+                ),
+            }
+            for i, n in enumerate(nodes)
         ]
 
     def preempt(self, args: Mapping) -> dict:
@@ -189,19 +319,118 @@ class ExtenderCore:
             return {"error": str(e)}
 
 
-def make_app(core: ExtenderCore):
-    """aiohttp application wiring the pure handlers to the wire."""
+class MicroBatcher:
+    """Coalesce concurrent filter/prioritize requests into one device call.
+
+    Requests arriving within ``window`` seconds ride one ExtenderCore
+    .run_many() (executed off the event loop). The analog of the reference's
+    in-proc 16-way parallel-for: here parallelism is the vmap pod axis."""
+
+    def __init__(self, core: ExtenderCore, window: float = 0.002):
+        self.core = core
+        self.window = window
+        self._pending: list = []
+        self._task = None
+
+    async def submit(self, verb: str, args: Mapping):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pending.append((verb, args, fut))
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(self._drain())
+        return await fut
+
+    async def _drain(self):
+        import asyncio
+
+        # loop until no request arrived while the previous batch was in the
+        # executor — submit() only spawns a new task when this one is done,
+        # so returning with _pending non-empty would strand those futures
+        while True:
+            await asyncio.sleep(self.window)
+            batch, self._pending = self._pending, []
+            if not batch:
+                return
+            loop = asyncio.get_running_loop()
+            t0 = time.perf_counter()
+            try:
+                results = await loop.run_in_executor(
+                    None,
+                    self.core.run_many,
+                    [(verb, args) for verb, args, _ in batch],
+                )
+            except Exception as e:
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            metrics.extender_batch_size.observe(len(batch))
+            metrics.extender_request_seconds.observe(time.perf_counter() - t0)
+            for (_, _, fut), res in zip(batch, results):
+                if fut.done():
+                    continue
+                if isinstance(res, DecodeError):
+                    fut.set_exception(res)
+                else:
+                    fut.set_result(res)
+
+
+def _load_state_file(cluster: ClusterState, path: str) -> None:
+    """Initial-state ingest: JSON/YAML with {"nodes": [...], "pods": [...],
+    "services": [...], "pdbs": [...]} of wire-shape dicts."""
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        import yaml
+
+        doc = yaml.safe_load(text)
+    for nd in doc.get("nodes") or []:
+        cluster.create_node(Node.from_dict(nd))
+    for pd in doc.get("pods") or []:
+        cluster.create_pod(Pod.from_dict(pd))
+    if doc.get("services"):
+        from ..api.objects import Service
+
+        for sd in doc["services"]:
+            cluster.create_service(Service.from_dict(sd))
+    if doc.get("pdbs"):
+        from ..api.objects import PodDisruptionBudget
+
+        for dd in doc["pdbs"]:
+            cluster.create_pdb(PodDisruptionBudget.from_dict(dd))
+
+
+def make_app(core: ExtenderCore, scheduler=None, batch_window: float = 0.002):
+    """aiohttp application wiring the pure handlers to the wire.
+
+    With ``scheduler`` (a Scheduler over the same ClusterState), a
+    background task drains the queue: ingested pods are bound by device
+    solves — serve --mode scheduler."""
+    import asyncio
+
     from aiohttp import web
+
+    batcher = MicroBatcher(core, window=batch_window)
 
     async def _json(request):
         return await request.json()
 
     async def filter_(request):
-        return web.json_response(core.filter(await _json(request)))
+        return web.json_response(
+            await batcher.submit("filter", await _json(request))
+        )
 
     async def prioritize(request):
         try:
-            return web.json_response(core.prioritize(await _json(request)))
+            return web.json_response(
+                await batcher.submit("prioritize", await _json(request))
+            )
         except Exception as e:
             return web.json_response({"error": str(e)}, status=500)
 
@@ -219,6 +448,62 @@ def make_app(core: ExtenderCore):
     async def healthz(request):
         return web.Response(text="ok")
 
+    # -- ingest surface (the watch-fed view's write side) --
+
+    def _items(doc):
+        return doc["items"] if isinstance(doc, Mapping) and "items" in doc else [doc]
+
+    async def post_nodes(request):
+        doc = await _json(request)
+        created = 0
+        for nd in _items(doc):
+            node = Node.from_dict(nd)
+            try:
+                core.cluster.create_node(node)
+            except ApiError:
+                core.cluster.update_node(node)
+            created += 1
+        return web.json_response({"applied": created})
+
+    async def delete_node(request):
+        try:
+            core.cluster.delete_node(request.match_info["name"])
+        except ApiError as e:
+            return web.json_response({"error": e.reason}, status=404)
+        return web.json_response({})
+
+    async def post_pods(request):
+        doc = await _json(request)
+        created = 0
+        for pd in _items(doc):
+            pod = Pod.from_dict(pd)
+            try:
+                core.cluster.create_pod(pod)
+            except ApiError:
+                core.cluster.update_pod(pod)
+            created += 1
+        return web.json_response({"applied": created})
+
+    async def delete_pod(request):
+        try:
+            core.cluster.delete_pod(
+                request.match_info["ns"], request.match_info["name"]
+            )
+        except ApiError as e:
+            return web.json_response({"error": e.reason}, status=404)
+        return web.json_response({})
+
+    async def get_state(request):
+        pods = core.cluster.list_pods()
+        return web.json_response(
+            {
+                "nodes": len(core.cluster.list_nodes()),
+                "pods": len(pods),
+                "unscheduled": sum(1 for p in pods if not p.node_name),
+                "resourceVersion": core.cluster.resource_version,
+            }
+        )
+
     app = web.Application()
     app.router.add_post("/filter", filter_)
     app.router.add_post("/prioritize", prioritize)
@@ -227,6 +512,50 @@ def make_app(core: ExtenderCore):
     app.router.add_get("/metrics", metrics_)
     for route in ("/healthz", "/livez", "/readyz"):
         app.router.add_get(route, healthz)
+    app.router.add_post("/api/nodes", post_nodes)
+    app.router.add_delete("/api/nodes/{name}", delete_node)
+    app.router.add_post("/api/pods", post_pods)
+    app.router.add_delete("/api/pods/{ns}/{name}", delete_pod)
+    app.router.add_get("/api/state", get_state)
+
+    if scheduler is not None:
+
+        async def drain(app):
+            loop = asyncio.get_running_loop()
+
+            async def loop_task():
+                import logging
+
+                log = logging.getLogger("kubernetes_tpu.serve")
+                log.info("scheduler drain loop running")
+                while True:
+                    progressed = False
+                    if scheduler.pending:
+                        try:
+                            res = await loop.run_in_executor(
+                                None, scheduler.schedule_batch
+                            )
+                        except Exception:
+                            # a failed batch must not kill the drain loop —
+                            # log and retry (pods stay queued)
+                            log.exception("schedule_batch failed")
+                            await asyncio.sleep(1.0)
+                            continue
+                        progressed = bool(
+                            res.scheduled
+                            or res.unschedulable
+                            or res.bind_failures
+                        )
+                    if not progressed:
+                        # pending may count backoff/unschedulable pods the
+                        # pop yields nothing for — don't busy-spin on them
+                        await asyncio.sleep(0.02)
+
+            task = asyncio.create_task(loop_task())
+            yield
+            task.cancel()
+
+        app.cleanup_ctx.append(drain)
     return app
 
 
@@ -235,10 +564,41 @@ def run_server(
     host: str = "127.0.0.1",
     port: int = 10259,
     node_cache_capable: bool = False,
+    mode: str = "extender",
+    state_file: str | None = None,
+    solver_config=None,
+    grpc_port: int = 0,
+    scheduler_config=None,
 ) -> None:
     """Blocking server entry (the cmd/kube-scheduler#Run analog serves
-    healthz+metrics on 10259)."""
+    healthz+metrics on 10259). mode="scheduler" also runs the batching
+    scheduler loop over the ingested state; grpc_port > 0 additionally
+    serves the bulk tensor gRPC path (SURVEY §6.8)."""
     from aiohttp import web
 
-    app = make_app(ExtenderCore(cluster, node_cache_capable))
-    web.run_app(app, host=host, port=port)
+    if state_file:
+        _load_state_file(cluster, state_file)
+    scheduler = None
+    if mode == "scheduler":
+        from ..scheduler import Scheduler
+
+        scheduler = Scheduler(cluster, scheduler_config)
+    core = ExtenderCore(
+        cluster, node_cache_capable, solver_config=solver_config
+    )
+    grpc_server = None
+    if grpc_port:
+        from .bulk import serve_bulk
+
+        grpc_server = serve_bulk(
+            cluster,
+            port=grpc_port,
+            scheduler=scheduler,
+            solver_config=solver_config,
+        )
+    app = make_app(core, scheduler=scheduler)
+    try:
+        web.run_app(app, host=host, port=port)
+    finally:
+        if grpc_server is not None:
+            grpc_server.stop(grace=1.0)

@@ -1,0 +1,264 @@
+"""Stateless batched filter/score evaluation — the device path behind the
+served extender boundary (SURVEY §8.2).
+
+The extender protocol (pkg/scheduler/extender.go#HTTPExtender) is advisory:
+/filter and /prioritize report feasibility and scores for ONE pod against a
+node list, and the CALLING kube-scheduler does the assume/bind. So unlike
+the exact solver's lax.scan (which carries node state across pods), the
+served evaluation is a pure function of the current snapshot: a vmap of the
+same fused filter+score pipeline (`solver.exact._mask_and_score`) over a pod
+batch, yielding `[P, N]` scores with -1 on infeasible lanes. Concurrent
+webhook requests micro-batch into one such call (server/batching.py), which
+is how per-request latency stays flat while the device does P×N work.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.objects import Node, Pod
+from ..tensorize.interpod import build_interpod_tensors, trivial_interpod_tensors
+from ..tensorize.plugins import (
+    build_port_tensors,
+    build_static_tensors,
+    trivial_port_tensors,
+    trivial_static_tensors,
+)
+from ..tensorize.schema import build_node_batch, build_pod_batch
+from ..tensorize.spread import build_spread_tensors, trivial_spread_tensors
+from .exact import ExactSolverConfig, _mask_and_score
+
+_PIPE_STATICS = (
+    "scoring_strategy",
+    "w_cpu",
+    "w_mem",
+    "rtc_shape",
+    "disabled",
+    "w_fit",
+    "w_balanced",
+    "w_taint",
+    "w_nodeaff",
+    "w_image",
+    "w_spread",
+    "w_interpod",
+    "use_spread",
+    "use_interpod",
+    "d_pad",
+    "ipa_d_pad",
+    "fdtype",
+)
+
+
+@partial(jax.jit, static_argnames=_PIPE_STATICS)
+def _eval_jit(tables, st, xs, **kw):
+    return jax.vmap(lambda x: _mask_and_score(tables, st, x, **kw))(xs)
+
+
+class BatchEvaluator:
+    """Object-level entry: pods × nodes → score matrix on device.
+
+    Reuses the solver's tensorizers so the served scores are bit-identical
+    to what the exact solver would compute for each pod against the same
+    snapshot (the first scan step sees exactly this state).
+    """
+
+    def __init__(self, config: ExactSolverConfig | None = None):
+        self.config = config or ExactSolverConfig()
+        if not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+        from ..utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()
+
+    def evaluate(
+        self,
+        pods: list[Pod],
+        nodes: list[Node],
+        pods_by_node: dict[str, list[Pod]],
+        services: list | None = None,
+        pvs: list | None = None,
+        pvcs: list | None = None,
+    ) -> np.ndarray:
+        """Returns scores [len(pods), len(nodes)] int32; -1 = infeasible.
+
+        Node index space is the order of ``nodes``; ``pods_by_node`` carries
+        already-placed pods (the extender's watch-fed NodeInfo view).
+        """
+        cfg = self.config
+        batch = build_node_batch(nodes, pods_by_node)
+        pbatch = build_pod_batch(pods, batch.vocab)
+        slot_nodes: list[Node | None] = list(nodes) + [None] * (
+            batch.padded - len(nodes)
+        )
+        placed_by_slot = {
+            i: list(pods_by_node[n.name])
+            for i, n in enumerate(nodes)
+            if pods_by_node.get(n.name)
+        }
+
+        services = services or []
+        need_spread = any(p.topology_spread_constraints for p in pods)
+        class_key_extra = None
+        if services and cfg.spread_defaulting == "System":
+            from ..ops.oracle.spread import default_selector, default_selector_key
+
+            need_spread = need_spread or any(
+                not p.topology_spread_constraints
+                and default_selector(p, services) is not None
+                for p in pods
+            )
+
+            def class_key_extra(p):
+                if p.topology_spread_constraints:
+                    return None
+                return default_selector_key(p, services)
+
+        def has_pod_affinity(p: Pod) -> bool:
+            return p.affinity is not None and (
+                p.affinity.pod_affinity is not None
+                or p.affinity.pod_anti_affinity is not None
+            )
+
+        need_interpod = any(has_pod_affinity(p) for p in pods) or any(
+            has_pod_affinity(q)
+            for placed in pods_by_node.values()
+            for q in placed
+        )
+        need_ports = any(p.host_ports() for p in pods)
+
+        volume_ctx = None
+        if any(p.pvc_names for p in pods):
+            from ..ops.oracle.volumes import VolumeContext
+
+            volume_ctx = VolumeContext.build(
+                pvs or [], pvcs or [], dict(pods_by_node)
+            )
+
+        static = build_static_tensors(
+            pods, pbatch, slot_nodes, batch.padded, volume_ctx,
+            disabled=frozenset(cfg.disabled_filters),
+            added_affinity=cfg.added_affinity,
+            class_key_extra=class_key_extra,
+        )
+        if need_ports:
+            ports = build_port_tensors(
+                pods, pbatch, slot_nodes, placed_by_slot, batch.padded
+            )
+        else:
+            ports = trivial_port_tensors(pbatch, batch.padded)
+        if need_spread:
+            spread = build_spread_tensors(
+                pods, static.reps, pbatch, slot_nodes, placed_by_slot,
+                batch.padded, static.c_pad,
+                services=services, defaulting=cfg.spread_defaulting,
+            )
+        else:
+            spread = trivial_spread_tensors(pbatch, batch.padded, static.c_pad)
+        if need_interpod:
+            interpod = build_interpod_tensors(
+                pods, static.reps, pbatch, slot_nodes, placed_by_slot,
+                batch.padded, static.c_pad,
+                hard_pod_affinity_weight=cfg.hard_pod_affinity_weight,
+            )
+        else:
+            interpod = trivial_interpod_tensors(
+                pbatch, batch.padded, static.c_pad
+            )
+        return self.evaluate_tensors(
+            batch, pbatch, static, ports, spread, interpod
+        )[:, : len(nodes)]
+
+    def evaluate_tensors(
+        self, batch, pbatch, static, ports, spread, interpod
+    ) -> np.ndarray:
+        """Low-level entry: prepared tensors -> scores
+        [num_pods, padded_nodes] int32 (-1 = infeasible). Shared by the
+        object path above and the bulk gRPC path's columnar batches."""
+        cfg = self.config
+        use_spread = not spread.empty
+        use_interpod = not interpod.empty
+
+        tables = {
+            "alloc": jnp.asarray(batch.allocatable),
+            "max_pods": jnp.asarray(batch.max_pods),
+            "node_valid": jnp.asarray(batch.valid),
+            "static_mask": jnp.asarray(static.mask),
+            "taint_cnt": jnp.asarray(static.taint_cnt),
+            "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
+            "image_score": jnp.asarray(static.image_score),
+            "spr": {
+                "dom": jnp.asarray(spread.dom),
+                "elig": jnp.asarray(spread.elig),
+                "max_skew": jnp.asarray(spread.max_skew),
+                "min_domains": jnp.asarray(spread.min_domains),
+                "self_match": jnp.asarray(spread.self_match),
+                "is_hostname": jnp.asarray(spread.is_hostname),
+                "hard": jnp.asarray(spread.hard),
+                "soft": jnp.asarray(spread.soft),
+            },
+            "ipa": {
+                "in_dom": jnp.asarray(interpod.in_dom),
+                "in_pref_w": jnp.asarray(interpod.in_pref_w),
+                "cls_req_aff": jnp.asarray(interpod.cls_req_aff),
+                "cls_req_anti": jnp.asarray(interpod.cls_req_anti),
+                "cls_pref": jnp.asarray(interpod.cls_pref),
+                "ex_dom": jnp.asarray(interpod.ex_dom),
+                "ex_anti": jnp.asarray(interpod.ex_anti),
+            },
+        }
+        st = {
+            "used": jnp.asarray(batch.used),
+            "nonzero_used": jnp.asarray(batch.nonzero_used),
+            "pod_count": jnp.asarray(batch.pod_count),
+            "port_used": jnp.asarray(ports.used),
+            "spr_cnt": jnp.asarray(spread.cnt0),
+            "ipa_in": jnp.asarray(interpod.in_cnt0),
+            "ipa_ex": jnp.asarray(interpod.ex_cnt0),
+        }
+        pod_valid = pbatch.valid & pbatch.feasible_static
+        xs = {
+            "req": jnp.asarray(pbatch.req),
+            "req_mask": jnp.asarray(pbatch.req_mask),
+            "nonzero_req": jnp.asarray(pbatch.nonzero_req),
+            "class_of": jnp.asarray(static.class_of),
+            "pod_conflict": jnp.asarray(ports.pod_conflict),
+        }
+        if use_interpod:
+            xs["ipa_m_anti"] = jnp.asarray(interpod.m_anti)
+            xs["ipa_m_w"] = jnp.asarray(interpod.m_w)
+            xs["ipa_self_aff"] = jnp.asarray(interpod.self_aff)
+
+        fdtype = (
+            jnp.float64 if cfg.balanced_fdtype == "float64" else jnp.float32
+        )
+        scores = _eval_jit(
+            tables,
+            st,
+            xs,
+            scoring_strategy=cfg.scoring_strategy,
+            w_cpu=cfg.cpu_weight,
+            w_mem=cfg.mem_weight,
+            rtc_shape=tuple(tuple(p) for p in cfg.rtc_shape),
+            disabled=tuple(sorted(cfg.disabled_filters)),
+            w_fit=cfg.fit_weight,
+            w_balanced=cfg.balanced_weight,
+            w_taint=cfg.taint_weight,
+            w_nodeaff=cfg.node_affinity_weight,
+            w_image=cfg.image_weight,
+            w_spread=cfg.spread_weight,
+            w_interpod=cfg.interpod_weight,
+            use_spread=use_spread,
+            use_interpod=use_interpod,
+            d_pad=spread.d_pad,
+            ipa_d_pad=interpod.d_pad,
+            fdtype=fdtype,
+        )
+        scores = np.asarray(scores)[: pbatch.num_pods]
+        # statically infeasible pods (unknown resource) never fit anywhere
+        return np.where(
+            pod_valid[: pbatch.num_pods, None], scores, np.int32(-1)
+        )

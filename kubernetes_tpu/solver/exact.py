@@ -148,10 +148,11 @@ def _fit_scorer(scoring_strategy, rtc_shape):
     return nr.least_allocated_score
 
 
-def _make_step(
+def _mask_and_score(
     tables,
+    st,
+    x,
     *,
-    tie_break: str,
     scoring_strategy: str,
     w_cpu: int,
     w_mem: int,
@@ -170,63 +171,82 @@ def _make_step(
     ipa_d_pad: int,
     fdtype,
 ):
-    """Builds the per-pod scan step (one full filter+score pipeline over all
-    nodes + assume scatter). Shared by the per-pod scan and the grouped
-    solver's non-uniform fallback branch."""
+    """One pod's full filter+score pipeline over all nodes against node
+    state ``st`` (runtime/framework.go#RunFilterPlugins + #RunScorePlugins,
+    fused). Returns ``score`` [N] int32 with -1 on infeasible lanes (the
+    mask is recoverable as ``score >= 0``). Shared by the sequential scan
+    step (which adds tie-break + assume scatter) and the stateless batch
+    evaluator behind the extender boundary (solver/evaluate.py)."""
     alloc = tables["alloc"]
     alloc2 = alloc[: MEM_IDX + 1]  # cpu, memory rows for scoring
     weights2 = jnp.asarray([w_cpu, w_mem], dtype=alloc.dtype)
     fit_scorer = _fit_scorer(scoring_strategy, rtc_shape)
     spr = tables.get("spr")
     ipa = tables.get("ipa")
+    cls = x["class_of"]
+
+    mask = tables["static_mask"][cls] & tables["node_valid"]
+    if "NodeResourcesFit" not in disabled:
+        mask = mask & nr.fit_mask(
+            x["req"], x["req_mask"], alloc, st["used"],
+            st["pod_count"], tables["max_pods"],
+        )
+    if "NodePorts" not in disabled:
+        mask = mask & ~pl.ports_conflict_mask(
+            x["pod_conflict"], st["port_used"]
+        )
+    if use_spread and "PodTopologySpread" not in disabled:
+        mask = mask & ~sp.hard_violations(spr, st["spr_cnt"], cls, d_pad)
+    if use_interpod:
+        ipa_allowed, ipa_raw = ip.filter_and_score(
+            ipa, st["ipa_in"], st["ipa_ex"], cls, x, ipa_d_pad,
+            tables["node_valid"],
+        )
+        if "InterPodAffinity" not in disabled:
+            mask = mask & ipa_allowed
+
+    requested = nr.scoring_requested(x["nonzero_req"], st["nonzero_used"])
+    score = w_fit * fit_scorer(requested, alloc2, weights2)
+    score = score + w_balanced * nr.balanced_allocation_score(
+        requested, alloc2, fdtype=fdtype
+    )
+    score = score.astype(jnp.int32)
+    if w_taint:
+        score = score + w_taint * pl.normalize_score(
+            tables["taint_cnt"][cls], mask, reverse=True
+        )
+    if w_nodeaff:
+        score = score + w_nodeaff * pl.normalize_score(
+            tables["nodeaff_pref"][cls], mask, reverse=False
+        )
+    if w_image:
+        score = score + w_image * tables["image_score"][cls]
+    if use_spread and w_spread:
+        score = score + w_spread * sp.soft_scores(
+            spr, st["spr_cnt"], cls, mask, d_pad, fdtype=fdtype
+        )
+    if use_interpod and w_interpod:
+        score = score + w_interpod * ip.normalize(ipa_raw, mask)
+    return jnp.where(mask, score, -1)
+
+
+def _make_step(
+    tables,
+    *,
+    tie_break: str,
+    **pipe_kw,
+):
+    """Builds the per-pod scan step (one full filter+score pipeline over all
+    nodes + assume scatter). Shared by the per-pod scan and the grouped
+    solver's non-uniform fallback branch."""
+    alloc = tables["alloc"]
+    use_spread = pipe_kw["use_spread"]
+    use_interpod = pipe_kw["use_interpod"]
 
     def step(carry, x):
         st, k = carry
-        cls = x["class_of"]
-
-        mask = tables["static_mask"][cls] & tables["node_valid"]
-        if "NodeResourcesFit" not in disabled:
-            mask = mask & nr.fit_mask(
-                x["req"], x["req_mask"], alloc, st["used"],
-                st["pod_count"], tables["max_pods"],
-            )
-        if "NodePorts" not in disabled:
-            mask = mask & ~pl.ports_conflict_mask(
-                x["pod_conflict"], st["port_used"]
-            )
-        if use_spread and "PodTopologySpread" not in disabled:
-            mask = mask & ~sp.hard_violations(spr, st["spr_cnt"], cls, d_pad)
-        if use_interpod:
-            ipa_allowed, ipa_raw = ip.filter_and_score(
-                ipa, st["ipa_in"], st["ipa_ex"], cls, x, ipa_d_pad,
-                tables["node_valid"],
-            )
-            if "InterPodAffinity" not in disabled:
-                mask = mask & ipa_allowed
-
-        requested = nr.scoring_requested(x["nonzero_req"], st["nonzero_used"])
-        score = w_fit * fit_scorer(requested, alloc2, weights2)
-        score = score + w_balanced * nr.balanced_allocation_score(
-            requested, alloc2, fdtype=fdtype
-        )
-        score = score.astype(jnp.int32)
-        if w_taint:
-            score = score + w_taint * pl.normalize_score(
-                tables["taint_cnt"][cls], mask, reverse=True
-            )
-        if w_nodeaff:
-            score = score + w_nodeaff * pl.normalize_score(
-                tables["nodeaff_pref"][cls], mask, reverse=False
-            )
-        if w_image:
-            score = score + w_image * tables["image_score"][cls]
-        if use_spread and w_spread:
-            score = score + w_spread * sp.soft_scores(
-                spr, st["spr_cnt"], cls, mask, d_pad, fdtype=fdtype
-            )
-        if use_interpod and w_interpod:
-            score = score + w_interpod * ip.normalize(ipa_raw, mask)
-        score = jnp.where(mask, score, -1)
+        score = _mask_and_score(tables, st, x, **pipe_kw)
+        mask = score >= 0
 
         best = jnp.max(score)
         feasible = best >= 0

@@ -41,20 +41,24 @@ def test_static_kw_matches_make_step_signature():
     import inspect
 
     import __graft_entry__ as ge
-    from kubernetes_tpu.solver.exact import _make_step
+    from kubernetes_tpu.solver.exact import _make_step, _mask_and_score
 
-    sig = inspect.signature(_make_step)
+    # _make_step forwards its **pipe_kw catch-all to _mask_and_score, so the
+    # full required set is the union of both signatures' keyword-only params
+    params: dict = {}
+    for fn in (_make_step, _mask_and_score):
+        params.update(inspect.signature(fn).parameters)
     required = {
         name
-        for name, p in sig.parameters.items()
+        for name, p in params.items()
         if p.kind is inspect.Parameter.KEYWORD_ONLY
         and p.default is inspect.Parameter.empty
     }
     supplied = set(ge._STATIC_KW) | {"fdtype"}
     missing = required - supplied
-    assert not missing, f"_STATIC_KW missing required _make_step kwargs: {missing}"
-    unknown = set(ge._STATIC_KW) - set(sig.parameters)
-    assert not unknown, f"_STATIC_KW has kwargs _make_step no longer takes: {unknown}"
+    assert not missing, f"_STATIC_KW missing required solver kwargs: {missing}"
+    unknown = set(ge._STATIC_KW) - set(params)
+    assert not unknown, f"_STATIC_KW has kwargs the solver no longer takes: {unknown}"
 
 
 def test_dryrun_multichip_8_devices():
