@@ -1,23 +1,28 @@
 """Benchmark entry point (driver-run on real TPU hardware).
 
-Two measurements:
-1. BASELINE.json config #2 — 5k homogeneous pods onto 1k nodes through the
-   full stack (state service -> queue -> snapshot -> exact TPU solve ->
-   bind), the batched equivalent of scheduler_perf's SchedulingBasic-style
-   throughput measurement (test/integration/scheduler_perf, SURVEY.md §4.5).
-2. The NORTH STAR (BASELINE.md): 50k pods x 10k nodes batch-solved via the
-   single-shot auction solver; target < 1 s device time.
+Measures the FULL BASELINE.md target ladder (VERDICT r2 #3):
 
-Prints ONE JSON line:
-  {"metric": ..., "value": pods/s, "unit": "pods/s", "vs_baseline": ...}
-with the north-star numbers as extra fields
-(north_star_*: solve seconds + x-vs-1s-target).
+  #1 scheduler_perf SchedulingBasic shape: 500 pods x 500 nodes, default
+     plugins, via the YAML-runner code path (test/integration/scheduler_perf).
+  #2 NodeResourcesFit + BalancedAllocation: 5k homogeneous pods x 1k nodes
+     through the full stack (state service -> queue -> snapshot -> exact TPU
+     solve -> bind). THE HEADLINE: the grouped fast path engages here.
+  #3 PodTopologySpread across 3 zones: 10k pods x 5k nodes, hard maxSkew=1
+     zone constraint.
+  #4 InterPodAffinity anti-affinity (the O(n^2) hot path): 5k pods x 5k
+     nodes, required hostname anti-affinity.
+  #5 Global rebalance north star: 50k pods x 10k nodes single-shot auction.
 
-vs_baseline compares against the reference default scheduler's ~300 pods/s
-sustained upper bound from BASELINE.md (API-bound 5k-node density tests).
-Steady-state throughput excludes the first batch (XLA compile); total wall
-including compile is reported alongside, as is pure device solve time
-(BASELINE.md measurement protocol: service time vs solve time separated).
+Each ladder reports steady-state (warm-start) pods/s — compiles happen in a
+same-shaped warmup pass (persistent compile cache makes restarts cheap) —
+plus per-workload invariant checks (all placed; skew bound; exclusivity).
+
+Prints ONE JSON line. ``value``/``vs_baseline`` headline ladder #2;
+``vs_baseline`` divides by the TOP of the reference's in-proc band
+(O(1-5k) pods/s on scheduler_perf-style runs, BASELINE.md) — the strictest
+available comparator. The API-bound ~300 pods/s figure is reported
+separately as vs_api_bound. Labels say which solver path each ladder
+exercises; nothing is extrapolated from the easy regime.
 """
 
 from __future__ import annotations
@@ -25,17 +30,160 @@ from __future__ import annotations
 import json
 import time
 
-N_NODES = 1_000
-N_PODS = 5_000
-BATCH = 4_096
-BASELINE_PODS_PER_SEC = 300.0
+BAND_TOP_PODS_PER_SEC = 5_000.0  # top of the in-proc CPU reference band
+API_BOUND_PODS_PER_SEC = 300.0  # sustained API/QPS-bound reference figure
 
 NS_NODES = 10_240
 NS_PODS = 51_200
 NS_TARGET_S = 1.0
 
 
-def north_star() -> dict:
+def _mk_node(i: int, zones: int = 3):
+    from kubernetes_tpu.api.wrappers import MakeNode
+
+    return (
+        MakeNode()
+        .name(f"node-{i:05}")
+        .capacity({"cpu": "16", "memory": "64Gi", "pods": "110"})
+        .label("topology.kubernetes.io/zone", f"z{i % zones}")
+        .label("kubernetes.io/hostname", f"node-{i:05}")
+        .obj()
+    )
+
+
+def _mk_pod(i: int, kind: str):
+    from kubernetes_tpu.api.wrappers import MakePod
+
+    b = (
+        MakePod()
+        .name(f"pod-{i:05}")
+        .label("app", kind)
+        .req({"cpu": "250m", "memory": "512Mi"})
+    )
+    if kind == "spread":
+        b = b.spread_constraint(
+            1, "topology.kubernetes.io/zone", "DoNotSchedule", {"app": kind}
+        )
+    elif kind == "anti":
+        b = b.pod_anti_affinity("kubernetes.io/hostname", {"app": kind})
+    return b.obj()
+
+
+def _run_ladder(
+    n_nodes: int,
+    n_pods: int,
+    kind: str,
+    batch: int,
+    warm_pods: int,
+) -> dict:
+    """Warm-start end-to-end run: a same-shaped throwaway cluster compiles
+    every executable (incl. the device-session heal path), then the timed
+    cluster runs the production path only."""
+    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.solver.exact import ExactSolverConfig
+    from kubernetes_tpu.state.cluster import ClusterState
+
+    def build(n_p):
+        cs = ClusterState()
+        for i in range(n_nodes):
+            cs.create_node(_mk_node(i))
+        sched = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=batch, solver=ExactSolverConfig(tie_break="random")
+            ),
+        )
+        for i in range(n_p):
+            cs.create_pod(_mk_pod(i, kind))
+        return cs, sched
+
+    t0 = time.perf_counter()
+    _, wsched = build(warm_pods)
+    wsched.schedule_batch()
+    wsched.schedule_batch()
+    warmup_s = time.perf_counter() - t0
+
+    cs, sched = build(n_pods)
+    batch_times: list[tuple[float, int]] = []
+    solve_s = 0.0
+    scheduled = 0
+    t0 = time.perf_counter()
+    while True:
+        tb = time.perf_counter()
+        r = sched.schedule_batch()
+        n = len(r.scheduled)
+        if not (r.scheduled or r.unschedulable or r.bind_failures):
+            break
+        batch_times.append((time.perf_counter() - tb, n))
+        solve_s += r.solve_seconds
+        scheduled += n
+    total = time.perf_counter() - t0
+
+    assert scheduled == n_pods, f"{kind}: only {scheduled}/{n_pods} scheduled"
+    _check_invariants(cs, kind)
+    per_pod = sorted(t for t, n in batch_times for _ in range(n))
+    p99 = per_pod[int(0.99 * (len(per_pod) - 1))] if per_pod else 0.0
+    return {
+        "pods": n_pods,
+        "nodes": n_nodes,
+        "pods_per_sec": round(scheduled / total, 1) if total else None,
+        "wall_s": round(total, 3),
+        "device_solve_s": round(solve_s, 3),
+        "p99_batch_latency_s": round(p99, 4),
+        "warmup_s": round(warmup_s, 2),
+    }
+
+
+def _check_invariants(cs, kind: str) -> None:
+    """Workload-specific correctness gates — a number only counts if the
+    bindings are right (BASELINE.md measurement protocol)."""
+    from collections import Counter
+
+    pods = [p for p in cs.list_pods() if p.name.startswith("pod-")]
+    if kind == "spread":
+        zones = Counter()
+        node_zone = {n.name: n.labels["topology.kubernetes.io/zone"] for n in cs.list_nodes()}
+        for p in pods:
+            zones[node_zone[p.node_name]] += 1
+        if zones:
+            assert max(zones.values()) - min(zones.values()) <= 1, (
+                f"zone skew violated: {dict(zones)}"
+            )
+    elif kind == "anti":
+        per_node = Counter(p.node_name for p in pods)
+        worst = max(per_node.values(), default=0)
+        assert worst <= 1, f"hostname anti-affinity violated: {worst} pods on one node"
+
+
+def ladder1_basic() -> dict:
+    """#1 via the scheduler_perf YAML-runner code path (SURVEY §4.5)."""
+    from kubernetes_tpu.perf.runner import PerfRunner
+
+    ops = [
+        {"opcode": "createNodes", "count": 500},
+        {"opcode": "createPods", "count": 500, "collectMetrics": True},
+    ]
+    runner = PerfRunner()
+    # warmup on the same shapes, then the measured run
+    runner.run_workload("SchedulingBasic", "warmup", ops, {})
+    t0 = time.perf_counter()
+    res = runner.run_workload("SchedulingBasic", "500Nodes", ops, {})
+    wall = time.perf_counter() - t0
+    assert res.scheduled == 500, f"#1: {res.scheduled}/500 scheduled"
+    thr = res.throughput_summary()
+    return {
+        "pods": 500,
+        "nodes": 500,
+        "pods_per_sec": round(res.measured_pods / res.measure_seconds, 1)
+        if res.measure_seconds
+        else None,
+        "wall_s": round(wall, 3),
+        "device_solve_s": round(res.solve_seconds, 3),
+        "throughput_summary": thr,
+    }
+
+
+def ladder5_north_star() -> dict:
     """50k x 10k single-shot rebalance: device solve time, steady state."""
     import numpy as np
     import jax.numpy as jnp
@@ -89,52 +237,15 @@ def north_star() -> dict:
     solve_s = time.perf_counter() - t0
     placed = int((np.asarray(out[0]) >= 0).sum())
     return {
-        "north_star_pods": NS_PODS,
-        "north_star_nodes": NS_NODES,
-        "north_star_solve_s": round(solve_s, 4),
-        "north_star_compile_s": round(compile_s, 2),
-        "north_star_placed": placed,
-        "north_star_vs_1s_target": round(NS_TARGET_S / solve_s, 2),
+        "pods": NS_PODS,
+        "nodes": NS_NODES,
+        "solve_s": round(solve_s, 4),
+        "compile_s": round(compile_s, 2),
+        "placed": placed,
+        "pods_per_sec": round(placed / solve_s, 1),
+        "vs_1s_target": round(NS_TARGET_S / solve_s, 2),
+        "solver": "single_shot auction (documented divergence: not sequential parity)",
     }
-
-
-def _warmup(n_nodes: int, n_pods: int, batch: int) -> float:
-    """Compile the exact-scan pipeline on the shapes the timed run will use
-    (VERDICT r1 #2: startup warmup on bucketed shapes). A throwaway
-    cluster of identical shape triggers the same executable; with the
-    persistent compilation cache it deserializes from disk on restarts."""
-    from kubernetes_tpu.api.wrappers import MakeNode, MakePod
-    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
-    from kubernetes_tpu.solver.exact import ExactSolverConfig
-    from kubernetes_tpu.state.cluster import ClusterState
-
-    t0 = time.perf_counter()
-    cs = ClusterState()
-    for i in range(n_nodes):
-        cs.create_node(
-            MakeNode()
-            .name(f"warm-node-{i:05}")
-            .capacity({"cpu": "16", "memory": "64Gi", "pods": "110"})
-            .obj()
-        )
-    sched = Scheduler(
-        cs,
-        SchedulerConfig(
-            batch_size=batch, solver=ExactSolverConfig(tie_break="random")
-        ),
-    )
-    for i in range(min(n_pods, batch + batch // 2)):
-        cs.create_pod(
-            MakePod()
-            .name(f"warm-pod-{i:05}")
-            .req({"cpu": "250m", "memory": "512Mi"})
-            .obj()
-        )
-    # two batches: the second exercises the device-session heal path
-    # (dirty-column scatter) so its executable is also warm before timing
-    sched.schedule_batch()
-    sched.schedule_batch()
-    return time.perf_counter() - t0
 
 
 def main() -> None:
@@ -147,78 +258,51 @@ def main() -> None:
 
     enable_persistent_cache()
 
-    from kubernetes_tpu.api.wrappers import MakeNode, MakePod
-    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
-    from kubernetes_tpu.solver.exact import ExactSolverConfig
-    from kubernetes_tpu.state.cluster import ClusterState
+    ladders = {}
+    ladders["1_basic_500x500"] = {
+        "config": "SchedulingBasic, default plugins, YAML-runner path",
+        "solver_path": "exact scan (grouped fast path)",
+        **ladder1_basic(),
+    }
+    ladders["2_fit_5kx1k"] = {
+        "config": "Fit+BalancedAllocation, homogeneous",
+        "solver_path": "exact scan (grouped fast path)",
+        **_run_ladder(1_000, 5_000, "plain", batch=4_096, warm_pods=6_144),
+    }
+    ladders["3_spread_10kx5k"] = {
+        "config": "PodTopologySpread hard maxSkew=1, 3 zones",
+        "solver_path": "exact per-pod scan (spread disables grouping)",
+        **_run_ladder(5_000, 10_000, "spread", batch=512, warm_pods=768),
+    }
+    ladders["4_interpod_5kx5k"] = {
+        "config": "InterPodAffinity required hostname anti-affinity",
+        "solver_path": "exact per-pod scan (interpod disables grouping)",
+        **_run_ladder(5_000, 5_000, "anti", batch=512, warm_pods=768),
+    }
+    ladders["5_rebalance_50kx10k"] = {
+        "config": "global rebalance, single batched auction solve",
+        **ladder5_north_star(),
+    }
 
-    warmup_s = _warmup(N_NODES, N_PODS, BATCH)
-
-    cs = ClusterState()
-    for i in range(N_NODES):
-        cs.create_node(
-            MakeNode()
-            .name(f"node-{i:05}")
-            .capacity({"cpu": "16", "memory": "64Gi", "pods": "110"})
-            .obj()
-        )
-    sched = Scheduler(
-        cs,
-        SchedulerConfig(batch_size=BATCH, solver=ExactSolverConfig(tie_break="random")),
-    )
-
-    t_create0 = time.perf_counter()
-    for i in range(N_PODS):
-        cs.create_pod(
-            MakePod()
-            .name(f"pod-{i:05}")
-            .req({"cpu": "250m", "memory": "512Mi"})
-            .obj()
-        )
-    create_seconds = time.perf_counter() - t_create0
-
-    batch_times: list[float] = []
-    solve_times: list[float] = []
-    scheduled = 0
-    t0 = time.perf_counter()
-    while True:
-        tb = time.perf_counter()
-        r = sched.schedule_batch()
-        n = len(r.scheduled)
-        if n == 0 and not r.unschedulable and not r.bind_failures:
-            break
-        batch_times.append((time.perf_counter() - tb, n))
-        solve_times.append(r.solve_seconds)
-        scheduled += n
-    total = time.perf_counter() - t0
-
-    assert scheduled == N_PODS, f"only {scheduled}/{N_PODS} scheduled"
-
-    # warm-start throughput over the whole workload: compilation happened in
-    # _warmup (persistent cache + device session), so every timed batch runs
-    # the production path
-    pods_per_sec = scheduled / total if total else float("inf")
-    # per-pod p99 latency: pods in a batch all land when the batch commits
-    per_pod = sorted(t for t, n in batch_times for _ in range(n))
-    p99 = per_pod[int(0.99 * (len(per_pod) - 1))]
-
-    ns = north_star()
+    headline = ladders["2_fit_5kx1k"]["pods_per_sec"]
     print(
         json.dumps(
             {
-                "metric": "pods scheduled/sec, 5k pods x 1k nodes, full default plugin pipeline (warm start, end-to-end)",
-                "value": round(pods_per_sec, 1),
+                "metric": (
+                    "pods scheduled/sec, BASELINE ladder #2 (5k pods x 1k "
+                    "nodes, full default plugin pipeline, warm start, "
+                    "end-to-end); all five ladder rows in `ladders`"
+                ),
+                "value": headline,
                 "unit": "pods/s",
-                "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
-                "total_wall_s": round(total, 3),
-                "first_batch_s": round(batch_times[0][0], 3) if batch_times else None,
-                "device_solve_s": round(sum(solve_times), 3),
-                "p99_batch_latency_s": round(p99, 4),
-                "warmup_s": round(warmup_s, 3),
-                "pod_create_s": round(create_seconds, 3),
-                "pods": N_PODS,
-                "nodes": N_NODES,
-                **ns,
+                "vs_baseline": round(headline / BAND_TOP_PODS_PER_SEC, 2),
+                "baseline_note": (
+                    "vs_baseline divides by the TOP of the reference's "
+                    "in-proc band (5k pods/s); vs_api_bound uses the "
+                    "~300 pods/s sustained API-bound figure"
+                ),
+                "vs_api_bound": round(headline / API_BOUND_PODS_PER_SEC, 2),
+                "ladders": ladders,
             }
         )
     )

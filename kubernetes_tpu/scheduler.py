@@ -105,15 +105,18 @@ class Scheduler:
         self.solver = next(iter(self.solvers.values()))
         self.preemptor = PreemptionEvaluator()
 
-        # initial informer sync (WaitForCacheSync equivalent)
-        for node in cluster.list_nodes():
-            self.cache.add_node(node)
-        for pod in cluster.list_pods():
-            if pod.node_name:
-                self.cache.add_pod(pod)
-            elif pod.scheduler_name in self.solvers:
-                self.queue.add(pod)
-        cluster.subscribe(self._on_event)
+        # initial informer sync (WaitForCacheSync equivalent) — atomic with
+        # the subscription so a concurrent writer can't slip an object
+        # between the list and the watch start
+        with cluster.lock:
+            for node in cluster.list_nodes():
+                self.cache.add_node(node)
+            for pod in cluster.list_pods():
+                if pod.node_name:
+                    self.cache.add_pod(pod)
+                elif pod.scheduler_name in self.solvers:
+                    self.queue.add(pod)
+            cluster.subscribe(self._on_event)
 
     # -- eventhandlers.go#addAllEventHandlers routing --
 
@@ -163,7 +166,15 @@ class Scheduler:
         K bindings. With a single profile (the common case) this is exactly
         one device solve; with multiple, pods route by spec.schedulerName
         (schedule_one.go#frameworkForPod) and sub-batches solve in pop
-        order."""
+        order.
+
+        Holds the cluster RLock for the whole cycle: queue/cache mutate via
+        watch events (fired under that lock), so the serve path's ingest
+        and gRPC threads are serialized against pop -> solve -> bind."""
+        with self.cluster.lock:
+            return self._schedule_batch_locked()
+
+    def _schedule_batch_locked(self) -> BatchResult:
         res = BatchResult()
         t0 = time.perf_counter()
         infos = self.queue.pop_batch(self.config.batch_size)

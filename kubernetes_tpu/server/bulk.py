@@ -102,9 +102,8 @@ class BulkCore:
     """Method implementations as bytes -> bytes functions (testable without
     a socket, like ExtenderCore's dict -> dict handlers)."""
 
-    def __init__(self, cluster: ClusterState, scheduler=None, solver_config=None):
+    def __init__(self, cluster: ClusterState, solver_config=None):
         self.cluster = cluster
-        self.scheduler = scheduler
         self._lock = threading.Lock()
         from ..solver.evaluate import BatchEvaluator
         from ..solver.exact import ExactSolver
@@ -175,6 +174,8 @@ class BulkCore:
                 assignments = self.single_shot.solve(batch, pbatch)
             else:
                 assignments = self.exact.solve(batch, pbatch)
+            committed = 0
+            commit_errors: dict[str, str] = {}
             if commit and names:
                 from ..api.objects import Container
 
@@ -183,7 +184,11 @@ class BulkCore:
                     if a < 0:
                         continue
                     pod_name = key.split("/", 1)[-1]
-                    # one create+bind per placed pod; advisory callers skip
+                    # one create+bind per placed pod; advisory callers skip.
+                    # Failures are reported per pod so the reply can never
+                    # silently diverge from committed state; a bind failure
+                    # rolls the created pod back (no unbound orphans).
+                    created = False
                     try:
                         self.cluster.create_pod(
                             Pod(
@@ -204,11 +209,23 @@ class BulkCore:
                                 ),
                             )
                         )
+                        created = True
                         self.cluster.bind(ns, pod_name, batch.names[int(a)])
-                    except ApiError:
-                        pass
+                        committed += 1
+                    except ApiError as e:
+                        commit_errors[key] = e.reason
+                        if created:
+                            try:
+                                self.cluster.delete_pod(ns, pod_name)
+                            except ApiError:
+                                pass
+        reply_meta: dict = {"nodes": batch.names, "mode": mode}
+        if commit:
+            reply_meta["committed"] = committed
+            if commit_errors:
+                reply_meta["commitErrors"] = commit_errors
         return tensorcodec.encode(
-            {"nodes": batch.names, "mode": mode},
+            reply_meta,
             {"assignments": np.asarray(assignments, dtype=np.int32)},
         )
 
@@ -282,11 +299,10 @@ def serve_bulk(
     cluster: ClusterState,
     port: int,
     host: str = "127.0.0.1",
-    scheduler=None,
     solver_config=None,
 ):
     """Start the bulk gRPC server (non-blocking); returns the grpc server."""
-    core = BulkCore(cluster, scheduler=scheduler, solver_config=solver_config)
+    core = BulkCore(cluster, solver_config=solver_config)
     server, bound = make_grpc_server(core, port=port, host=host)
     server.start()
     return server

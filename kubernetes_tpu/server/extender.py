@@ -123,13 +123,18 @@ class ExtenderCore:
         """[len(pods), len(nodes)] int32 full-pipeline totals, -1 =
         infeasible — one device call for the whole pod group."""
         if self.backend == "device":
+            with self.cluster.lock:  # one consistent snapshot of the view
+                pods_by_node = self._pods_by_node()
+                services = self.cluster.list_services()
+                pvs = self.cluster.list_pvs()
+                pvcs = self.cluster.list_pvcs()
             return self.evaluator.evaluate(
                 list(pods),
                 nodes,
-                self._pods_by_node(),
-                services=self.cluster.list_services(),
-                pvs=self.cluster.list_pvs(),
-                pvcs=self.cluster.list_pvcs(),
+                pods_by_node,
+                services=services,
+                pvs=pvs,
+                pvcs=pvcs,
             )
         oracle = self._oracle(nodes)
         rows = np.full((len(pods), len(nodes)), -1, dtype=np.int32)
@@ -177,7 +182,7 @@ class ExtenderCore:
             try:
                 pod = Pod.from_dict(args["pod"])
                 nodes, by_name, unknown = self._resolve_nodes(args)
-            except KeyError as e:
+            except Exception as e:  # any decode failure stays per-request
                 if verb == "filter":
                     results[ri] = {"error": str(e)}
                 else:
@@ -591,10 +596,7 @@ def run_server(
         from .bulk import serve_bulk
 
         grpc_server = serve_bulk(
-            cluster,
-            port=grpc_port,
-            scheduler=scheduler,
-            solver_config=solver_config,
+            cluster, port=grpc_port, solver_config=solver_config
         )
     app = make_app(core, scheduler=scheduler)
     try:

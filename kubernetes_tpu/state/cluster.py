@@ -21,6 +21,8 @@ thing):
 
 from __future__ import annotations
 
+import functools
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Literal
 
@@ -53,11 +55,17 @@ Watcher = Callable[[Event], None]
 
 
 class ClusterState:
-    """Single-writer in-memory store. All methods are synchronous; the
-    process model is one Python thread (SURVEY §6.2 — the reference's
-    mutex-guarded cache maps to plain single-threaded code here)."""
+    """In-memory store guarded by one RLock (``self.lock``), the analog of
+    the reference's mutex-guarded cache (SURVEY §6.2). The serve path
+    mutates it from three threads (aiohttp event loop ingest, the scheduler
+    drain executor, gRPC workers); every public method takes the lock, and
+    watch callbacks fire under it so subscriber state (queue/cache) updates
+    are serialized with the writes that caused them. The Scheduler holds
+    the same lock across a whole schedule_batch, which makes its
+    pop -> solve -> bind cycle atomic with respect to ingest."""
 
     def __init__(self) -> None:
+        self.lock = threading.RLock()
         self._rv = 0
         self._pods: dict[str, Pod] = {}  # key = ns/name
         self._nodes: dict[str, Node] = {}
@@ -253,3 +261,22 @@ class ClusterState:
     def create_pods(self, pods: Iterable[Pod]) -> None:
         for p in pods:
             self.create_pod(p)
+
+
+def _locked(fn):
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self.lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
+# Guard every public method with the instance RLock (reentrant: e.g. the
+# scheduler's preemption path calls delete_pod while holding the lock
+# across schedule_batch).
+for _name, _fn in list(vars(ClusterState).items()):
+    if _name.startswith("_") or not callable(_fn):
+        continue
+    setattr(ClusterState, _name, _locked(_fn))
+del _name, _fn
