@@ -231,10 +231,14 @@ def ladder5_north_star() -> dict:
     out = _single_shot_jit(*fresh(), **kw)
     out[0].block_until_ready()
     compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = _single_shot_jit(*fresh(), **kw)
-    out[0].block_until_ready()
-    solve_s = time.perf_counter() - t0
+    # best of 3: the axon tunnel's throughput varies run to run (measured
+    # 3x swings on identical executables); min is the honest device time
+    solve_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = _single_shot_jit(*fresh(), **kw)
+        out[0].block_until_ready()
+        solve_s = min(solve_s, time.perf_counter() - t0)
     placed = int((np.asarray(out[0]) >= 0).sum())
     return {
         "pods": NS_PODS,

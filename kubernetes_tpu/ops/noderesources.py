@@ -26,6 +26,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .fastmath import floor_div_exact
+
 MAX_NODE_SCORE = 100
 
 
@@ -57,27 +59,40 @@ def least_allocated_score(
     requested: jax.Array,  # [R, N] int — per scoring resource
     alloc: jax.Array,  # [R, N] int
     weights: jax.Array,  # [R] int
+    div=floor_div_exact,
 ) -> jax.Array:  # [N] int — 0..100
-    """(alloc - requested) * 100 // alloc per resource, weighted int mean."""
+    """(alloc - requested) * 100 // alloc per resource, weighted int mean.
+
+    ``div``: exact int64 floor division. The float-estimate trick wins on
+    per-step [R, N] shapes but LOSES on the grouped solver's bulk
+    [R, G*N] tables (measured 3x) — bulk callers pass jnp.floor_divide;
+    both are exact on these non-negative operands."""
     ok = (alloc > 0) & (requested <= alloc)
     per_res = jnp.where(
         ok,
-        (alloc - requested) * MAX_NODE_SCORE // jnp.maximum(alloc, 1),
+        div((alloc - requested) * MAX_NODE_SCORE, jnp.maximum(alloc, 1)),
         0,
     )
     wsum = jnp.sum(weights)
-    return jnp.sum(per_res * weights[:, None], axis=0) // jnp.maximum(wsum, 1)
+    return div(
+        jnp.sum(per_res * weights[:, None], axis=0), jnp.maximum(wsum, 1)
+    )
 
 
 def most_allocated_score(
-    requested: jax.Array, alloc: jax.Array, weights: jax.Array
+    requested: jax.Array, alloc: jax.Array, weights: jax.Array,
+    div=floor_div_exact,
 ) -> jax.Array:
     ok = (alloc > 0) & (requested <= alloc)
     per_res = jnp.where(
-        ok, requested * MAX_NODE_SCORE // jnp.maximum(alloc, 1), 0
+        ok,
+        div(requested * MAX_NODE_SCORE, jnp.maximum(alloc, 1)),
+        0,
     )
     wsum = jnp.sum(weights)
-    return jnp.sum(per_res * weights[:, None], axis=0) // jnp.maximum(wsum, 1)
+    return div(
+        jnp.sum(per_res * weights[:, None], axis=0), jnp.maximum(wsum, 1)
+    )
 
 
 def rtc_score(
@@ -86,20 +101,21 @@ def rtc_score(
     weights: jax.Array,  # [R] int
     shape_x: jax.Array,  # [S] int — utilization breakpoints, ascending 0..100
     shape_y: jax.Array,  # [S] int — scores 0..10 at the breakpoints
+    div=floor_div_exact,
 ) -> jax.Array:
     """RequestedToCapacityRatio: piecewise-linear over integer utilization,
     scaled by MaxNodeScore/10 (shape scores are 0..10 like extender
     priorities)."""
     util = jnp.where(
         alloc > 0,
-        jnp.minimum(requested * 100 // jnp.maximum(alloc, 1), 100),
+        jnp.minimum(div(requested * 100, jnp.maximum(alloc, 1)), 100),
         0,
     )  # [R, N]
 
     def trunc_div(a, b):
         # Go int64 division truncates toward zero; jnp // floors. Decreasing
         # shape segments make the numerator negative, where they differ.
-        q = jnp.abs(a) // jnp.maximum(jnp.abs(b), 1)
+        q = div(jnp.abs(a), jnp.maximum(jnp.abs(b), 1))
         return jnp.where((a >= 0) == (b >= 0), q, -q)
 
     def interp(u):  # u: [R, N] int
@@ -114,7 +130,9 @@ def rtc_score(
 
     per_res = jnp.where(alloc > 0, interp(util) * (MAX_NODE_SCORE // 10), 0)
     wsum = jnp.sum(weights)
-    return jnp.sum(per_res * weights[:, None], axis=0) // jnp.maximum(wsum, 1)
+    return div(
+        jnp.sum(per_res * weights[:, None], axis=0), jnp.maximum(wsum, 1)
+    )
 
 
 def balanced_allocation_score(

@@ -50,6 +50,9 @@ _PIPE_STATICS = (
     "d_pad",
     "ipa_d_pad",
     "fdtype",
+    "spread_soft",
+    "ipa_ident",
+    "ipa_score",
 )
 
 
@@ -256,6 +259,9 @@ class BatchEvaluator:
             d_pad=spread.d_pad,
             ipa_d_pad=interpod.d_pad,
             fdtype=fdtype,
+            spread_soft=spread.has_soft,
+            ipa_ident=interpod.ident,
+            ipa_score=interpod.has_score,
         )
         scores = np.asarray(scores)[: pbatch.num_pods]
         # statically infeasible pods (unknown resource) never fit anywhere

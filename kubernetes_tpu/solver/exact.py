@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import fastmath
 from ..ops import interpod as ip
 from ..ops import noderesources as nr
 from ..ops import plugins as pl
@@ -134,18 +135,27 @@ def grouped_eligible(
     )
 
 
-def _fit_scorer(scoring_strategy, rtc_shape):
+def _fit_scorer(scoring_strategy, rtc_shape, bulk: bool = False):
     """Scoring-strategy dispatch shared by the per-pod pipeline and the
-    grouped fast path (resource_allocation.go scorer selection)."""
+    grouped fast path (resource_allocation.go scorer selection).
+
+    ``bulk``: the grouped solver evaluates [R, G*N] tables, where plain
+    int64 `//` beats the float-estimate division used on per-step shapes
+    (both exact; see ops/fastmath.py)."""
+    div = jnp.floor_divide if bulk else fastmath.floor_div_exact
     if scoring_strategy == "RequestedToCapacityRatio" and rtc_shape:
         sx = jnp.asarray([int(p[0]) for p in rtc_shape], dtype=jnp.int64)
         sy = jnp.asarray([int(p[1]) for p in rtc_shape], dtype=jnp.int64)
         return lambda requested, alloc, w: nr.rtc_score(
-            requested, alloc, w, sx, sy
+            requested, alloc, w, sx, sy, div=div
         )
     if scoring_strategy == "MostAllocated":
-        return nr.most_allocated_score
-    return nr.least_allocated_score
+        return lambda requested, alloc, w: nr.most_allocated_score(
+            requested, alloc, w, div=div
+        )
+    return lambda requested, alloc, w: nr.least_allocated_score(
+        requested, alloc, w, div=div
+    )
 
 
 def _mask_and_score(
@@ -170,13 +180,22 @@ def _mask_and_score(
     d_pad: int,
     ipa_d_pad: int,
     fdtype,
+    spread_soft: bool = True,
+    ipa_ident: bool = False,
+    ipa_score: bool = True,
 ):
     """One pod's full filter+score pipeline over all nodes against node
     state ``st`` (runtime/framework.go#RunFilterPlugins + #RunScorePlugins,
     fused). Returns ``score`` [N] int32 with -1 on infeasible lanes (the
     mask is recoverable as ``score >= 0``). Shared by the sequential scan
     step (which adds tie-break + assume scatter) and the stateless batch
-    evaluator behind the extender boundary (solver/evaluate.py)."""
+    evaluator behind the extender boundary (solver/evaluate.py).
+
+    ``spread_soft``/``ipa_ident``/``ipa_score`` are batch-static facts the
+    tensorizers proved (no soft constraints; unique-domain topologies; no
+    preferred terms): each one statically removes work from the compiled
+    step — the measured difference is large (SURVEY §8.8: the per-pod scan
+    budget is per-step microseconds, not milliseconds)."""
     alloc = tables["alloc"]
     alloc2 = alloc[: MEM_IDX + 1]  # cpu, memory rows for scoring
     weights2 = jnp.asarray([w_cpu, w_mem], dtype=alloc.dtype)
@@ -201,6 +220,7 @@ def _mask_and_score(
         ipa_allowed, ipa_raw = ip.filter_and_score(
             ipa, st["ipa_in"], st["ipa_ex"], cls, x, ipa_d_pad,
             tables["node_valid"],
+            ident=ipa_ident, score=ipa_score and w_interpod > 0,
         )
         if "InterPodAffinity" not in disabled:
             mask = mask & ipa_allowed
@@ -221,11 +241,11 @@ def _mask_and_score(
         )
     if w_image:
         score = score + w_image * tables["image_score"][cls]
-    if use_spread and w_spread:
+    if use_spread and w_spread and spread_soft:
         score = score + w_spread * sp.soft_scores(
             spr, st["spr_cnt"], cls, mask, d_pad, fdtype=fdtype
         )
-    if use_interpod and w_interpod:
+    if use_interpod and w_interpod and ipa_score:
         score = score + w_interpod * ip.normalize(ipa_raw, mask)
     return jnp.where(mask, score, -1)
 
@@ -351,7 +371,7 @@ def _solve_grouped(
     alloc = tables["alloc"]
     alloc2 = alloc[: MEM_IDX + 1]
     weights2 = jnp.asarray([w_cpu, w_mem], dtype=alloc.dtype)
-    fit_scorer = _fit_scorer(scoring_strategy, rtc_shape)
+    fit_scorer = _fit_scorer(scoring_strategy, rtc_shape, bulk=True)
     n = alloc.shape[1]
     step = _make_step(tables, **kw)
 
@@ -626,6 +646,9 @@ _run_packed_jit = jax.jit(
         "d_pad",
         "ipa_d_pad",
         "fdtype",
+        "spread_soft",
+        "ipa_ident",
+        "ipa_score",
     ),
     donate_argnums=(2,),
 )
@@ -957,6 +980,9 @@ class ExactSolver:
             d_pad=spread.d_pad,
             ipa_d_pad=interpod.d_pad,
             fdtype=fdtype,
+            spread_soft=spread.has_soft,
+            ipa_ident=interpod.ident,
+            ipa_score=interpod.has_score,
         )
         group = cfg.group_size
         grouped = grouped_eligible(

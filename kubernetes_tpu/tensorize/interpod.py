@@ -73,6 +73,32 @@ class InterpodTensors:
     def empty(self) -> bool:
         return self.num_in == 0 and self.num_ex == 0
 
+    @property
+    def ident(self) -> bool:
+        """True when every term row maps each valid node to a UNIQUE domain
+        (hostname topologies with per-node hostname labels) — verified
+        numerically, enabling domain_counts' no-aggregation fast path.
+        Rows are deduped by content first: terms sharing a topology key
+        share byte-identical rows (dom_cache), so each distinct row is
+        checked once."""
+        seen: set[bytes] = set()
+        for dom in (self.in_dom, self.ex_dom):
+            for row in dom:
+                key = row.tobytes()
+                if key in seen:
+                    continue
+                seen.add(key)
+                v = row[row >= 0]
+                if v.size and np.unique(v).size != v.size:
+                    return False
+        return True
+
+    @property
+    def has_score(self) -> bool:
+        """False when no preferred terms / symmetry weights exist anywhere
+        in the batch: the scoring section is statically all-zero."""
+        return bool((self.in_pref_w != 0).any() or (self.m_w != 0).any())
+
 
 def trivial_interpod_tensors(
     pbatch: PodBatch, padded_n: int, c_pad: int

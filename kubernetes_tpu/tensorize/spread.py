@@ -54,6 +54,12 @@ class SpreadTensors:
     def empty(self) -> bool:
         return self.num_instances == 0
 
+    @property
+    def has_soft(self) -> bool:
+        """False when no class has a soft constraint: soft_scores is
+        statically zero and the scan can skip it."""
+        return bool((self.soft >= 0).any())
+
 
 def trivial_spread_tensors(pbatch: PodBatch, padded_n: int, c_pad: int) -> SpreadTensors:
     z = np.zeros((INST_PAD, padded_n), dtype=np.int32)

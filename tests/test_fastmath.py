@@ -1,0 +1,52 @@
+"""ops/fastmath.floor_div_exact must be bit-identical to `//` on its
+documented contract: non-negative numerators, positive denominators,
+quotients below 2^23 (every kernel call site has q <= ~10^4 — scores
+scaled by 100)."""
+
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from kubernetes_tpu.ops.fastmath import floor_div_exact
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    q=st.integers(min_value=0, max_value=(1 << 23) - 1),
+    den=st.integers(min_value=1, max_value=(1 << 36)),
+    data=st.data(),
+)
+def test_scalar_matches_floordiv(q, den, data):
+    r = data.draw(st.integers(min_value=0, max_value=den - 1))
+    num = q * den + r  # true quotient is exactly q
+    got = int(
+        floor_div_exact(jnp.asarray(num, jnp.int64), jnp.asarray(den, jnp.int64))
+    )
+    assert got == q
+
+
+def test_vector_matches_floordiv():
+    rng = np.random.default_rng(0)
+    # score-shaped ranges (the hot path): quotients <= 100, int64 operands
+    alloc = rng.integers(1, 64 << 30, size=4096).astype(np.int64)
+    req = (alloc * rng.random(4096)).astype(np.int64)
+    got = np.asarray(
+        floor_div_exact(jnp.asarray((alloc - req) * 100), jnp.asarray(alloc))
+    )
+    np.testing.assert_array_equal(got, (alloc - req) * 100 // alloc)
+    # larger quotients near the contract edge
+    den = rng.integers(1, 1 << 20, size=4096).astype(np.int64)
+    q = rng.integers(0, 1 << 23, size=4096).astype(np.int64)
+    num = q * den + rng.integers(0, 1 << 19, size=4096).astype(np.int64) % den
+    got = np.asarray(floor_div_exact(jnp.asarray(num), jnp.asarray(den)))
+    np.testing.assert_array_equal(got, num // den)
+
+
+def test_int32_matches_floordiv():
+    rng = np.random.default_rng(1)
+    den = rng.integers(1, 1 << 8, size=4096).astype(np.int32)
+    q = rng.integers(0, 1 << 22, size=4096).astype(np.int32)
+    num = q * den + rng.integers(0, 1 << 7, size=4096).astype(np.int32) % den
+    got = np.asarray(floor_div_exact(jnp.asarray(num), jnp.asarray(den)))
+    np.testing.assert_array_equal(got, num // den)
