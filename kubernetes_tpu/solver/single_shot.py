@@ -288,22 +288,52 @@ class SingleShotSolver:
         nodes: NodeBatch,
         pods: PodBatch,
         static: StaticPluginTensors | None = None,
+        mesh=None,
     ) -> np.ndarray:
+        """``mesh``: an optional jax.sharding.Mesh with a "nodes" axis — the
+        v5e-8 path (SURVEY §6.7): every node-resident array shards over its
+        trailing node axis, pod/class arrays replicate, and GSPMD inserts
+        the cross-shard collectives (top-k, segment admission) the auction
+        rounds need. Same numerics as the single-chip path — integer score
+        arithmetic and stable sorts make the result device-count-invariant
+        (tests/test_sharding.py asserts bit-equality on an 8-way mesh)."""
         if static is None:
             static = trivial_static_tensors(pods, nodes.padded, nodes.schedulable)
         rc_req, rc_static, rc_of = request_classes(pods, static)
+        args = [
+            nodes.allocatable,
+            nodes.used,
+            nodes.pod_count,
+            nodes.max_pods,
+            nodes.valid,
+            static.mask,
+            rc_req,
+            rc_static,
+            rc_of,
+            pods.priority,
+            pods.valid & pods.feasible_static,
+        ]
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            node_sharded = {0, 1, 5}  # trailing-node 2D arrays
+            node_1d = {2, 3, 4}
+            shardings = []
+            for i in range(len(args)):
+                if i in node_sharded:
+                    shardings.append(NamedSharding(mesh, P(None, "nodes")))
+                elif i in node_1d:
+                    shardings.append(NamedSharding(mesh, P("nodes")))
+                else:
+                    shardings.append(NamedSharding(mesh, P()))
+            args = [
+                jax.device_put(jnp.asarray(a), s)
+                for a, s in zip(args, shardings)
+            ]
+        else:
+            args = [jnp.asarray(a) for a in args]
         assigned, used, pod_count, _ = _single_shot_jit(
-            jnp.asarray(nodes.allocatable),
-            jnp.asarray(nodes.used),
-            jnp.asarray(nodes.pod_count),
-            jnp.asarray(nodes.max_pods),
-            jnp.asarray(nodes.valid),
-            jnp.asarray(static.mask),
-            jnp.asarray(rc_req),
-            jnp.asarray(rc_static),
-            jnp.asarray(rc_of),
-            jnp.asarray(pods.priority),
-            jnp.asarray(pods.valid & pods.feasible_static),
+            *args,
             max_rounds=self.config.max_rounds,
             price_step=self.config.price_step,
             top_t=self.config.top_t,

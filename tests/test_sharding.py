@@ -1,0 +1,125 @@
+"""Multi-chip sharding correctness on the 8-device virtual CPU mesh
+(SURVEY §6.7; conftest.py provisions the devices).
+
+The node axis is this framework's "sequence/context" dimension: node tables
+and carried state shard over it, per-pod inputs replicate, and XLA/GSPMD
+inserts the collectives (argmax, cumsum, segment reductions become
+cross-shard). These tests prove sharded == unsharded BIT-EQUALITY for both
+solvers — the property the driver's dryrun_multichip compile-checks but
+cannot assert against a single-chip reference."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from __graft_entry__ import _STATIC_KW, _example_args
+from kubernetes_tpu.solver.exact import _solve_scan
+from kubernetes_tpu.solver.single_shot import SingleShotConfig, SingleShotSolver
+from kubernetes_tpu.tensorize.schema import build_node_batch, build_pod_batch
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+
+N_DEVICES = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < N_DEVICES:
+        pytest.skip(f"needs {N_DEVICES} virtual devices")
+    return Mesh(np.array(jax.devices()[:N_DEVICES]), axis_names=("nodes",))
+
+
+def _shardings(mesh, tables, state0, xs):
+    shard_2d = NamedSharding(mesh, P(None, "nodes"))
+    shard_1d = NamedSharding(mesh, P("nodes"))
+    repl = NamedSharding(mesh, P())
+
+    def node_sharding(a):
+        if a.ndim == 2:
+            return shard_2d
+        return shard_1d
+
+    tables_sh = jtu.tree_map(node_sharding, tables)
+    # per-instance/per-class scalar tables are replicated (no node axis)
+    for grp, names in (
+        ("spr", ("max_skew", "min_domains", "self_match", "is_hostname", "hard", "soft")),
+        ("ipa", ("in_pref_w", "cls_req_aff", "cls_req_anti", "cls_pref", "ex_anti")),
+    ):
+        for name in names:
+            tables_sh[grp][name] = repl
+    state_sh = jtu.tree_map(node_sharding, state0)
+    xs_sh = jtu.tree_map(lambda a: repl, xs)
+    return tables_sh, state_sh, xs_sh, repl
+
+
+def test_exact_scan_sharded_equals_unsharded(mesh):
+    """The full exact-parity scan (spread + interpod active) over a 1024-node
+    axis sharded 8 ways must produce the identical assignment sequence and
+    final node state."""
+    tables, state0, xs = _example_args(n_nodes=1024, n_pods=64)
+    fn = functools.partial(_solve_scan, **_STATIC_KW, fdtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    ref_asg, ref_state = jax.jit(fn)(tables, state0, xs, key)
+    ref_asg = np.asarray(ref_asg)
+
+    tables_sh, state_sh, xs_sh, repl = _shardings(mesh, tables, state0, xs)
+    out = jax.jit(fn, in_shardings=(tables_sh, state_sh, xs_sh, repl))(
+        jtu.tree_map(jax.device_put, tables, tables_sh),
+        jtu.tree_map(jax.device_put, state0, state_sh),
+        jtu.tree_map(jax.device_put, xs, xs_sh),
+        jax.device_put(key, repl),
+    )
+    np.testing.assert_array_equal(np.asarray(out[0]), ref_asg)
+    for k in ref_state:
+        np.testing.assert_array_equal(
+            np.asarray(out[1][k]), np.asarray(ref_state[k]), err_msg=k
+        )
+    assert int((ref_asg >= 0).sum()) == 64  # everything placed
+
+
+def _single_shot_workload(n_nodes=1024, n_pods=768):
+    rng = np.random.default_rng(42)
+    nodes = [
+        MakeNode()
+        .name(f"n-{i:04}")
+        .capacity({"cpu": "8", "memory": "32Gi", "pods": "40"})
+        .obj()
+        for i in range(n_nodes)
+    ]
+    pods = []
+    for i in range(n_pods):
+        cpu = int(rng.integers(1, 8)) * 250
+        mem = int(rng.integers(1, 5)) << 29
+        pods.append(
+            MakePod()
+            .name(f"p-{i:04}")
+            .req({"cpu": f"{cpu}m", "memory": mem})
+            .priority(int(rng.integers(0, 5)))
+            .obj()
+        )
+    batch = build_node_batch(nodes)
+    pbatch = build_pod_batch(pods, batch.vocab)
+    return batch, pbatch
+
+
+def test_single_shot_sharded_equals_unsharded(mesh):
+    """The auction solver — the 50k x 10k rebalance engine, i.e. the actual
+    v5e-8 workload — sharded over the node axis must commit the identical
+    assignment vector and node state."""
+    batch_ref, pbatch = _single_shot_workload()
+    batch_sh, _ = _single_shot_workload()
+
+    solver = SingleShotSolver(SingleShotConfig())
+    ref = solver.solve(batch_ref, pbatch)
+    sharded = solver.solve(batch_sh, pbatch, mesh=mesh)
+
+    np.testing.assert_array_equal(sharded, ref)
+    np.testing.assert_array_equal(batch_sh.used, batch_ref.used)
+    np.testing.assert_array_equal(batch_sh.pod_count, batch_ref.pod_count)
+    placed = int((ref >= 0).sum())
+    assert placed == pbatch.num_pods  # capacity is ample: all place
