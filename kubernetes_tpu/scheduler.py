@@ -22,6 +22,8 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from . import metrics
 from .api.objects import Pod
 from .solver.exact import ExactSolver, ExactSolverConfig
@@ -105,6 +107,11 @@ class Scheduler:
         self.solver = next(iter(self.solvers.values()))
         self.preemptor = PreemptionEvaluator()
 
+        # nominated-pod index (the reference's nominator map): unbound pods
+        # carrying status.nominatedNodeName, maintained from watch events so
+        # the per-batch lookup is O(nominated), not O(all pods)
+        self.nominated_pods: dict[str, Pod] = {}
+
         # initial informer sync (WaitForCacheSync equivalent) — atomic with
         # the subscription so a concurrent writer can't slip an object
         # between the list and the watch start
@@ -114,8 +121,11 @@ class Scheduler:
             for pod in cluster.list_pods():
                 if pod.node_name:
                     self.cache.add_pod(pod)
-                elif pod.scheduler_name in self.solvers:
-                    self.queue.add(pod)
+                else:
+                    if pod.nominated_node_name:
+                        self.nominated_pods[pod.key] = pod
+                    if pod.scheduler_name in self.solvers:
+                        self.queue.add(pod)
             cluster.subscribe(self._on_event)
 
     # -- eventhandlers.go#addAllEventHandlers routing --
@@ -123,6 +133,12 @@ class Scheduler:
     def _on_event(self, ev: Event) -> None:
         if ev.kind == "Pod":
             pod = ev.obj
+            # nominator-map maintenance: an unbound pod with a nomination is
+            # indexed; binding or clearing the nomination drops it
+            if ev.type != "DELETED" and not pod.node_name and pod.nominated_node_name:
+                self.nominated_pods[pod.key] = pod
+            else:
+                self.nominated_pods.pop(pod.key, None)
             if ev.type == "ADDED":
                 if pod.node_name:
                     self.cache.add_pod(pod)
@@ -265,9 +281,20 @@ class Scheduler:
         # the tight pow2 bucket.
         from .solver.exact import grouped_eligible
 
+        # nominated pods force the per-pod scan (grouped_eligible), so
+        # detect them before committing to the fixed pod-axis bucket
+        nom_pairs = []
+        for q in self.nominated_pods.values():
+            try:
+                nom_pairs.append(
+                    (q, self.snapshot.slot_of(q.nominated_node_name))
+                )
+            except KeyError:
+                continue  # nominated node no longer in the snapshot
+
         grouped_ok = grouped_eligible(
             solver.config, self.config.batch_size, batch.padded,
-            need_spread, need_interpod,
+            need_spread, need_interpod, bool(nom_pairs),
         )
         pod_pad = (
             self.config.batch_size
@@ -339,12 +366,33 @@ class Scheduler:
                 hard_pod_affinity_weight=solver.config.hard_pod_affinity_weight,
             )
 
+        # nominated-pod load (RunFilterPluginsWithNominatedPods analog):
+        # unbound pods carrying a nomination count as placed on their
+        # nominated node for higher/equal-priority peers; pods in THIS
+        # batch that are themselves nominated get a per-pod slot for the
+        # evaluateNominatedNode-first pick and self-exclusion
+        from .tensorize.schema import build_nominated_tensors
+
+        nominated = build_nominated_tensors(
+            nom_pairs, batch.vocab, batch.padded
+        )
+        nominated_slot = None
+        if not nominated.empty:
+            # batch pods carrying a nomination are in nom_pairs (same
+            # objects, same slot resolution) — reuse, don't re-resolve
+            slot_by_key = {p.key: slot for p, slot in nom_pairs}
+            nominated_slot = np.full(len(pods), -1, dtype=np.int32)
+            for i, p in enumerate(pods):
+                nominated_slot[i] = slot_by_key.get(p.key, -1)
+
         t1 = time.perf_counter()
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
         assignments = solver.solve(
             batch, pbatch, static, ports, spread, interpod,
             col_versions=self.snapshot.col_versions,
+            nominated=nominated if not nominated.empty else None,
+            nominated_slot=nominated_slot,
         )
         res.solve_seconds += time.perf_counter() - t1
         metrics.tensorize_seconds.observe(max(t1 - gs, 0.0))

@@ -120,16 +120,19 @@ def grouped_eligible(
     node_pad: int,
     use_spread: bool,
     use_interpod: bool,
+    use_nominated: bool = False,
 ) -> bool:
     """Single source of truth for the grouped fast path's dispatch
     condition — the scheduler consults it when choosing the pod-axis
     padding bucket, and ExactSolver.solve when picking the executable, so
-    the two can never drift into padding-without-grouping."""
+    the two can never drift into padding-without-grouping. Nominated-pod
+    load (rare, preemption aftermath) routes through the per-pod scan."""
     return (
         cfg.group_size > 1
         and not cfg.disabled_filters
         and not use_spread
         and not use_interpod
+        and not use_nominated
         and pod_pad % cfg.group_size == 0
         and node_pad >= cfg.group_size  # order[:group] gather needs N >= G
     )
@@ -183,6 +186,7 @@ def _mask_and_score(
     spread_soft: bool = True,
     ipa_ident: bool = False,
     ipa_score: bool = True,
+    use_nominated: bool = False,
 ):
     """One pod's full filter+score pipeline over all nodes against node
     state ``st`` (runtime/framework.go#RunFilterPlugins + #RunScorePlugins,
@@ -205,10 +209,30 @@ def _mask_and_score(
     cls = x["class_of"]
 
     mask = tables["static_mask"][cls] & tables["node_valid"]
+    used = st["used"]
+    pod_count = st["pod_count"]
+    if use_nominated:
+        # addNominatedPods: nominated pods with priority >= this pod's
+        # count as placed for the monotone filters; the pod's own
+        # nomination (always inside its own level row) is subtracted out
+        lvl = x["nom_level"]
+        s = x["nominated_slot"]
+        is_nom = s >= 0
+        ss = jnp.maximum(s, 0)
+        # nom_corr_* carries the load of nominated pods already PLACED by
+        # earlier scan steps (the nominator-map removal on assume) so their
+        # requests aren't counted twice — once as real used, once as
+        # nominated load
+        extra_u = tables["nom_used"][lvl] - st["nom_corr_used"][lvl]
+        extra_c = tables["nom_cnt"][lvl] - st["nom_corr_cnt"][lvl]
+        extra_u = extra_u.at[:, ss].add(-x["req"] * is_nom.astype(extra_u.dtype))
+        extra_c = extra_c.at[ss].add(-is_nom.astype(extra_c.dtype))
+        used = used + extra_u
+        pod_count = pod_count + extra_c
     if "NodeResourcesFit" not in disabled:
         mask = mask & nr.fit_mask(
-            x["req"], x["req_mask"], alloc, st["used"],
-            st["pod_count"], tables["max_pods"],
+            x["req"], x["req_mask"], alloc, used,
+            pod_count, tables["max_pods"],
         )
     if "NodePorts" not in disabled:
         mask = mask & ~pl.ports_conflict_mask(
@@ -279,11 +303,18 @@ def _make_step(
         else:
             pick_rank = 0
         pick = jnp.argmax(csum > pick_rank).astype(jnp.int32)
+        if pipe_kw.get("use_nominated"):
+            # schedule_one.go#evaluateNominatedNode: a pod carrying a
+            # nomination takes that node if it is feasible, before any
+            # scoring of alternatives
+            s = x["nominated_slot"]
+            nom_ok = (s >= 0) & mask[jnp.maximum(s, 0)]
+            pick = jnp.where(nom_ok, jnp.maximum(s, 0).astype(jnp.int32), pick)
 
         found = feasible & x["pod_valid"]
         d = found.astype(alloc.dtype)
         di = found.astype(jnp.int32)
-        st = dict(
+        new_st = dict(
             used=st["used"].at[:, pick].add(x["req"] * d),
             nonzero_used=st["nonzero_used"].at[:, pick].add(x["nonzero_req"] * d),
             pod_count=st["pod_count"].at[pick].add(di),
@@ -304,6 +335,24 @@ def _make_step(
                 else st["ipa_ex"]
             ),
         )
+        if pipe_kw.get("use_nominated"):
+            # a placed nominated pod leaves the nominator map: accumulate
+            # its load (at its NOMINATED slot, where nom_used counted it)
+            # into the correction rows its priority contributed to
+            s_nom = x["nominated_slot"]
+            placed_nom = found & (s_nom >= 0)
+            ssn = jnp.maximum(s_nom, 0)
+            rows = st["nom_corr_cnt"].shape[0]
+            lev_mask = (
+                jnp.arange(rows, dtype=jnp.int32) >= x["nom_level"]
+            ) & placed_nom
+            new_st["nom_corr_used"] = st["nom_corr_used"].at[:, :, ssn].add(
+                lev_mask[:, None].astype(alloc.dtype) * x["req"][None, :]
+            )
+            new_st["nom_corr_cnt"] = st["nom_corr_cnt"].at[:, ssn].add(
+                lev_mask.astype(jnp.int32)
+            )
+        st = new_st
         assignment = jnp.where(found, pick, -1).astype(jnp.int32)
         return (st, k), assignment
 
@@ -593,6 +642,7 @@ def _run_packed(
     xi32,  # [P, *] int32
     xbool,  # [P, *] bool
     uniform,  # [P // group] bool (grouped) or [1] dummy
+    nom_used,  # [L+1, K, N] int64 cumulative nominated load ([1,1,1] unused)
     key,
     *,
     bspec,  # tuple of (name, start, width)
@@ -605,6 +655,14 @@ def _run_packed(
     state0 = dict(persist)
     for name, s, w in bspec:
         state0[name] = bstate[s : s + w]
+    if kw.get("use_nominated"):
+        tables["nom_used"] = nom_used
+        tables["nom_cnt"] = state0.pop("nom_cnt")
+        # placed-nominated correction carry (starts empty each batch)
+        state0["nom_corr_used"] = jnp.zeros_like(nom_used)
+        state0["nom_corr_cnt"] = jnp.zeros(
+            (nom_used.shape[0], nom_used.shape[2]), dtype=jnp.int32
+        )
     srcs = {"i64": xi64, "i32": xi32, "bool": xbool}
     xs = {}
     for name, src, s, w, squeeze in xspec:
@@ -649,6 +707,7 @@ _run_packed_jit = jax.jit(
         "spread_soft",
         "ipa_ident",
         "ipa_score",
+        "use_nominated",
     ),
     donate_argnums=(2,),
 )
@@ -830,6 +889,8 @@ class ExactSolver:
         spread: SpreadTensors | None = None,
         interpod: InterpodTensors | None = None,
         col_versions: np.ndarray | None = None,
+        nominated=None,  # NominatedTensors | None
+        nominated_slot: np.ndarray | None = None,  # [num_pods] int32, -1 none
     ) -> np.ndarray:
         """Returns assignments [num_pods] of node indices (-1 = unschedulable).
 
@@ -860,6 +921,7 @@ class ExactSolver:
             interpod = trivial_interpod_tensors(pods, nodes.padded, static.c_pad)
         use_spread = not spread.empty
         use_interpod = not interpod.empty
+        use_nominated = nominated is not None and not nominated.empty
         session = col_versions is not None
 
         if session:
@@ -916,7 +978,14 @@ class ExactSolver:
             b_arrs.append(arr)
             bspec.append((name, off, arr.shape[0]))
             off += arr.shape[0]
+        if use_nominated:
+            b_arrs.append(nominated.count)
+            bspec.append(("nom_cnt", off, nominated.count.shape[0]))
+            off += nominated.count.shape[0]
         bstate = np.concatenate(b_arrs, axis=0)
+        nom_used = (
+            nominated.used if use_nominated else np.zeros((1, 1, 1), np.int64)
+        )
 
         # per-pod inputs, one upload per dtype class
         pod_valid = (pods.valid & pods.feasible_static)[:, None]
@@ -925,6 +994,17 @@ class ExactSolver:
             ("class_of", np.asarray(static.class_of)[:, None]),
             ("pod_takes", np.asarray(ports.pod_takes)),
         ]
+        if use_nominated:
+            slots = np.full(pods.padded, -1, dtype=np.int32)
+            if nominated_slot is not None:
+                slots[: len(nominated_slot)] = nominated_slot
+            levels = nominated.level_of(
+                np.asarray(pods.priority, dtype=np.int32)
+            )
+            i32_cols += [
+                ("nom_level", levels[:, None]),
+                ("nominated_slot", slots[:, None]),
+            ]
         bool_cols = [
             ("req_mask", pods.req_mask),
             ("pod_valid", pod_valid),
@@ -942,7 +1022,10 @@ class ExactSolver:
                 ("ipa_m_anti", np.asarray(interpod.m_anti)),
                 ("ipa_self_aff", np.asarray(interpod.self_aff)[:, None]),
             ]
-        squeeze_names = {"class_of", "pod_valid", "ipa_self_aff"}
+        squeeze_names = {
+            "class_of", "pod_valid", "ipa_self_aff", "nom_level",
+            "nominated_slot",
+        }
 
         def pack_x(cols):
             spec = []
@@ -983,10 +1066,12 @@ class ExactSolver:
             spread_soft=spread.has_soft,
             ipa_ident=interpod.ident,
             ipa_score=interpod.has_score,
+            use_nominated=use_nominated,
         )
         group = cfg.group_size
         grouped = grouped_eligible(
-            cfg, pods.padded, nodes.padded, use_spread, use_interpod
+            cfg, pods.padded, nodes.padded, use_spread, use_interpod,
+            use_nominated,
         )
         if grouped:
             uniform = jnp.asarray(
@@ -1005,6 +1090,7 @@ class ExactSolver:
             jnp.asarray(xi32),
             jnp.asarray(xbool),
             uniform,
+            jnp.asarray(nom_used),
             key,
             bspec=tuple(bspec),
             xspec=xspec,

@@ -233,6 +233,77 @@ def build_node_batch(
     )
 
 
+@dataclass
+class NominatedTensors:
+    """Nominated-pod load for RunFilterPluginsWithNominatedPods semantics
+    (framework/runtime/framework.go#addNominatedPods): when scheduling pod
+    p, nominated pods with priority >= p.priority count as if already
+    placed on their nominated node — the resource/count filters see their
+    load, so a preemptor's freed capacity cannot be stolen by a
+    lower-priority pod.
+
+    Levels are the distinct nominated priorities, DESCENDING; row l of the
+    cumulative tensors holds the total load of nominated pods with
+    priority >= levels[l-1] (row 0 = no load, for pods outranking every
+    nomination). A pod's row index comes from level_of(). Only the
+    monotone filters (resources, pod count) consume this — adding load
+    can only shrink the feasible set, so the reference's run-twice
+    protocol collapses to one run for them; the non-monotone plugins
+    (affinity symmetry from nominated pods) are documented out of scope.
+    """
+
+    levels: np.ndarray  # [L] int32 distinct nominated priorities, desc
+    used: np.ndarray  # [L+1, K, Np] int64 cumulative nominated requests
+    count: np.ndarray  # [L+1, Np] int32 cumulative nominated pod counts
+
+    @property
+    def empty(self) -> bool:
+        return self.levels.size == 0
+
+    def level_of(self, priority: np.ndarray) -> np.ndarray:
+        """[P] priorities -> [P] row indices: number of levels with
+        priority >= the pod's (0 = none apply)."""
+        # levels desc; count levels >= priority
+        return np.searchsorted(-self.levels, -np.asarray(priority), side="right").astype(
+            np.int32
+        )
+
+
+def build_nominated_tensors(
+    nominated: Sequence[tuple[Pod, int]],  # (pod, node slot)
+    vocab: "ResourceVocab",
+    n_pad: int,
+) -> NominatedTensors:
+    """``nominated``: unbound pods carrying status.nominatedNodeName,
+    with their nominated node's snapshot slot."""
+    if not nominated:
+        return NominatedTensors(
+            levels=np.zeros(0, dtype=np.int32),
+            used=np.zeros((1, len(vocab), n_pad), dtype=np.int64),
+            count=np.zeros((1, n_pad), dtype=np.int32),
+        )
+    k = len(vocab)
+    prios = sorted({p.effective_priority for p, _ in nominated}, reverse=True)
+    levels = np.asarray(prios, dtype=np.int32)
+    # pad the level axis to a small pow2 bucket so the number of distinct
+    # nominated priorities doesn't mint fresh XLA executables (§8.8
+    # recompile storms); padding rows repeat the last cumulative row and
+    # are never indexed (level_of <= len(prios))
+    rows = 4
+    while rows < len(prios) + 1:
+        rows *= 2
+    used = np.zeros((rows, k, n_pad), dtype=np.int64)
+    count = np.zeros((rows, n_pad), dtype=np.int32)
+    # each pod's load lands in every cumulative row that includes its
+    # priority (its own level row and every lower-priority row below it)
+    for pod, slot in nominated:
+        row = prios.index(pod.effective_priority) + 1
+        r = vocab.vectorize(pod.resource_request())
+        used[row:, :, slot] += r[None, :]
+        count[row:, slot] += 1
+    return NominatedTensors(levels=levels, used=used, count=count)
+
+
 def build_pod_batch(
     pods: Sequence[Pod],
     vocab: ResourceVocab,
