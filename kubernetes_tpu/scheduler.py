@@ -154,15 +154,22 @@ class Scheduler:
                     self.queue.update(pod)
             else:  # DELETED
                 if pod.node_name:
+                    freed_node = pod.node_name
                     self.cache.remove_pod(pod.key)
-                    # AssignedPodDelete frees resources: wake parked pods
-                    self.queue.move_all_to_active_or_backoff("AssignedPodDelete")
+                    # AssignedPodDelete frees resources on ONE node: wake
+                    # only pods whose requests fit its new free capacity
+                    self.queue.move_all_to_active_or_backoff(
+                        "AssignedPodDelete",
+                        worth=self._fit_hint(freed_node),
+                    )
                 else:
                     self.queue.delete(pod.key)
         else:  # Node
             if ev.type == "ADDED":
                 self.cache.add_node(ev.obj)
-                self.queue.move_all_to_active_or_backoff("NodeAdd")
+                self.queue.move_all_to_active_or_backoff(
+                    "NodeAdd", worth=self._fit_hint(ev.obj.name)
+                )
             elif ev.type == "MODIFIED":
                 old = self.cache.nodes.get(ev.obj.name)
                 old_node = old.node if old is not None else None
@@ -171,9 +178,51 @@ class Scheduler:
                 # #nodeSchedulingPropertiesChange): only wake parked pods for
                 # node changes that could make one schedulable
                 if old_node is None or _node_change_could_help(old_node, ev.obj):
-                    self.queue.move_all_to_active_or_backoff("NodeUpdate")
+                    # label/taint/unschedulable changes can unblock pods
+                    # regardless of resources; a pure allocatable change
+                    # only helps pods that now FIT this node
+                    resource_only = old_node is not None and (
+                        old_node.labels == ev.obj.labels
+                        and old_node.taints == ev.obj.taints
+                        and old_node.unschedulable == ev.obj.unschedulable
+                    )
+                    self.queue.move_all_to_active_or_backoff(
+                        "NodeUpdate",
+                        worth=self._fit_hint(ev.obj.name)
+                        if resource_only
+                        else None,
+                    )
             else:
                 self.cache.remove_node(ev.obj.name)
+
+    def _fit_hint(self, node_name: str):
+        """isPodWorthRequeuing gate for fit-shaped events (NodeAdd, a pure
+        allocatable NodeUpdate, AssignedPodDelete): the event changed ONE
+        node's capacity, so a parked pod is worth requeuing only if its
+        requests fit that node's new free capacity (noderesources/fit.go
+        #isSchedulableAfterNodeChange). Requests that don't fit there
+        cannot have been unblocked by this event. Other filters (taints,
+        selectors) are NOT checked — failing them here could only cause a
+        missed wakeup if they also changed, which routes through the
+        worth=None path."""
+
+        def worth(info) -> bool:
+            ninfo = self.cache.nodes.get(node_name)
+            if ninfo is None or ninfo.node is None:
+                return True  # node vanished mid-event: stay conservative
+            node = ninfo.node
+            if node.unschedulable:
+                return False
+            if len(ninfo.pods) + 1 > node.allowed_pod_number:
+                return False
+            for r, v in info.pod.resource_request().items():
+                if v <= 0 or r == "pods":
+                    continue
+                if ninfo.used.get(r, 0) + v > node.allocatable.get(r, 0):
+                    return False
+            return True
+
+        return worth
 
     # -- the scheduling loop --
 
@@ -193,6 +242,10 @@ class Scheduler:
     def _schedule_batch_locked(self) -> BatchResult:
         res = BatchResult()
         t0 = time.perf_counter()
+        # #flushUnschedulablePodsLeftover: the reference runs this on a 30s
+        # timer goroutine; batching gives a natural tick — pods parked
+        # longer than 5 min force back into rotation before each pop
+        self.queue.flush_unschedulable_leftover()
         infos = self.queue.pop_batch(self.config.batch_size)
         if not infos:
             return res

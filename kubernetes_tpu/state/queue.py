@@ -205,13 +205,18 @@ class PriorityQueue:
             info.timestamp = now
             self._push_active(info)
 
-    def move_all_to_active_or_backoff(self, event: str = "") -> None:
-        """#MoveAllToActiveOrBackoffQueue. QueueingHints reduce the moved set
-        per event type; until hint registration lands, every parked pod moves
-        (strictly more wakeups than the reference — safe, not lossy)."""
+    def move_all_to_active_or_backoff(self, event: str = "", worth=None) -> None:
+        """#MoveAllToActiveOrBackoffQueue with QueueingHints: ``worth`` is
+        the isPodWorthRequeuing gate (scheduling_queue.go) — a predicate
+        over QueuedPodInfo built by the event handler from what actually
+        changed (e.g. "does this pod fit the updated node's new free
+        capacity"). Pods failing the hint STAY parked; ``worth=None``
+        moves everything (events with no registered hint — safe,
+        strictly more wakeups than the reference)."""
         self._move_request_cycle = self.scheduling_cycle
         for info in list(self._unschedulable.values()):
-            self._move_one(info)
+            if worth is None or worth(info):
+                self._move_one(info)
 
     def flush_backoff_completed(self) -> None:
         """#flushBackoffQCompleted (reference runs this every 1s; we run it

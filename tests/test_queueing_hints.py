@@ -1,0 +1,128 @@
+"""QueueingHints + leftover flush (VERDICT r2 #7): the
+isPodWorthRequeuing gate (scheduling_queue.go) — fit-shaped events wake
+only pods the changed node could now admit — and the 5-minute forced
+flush running from schedule_batch."""
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def _sched(cs, clock):
+    return Scheduler(
+        cs,
+        SchedulerConfig(solver=ExactSolverConfig(tie_break="first")),
+        clock=clock,
+    )
+
+
+def _park_two_blocked_pods(cs, sched):
+    """One CPU-blocked pod, one memory-blocked pod; both end up parked."""
+    cs.create_pod(MakePod().name("cpu-blocked").req({"cpu": "8"}).obj())
+    cs.create_pod(
+        MakePod().name("mem-blocked").req({"cpu": "1", "memory": "64Gi"}).obj()
+    )
+    r = sched.schedule_batch()
+    assert len(r.unschedulable) == 2
+    assert sched.queue.pending_counts()["unschedulable"] == 2
+
+
+def test_cpu_only_node_update_does_not_wake_memory_blocked_pod():
+    clock = FakeClock()
+    cs = ClusterState()
+    node = MakeNode().name("n").capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj()
+    cs.create_node(node)
+    sched = _sched(cs, clock)
+    _park_two_blocked_pods(cs, sched)
+
+    # grow ONLY cpu: 2 -> 16; memory unchanged
+    bigger = MakeNode().name("n").capacity(
+        {"cpu": "16", "memory": "4Gi", "pods": "10"}
+    ).obj()
+    cs.update_node(bigger)
+    counts = sched.queue.pending_counts()
+    # cpu-blocked woke (now fits); mem-blocked stayed parked
+    assert counts["unschedulable"] == 1
+    clock.advance(2.0)  # past the retry backoff
+    r = sched.schedule_batch()
+    assert dict(r.scheduled).get("default/cpu-blocked") == "n"
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+
+
+def test_node_add_wakes_only_fitting_pods():
+    clock = FakeClock()
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("tiny").capacity({"cpu": "1", "memory": "1Gi", "pods": "10"}).obj()
+    )
+    sched = _sched(cs, clock)
+    _park_two_blocked_pods(cs, sched)
+
+    cs.create_node(
+        MakeNode().name("cpu-big").capacity({"cpu": "32", "memory": "2Gi", "pods": "10"}).obj()
+    )
+    counts = sched.queue.pending_counts()
+    assert counts["unschedulable"] == 1  # mem-blocked still parked
+
+
+def test_label_change_wakes_everything():
+    """A label change can unblock selector-filtered pods the fit hint knows
+    nothing about — it must take the move-everything path."""
+    clock = FakeClock()
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n").capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj()
+    )
+    sched = _sched(cs, clock)
+    cs.create_pod(
+        MakePod().name("selective").req({"cpu": "1"}).node_selector({"tier": "gold"}).obj()
+    )
+    r = sched.schedule_batch()
+    assert r.unschedulable
+    relabeled = (
+        MakeNode().name("n").capacity({"cpu": "2", "memory": "4Gi", "pods": "10"})
+        .label("tier", "gold").obj()
+    )
+    cs.update_node(relabeled)
+    assert sched.queue.pending_counts()["unschedulable"] == 0
+    clock.advance(2.0)  # past the retry backoff
+    r = sched.schedule_batch()
+    assert dict(r.scheduled).get("default/selective") == "n"
+
+
+def test_assigned_pod_delete_wakes_fitting_pods_only():
+    clock = FakeClock()
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n").capacity({"cpu": "4", "memory": "4Gi", "pods": "10"}).obj()
+    )
+    cs.create_pod(MakePod().name("occupant").req({"cpu": "4"}).obj())
+    cs.bind("default", "occupant", "n")
+    sched = _sched(cs, clock)
+    _park_two_blocked_pods(cs, sched)  # cpu-blocked wants 8 (never fits n!)
+
+    cs.delete_pod("default", "occupant")
+    counts = sched.queue.pending_counts()
+    # freed 4 cpu: cpu-blocked wants 8 -> still parked; mem-blocked wants
+    # 64Gi -> still parked. Nothing fits, nothing wakes.
+    assert counts["unschedulable"] == 2
+
+
+def test_leftover_flush_from_schedule_batch():
+    """Pods parked > 5 min force back into rotation on the next batch even
+    with no event and no hint match."""
+    clock = FakeClock()
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n").capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj()
+    )
+    sched = _sched(cs, clock)
+    cs.create_pod(MakePod().name("stuck").req({"cpu": "8"}).obj())
+    sched.schedule_batch()
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+
+    clock.advance(301.0)
+    r = sched.schedule_batch()  # flush moves it active; batch re-attempts it
+    assert "default/stuck" in r.unschedulable  # re-tried (and re-parked)
