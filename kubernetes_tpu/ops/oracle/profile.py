@@ -275,13 +275,22 @@ class FullOracle:
     def validate_assignments(
         self, pods: Sequence[Pod], assignments: Sequence[int],
         names: Sequence[str] | None = None,
+        sample: "set[int] | None" = None,
     ) -> list[str]:
         """Replay solver choices, checking each against the oracle tie set.
         ``names``: solver's node name per assignment (to map index spaces);
-        defaults to self.nodes order."""
+        defaults to self.nodes order. ``sample``: step indices to verify
+        (every step is still REPLAYED so state stays exact; only the
+        expensive tie-set computation is skipped elsewhere) — the
+        large-scale parity gate's knob (SURVEY §8.6: sampled asserts)."""
         index_of = {on.node.name: i for i, on in enumerate(self.nodes)}
         errors: list[str] = []
         for step, (pod, pick) in enumerate(zip(pods, assignments)):
+            if sample is not None and step not in sample:
+                if pick >= 0:
+                    oi = index_of[names[step]] if names is not None else pick
+                    self.nodes[oi].add_pod(pod)
+                continue
             _, ties = self.feasible_and_ties(pod)
             if pick == -1:
                 if ties:
