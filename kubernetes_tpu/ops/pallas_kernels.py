@@ -20,6 +20,30 @@ n-tile axis innermost so the output block stays resident and accumulates
 (@pl.when zero-init on the first tile).
 
 Works in interpret mode on CPU (tests) and compiled on the axon TPU.
+
+**Production wiring decision (round 3) — NEGATIVE RESULT, measured:**
+the solver does NOT call this kernel. Three facts, established on this
+box's jax 0.9 + experimental axon PJRT:
+
+1. With ``jax_enable_x64`` enabled — which the solver REQUIRES process-wide
+   (int64 resource arithmetic; memory bytes overflow int32) — Pallas
+   lowering of this kernel crashes with a RecursionError inside dtype
+   conversion (jax/_src/numpy/lax_numpy.py astype), both standalone and
+   under lax.scan. With x64 off it compiles and matches the reference
+   (parity verified on TPU), so the kernel is sound; the x64 interaction
+   is a toolchain defect this build cannot work around.
+2. The workload that made this aggregation expensive — hostname-topology
+   terms, where d_pad ~ N and the flattened segment_sum cost ~0.8 ms per
+   scan step — is now served by ops/interpod.domain_counts' IDENTITY mode
+   (unique-domain rows need no aggregation at all), removing the hot case
+   without any kernel.
+3. The remaining small-d_pad segment_sum costs ~0.25 ms/step
+   (zone-topology shapes), below the measured per-call benefit a Pallas
+   replacement could deliver here even if it compiled.
+
+The kernel + interpret-mode parity tests stay as the validated fallback:
+if a future jax/axon build fixes the x64 lowering, wiring it is a
+one-line change in the domain_counts dispatchers.
 """
 
 from __future__ import annotations

@@ -354,7 +354,21 @@ class Scheduler:
             if grouped_ok and len(pods) <= self.config.batch_size
             else None
         )
-        pbatch = build_pod_batch(pods, batch.vocab, pad=pod_pad)
+        # per-plugin host tensorization timings feed the reference's
+        # plugin_execution_duration_seconds series: inside the fused device
+        # program per-plugin attribution doesn't exist, but the host-side
+        # per-plugin-family tensorizers are real measured work
+        def _timed(plugin: str, fn, *a, **kw):
+            tp = time.perf_counter()
+            out = fn(*a, **kw)
+            metrics.plugin_execution_duration_seconds.labels(
+                plugin, "PreFilter", "Success"
+            ).observe(time.perf_counter() - tp)
+            return out
+
+        pbatch = _timed(
+            "NodeResourcesFit", build_pod_batch, pods, batch.vocab, pad=pod_pad
+        )
 
         # Node objects in snapshot-slot order, for the plugin tensorizers
         # (share the solver's node index space).
@@ -385,7 +399,9 @@ class Scheduler:
                     return None
                 return default_selector_key(p, services)
 
-        static = build_static_tensors(
+        static = _timed(
+            "NodeAffinity",  # the static-mask family's dominant member
+            build_static_tensors,
             pods, pbatch, slot_nodes, batch.padded, volume_ctx,
             disabled=frozenset(solver.config.disabled_filters),
             added_affinity=solver.config.added_affinity,
@@ -398,14 +414,16 @@ class Scheduler:
                 if info is not None and info.node is not None and info.pods:
                     placed_by_slot[slot] = list(info.pods.values())
         if need_ports:
-            ports = build_port_tensors(
-                pods, pbatch, slot_nodes, placed_by_slot, batch.padded
+            ports = _timed(
+                "NodePorts", build_port_tensors,
+                pods, pbatch, slot_nodes, placed_by_slot, batch.padded,
             )
         else:
             ports = trivial_port_tensors(pbatch, batch.padded)
         spread = None
         if need_spread:
-            spread = build_spread_tensors(
+            spread = _timed(
+                "PodTopologySpread", build_spread_tensors,
                 pods, static.reps, pbatch, slot_nodes,
                 placed_by_slot, batch.padded, static.c_pad,
                 services=services,
@@ -413,7 +431,8 @@ class Scheduler:
             )
         interpod = None
         if need_interpod:
-            interpod = build_interpod_tensors(
+            interpod = _timed(
+                "InterPodAffinity", build_interpod_tensors,
                 pods, static.reps, pbatch, slot_nodes,
                 placed_by_slot, batch.padded, static.c_pad,
                 hard_pod_affinity_weight=solver.config.hard_pod_affinity_weight,
@@ -447,12 +466,25 @@ class Scheduler:
             nominated=nominated if not nominated.empty else None,
             nominated_slot=nominated_slot,
         )
-        res.solve_seconds += time.perf_counter() - t1
+        solve_dt = time.perf_counter() - t1
+        res.solve_seconds += solve_dt
         metrics.tensorize_seconds.observe(max(t1 - gs, 0.0))
+        # extension-point durations with the reference's metric name: the
+        # fused device program IS RunFilterPlugins+RunScorePlugins, so its
+        # wall time reports under Filter (documented mapping, SURVEY §6.5);
+        # host tensorization maps to PreFilter
+        metrics.framework_extension_point_duration_seconds.labels(
+            "PreFilter", "Success", profile
+        ).observe(max(t1 - gs, 0.0))
+        metrics.framework_extension_point_duration_seconds.labels(
+            "Filter", "Success", profile
+        ).observe(solve_dt)
 
         preempt_placed: dict[int, list[Pod]] | None = None
         preempt_pdbs: list = []
         cluster_has_affinity = False
+        preempt_dt = 0.0
+        bind_dt = 0.0
         for idx, (info, a) in enumerate(zip(infos, assignments)):
             pod = info.pod
             cycle = base_cycle + cycle_offsets[idx] + 1
@@ -470,10 +502,12 @@ class Scheduler:
                             for i2 in self.cache.nodes.values()
                             if i2.node is not None
                         )
+                    tpf = time.perf_counter()
                     self._try_preempt(
                         pod, static, idx, res, preempt_placed, slot_nodes,
                         preempt_pdbs, cluster_has_affinity, solver,
                     )
+                    preempt_dt += time.perf_counter() - tpf
                 res.unschedulable.append(pod.key)
                 self.queue.add_unschedulable(info, cycle)
                 continue
@@ -488,10 +522,20 @@ class Scheduler:
                 self.queue.add_unschedulable(info, cycle)
                 continue
             try:
+                tb = time.perf_counter()
                 self.cluster.bind(pod.namespace, pod.name, node_name)
+                bind_dt += time.perf_counter() - tb
                 self.cache.finish_binding(pod.key)
                 res.scheduled.append((pod.key, node_name))
                 res.latencies.append(time.perf_counter() - t0)
+                # pod-level SLIs: attempts-to-success histogram and e2e
+                # latency from first queue entry, labeled by attempt count
+                metrics.pod_scheduling_attempts.observe(info.attempts)
+                metrics.pod_scheduling_sli_duration_seconds.labels(
+                    str(min(info.attempts, 16))
+                ).observe(
+                    max(self.clock.now() - info.initial_attempt_timestamp, 0.0)
+                )
                 # keep the lazily-snapshotted preemption view in sync with
                 # binds made later in this batch, so a subsequent failing
                 # pod's dry-run sees current node occupancy
@@ -505,6 +549,14 @@ class Scheduler:
                     pass
                 res.bind_failures.append((pod.key, e.reason))
                 self.queue.add_unschedulable(info, cycle)
+        if preempt_dt:
+            metrics.framework_extension_point_duration_seconds.labels(
+                "PostFilter", "Success", profile
+            ).observe(preempt_dt)
+        if bind_dt:
+            metrics.framework_extension_point_duration_seconds.labels(
+                "Bind", "Success", profile
+            ).observe(bind_dt)
 
         # per-profile attempt metrics (this group's own wall time)
         attempt_avg = (time.perf_counter() - gs) / max(len(infos), 1)

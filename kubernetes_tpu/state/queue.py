@@ -32,6 +32,7 @@ import heapq
 import itertools
 from dataclasses import dataclass, field
 
+from .. import metrics
 from ..api.objects import Pod
 from ..utils.clock import Clock
 
@@ -135,9 +136,11 @@ class PriorityQueue:
             self._gated[pod.key] = info
             self._info[pod.key] = info
             self._where[pod.key] = "gated"
+            metrics.queue_incoming_pods_total.labels("gated", "PodAdd").inc()
             return
         self._info[pod.key] = info
         self._push_active(info)
+        metrics.queue_incoming_pods_total.labels("active", "PodAdd").inc()
 
     def update(self, pod: Pod) -> None:
         info = self._info.get(pod.key)
@@ -192,9 +195,15 @@ class PriorityQueue:
         if self._move_request_cycle >= pod_scheduling_cycle:
             # an event fired while this pod was in flight: don't park it
             self._push_backoff(info)
+            metrics.queue_incoming_pods_total.labels(
+                "backoff", "ScheduleAttemptFailure"
+            ).inc()
         else:
             self._unschedulable[info.key] = info
             self._where[info.key] = "unsched"
+            metrics.queue_incoming_pods_total.labels(
+                "unschedulable", "ScheduleAttemptFailure"
+            ).inc()
 
     def _move_one(self, info: QueuedPodInfo) -> None:
         self._unschedulable.pop(info.key, None)
@@ -217,6 +226,9 @@ class PriorityQueue:
         for info in list(self._unschedulable.values()):
             if worth is None or worth(info):
                 self._move_one(info)
+                metrics.queue_incoming_pods_total.labels(
+                    self._where[info.key], event or "ClusterEvent"
+                ).inc()
 
     def flush_backoff_completed(self) -> None:
         """#flushBackoffQCompleted (reference runs this every 1s; we run it
@@ -233,6 +245,9 @@ class PriorityQueue:
             info = self._info[key]
             info.timestamp = now
             self._push_active(info)
+            metrics.queue_incoming_pods_total.labels(
+                "active", "BackoffComplete"
+            ).inc()
 
     def flush_unschedulable_leftover(self) -> None:
         """#flushUnschedulablePodsLeftover: pods stuck > 5 min forced back."""
