@@ -26,8 +26,24 @@ def _load_config(path: str | None) -> config_types.KubeSchedulerConfiguration:
     return config_types.KubeSchedulerConfiguration()
 
 
+def _feature_gates(args):
+    """Parse --feature-gates (component-base/featuregate syntax); parse
+    errors exit 1 like the reference's flag validation."""
+    from .utils.featuregate import FeatureGates
+
+    try:
+        fg = FeatureGates.parse(getattr(args, "feature_gates", None))
+    except ValueError as e:
+        print(f"error: --feature-gates: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    for w in fg.warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    return fg
+
+
 def cmd_config(args) -> int:
     cfg = _load_config(args.config)
+    _feature_gates(args)  # validate the flag here too (exit 1 on error)
     # building the runtime config runs the per-profile solver validation
     # (scoring strategy shapes, disableable filters, resource weights) so
     # its warnings surface here too, not only at serve/perf time
@@ -63,6 +79,7 @@ def cmd_serve(args) -> int:
         print(f"warning: {w}", file=sys.stderr)
     cluster = ClusterState()
     sched_cfg = config_types.scheduler_config(cfg)
+    sched_cfg.feature_gates = _feature_gates(args)
     run_server(
         cluster,
         host=args.host,
@@ -81,7 +98,9 @@ def cmd_perf(args) -> int:
     from .perf.runner import PerfRunner
 
     cfg = _load_config(args.config)
-    runner = PerfRunner(config_types.scheduler_config(cfg))
+    sched_cfg = config_types.scheduler_config(cfg)
+    sched_cfg.feature_gates = _feature_gates(args)
+    runner = PerfRunner(sched_cfg)
     results = runner.run_file(args.workload, workload_filter=args.workload_name)
     for r in results:
         print(
@@ -105,6 +124,11 @@ def main(argv: list[str] | None = None) -> int:
         description="TPU-native pod->node assignment engine",
     )
     parser.add_argument("--config", help="KubeSchedulerConfiguration YAML")
+    parser.add_argument(
+        "--feature-gates",
+        help='component-base style gate list, e.g. '
+        '"SchedulerQueueingHints=false,PodSchedulingReadiness=true"',
+    )
     parser.add_argument(
         "--leader-elect",
         action="store_true",

@@ -30,6 +30,7 @@ from .solver.exact import ExactSolver, ExactSolverConfig
 from .solver.preemption import PreemptionEvaluator
 from .state.cache import SchedulerCache
 from .state.cluster import ApiError, ClusterState, Event
+from .state.volume_binder import VolumeBindingError
 from .state.queue import PriorityQueue, QueuedPodInfo
 from .state.snapshot import Snapshot
 from .tensorize.plugins import (
@@ -55,6 +56,8 @@ class SchedulerConfig:
     # at queue-add, like the reference's frameworkForPod miss. None = the
     # single default profile using `solver`.
     profiles: dict[str, ExactSolverConfig] | None = None
+    # component-base/featuregate analog (--feature-gates); None = defaults
+    feature_gates: object = None
 
 
 def _node_change_could_help(old, new) -> bool:
@@ -92,9 +95,20 @@ class Scheduler:
         self.cluster = cluster
         self.config = config or SchedulerConfig()
         self.clock = clock or Clock()
+        from .utils.featuregate import FeatureGates
+
+        self.feature_gates = self.config.feature_gates or FeatureGates()
         self.cache = SchedulerCache(self.clock, assume_ttl=self.config.assume_ttl)
-        self.queue = PriorityQueue(self.clock)
+        self.queue = PriorityQueue(
+            self.clock,
+            honor_scheduling_gates=self.feature_gates.enabled(
+                "PodSchedulingReadiness"
+            ),
+        )
         self.snapshot = Snapshot()
+        from .state.volume_binder import VolumeBinder
+
+        self.volume_binder = VolumeBinder(cluster)
         # profile map: schedulerName -> solver (profile/profile.go#NewMap)
         from .api.objects import DEFAULT_SCHEDULER_NAME
 
@@ -204,7 +218,10 @@ class Scheduler:
         cannot have been unblocked by this event. Other filters (taints,
         selectors) are NOT checked — failing them here could only cause a
         missed wakeup if they also changed, which routes through the
-        worth=None path."""
+        worth=None path. Returns None (move everything) when the
+        SchedulerQueueingHints feature gate is off."""
+        if not self.feature_gates.enabled("SchedulerQueueingHints"):
+            return None
 
         def worth(info) -> bool:
             ninfo = self.cache.nodes.get(node_name)
@@ -523,9 +540,22 @@ class Scheduler:
                 continue
             try:
                 tb = time.perf_counter()
+                # volumebinding Reserve + PreBind (AssumePodVolumes ->
+                # BindPodVolumes) run before the binding subresource call,
+                # exactly the reference's cycle order; any failure below
+                # unreserves (rolls back committed PV/PVC writes)
+                if pod.pvc_names:
+                    ninfo = self.cache.nodes.get(node_name)
+                    if ninfo is None or ninfo.node is None:
+                        raise VolumeBindingError(
+                            f"node {node_name} vanished before volume binding"
+                        )
+                    self.volume_binder.assume_pod_volumes(pod, ninfo.node)
+                    self.volume_binder.bind_pod_volumes(pod)
                 self.cluster.bind(pod.namespace, pod.name, node_name)
                 bind_dt += time.perf_counter() - tb
                 self.cache.finish_binding(pod.key)
+                self.volume_binder.finish(pod.key)
                 res.scheduled.append((pod.key, node_name))
                 res.latencies.append(time.perf_counter() - t0)
                 # pod-level SLIs: attempts-to-success histogram and e2e
@@ -543,11 +573,23 @@ class Scheduler:
                     preempt_placed.setdefault(int(a), []).append(pod)
             except ApiError as e:
                 # bindingCycle failure path: Unreserve -> ForgetPod -> requeue
+                self.volume_binder.unreserve(pod.key)
                 try:
                     self.cache.forget_pod(pod.key)
                 except Exception:
                     pass
                 res.bind_failures.append((pod.key, e.reason))
+                self.queue.add_unschedulable(info, cycle)
+            except VolumeBindingError as e:
+                # Reserve failed (e.g. a WaitForFirstConsumer claim with no
+                # PV on the chosen node — it passed Filter by design):
+                # Unreserve -> ForgetPod -> requeue with backoff
+                self.volume_binder.unreserve(pod.key)
+                try:
+                    self.cache.forget_pod(pod.key)
+                except Exception:
+                    pass
+                res.bind_failures.append((pod.key, str(e)))
                 self.queue.add_unschedulable(info, cycle)
         if preempt_dt:
             metrics.framework_extension_point_duration_seconds.labels(

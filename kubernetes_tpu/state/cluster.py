@@ -242,6 +242,13 @@ class ClusterState:
     def list_pvs(self) -> list[PersistentVolume]:
         return list(self._pvs.values())
 
+    def update_pv(self, pv: PersistentVolume) -> PersistentVolume:
+        if pv.name not in self._pvs:
+            raise ApiError("NotFound", pv.name)
+        pv.resource_version = self._next_rv()
+        self._pvs[pv.name] = pv
+        return pv
+
     def create_pvc(self, pvc: PersistentVolumeClaim) -> PersistentVolumeClaim:
         if pvc.key in self._pvcs:
             raise ApiError("AlreadyExists", pvc.key)
@@ -251,6 +258,13 @@ class ClusterState:
 
     def list_pvcs(self) -> list[PersistentVolumeClaim]:
         return list(self._pvcs.values())
+
+    def update_pvc(self, pvc: PersistentVolumeClaim) -> PersistentVolumeClaim:
+        if pvc.key not in self._pvcs:
+            raise ApiError("NotFound", pvc.key)
+        pvc.resource_version = self._next_rv()
+        self._pvcs[pvc.key] = pvc
+        return pvc
 
     # -- bulk helpers for benchmarks --
 

@@ -62,10 +62,14 @@ class PriorityQueue:
         clock: Clock | None = None,
         pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
         pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+        honor_scheduling_gates: bool = True,
     ):
         self._clock = clock or Clock()
         self._initial_backoff = pod_initial_backoff
         self._max_backoff = pod_max_backoff
+        # PodSchedulingReadiness feature gate: when off, schedulingGates
+        # are ignored (pre-1.26 behavior) and nothing parks as gated
+        self._honor_gates = honor_scheduling_gates
         self._seq = itertools.count()
 
         self._active: list[tuple[int, float, int, str]] = []  # (-prio, ts, seq, key)
@@ -130,7 +134,7 @@ class PriorityQueue:
         info = QueuedPodInfo(
             pod=pod, timestamp=now, initial_attempt_timestamp=now
         )
-        if pod.scheduling_gates:
+        if pod.scheduling_gates and self._honor_gates:
             # PreEnqueue rejection (schedulinggates plugin)
             info.gated = True
             self._gated[pod.key] = info

@@ -1,0 +1,173 @@
+"""volumebinding Reserve/PreBind (AssumePodVolumes/BindPodVolumes) and the
+feature-gate map — the last two missing components from VERDICT r2 (#9)."""
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    PersistentVolume,
+    PersistentVolumeClaim,
+)
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.featuregate import FeatureGates
+
+GB = 1024**3
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _cluster_with_pvs():
+    cs = ClusterState()
+    for i, zone in enumerate(["east", "east", "west"]):
+        cs.create_node(
+            MakeNode().name(f"n{i}")
+            .capacity({"cpu": "4", "memory": "8Gi", "pods": "10"})
+            .label(ZONE, zone).obj()
+        )
+    # two PVs zoned east, one west; sizes differ so smallest-fit is visible
+    for name, zone, size in (
+        ("pv-small", "east", 5 * GB),
+        ("pv-big", "east", 50 * GB),
+        ("pv-west", "west", 20 * GB),
+    ):
+        cs.create_pv(
+            PersistentVolume(
+                name=name,
+                labels={ZONE: zone},
+                capacity_bytes=size,
+                storage_class="standard",
+            )
+        )
+    return cs
+
+
+def _sched(cs, gates=None):
+    return Scheduler(
+        cs,
+        SchedulerConfig(
+            solver=ExactSolverConfig(tie_break="first"), feature_gates=gates
+        ),
+        clock=FakeClock(),
+    )
+
+
+def test_wffc_claim_binds_at_schedule_time():
+    """WaitForFirstConsumer claim: passes Filter unbound, binds at
+    Reserve/PreBind on the chosen node — the smallest adequate PV."""
+    cs = _cluster_with_pvs()
+    cs.create_pvc(
+        PersistentVolumeClaim(
+            name="data", storage_class="standard", request_bytes=2 * GB,
+            wait_for_first_consumer=True,
+        )
+    )
+    sched = _sched(cs)
+    cs.create_pod(MakePod().name("p").req({"cpu": "1"}).pvc("data").obj())
+    r = sched.schedule_batch()
+    assert dict(r.scheduled).get("default/p")
+    pvc = {c.key: c for c in cs.list_pvcs()}["default/data"]
+    assert pvc.volume_name == "pv-small"  # smallest adequate
+    pv = {v.name: v for v in cs.list_pvs()}["pv-small"]
+    assert pv.claim_ref == "default/data"
+
+
+def test_reserve_failure_requeues_and_rolls_back():
+    """A WFFC claim too big for any PV: Filter passes (deferred), Reserve
+    fails, the pod requeues, and nothing stays bound."""
+    cs = _cluster_with_pvs()
+    cs.create_pvc(
+        PersistentVolumeClaim(
+            name="huge", storage_class="standard", request_bytes=500 * GB,
+            wait_for_first_consumer=True,
+        )
+    )
+    sched = _sched(cs)
+    cs.create_pod(MakePod().name("p").req({"cpu": "1"}).pvc("huge").obj())
+    r = sched.schedule_batch()
+    assert r.bind_failures and "no matching PersistentVolume" in r.bind_failures[0][1]
+    assert not r.scheduled
+    assert all(not v.claim_ref for v in cs.list_pvs())
+    assert all(not c.volume_name for c in cs.list_pvcs())
+
+
+def test_two_claims_get_distinct_pvs():
+    cs = _cluster_with_pvs()
+    for name in ("a", "b"):
+        cs.create_pvc(
+            PersistentVolumeClaim(
+                name=name, storage_class="standard", request_bytes=2 * GB,
+                wait_for_first_consumer=True,
+            )
+        )
+    sched = _sched(cs)
+    cs.create_pod(
+        MakePod().name("p").req({"cpu": "1"}).pvc("a").pvc("b").obj()
+    )
+    r = sched.schedule_batch()
+    assert dict(r.scheduled).get("default/p")
+    bound = {c.name: c.volume_name for c in cs.list_pvcs()}
+    assert bound["a"] and bound["b"] and bound["a"] != bound["b"]
+
+
+# -- feature gates -----------------------------------------------------------
+
+
+def test_feature_gate_parsing():
+    fg = FeatureGates.parse("SchedulerQueueingHints=false")
+    assert not fg.enabled("SchedulerQueueingHints")
+    assert fg.enabled("PodSchedulingReadiness")  # default
+    with pytest.raises(ValueError):
+        FeatureGates.parse("NoSuchGate=true")
+    with pytest.raises(ValueError):
+        FeatureGates.parse("SchedulerQueueingHints=maybe")
+    assert FeatureGates.parse("DynamicResourceAllocation=true").warnings
+
+
+def test_pod_scheduling_readiness_gate_off_ignores_gates():
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n").capacity({"cpu": "4", "memory": "8Gi", "pods": "10"}).obj()
+    )
+    gated_pod = MakePod().name("g").req({"cpu": "1"}).scheduling_gates(["wait"]).obj()
+
+    # gate ON (default): pod parks as gated
+    s1 = _sched(cs)
+    cs.create_pod(gated_pod)
+    r = s1.schedule_batch()
+    assert not r.scheduled
+    assert s1.queue.pending_counts()["gated"] == 1
+    cs.delete_pod("default", "g")
+
+    # gate OFF: schedulingGates ignored, pod schedules
+    cs2 = ClusterState()
+    cs2.create_node(
+        MakeNode().name("n").capacity({"cpu": "4", "memory": "8Gi", "pods": "10"}).obj()
+    )
+    s2 = _sched(cs2, gates=FeatureGates.parse("PodSchedulingReadiness=false"))
+    cs2.create_pod(
+        MakePod().name("g").req({"cpu": "1"}).scheduling_gates(["wait"]).obj()
+    )
+    r = s2.schedule_batch()
+    assert dict(r.scheduled).get("default/g") == "n"
+
+
+def test_queueing_hints_gate_off_moves_everything():
+    """With SchedulerQueueingHints=false, a cpu-only node update wakes even
+    a memory-blocked pod (the pre-hints behavior)."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n").capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj()
+    )
+    sched = _sched(cs, gates=FeatureGates.parse("SchedulerQueueingHints=false"))
+    cs.create_pod(
+        MakePod().name("mem-blocked").req({"cpu": "1", "memory": "64Gi"}).obj()
+    )
+    sched.schedule_batch()
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+    cs.update_node(
+        MakeNode().name("n").capacity({"cpu": "16", "memory": "4Gi", "pods": "10"}).obj()
+    )
+    # gate off: moved despite not fitting
+    assert sched.queue.pending_counts()["unschedulable"] == 0
