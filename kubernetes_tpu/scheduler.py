@@ -362,9 +362,33 @@ class Scheduler:
             except KeyError:
                 continue  # nominated node no longer in the snapshot
 
+        # mirror the tensor-level groupable facts from the pods (solve
+        # recomputes them from the tensors; disagreement degrades to
+        # padded-slow, never wrong): hard-only spread with no soft
+        # constraints / no service defaults; anti-affinity-only interpod
+        spread_groupable = need_spread and not services and all(
+            all(
+                c.when_unsatisfiable == "DoNotSchedule"
+                for c in p.topology_spread_constraints
+            )
+            for p in pods
+        )
+        interpod_groupable = need_interpod and all(
+            p.affinity is None
+            or (
+                p.affinity.pod_affinity is None
+                and (
+                    p.affinity.pod_anti_affinity is None
+                    or not p.affinity.pod_anti_affinity.preferred
+                )
+            )
+            for p in pods
+        )
         grouped_ok = grouped_eligible(
             solver.config, self.config.batch_size, batch.padded,
             need_spread, need_interpod, bool(nom_pairs),
+            spread_groupable=spread_groupable,
+            interpod_groupable=interpod_groupable,
         )
         pod_pad = (
             self.config.batch_size

@@ -37,6 +37,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import ops as jops
 
 from ..ops import fastmath
 from ..ops import interpod as ip
@@ -121,17 +122,26 @@ def grouped_eligible(
     use_spread: bool,
     use_interpod: bool,
     use_nominated: bool = False,
+    spread_groupable: bool = False,
+    interpod_groupable: bool = False,
 ) -> bool:
     """Single source of truth for the grouped fast path's dispatch
     condition — the scheduler consults it when choosing the pod-axis
     padding bucket, and ExactSolver.solve when picking the executable, so
     the two can never drift into padding-without-grouping. Nominated-pod
-    load (rare, preemption aftermath) routes through the per-pod scan."""
+    load (rare, preemption aftermath) routes through the per-pod scan.
+
+    ``spread_groupable``/``interpod_groupable``: the batch-level facts
+    that make the kind-2/3 quota chunks possible (hard-only spread with no
+    soft constraints; anti-affinity-only interpod). Solve derives them
+    from the tensors; the scheduler mirrors them from the pods for its
+    padding decision — a mismatch degrades to padded-slow, never to a
+    wrong result (unqualified chunks replay the full pipeline)."""
     return (
         cfg.group_size > 1
         and not cfg.disabled_filters
-        and not use_spread
-        and not use_interpod
+        and (not use_spread or spread_groupable)
+        and (not use_interpod or interpod_groupable)
         and not use_nominated
         and pod_pad % cfg.group_size == 0
         and node_pad >= cfg.group_size  # order[:group] gather needs N >= G
@@ -375,7 +385,7 @@ def _solve_grouped(
     tables,
     state0,
     xs,  # per-pod scanned inputs, leading axis P (P % group == 0)
-    uniform,  # [P // group] bool — chunk g holds `group` identical valid pods
+    kinds,  # [P // group] int32 chunk dispatch (see _chunk_kinds)
     key,
     *,
     group: int,
@@ -383,27 +393,36 @@ def _solve_grouped(
 ):
     """Grouped exact scan (SURVEY §8.4 'batched variant').
 
-    The pod axis is cut into chunks of ``group`` consecutive pods. A chunk
-    whose pods are identical (same scheduling class, requests, and port
-    rows — the deployment-replicas case detected host-side) takes a fast
-    path that reproduces sequential greedy placement exactly but with G
-    cheap frontier steps instead of G full pipelines:
+    The pod axis is cut into chunks of ``group`` consecutive pods; a
+    host-computed per-chunk KIND picks the executable branch:
 
-    - placing one pod only changes the *chosen node's* fit/score column
-      (resources, pod count, ports are node-local), so per-node placement
-      capacities ``cap[n]`` and the score-after-j-placements table
-      ``S[j, n]`` are precomputed dense once per chunk;
-    - the cross-node coupling (DefaultNormalizeScore over the feasible set
-      for TaintToleration/NodeAffinity) is recomputed each iteration from
-      the current mask, which is exactly what the per-pod pipeline does;
-    - an infeasible pod leaves state untouched, so later identical pods
-      are infeasible too — matching the sequential scan's fixpoint.
+      0  slow: inner per-pod scan with the full pipeline — bit-identical
+         to the ungrouped solver (mixed chunks, anything unproven).
+      1  plain fast: identical pods whose class is spread/interpod-NEUTRAL
+         (host-verified zero involvement) — node-local frontier stepping
+         with multi-placement, as before.
+      2  spread fast: identical pods with exactly ONE hard topology-spread
+         constraint (no soft, no min_domains, zero taint/nodeaff
+         preference rows, interpod-neutral). Domain-quota multi-placement:
+         per iteration, up to quota_d = globalMin + maxSkew - count_d pods
+         may land in domain d on distinct eligible nodes. Each placement
+         is sequentially valid: counts only grow within quota (its own
+         skew check holds at its turn since globalMin can only rise), and
+         with zero preference rows every score is placement-count
+         independent, so a chosen tie node is still an argmax tie at its
+         turn even if other nodes leave the mask.
+      3  anti fast: identical pods with exactly ONE required anti-affinity
+         term (self-selecting, symmetric ex term on the same topology,
+         no affinity/preferred, zero preference rows, spread-neutral).
+         Same machinery with quota_d = 1 while the domain is empty — on
+         hostname topology every node is its own domain, so a whole chunk
+         places in ~one iteration (the scheduler_perf
+         SchedulingPodAntiAffinity shape).
 
-    Chunks that are not uniform (mixed classes, partial final chunk) fall
-    back to an inner per-pod scan with the full pipeline — bit-identical
-    to the ungrouped solver. Only valid when spread/interpod are inactive
-    for the batch: those plugins couple scores across nodes through domain
-    counts, which the fast path does not model.
+    Random-mode multi-placement (all fast kinds) produces a sequentially
+    VALID outcome whose distribution differs from the per-pod scan for the
+    same seed (ExactSolverConfig.group_size documents this); "first" mode
+    places one pod per iteration and is bit-identical to the scan.
     """
     tie_break = kw["tie_break"]
     w_cpu = kw["w_cpu"]
@@ -424,186 +443,494 @@ def _solve_grouped(
     n = alloc.shape[1]
     step = _make_step(tables, **kw)
 
+    use_spread = kw["use_spread"]
+    use_interpod = kw["use_interpod"]
+    d_pad = kw["d_pad"]
+    ipa_d_pad = kw["ipa_d_pad"]
+    iota_n = jnp.arange(n, dtype=jnp.int32)
+
     def slow_chunk(st, k, cxs):
         (st, k), asg = jax.lax.scan(step, (st, k), cxs)
         return st, k, asg
 
-    def fast_chunk(st, k, cxs):
-        req = cxs["req"][0]  # [K] int64
-        req_mask = cxs["req_mask"][0]
-        nz = cxs["nonzero_req"][0]  # [2] int64
-        takes = cxs["pod_takes"][0]
-        conflict_row = cxs["pod_conflict"][0]
-        cls = cxs["class_of"][0]
-        # number of pods to place: `group` for a uniform chunk, 0 for an
-        # all-padding chunk (uniformity marks both; this makes fixed-bucket
-        # pod padding nearly free instead of G full pipeline steps)
-        vcnt = jnp.sum(cxs["pod_valid"].astype(jnp.int32)).astype(jnp.int32)
+    def make_fast(mode):
+        """mode: None (plain) | "spread" | "anti" — the quota machinery is
+        shared; mode picks the domain model (host preconditions in
+        _chunk_kinds guarantee each branch only sees chunks it is exact
+        for)."""
 
-        # capacity: how many MORE identical pods each node can take
-        free = alloc - st["used"]
-        cap_res = jnp.where(
-            req_mask[:, None], free // jnp.maximum(req, 1)[:, None], group
-        )
-        cap = jnp.min(cap_res, axis=0)
-        cap = jnp.minimum(
-            cap, (tables["max_pods"] - st["pod_count"]).astype(cap.dtype)
-        )
-        conflict_now = pl.ports_conflict_mask(conflict_row, st["port_used"])
-        has_ports = jnp.any(takes > 0)
-        self_conf = jnp.any((takes > 0) & conflict_row)
-        cap = jnp.where(conflict_now & has_ports, 0, cap)
-        cap = jnp.where(self_conf & ~conflict_now, jnp.minimum(cap, 1), cap)
-        base_mask = tables["static_mask"][cls] & tables["node_valid"]
-        cap = jnp.clip(jnp.where(base_mask, cap, 0), 0, group).astype(jnp.int32)
+        def fast_chunk(st, k, cxs):
+            req = cxs["req"][0]  # [K] int64
+            req_mask = cxs["req_mask"][0]
+            nz = cxs["nonzero_req"][0]  # [2] int64
+            takes = cxs["pod_takes"][0]
+            conflict_row = cxs["pod_conflict"][0]
+            cls = cxs["class_of"][0]
+            # number of pods to place: `group` for a uniform chunk, 0 for
+            # an all-padding chunk (kinds marks both; this makes
+            # fixed-bucket pod padding nearly free)
+            vcnt = jnp.sum(cxs["pod_valid"].astype(jnp.int32)).astype(
+                jnp.int32
+            )
 
-        # S[j-1, n]: fit+balanced (+static image) score for placing the j-th
-        # identical pod on node n, j = 1..group — same kernels as the
-        # per-pod pipeline, evaluated on the [2, G*N] flattened grid
-        j = jnp.arange(1, group + 1, dtype=alloc.dtype)
-        req_g = (
-            st["nonzero_used"][:, None, :] + nz[:, None, None] * j[None, :, None]
-        ).reshape(2, group * n)
-        alloc_g = jnp.broadcast_to(alloc2[:, None, :], (2, group, n)).reshape(
-            2, group * n
-        )
-        s = w_fit * fit_scorer(req_g, alloc_g, weights2)
-        s = s + w_balanced * nr.balanced_allocation_score(
-            req_g, alloc_g, fdtype=fdtype
-        )
-        s_table = s.astype(jnp.int32).reshape(group, n)
-        if w_image:
-            s_table = s_table + w_image * tables["image_score"][cls][None, :]
+            # capacity: how many MORE identical pods each node can take
+            free = alloc - st["used"]
+            cap_res = jnp.where(
+                req_mask[:, None], free // jnp.maximum(req, 1)[:, None], group
+            )
+            cap = jnp.min(cap_res, axis=0)
+            cap = jnp.minimum(
+                cap, (tables["max_pods"] - st["pod_count"]).astype(cap.dtype)
+            )
+            conflict_now = pl.ports_conflict_mask(
+                conflict_row, st["port_used"]
+            )
+            has_ports = jnp.any(takes > 0)
+            self_conf = jnp.any((takes > 0) & conflict_row)
+            cap = jnp.where(conflict_now & has_ports, 0, cap)
+            cap = jnp.where(
+                self_conf & ~conflict_now, jnp.minimum(cap, 1), cap
+            )
+            base_mask = tables["static_mask"][cls] & tables["node_valid"]
+            cap = jnp.clip(jnp.where(base_mask, cap, 0), 0, group).astype(
+                jnp.int32
+            )
 
-        taint_row = tables["taint_cnt"][cls]
-        nodeaff_row = tables["nodeaff_pref"][cls]
+            # S[j-1, n]: fit+balanced (+static image) score for placing the
+            # j-th identical pod on node n, j = 1..group — same kernels as
+            # the per-pod pipeline, on the [2, G*N] flattened grid
+            j = jnp.arange(1, group + 1, dtype=alloc.dtype)
+            req_g = (
+                st["nonzero_used"][:, None, :]
+                + nz[:, None, None] * j[None, :, None]
+            ).reshape(2, group * n)
+            alloc_g = jnp.broadcast_to(
+                alloc2[:, None, :], (2, group, n)
+            ).reshape(2, group * n)
+            s = w_fit * fit_scorer(req_g, alloc_g, weights2)
+            s = s + w_balanced * nr.balanced_allocation_score(
+                req_g, alloc_g, fdtype=fdtype
+            )
+            s_table = s.astype(jnp.int32).reshape(group, n)
+            if w_image:
+                s_table = s_table + w_image * tables["image_score"][cls][None, :]
 
-        def scores_at(m):
-            mask_t = m < cap
-            f = jnp.take_along_axis(
-                s_table, jnp.clip(m, 0, group - 1)[None, :], axis=0
-            )[0]
-            total = f
-            # same DefaultNormalizeScore helper as the per-pod pipeline —
-            # recomputed per iteration because the feasible mask shifts as
-            # nodes saturate
-            if w_taint:
-                total = total + w_taint * pl.normalize_score(
-                    taint_row, mask_t, reverse=True
+            taint_row = tables["taint_cnt"][cls]
+            nodeaff_row = tables["nodeaff_pref"][cls]
+
+            # -- domain model (mode-static) --
+            if mode == "spread":
+                spr = tables["spr"]
+                jj = jnp.maximum(spr["hard"][cls, 0], 0)
+                dom_row = spr["dom"][jj]  # [N] (-1 = key missing)
+                hk = dom_row >= 0
+                dd = jnp.where(hk, dom_row, 0)
+                counted = spr["elig"][jj] & hk
+                base_cnt = st["spr_cnt"][jj]
+                skew_lim = spr["max_skew"][jj]
+                dom_present = (
+                    jops.segment_sum(
+                        counted.astype(jnp.int32), dd, num_segments=d_pad
+                    )
+                    > 0
                 )
-            if w_nodeaff:
-                total = total + w_nodeaff * pl.normalize_score(
-                    nodeaff_row, mask_t, reverse=False
+                dpad_local = d_pad
+            elif mode == "anti":
+                ipa = tables["ipa"]
+                jj = jnp.maximum(ipa["cls_req_anti"][cls, 0], 0)
+                dom_row = ipa["in_dom"][jj]
+                hk = dom_row >= 0
+                dd = jnp.where(hk, dom_row, 0)
+                # own symmetric ex term (host precondition: exactly one,
+                # same topology/domain row): its counts also block
+                ex_owned_row = cxs["ipa_ex_owned"][0]  # [Te]
+                ee = jnp.argmax(ex_owned_row > 0).astype(jnp.int32)
+                v_in = cxs["ipa_in_match"][0][jj]
+                v_ex = ex_owned_row[ee]
+                base_cnt = st["ipa_in"][jj] + st["ipa_ex"][ee]
+                dpad_local = ipa_d_pad
+
+            def domain_eval(m):
+                """(extra feasibility mask [N], quota_d [D], charged [N]).
+                charged=False nodes (missing key / not counted) affect no
+                domain totals and bypass quotas."""
+                if mode == "spread":
+                    cnt_now = jnp.where(counted, base_cnt + m, 0)
+                    dc = jops.segment_sum(cnt_now, dd, num_segments=dpad_local)
+                    mn = jnp.min(
+                        jnp.where(dom_present, dc, jnp.int32(2**30))
+                    )
+                    node_dc = dc[dd]
+                    ok = hk & (node_dc + 1 - mn <= skew_lim)
+                    quota_d = jnp.clip(mn + skew_lim - dc, 0, group)
+                    return ok, quota_d, counted
+                if mode == "anti":
+                    cnt_now = jnp.where(
+                        hk, base_cnt + (v_in + v_ex) * m, 0
+                    )
+                    dc = jops.segment_sum(cnt_now, dd, num_segments=dpad_local)
+                    node_dc = dc[dd]
+                    ok = (~hk) | (node_dc == 0)
+                    quota_d = jnp.where(dc == 0, 1, 0).astype(jnp.int32)
+                    return ok, quota_d, hk
+                ones_d = jnp.ones(1, dtype=jnp.int32)
+                return (
+                    jnp.ones(n, dtype=bool),
+                    ones_d,
+                    jnp.zeros(n, dtype=bool),
                 )
-            return jnp.where(mask_t, total, -1), mask_t
 
-        m0 = jnp.zeros(n, dtype=jnp.int32)
-        asg0 = jnp.full(group, -1, dtype=jnp.int32)
-        iota_g = jnp.arange(group, dtype=jnp.int32)
-
-        if tie_break == TIE_RANDOM:
-            # Multi-placement: in one iteration place up to q identical pods
-            # on q DISTINCT tie-set nodes. Sequentially valid because a
-            # placement only changes its own node's column, so every not-yet-
-            # chosen tie node is still in the (random) tie set when its pod
-            # arrives; nodes that would saturate (leave the feasible mask and
-            # so shift DefaultNormalizeScore for later pods) are excluded
-            # and handled by a single fallback placement. Terminates: each
-            # iteration places >= 1 pod or proves infeasibility.
-            def cond(state):
-                m, asg, placed, k = state
-                return placed < vcnt
-
-            def body(state):
-                m, asg, placed, k = state
-                total, mask_t = scores_at(m)
-                best = jnp.max(total)
-                feasible = best >= 0
-                tie = (total == best) & mask_t
-                # a node is multi-place eligible only if its placement
-                # cannot perturb later pods in this iteration: it must not
-                # saturate (mask/normalization would shift), and its frontier
-                # score must not INCREASE (BalancedAllocation can rise as a
-                # node fills; the node would become a strict max and the
-                # sequential process would be forced to re-pick it). The
-                # normalization terms are per-node constants while the mask
-                # is stable, so comparing raw frontier rows suffices.
-                f_now = jnp.take_along_axis(
+            def scores_at(m, extra_ok):
+                mask_t = (m < cap) & extra_ok
+                f = jnp.take_along_axis(
                     s_table, jnp.clip(m, 0, group - 1)[None, :], axis=0
                 )[0]
-                next_f = jnp.take_along_axis(
-                    s_table, jnp.clip(m + 1, 0, group - 1)[None, :], axis=0
-                )[0]
-                eligible = tie & ((m + 1) < cap) & (next_f <= f_now)
+                total = f
+                # DefaultNormalizeScore, recomputed per iteration because
+                # the feasible mask shifts as nodes saturate. In quota
+                # modes the host precondition makes these rows all-zero,
+                # so the terms are the same constant on every node — they
+                # cannot move an argmax and are skipped at trace time
+                # (normalize costs a real per-iteration int division).
+                if mode is None:
+                    if w_taint:
+                        total = total + w_taint * pl.normalize_score(
+                            taint_row, mask_t, reverse=True
+                        )
+                    if w_nodeaff:
+                        total = total + w_nodeaff * pl.normalize_score(
+                            nodeaff_row, mask_t, reverse=False
+                        )
+                return jnp.where(mask_t, total, -1), mask_t
 
-                k, s1, s2 = jax.random.split(k, 3)
-                r = jax.random.uniform(s1, (n,))
-                order = jnp.argsort(jnp.where(eligible, r, 2.0)).astype(
-                    jnp.int32
-                )  # [N]
-                n_elig = jnp.sum(eligible.astype(jnp.int32))
-                q = jnp.minimum(n_elig, vcnt - placed)
+            m0 = jnp.zeros(n, dtype=jnp.int32)
+            asg0 = jnp.full(group, -1, dtype=jnp.int32)
+            iota_g = jnp.arange(group, dtype=jnp.int32)
 
-                # q == 0 but feasible: single placement on one tie node
-                # (possibly saturating — next iteration re-normalizes)
-                csum = jnp.cumsum(tie)
-                pick_rank = (
-                    jax.random.randint(s2, (), 0, 1 << 30)
-                    % jnp.maximum(csum[-1], 1)
+            if tie_break == TIE_RANDOM:
+                # Multi-placement (see _solve_grouped docstring for the
+                # validity argument per mode). Terminates: each iteration
+                # places >= 1 pod or proves infeasibility.
+                def cond(state):
+                    m, asg, placed, k = state
+                    return placed < vcnt
+
+                def body(state):
+                    m, asg, placed, k = state
+                    extra_ok, quota_d, charged = domain_eval(m)
+                    total, mask_t = scores_at(m, extra_ok)
+                    best = jnp.max(total)
+                    feasible = best >= 0
+                    tie = (total == best) & mask_t
+                    # Node-local multi-place eligibility differs by mode:
+                    # - plain: a chosen node must stay in the mask with a
+                    #   non-increasing frontier, else DefaultNormalizeScore
+                    #   and the tie set shift for later pods this iteration.
+                    # - anti: a placed node's domain becomes quota-blocked,
+                    #   removing it from the mask — its risen frontier can
+                    #   never out-tie later pods, so tie alone suffices.
+                    # - spread: a placed node may STAY in the mask (domain
+                    #   quota remaining), so the frontier-rise exclusion is
+                    #   still required; saturation is harmless (constant
+                    #   normalize rows by host precondition).
+                    if mode is None:
+                        f_now = jnp.take_along_axis(
+                            s_table, jnp.clip(m, 0, group - 1)[None, :], axis=0
+                        )[0]
+                        next_f = jnp.take_along_axis(
+                            s_table,
+                            jnp.clip(m + 1, 0, group - 1)[None, :],
+                            axis=0,
+                        )[0]
+                        eligible = tie & ((m + 1) < cap) & (next_f <= f_now)
+                    elif mode == "spread":
+                        f_now = jnp.take_along_axis(
+                            s_table, jnp.clip(m, 0, group - 1)[None, :], axis=0
+                        )[0]
+                        next_f = jnp.take_along_axis(
+                            s_table,
+                            jnp.clip(m + 1, 0, group - 1)[None, :],
+                            axis=0,
+                        )[0]
+                        eligible = tie & (next_f <= f_now)
+                    else:  # anti
+                        eligible = tie
+
+                    k, s1, s2 = jax.random.split(k, 3)
+                    if mode is None:
+                        r = jax.random.uniform(s1, (n,))
+                        accept = eligible
+                        order = jnp.argsort(
+                            jnp.where(accept, r, 2.0)
+                        ).astype(jnp.int32)
+                        n_acc = jnp.sum(accept.astype(jnp.int32))
+                        q = jnp.minimum(n_acc, vcnt - placed)
+                    else:
+                        ec = eligible & charged
+                        rb = (
+                            jax.random.randint(
+                                s1, (n,), 0, 1 << 20, dtype=jnp.int32
+                            ).astype(jnp.int64)
+                            * n
+                            + iota_n
+                        )  # unique per-node random keys
+                        if mode == "spread":
+                            # WATER-FILL: when every present domain sits at
+                            # the same count (totally balanced — the steady
+                            # state of a spread workload) and no
+                            # skew-blocked node could strictly out-score
+                            # today's best after re-entering, k full
+                            # ROUNDS are sequentially valid at once: the
+                            # round-robin replay keeps the profile within
+                            # 1 of balanced at every step, so each
+                            # placement's skew bound holds for any
+                            # maxSkew >= 1, and mask changes can only add
+                            # ties or remove non-chosen nodes.
+                            seg_elig = jops.segment_sum(
+                                ec.astype(jnp.int32),
+                                dd,
+                                num_segments=dpad_local,
+                            )
+                            d_present = jnp.sum(
+                                dom_present.astype(jnp.int32)
+                            )
+                            dc_now = jops.segment_sum(
+                                jnp.where(counted, base_cnt + m, 0),
+                                dd,
+                                num_segments=dpad_local,
+                            )
+                            mx_dc = jnp.max(
+                                jnp.where(dom_present, dc_now, -1)
+                            )
+                            mn_dc = jnp.min(
+                                jnp.where(dom_present, dc_now, 2**30)
+                            )
+                            blocked_over = jnp.any(
+                                (m < cap)
+                                & hk
+                                & ~extra_ok
+                                & (f_now > best)
+                            )
+                            kk = jnp.minimum(
+                                jnp.min(
+                                    jnp.where(
+                                        dom_present, seg_elig, 2**30
+                                    )
+                                ),
+                                (vcnt - placed)
+                                // jnp.maximum(d_present, 1),
+                            )
+                            waterfill = (
+                                (mx_dc == mn_dc)
+                                & ~blocked_over
+                                & (kk >= 1)
+                            )
+                        else:
+                            waterfill = jnp.bool_(False)
+                            kk = jnp.int32(0)
+
+                        def wf_accept(_):
+                            # one sort per iteration, amortized over k*D
+                            # placements: rank eligible nodes within their
+                            # domain by random key, accept rank < k.
+                            # POSITIONS interleave domains round-robin
+                            # (round r of every present domain before
+                            # round r+1 of any) — the emitted assignment
+                            # order IS the sequential replay order, and
+                            # only the interleaved order keeps every
+                            # step's skew bound valid.
+                            keyf = jnp.where(
+                                ec,
+                                dd.astype(jnp.float32) * 2.0
+                                + jax.random.uniform(s1, (n,)),
+                                jnp.float32(jnp.inf),
+                            )
+                            si = jnp.argsort(keyf)
+                            sd = dd[si]
+                            elig_s = ec[si]
+                            is_start = elig_s & (
+                                (iota_n == 0) | (sd != jnp.roll(sd, 1))
+                            )
+                            start_pos = jax.lax.associative_scan(
+                                jnp.maximum,
+                                jnp.where(is_start, iota_n, -1),
+                            )
+                            rank = iota_n - start_pos
+                            accept_s = elig_s & (rank < kk)
+                            accept = (
+                                jnp.zeros(n, dtype=bool)
+                                .at[si]
+                                .set(accept_s)
+                            )
+                            d_rank = (
+                                jnp.cumsum(dom_present.astype(jnp.int32))
+                                - 1
+                            )
+                            rank_n = (
+                                jnp.zeros(n, dtype=jnp.int32)
+                                .at[si]
+                                .set(rank.astype(jnp.int32))
+                            )
+                            pos = rank_n * d_present + d_rank[dd]
+                            return accept, pos.astype(jnp.int32)
+
+                        def winner_accept(_):
+                            # sort-free single-round selection: one
+                            # segment_max winner per domain with quota
+                            # (TPU sorts cost ~1 ms per [5k] vector; the
+                            # 1-3 placements of an unbalanced iteration
+                            # can't amortize one)
+                            seg_key = jops.segment_max(
+                                jnp.where(ec, rb, -1),
+                                dd,
+                                num_segments=dpad_local,
+                            )
+                            if mode == "spread":
+                                # re-entry gate for maxSkew > 1 (min may
+                                # rise mid-iteration; maxSkew == 1 places
+                                # only into distinct current-min domains)
+                                blocked_high = jnp.any(
+                                    (m < cap)
+                                    & hk
+                                    & ~extra_ok
+                                    & (f_now >= best)
+                                )
+                                quota_eff = jnp.where(
+                                    (skew_lim > 1) & blocked_high,
+                                    0,
+                                    quota_d,
+                                )
+                            else:
+                                quota_eff = quota_d
+                            win = (
+                                ec
+                                & (rb == seg_key[dd])
+                                & (quota_eff[dd] >= 1)
+                            )
+                            # uncharged nodes affect no totals: no quota.
+                            # Single-round placements are order-free (each
+                            # accepted node sits in a distinct domain
+                            # within old-min quota), so index-order
+                            # positions via prefix sums are fine.
+                            acc = win | (eligible & ~charged)
+                            return acc, (
+                                jnp.cumsum(acc.astype(jnp.int32)) - 1
+                            ).astype(jnp.int32)
+
+                        # waterfill accepts EXACTLY k per present domain —
+                        # quota-free nodes would let the q-truncation cut
+                        # into the charged set unevenly, breaking the
+                        # round-robin replay; they place in later
+                        # iterations instead
+                        if mode == "spread":
+                            accept, pos_iter = jax.lax.cond(
+                                waterfill, wf_accept, winner_accept, None
+                            )
+                        else:
+                            accept, pos_iter = winner_accept(None)
+                        q = jnp.minimum(
+                            jnp.sum(accept.astype(jnp.int32)),
+                            vcnt - placed,
+                        )
+
+                    # q == 0 but feasible: single placement on one tie node
+                    # (possibly saturating — next iteration recomputes)
+                    csum = jnp.cumsum(tie)
+                    pick_rank = (
+                        jax.random.randint(s2, (), 0, 1 << 30)
+                        % jnp.maximum(csum[-1], 1)
+                    )
+                    pick = jnp.argmax(csum > pick_rank).astype(jnp.int32)
+
+                    multi = q > 0
+                    n_placed = jnp.where(
+                        feasible, jnp.where(multi, q, 1), 0
+                    ).astype(jnp.int32)
+
+                    if mode is None:
+                        chosen = jnp.where(
+                            multi,
+                            jnp.where(iota_g < q, order[:group], -1),
+                            jnp.where(iota_g < 1, pick, -1),
+                        )  # [G] node ids for this iteration's pods, -1 pad
+                        chosen = jnp.where(feasible, chosen, -1)
+                        pos = jnp.where(chosen >= 0, placed + iota_g, group)
+                        asg = asg.at[pos].set(chosen, mode="drop")
+                        m = m.at[jnp.where(chosen >= 0, chosen, n)].add(
+                            jnp.int32(1), mode="drop"
+                        )
+                    else:
+                        take = accept & (pos_iter < q) & multi & feasible
+                        idx_multi = jnp.where(
+                            take, placed + pos_iter, group
+                        )
+                        asg = asg.at[idx_multi].set(iota_n, mode="drop")
+                        single = (~multi) & feasible
+                        asg = asg.at[
+                            jnp.where(single, placed, group)
+                        ].set(pick, mode="drop")
+                        delta_m = take.astype(jnp.int32) + (
+                            jnp.zeros(n, dtype=jnp.int32)
+                            .at[pick]
+                            .set(jnp.int32(1))
+                            * single.astype(jnp.int32)
+                        )
+                        m = m + delta_m
+                    placed = jnp.where(feasible, placed + n_placed, vcnt)
+                    return m, asg, placed, k
+
+                m, asg, _, k = jax.lax.while_loop(
+                    cond, body, (m0, asg0, jnp.int32(0), k)
                 )
-                pick = jnp.argmax(csum > pick_rank).astype(jnp.int32)
+            else:
+                # Deterministic lowest-index tie-break: one placement per
+                # iteration, exactly the per-pod pipeline's argmax.
+                def body(t, acc):
+                    m, asg = acc
+                    extra_ok, _, _ = domain_eval(m)
+                    total, _ = scores_at(m, extra_ok)
+                    best = jnp.max(total)
+                    feasible = (best >= 0) & (t < vcnt)
+                    pick = jnp.argmax(total).astype(jnp.int32)
+                    m = m.at[pick].add(feasible.astype(jnp.int32))
+                    asg = asg.at[t].set(jnp.where(feasible, pick, -1))
+                    return m, asg
 
-                multi = q > 0
-                chosen = jnp.where(
-                    multi,
-                    jnp.where(iota_g < q, order[:group], -1),
-                    jnp.where(iota_g < 1, pick, -1),
-                )  # [G] node ids for this iteration's pods, -1 pad
-                chosen = jnp.where(feasible, chosen, -1)
-                n_placed = jnp.where(
-                    feasible, jnp.where(multi, q, 1), 0
-                ).astype(jnp.int32)
+                m, asg = jax.lax.fori_loop(0, group, body, (m0, asg0))
 
-                pos = jnp.where(chosen >= 0, placed + iota_g, group)
-                asg = asg.at[pos].set(chosen, mode="drop")
-                m = m.at[jnp.where(chosen >= 0, chosen, n)].add(
-                    jnp.int32(1), mode="drop"
-                )
-                placed = jnp.where(feasible, placed + n_placed, vcnt)
-                return m, asg, placed, k
-
-            m, asg, _, k = jax.lax.while_loop(
-                cond, body, (m0, asg0, jnp.int32(0), k)
+            d = m.astype(alloc.dtype)
+            st = dict(
+                st,
+                used=st["used"] + req[:, None] * d[None, :],
+                nonzero_used=st["nonzero_used"] + nz[:, None] * d[None, :],
+                pod_count=st["pod_count"] + m,
+                port_used=st["port_used"] + takes[:, None] * m[None, :],
             )
-        else:
-            # Deterministic lowest-index tie-break: one placement per
-            # iteration, exactly the per-pod pipeline's argmax.
-            def body(t, acc):
-                m, asg = acc
-                total, _ = scores_at(m)
-                best = jnp.max(total)
-                feasible = (best >= 0) & (t < vcnt)
-                pick = jnp.argmax(total).astype(jnp.int32)
-                m = m.at[pick].add(feasible.astype(jnp.int32))
-                asg = asg.at[t].set(jnp.where(feasible, pick, -1))
-                return m, asg
+            # family occupancy updates (rows are zero for neutral chunks,
+            # making these no-ops for kind-1 chunks in active batches)
+            if use_spread:
+                st["spr_cnt"] = st["spr_cnt"] + cxs["spr_placed"][0].astype(
+                    jnp.int32
+                )[:, None] * m[None, :]
+            if use_interpod:
+                st["ipa_in"] = st["ipa_in"] + cxs["ipa_in_match"][0][
+                    :, None
+                ] * m[None, :]
+                st["ipa_ex"] = st["ipa_ex"] + cxs["ipa_ex_owned"][0][
+                    :, None
+                ] * m[None, :]
+            return st, k, asg
 
-            m, asg = jax.lax.fori_loop(0, group, body, (m0, asg0))
+        return fast_chunk
 
-        d = m.astype(alloc.dtype)
-        st = dict(
-            st,
-            used=st["used"] + req[:, None] * d[None, :],
-            nonzero_used=st["nonzero_used"] + nz[:, None] * d[None, :],
-            pod_count=st["pod_count"] + m,
-            port_used=st["port_used"] + takes[:, None] * m[None, :],
-        )
-        return st, k, asg
+    branches = [slow_chunk, make_fast(None)]
+    branches.append(make_fast("spread") if use_spread else branches[1])
+    branches.append(make_fast("anti") if use_interpod else branches[1])
 
     def chunk_step(carry, x):
         st, k = carry
-        cxs, uni = x
-        st, k, asg = jax.lax.cond(uni, fast_chunk, slow_chunk, st, k, cxs)
+        cxs, kind = x
+        st, k, asg = jax.lax.switch(kind, branches, st, k, cxs)
         return (st, k), asg
 
     p = next(iter(xs.values())).shape[0]
@@ -611,7 +938,7 @@ def _solve_grouped(
         lambda a: a.reshape((p // group, group) + a.shape[1:]), xs
     )
     (state, _), assignments = jax.lax.scan(
-        chunk_step, (state0, key), (cxs_all, uniform)
+        chunk_step, (state0, key), (cxs_all, kinds)
     )
     return assignments.reshape(p), state
 
@@ -641,7 +968,7 @@ def _run_packed(
     xi64,  # [P, *] int64 packed per-pod inputs
     xi32,  # [P, *] int32
     xbool,  # [P, *] bool
-    uniform,  # [P // group] bool (grouped) or [1] dummy
+    kinds,  # [P // group] int32 chunk kinds (grouped) or [1] dummy
     nom_used,  # [L+1, K, N] int64 cumulative nominated load ([1,1,1] unused)
     key,
     *,
@@ -670,7 +997,7 @@ def _run_packed(
         xs[name] = a[:, 0] if squeeze else a
     if grouped:
         assignments, state = _solve_grouped(
-            tables, state0, xs, uniform, key, group=group, **kw
+            tables, state0, xs, kinds, key, group=group, **kw
         )
     else:
         assignments, state = _solve_scan(tables, state0, xs, key, **kw)
@@ -1072,14 +1399,19 @@ class ExactSolver:
         grouped = grouped_eligible(
             cfg, pods.padded, nodes.padded, use_spread, use_interpod,
             use_nominated,
+            spread_groupable=not spread.has_soft,
+            interpod_groupable=interpod.anti_only,
         )
         if grouped:
-            uniform = jnp.asarray(
-                self._uniform_chunks(pods, static, ports, group)
+            kinds = jnp.asarray(
+                self._chunk_kinds(
+                    pods, static, ports, spread, interpod, group,
+                    use_spread, use_interpod,
+                )
             )
         else:
             group = 1
-            uniform = jnp.zeros(1, dtype=bool)
+            kinds = jnp.zeros(1, dtype=jnp.int32)
 
         assignments, new_persist = _run_packed_jit(
             nt,
@@ -1089,7 +1421,7 @@ class ExactSolver:
             jnp.asarray(xi64),
             jnp.asarray(xi32),
             jnp.asarray(xbool),
-            uniform,
+            kinds,
             jnp.asarray(nom_used),
             key,
             bspec=tuple(bspec),
@@ -1109,14 +1441,25 @@ class ExactSolver:
         return np.asarray(assignments)[: pods.num_pods]
 
     @staticmethod
-    def _uniform_chunks(
-        pods: PodBatch, static: StaticPluginTensors, ports: PortTensors,
+    def _chunk_kinds(
+        pods: PodBatch,
+        static: StaticPluginTensors,
+        ports: PortTensors,
+        spread: SpreadTensors,
+        interpod: InterpodTensors,
         group: int,
+        use_spread: bool,
+        use_interpod: bool,
     ) -> np.ndarray:
-        """[P // group] bool — chunk g consists of `group` consecutive pods
-        that are identical for scheduling purposes (same class, requests,
-        port rows) and all valid. Vectorized host-side; the device fast
-        path relies on this exactly."""
+        """[P // group] int32 chunk dispatch for _solve_grouped:
+        0 slow / 1 plain fast / 2 spread fast / 3 anti fast.
+
+        A fast kind requires `group` consecutive IDENTICAL valid pods
+        (class, requests, port rows, and — when active — the spread/
+        interpod per-pod rows). Kind 2/3 additionally require the single-
+        constraint, zero-preference-row shapes whose sequential validity
+        the device branches prove (see _solve_grouped); anything else is
+        kind 0 and replays the full per-pod pipeline."""
         gn = pods.padded // group
 
         def same(arr: np.ndarray) -> np.ndarray:
@@ -1126,15 +1469,126 @@ class ExactSolver:
         valid = pods.valid & pods.feasible_static
         vchunk = valid.reshape(gn, group)
         uniform = vchunk.all(axis=1)
-        for arr in (
+        arrays = [
             np.asarray(static.class_of),
             pods.req,
             pods.req_mask,
             pods.nonzero_req,
             np.asarray(ports.pod_conflict),
             np.asarray(ports.pod_takes),
-        ):
+        ]
+        if use_spread:
+            arrays.append(np.asarray(spread.placed_match))
+        if use_interpod:
+            arrays += [
+                np.asarray(interpod.in_match),
+                np.asarray(interpod.ex_owned),
+                np.asarray(interpod.m_anti),
+                np.asarray(interpod.m_w),
+                np.asarray(interpod.self_aff)[:, None],
+            ]
+        for arr in arrays:
             uniform &= same(arr)
-        # all-padding chunks (fixed-bucket pod padding) are trivially
-        # "uniform": the fast path sees vcnt == 0 and places nothing
-        return uniform | ~vchunk.any(axis=1)
+        padding = ~vchunk.any(axis=1)
+
+        kinds = np.zeros(gn, dtype=np.int32)
+        # all-padding chunks are trivially fast: vcnt == 0 places nothing
+        kinds[padding] = 1
+        if not (use_spread or use_interpod):
+            kinds[uniform] = 1
+            return kinds
+
+        class_of = np.asarray(static.class_of)
+        taint = np.asarray(static.taint_cnt)
+        nodeaff = np.asarray(static.nodeaff_pref)
+        # hoist tensor->ndarray conversions out of the per-chunk loop
+        if use_spread:
+            spr_hard = np.asarray(spread.hard)
+            spr_soft = np.asarray(spread.soft)
+            spr_placed = np.asarray(spread.placed_match)
+            spr_min_dom = np.asarray(spread.min_domains)
+        if use_interpod:
+            ipa_anti = np.asarray(interpod.cls_req_anti)
+            ipa_aff = np.asarray(interpod.cls_req_aff)
+            ipa_pref = np.asarray(interpod.cls_pref)
+            ipa_in_m = np.asarray(interpod.in_match)
+            ipa_ex_o = np.asarray(interpod.ex_owned)
+            ipa_m_anti = np.asarray(interpod.m_anti)
+            ipa_m_w = np.asarray(interpod.m_w)
+            ipa_ex_anti = np.asarray(interpod.ex_anti)
+            ipa_in_dom = np.asarray(interpod.in_dom)
+            ipa_ex_dom = np.asarray(interpod.ex_dom)
+        first = np.arange(gn) * group  # first pod index per chunk
+        for g in np.nonzero(uniform & ~padding)[0]:
+            i = int(first[g])
+            c = int(class_of[i])
+            no_pref_rows = not taint[c].any() and not nodeaff[c].any()
+
+            if use_spread:
+                hard_row = spr_hard[c]
+                soft_row = spr_soft[c]
+                placed_row = spr_placed[i]
+                spr_neutral = (
+                    (hard_row < 0).all()
+                    and (soft_row < 0).all()
+                    and not placed_row.any()
+                )
+                j = int(hard_row[0])
+                spr_fast = (
+                    j >= 0
+                    and (hard_row[1:] < 0).all()
+                    and (soft_row < 0).all()
+                    and no_pref_rows
+                    and bool(placed_row[j])
+                    and not placed_row[np.arange(len(placed_row)) != j].any()
+                    and int(spr_min_dom[j]) < 0
+                )
+            else:
+                spr_neutral, spr_fast = True, False
+
+            if use_interpod:
+                anti_row = ipa_anti[c]
+                aff_row = ipa_aff[c]
+                pref_row = ipa_pref[c]
+                in_m = ipa_in_m[i]
+                ex_o = ipa_ex_o[i]
+                m_anti = ipa_m_anti[i]
+                m_w = ipa_m_w[i]
+                ipa_neutral = (
+                    (anti_row < 0).all()
+                    and (aff_row < 0).all()
+                    and (pref_row < 0).all()
+                    and not in_m.any()
+                    and not ex_o.any()
+                    and not m_anti.any()
+                    and not m_w.any()
+                )
+                j = int(anti_row[0])
+                ex_idx = np.nonzero(ex_o)[0]
+                ipa_fast = (
+                    j >= 0
+                    and (anti_row[1:] < 0).all()
+                    and (aff_row < 0).all()
+                    and (pref_row < 0).all()
+                    and no_pref_rows
+                    and not m_w.any()
+                    and in_m[j] > 0
+                    and not in_m[np.arange(len(in_m)) != j].any()
+                    and len(ex_idx) == 1
+                    and bool(m_anti[ex_idx[0]])
+                    and m_anti.sum() == 1
+                    and bool(ipa_ex_anti[ex_idx[0]])
+                    and np.array_equal(
+                        ipa_in_dom[j], ipa_ex_dom[ex_idx[0]]
+                    )
+                )
+            else:
+                ipa_neutral, ipa_fast = True, False
+
+            if spr_fast and ipa_neutral:
+                kinds[g] = 2
+            elif ipa_fast and spr_neutral:
+                kinds[g] = 3
+            elif spr_neutral and ipa_neutral:
+                kinds[g] = 1
+        return kinds

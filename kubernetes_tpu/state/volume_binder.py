@@ -54,12 +54,13 @@ class VolumeBinder:
         if not pod.pvc_names:
             return False
         pvcs = {c.key: c for c in self.cluster.list_pvcs()}
+        # one mutable context: assumed PVs are removed as claims take them,
+        # so multi-claim pods never share a PV and nothing is copied per
+        # claim
         ctx = VolumeContext(
             pvs={pv.name: pv for pv in self.cluster.list_pvs()},
-            pvcs=pvcs,
         )
         assumptions: list[_Assumption] = []
-        taken: set[str] = set()  # PVs assumed for earlier claims of this pod
         for claim_name in pod.pvc_names:
             key = f"{pod.namespace}/{claim_name}"
             pvc = pvcs.get(key)
@@ -67,23 +68,14 @@ class VolumeBinder:
                 raise VolumeBindingError(f"claim {key} not found")
             if pvc.volume_name:
                 continue  # already bound — nothing to assume
-            # find_matching_pv already prefers the smallest adequate PV;
-            # multi-claim pods just exclude PVs taken by earlier claims
-            pv = find_matching_pv(
-                VolumeContext(
-                    pvs={
-                        n: v for n, v in ctx.pvs.items() if n not in taken
-                    },
-                ),
-                pvc,
-                node,
-            )
+            # find_matching_pv already prefers the smallest adequate PV
+            pv = find_matching_pv(ctx, pvc, node)
             if pv is None:
                 raise VolumeBindingError(
                     f"claim {key}: no matching PersistentVolume on "
                     f"node {node.name}"
                 )
-            taken.add(pv.name)
+            del ctx.pvs[pv.name]  # later claims of this pod can't reuse it
             assumptions.append(_Assumption(pvc=pvc, pv=pv))
         if assumptions:
             self._assumed[pod.key] = assumptions

@@ -99,6 +99,14 @@ class InterpodTensors:
         in the batch: the scoring section is statically all-zero."""
         return bool((self.in_pref_w != 0).any() or (self.m_w != 0).any())
 
+    @property
+    def anti_only(self) -> bool:
+        """True when the batch carries required ANTI-affinity only — no
+        required affinity, no preferred terms anywhere. The shape the
+        grouped solver's quota fast path can handle (solver/exact.py
+        _chunk_kinds refines per chunk)."""
+        return bool((self.cls_req_aff < 0).all()) and not self.has_score
+
 
 def trivial_interpod_tensors(
     pbatch: PodBatch, padded_n: int, c_pad: int
