@@ -130,6 +130,11 @@ def main(argv: list[str] | None = None) -> int:
         '"SchedulerQueueingHints=false,PodSchedulingReadiness=true"',
     )
     parser.add_argument(
+        "--trace-dir",
+        help="write jax.profiler TensorBoard traces of device solves here "
+        "(SURVEY §6.1; the --profiling analog)",
+    )
+    parser.add_argument(
         "--leader-elect",
         action="store_true",
         help="accepted for config parity; single-process build ignores it",
@@ -173,6 +178,13 @@ def main(argv: list[str] | None = None) -> int:
             "warning: --leader-elect ignored (single-process build)",
             file=sys.stderr,
         )
+    if args.trace_dir:
+        import atexit
+
+        from .utils import tracing
+
+        tracing.enable(args.trace_dir)
+        atexit.register(tracing.stop)
     return args.fn(args)
 
 

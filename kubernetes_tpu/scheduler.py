@@ -253,7 +253,13 @@ class Scheduler:
         Holds the cluster RLock for the whole cycle: queue/cache mutate via
         watch events (fired under that lock), so the serve path's ingest
         and gRPC threads are serialized against pop -> solve -> bind."""
+        from .utils import tracing
+
         with self.cluster.lock:
+            if tracing.enabled():
+                self._trace_step = getattr(self, "_trace_step", 0) + 1
+                with tracing.step("schedule_batch", self._trace_step):
+                    return self._schedule_batch_locked()
             return self._schedule_batch_locked()
 
     def _schedule_batch_locked(self) -> BatchResult:

@@ -1,0 +1,55 @@
+"""Profiling traces — SURVEY §6.1's TPU equivalent of the reference's
+utiltrace step-traces + pprof: `jax.profiler` TensorBoard traces around
+device solves, plus the per-stage wall-time histograms the metrics module
+already exports under the reference's names.
+
+Enable with `--trace-dir DIR` on `serve`/`perf` (or programmatically via
+``enable(dir)``): each schedule_batch runs inside a
+``jax.profiler.StepTraceAnnotation`` and the whole session's device
+activity lands in DIR as a TensorBoard trace
+(`tensorboard --logdir DIR` → Profile tab). Tracing is off by default —
+the profiler's overhead belongs in a debugging session, not the hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+_trace_dir: str | None = None
+_started = False
+
+
+def enable(trace_dir: str) -> None:
+    global _trace_dir
+    _trace_dir = trace_dir
+
+
+def enabled() -> bool:
+    return _trace_dir is not None
+
+
+@contextlib.contextmanager
+def step(name: str, step_num: int = 0):
+    """Annotate one scheduling batch; starts the session trace lazily on
+    first use so importing this module never touches the profiler."""
+    global _started
+    if _trace_dir is None:
+        yield
+        return
+    import jax
+
+    if not _started:
+        jax.profiler.start_trace(_trace_dir)
+        _started = True
+    with jax.profiler.StepTraceAnnotation(name, step_num=step_num):
+        yield
+
+
+def stop() -> None:
+    """Flush the session trace (atexit-safe: no-op when never started)."""
+    global _started
+    if _started:
+        import jax
+
+        jax.profiler.stop_trace()
+        _started = False

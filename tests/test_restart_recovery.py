@@ -1,0 +1,84 @@
+"""Crash/restart statelessness (SURVEY §6.3): the scheduler holds no
+durable state — a fresh Scheduler over the same ClusterState resyncs via
+the initial informer sync and continues correctly, including in-flight
+preemption intent persisted in pod.status.nominatedNodeName."""
+
+import tempfile
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils import tracing
+
+
+def _cfg():
+    return SchedulerConfig(solver=ExactSolverConfig(tie_break="first"))
+
+
+def test_restart_resumes_pending_and_nominations():
+    clock = FakeClock()
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n").capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj()
+    )
+    s1 = Scheduler(cs, _cfg(), clock=clock)
+
+    # schedule one pod, preempt for another, then "crash" (drop s1)
+    victim = MakePod().name("victim").priority(0).req({"cpu": "2"}).obj()
+    cs.create_pod(victim)
+    cs.bind("default", "victim", "n")
+    cs.create_pod(MakePod().name("preemptor").priority(10).req({"cpu": "2"}).obj())
+    r = s1.schedule_batch()
+    assert r.preemptions
+    assert cs.get_pod("default", "preemptor").nominated_node_name == "n"
+
+    # restart: a NEW scheduler over the same cluster state must pick up the
+    # pending preemptor (initial sync), honor its persisted nomination, and
+    # protect it from a thief that arrived during the outage
+    cs.create_pod(MakePod().name("thief").priority(1).req({"cpu": "2"}).obj())
+    clock.advance(30.0)
+    s2 = Scheduler(cs, _cfg(), clock=clock)
+    assert "default/preemptor" in s2.nominated_pods
+    r = s2.schedule_batch()
+    placed = dict(r.scheduled)
+    assert placed.get("default/preemptor") == "n"
+    assert "default/thief" in r.unschedulable
+
+
+def test_restart_reconstructs_bound_state():
+    """Bound pods re-enter the cache on restart: a full node stays full."""
+    clock = FakeClock()
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n").capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj()
+    )
+    s1 = Scheduler(cs, _cfg(), clock=clock)
+    cs.create_pod(MakePod().name("a").req({"cpu": "2"}).obj())
+    assert dict(s1.schedule_batch().scheduled).get("default/a") == "n"
+
+    s2 = Scheduler(cs, _cfg(), clock=clock)
+    cs.create_pod(MakePod().name("b").req({"cpu": "2"}).obj())
+    r = s2.schedule_batch()
+    assert "default/b" in r.unschedulable or r.preemptions == []
+
+
+def test_tracing_wraps_schedule_batch(tmp_path):
+    """--trace-dir plumbing: enabling tracing must not change behavior and
+    must produce a trace directory when solves run."""
+    tracing.enable(str(tmp_path))
+    try:
+        clock = FakeClock()
+        cs = ClusterState()
+        cs.create_node(
+            MakeNode().name("n").capacity({"cpu": "4", "memory": "8Gi", "pods": "10"}).obj()
+        )
+        sched = Scheduler(cs, _cfg(), clock=clock)
+        cs.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+        r = sched.schedule_batch()
+        assert dict(r.scheduled).get("default/p") == "n"
+    finally:
+        tracing.stop()
+        tracing._trace_dir = None
+    assert any(tmp_path.iterdir())  # the profiler wrote a session dir
