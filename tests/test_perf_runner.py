@@ -19,6 +19,30 @@ def runner():
     )
 
 
+def test_shipped_performance_config_runs():
+    """The in-repo performance-config.yaml (the operator-facing
+    scheduler_perf DSL artifact) must parse and schedule its
+    SchedulingBasic workload end to end."""
+    import pathlib
+
+    import kubernetes_tpu.perf as perf_pkg
+
+    cfg = pathlib.Path(perf_pkg.__file__).parent / "performance-config.yaml"
+    results = runner().run_file(cfg, workload_filter="500Nodes")
+    basic = [r for r in results if r.test_case == "SchedulingBasic"]
+    assert basic and basic[0].scheduled == 1500
+    assert basic[0].unschedulable == 0
+    # every test case in the file must have executed its 500Nodes workload
+    assert {r.test_case for r in results} == {
+        "SchedulingBasic",
+        "SchedulingPodAntiAffinity",
+        "SchedulingPodTopologySpread",
+        "SchedulingWithMixedChurn",
+    }
+    anti = [r for r in results if r.test_case == "SchedulingPodAntiAffinity"][0]
+    assert anti.scheduled == 400
+
+
 def test_scheduling_basic_shape(tmp_path):
     cfg = write_config(
         tmp_path,
