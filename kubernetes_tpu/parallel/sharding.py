@@ -1,0 +1,80 @@
+"""Device-mesh sharding for the pods × nodes solve (SURVEY §6.7).
+
+The reference's only parallelism is a 16-goroutine parallel-for across
+nodes inside one pod's cycle (framework/parallelize/parallelism.go) plus
+node sampling and active/passive replication. The TPU framework's
+parallelism is the hardware kind: the NODE axis is this problem's
+"sequence/context" dimension, sharded over a `jax.sharding.Mesh` so per-
+step reductions (argmax, cumsum, segment sums) become XLA collectives over
+ICI — the scaling-book recipe: pick a mesh, annotate shardings, let GSPMD
+insert the collectives.
+
+Conventions (used by SingleShotSolver.solve(mesh=...), the exact scan's
+multichip dryrun, and tests/test_sharding.py):
+- node-resident arrays carry the node axis LAST -> P(None, "nodes") for
+  2-D tables, P("nodes") for 1-D columns;
+- per-pod / per-class / per-instance arrays replicate (they are small and
+  every shard needs them for its local mask/score block);
+- results are device-count invariant BIT-EXACTLY: integer score
+  arithmetic and stable reductions make sharded == unsharded, which the
+  tests assert on the 8-device virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NODE_AXIS = "nodes"
+
+
+def node_mesh(n_devices: int | None = None):
+    """A 1-D mesh over the node axis (the v5e-8 shape: 8 chips, ICI ring).
+
+    Uses the first ``n_devices`` visible devices (default: all)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), axis_names=(NODE_AXIS,))
+
+
+def node_sharding(mesh, ndim: int):
+    """NamedSharding for a node-resident array: node axis last."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if ndim == 1:
+        return NamedSharding(mesh, P(NODE_AXIS))
+    return NamedSharding(mesh, P(*([None] * (ndim - 1) + [NODE_AXIS])))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def shard_node_tree(mesh, tree, replicate_names: frozenset[str] = frozenset()):
+    """Map a pytree of arrays to shardings: arrays shard over their
+    trailing node axis unless their dict key is in ``replicate_names``
+    (per-class / per-instance tables without a node axis)."""
+    import jax.tree_util as jtu
+
+    repl = replicated(mesh)
+
+    def one(path, a):
+        key = path[-1].key if path and hasattr(path[-1], "key") else None
+        if key in replicate_names:
+            return repl
+        return node_sharding(mesh, np.ndim(a))
+
+    return jtu.tree_map_with_path(one, tree)
+
+
+def device_put_tree(tree, shardings):
+    """jax.device_put each leaf with its sharding."""
+    import jax
+    import jax.tree_util as jtu
+
+    return jtu.tree_map(jax.device_put, tree, shardings)

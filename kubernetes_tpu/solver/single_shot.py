@@ -314,21 +314,17 @@ class SingleShotSolver:
             pods.valid & pods.feasible_static,
         ]
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel.sharding import node_sharding, replicated
 
-            node_sharded = {0, 1, 5}  # trailing-node 2D arrays
-            node_1d = {2, 3, 4}
-            shardings = []
-            for i in range(len(args)):
-                if i in node_sharded:
-                    shardings.append(NamedSharding(mesh, P(None, "nodes")))
-                elif i in node_1d:
-                    shardings.append(NamedSharding(mesh, P("nodes")))
-                else:
-                    shardings.append(NamedSharding(mesh, P()))
+            node_axis_args = {0, 1, 2, 3, 4, 5}  # node-resident inputs
             args = [
-                jax.device_put(jnp.asarray(a), s)
-                for a, s in zip(args, shardings)
+                jax.device_put(
+                    jnp.asarray(a),
+                    node_sharding(mesh, np.ndim(a))
+                    if i in node_axis_args
+                    else replicated(mesh),
+                )
+                for i, a in enumerate(args)
             ]
         else:
             args = [jnp.asarray(a) for a in args]

@@ -107,6 +107,35 @@ def _single_shot_workload(n_nodes=1024, n_pods=768):
     return batch, pbatch
 
 
+def test_parallel_sharding_helpers(mesh):
+    """parallel/sharding.py: the mesh/spec helpers used by the solvers."""
+    from kubernetes_tpu.parallel.sharding import (
+        device_put_tree,
+        node_mesh,
+        node_sharding,
+        replicated,
+        shard_node_tree,
+    )
+
+    m = node_mesh(N_DEVICES)
+    assert m.axis_names == ("nodes",)
+    s2 = node_sharding(m, 2)
+    assert s2.spec == (None, "nodes")
+    s1 = node_sharding(m, 1)
+    assert s1.spec == ("nodes",)
+    assert replicated(m).spec == ()
+
+    tree = {
+        "alloc": np.zeros((3, 1024), np.int64),
+        "max_skew": np.ones(8, np.int32),
+    }
+    sh = shard_node_tree(m, tree, replicate_names=frozenset({"max_skew"}))
+    assert sh["alloc"].spec == (None, "nodes")
+    assert sh["max_skew"].spec == ()
+    placed = device_put_tree(tree, sh)
+    np.testing.assert_array_equal(np.asarray(placed["alloc"]), tree["alloc"])
+
+
 def test_single_shot_sharded_equals_unsharded(mesh):
     """The auction solver — the 50k x 10k rebalance engine, i.e. the actual
     v5e-8 workload — sharded over the node axis must commit the identical
