@@ -110,6 +110,8 @@ class TpuSolverSection:
     seed: int = 0
     balanced_fdtype: str = "float32"
     enable_preemption: bool = True
+    # grouped fast-path chunk size (ExactSolverConfig.group_size; 0 = off)
+    group_size: int = 64
     single_shot: SingleShotSection = field(default_factory=SingleShotSection)
 
 
@@ -248,6 +250,7 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
         seed=int(ts.get("seed") or 0),
         balanced_fdtype=ts.get("balancedFdtype") or "float32",
         enable_preemption=bool(ts.get("enablePreemption", True)),
+        group_size=int(ts.get("groupSize", 64)),
         single_shot=SingleShotSection(
             max_rounds=int(ss.get("maxRounds") or 32),
             price_step=int(ss.get("priceStep") or 8),
@@ -342,6 +345,7 @@ def _solver_config(cfg: KubeSchedulerConfiguration, p: Profile):
         tie_break=cfg.tpu_solver.tie_break,
         seed=cfg.tpu_solver.seed,
         balanced_fdtype=cfg.tpu_solver.balanced_fdtype,
+        group_size=cfg.tpu_solver.group_size,
         scoring_strategy=p.scoring_strategy.type,
         cpu_weight=res_weights["cpu"],
         mem_weight=res_weights["memory"],

@@ -80,7 +80,7 @@ class WorkloadResult:
 
     def throughput_summary(self) -> dict[str, float]:
         if not self.samples:
-            return {"avg": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
+            return {"avg": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0, "steady": 0.0}
         a = np.asarray(self.samples)
         return {
             "avg": float(
@@ -91,6 +91,10 @@ class WorkloadResult:
             "p50": float(np.percentile(a, 50)),
             "p90": float(np.percentile(a, 90)),
             "p99": float(np.percentile(a, 99)),
+            # cold-start honesty: the first measured batch usually carries
+            # the XLA compile; "steady" drops it so one CLI run shows both
+            # the cold and the warm story (bench.py warms explicitly)
+            "steady": float(a[1:].mean()) if len(a) > 1 else float(a[0]),
         }
 
 

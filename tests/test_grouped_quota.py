@@ -155,6 +155,33 @@ def test_anti_random_grouped_sequentially_valid(seed):
     assert len(set(int(x) for x in a)) == 24
 
 
+def test_quota_paths_valid_at_device_scale():
+    """Padding/bucketing edges at a realistic node count: 512 nodes x
+    mixed spread+anti chunks through the grouped solver, oracle-replayed
+    with sampled tie-set checks (every 8th step + every failure)."""
+    nodes = mk_nodes(512)
+    pods = mk_pods(4 * GROUP, "spread") + mk_pods(4 * GROUP, "anti")
+    a, nb = solve(nodes, pods, "random", GROUP)
+    a = np.asarray(a)
+    assert int((a >= 0).sum()) == len(pods)
+
+    oracle = FullOracle(make_oracle_nodes(nodes))
+    names = [nb.names[x] if x >= 0 else None for x in a]
+    sample = {i for i in range(len(pods)) if i % 8 == 0 or a[i] < 0}
+    errors = oracle.validate_assignments(
+        pods, list(a), names=names, sample=sample
+    )
+    assert not errors, "\n".join(errors[:5])
+    # invariants over the full assignment
+    zones = np.asarray(
+        [int(nb.names[x].split("-")[1]) % 3 for x in a[: 4 * GROUP]]
+    )
+    counts = np.bincount(zones, minlength=3)
+    assert counts.max() - counts.min() <= 1
+    anti_nodes = [int(x) for x in a[4 * GROUP :]]
+    assert len(set(anti_nodes)) == 4 * GROUP  # hostname exclusivity
+
+
 def test_anti_overload_marks_surplus_unschedulable():
     """More anti pods than nodes: exactly n_nodes place, the rest fail —
     and the grouped result agrees with the ungrouped scan's count."""
