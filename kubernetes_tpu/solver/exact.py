@@ -90,15 +90,17 @@ class ExactSolverConfig:
     hard_pod_affinity_weight: int = 1
     balanced_fdtype: str = "float32"  # float64 for bit-parity on CPU tests
     # Grouped fast path (§8.4 batched variant): chunk size for runs of
-    # identical pods; 0/1 disables. Only engages when spread/interpod are
-    # inactive for the batch (those couple scores across nodes).
-    # With tie_break="random" the grouped path samples q DISTINCT tie-set
-    # nodes per iteration (without replacement) while the per-pod scan
-    # samples ties with replacement: every grouped result is a sequentially
-    # valid outcome, but the placement DISTRIBUTION differs from the
-    # ungrouped solver for the same seed, so random-mode runs are not
-    # reproducible across group_size settings. tie_break="first" is
-    # bit-identical either way.
+    # identical pods; 0/1 disables. Engages for plain batches and — via
+    # the kind-2/3 quota chunks — for hard-only spread and anti-only
+    # interpod batches (grouped_eligible + _chunk_kinds hold the exact
+    # conditions); soft spread / preferred terms / nominated pods route
+    # through the per-pod scan. With tie_break="random" the grouped path
+    # places q DISTINCT tie-set nodes per iteration (without replacement)
+    # while the per-pod scan samples ties with replacement: every grouped
+    # result is a sequentially valid outcome, but the placement
+    # DISTRIBUTION differs from the ungrouped solver for the same seed, so
+    # random-mode runs are not reproducible across group_size settings.
+    # tie_break="first" is bit-identical either way.
     group_size: int = 64
     # plugins.filter.disabled for this profile (runtime/framework.go):
     # names whose Filter stage is skipped. Static-mask plugins are handled
@@ -551,9 +553,10 @@ def _solve_grouped(
                 dpad_local = ipa_d_pad
 
             def domain_eval(m):
-                """(extra feasibility mask [N], quota_d [D], charged [N]).
-                charged=False nodes (missing key / not counted) affect no
-                domain totals and bypass quotas."""
+                """(extra feasibility mask [N], quota_d [D], charged [N],
+                dc [D] current domain counts). charged=False nodes
+                (missing key / not counted) affect no domain totals and
+                bypass quotas."""
                 if mode == "spread":
                     cnt_now = jnp.where(counted, base_cnt + m, 0)
                     dc = jops.segment_sum(cnt_now, dd, num_segments=dpad_local)
@@ -563,7 +566,7 @@ def _solve_grouped(
                     node_dc = dc[dd]
                     ok = hk & (node_dc + 1 - mn <= skew_lim)
                     quota_d = jnp.clip(mn + skew_lim - dc, 0, group)
-                    return ok, quota_d, counted
+                    return ok, quota_d, counted, dc
                 if mode == "anti":
                     cnt_now = jnp.where(
                         hk, base_cnt + (v_in + v_ex) * m, 0
@@ -572,12 +575,13 @@ def _solve_grouped(
                     node_dc = dc[dd]
                     ok = (~hk) | (node_dc == 0)
                     quota_d = jnp.where(dc == 0, 1, 0).astype(jnp.int32)
-                    return ok, quota_d, hk
+                    return ok, quota_d, hk, dc
                 ones_d = jnp.ones(1, dtype=jnp.int32)
                 return (
                     jnp.ones(n, dtype=bool),
                     ones_d,
                     jnp.zeros(n, dtype=bool),
+                    ones_d,
                 )
 
             def scores_at(m, extra_ok):
@@ -617,7 +621,7 @@ def _solve_grouped(
 
                 def body(state):
                     m, asg, placed, k = state
-                    extra_ok, quota_d, charged = domain_eval(m)
+                    extra_ok, quota_d, charged, dc_now = domain_eval(m)
                     total, mask_t = scores_at(m, extra_ok)
                     best = jnp.max(total)
                     feasible = best >= 0
@@ -694,11 +698,8 @@ def _solve_grouped(
                             d_present = jnp.sum(
                                 dom_present.astype(jnp.int32)
                             )
-                            dc_now = jops.segment_sum(
-                                jnp.where(counted, base_cnt + m, 0),
-                                dd,
-                                num_segments=dpad_local,
-                            )
+                            # dc_now comes from this iteration's
+                            # domain_eval — no second segment_sum
                             mx_dc = jnp.max(
                                 jnp.where(dom_present, dc_now, -1)
                             )
@@ -887,7 +888,7 @@ def _solve_grouped(
                 # iteration, exactly the per-pod pipeline's argmax.
                 def body(t, acc):
                     m, asg = acc
-                    extra_ok, _, _ = domain_eval(m)
+                    extra_ok, _, _, _ = domain_eval(m)
                     total, _ = scores_at(m, extra_ok)
                     best = jnp.max(total)
                     feasible = (best >= 0) & (t < vcnt)
