@@ -1,0 +1,153 @@
+"""Scheduling Framework plugin interfaces — the in-process, extension-
+point-shaped API of SURVEY §8.2, mirroring
+pkg/scheduler/framework/interface.go so plugin code and plugin tests read
+like their upstream counterparts:
+
+- `Status` / `StatusCode` (interface.go#Status, #Code): Success,
+  Unschedulable, UnschedulableAndUnresolvable, Wait, Skip, Error;
+- `CycleState` (framework/cycle_state.go): per-pod keyed scratch with
+  read/write/clone;
+- plugin protocols named for their extension points (PreFilterPlugin,
+  FilterPlugin, ScorePlugin) with the upstream method shapes.
+
+Two consumption paths:
+1. `framework.runtime.Framework` runs the points host-side over API
+   objects — the fixture upstream plugin tests build with
+   runtime.NewFramework.
+2. Out-of-tree plugins plug into the TPU solve itself via
+   SchedulerConfig.out_of_tree_plugins: because the device pipeline is
+   class-vectorized, a custom plugin's Filter/Score run host-side once
+   per (pod scheduling class, node) and fold into the per-class static
+   mask / score tables the fused kernel already consumes — the TPU-shaped
+   equivalent of registering an in-process Go plugin. Contract for
+   solver-path plugins: depend only on node state plus the pod fields in
+   the scheduling-class identity — labels, annotations, and the in-tree
+   spec fields (selectors, affinity, tolerations, requests, ports,
+   spread) — never on other pending pods or on per-pod uniqueness like
+   the name (two pods identical in those fields share one verdict by
+   construction).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from ..api.objects import Node, Pod
+
+MAX_NODE_SCORE = 100  # interface.go#MaxNodeScore
+MIN_NODE_SCORE = 0
+
+
+class StatusCode(enum.IntEnum):
+    SUCCESS = 0
+    ERROR = 1
+    UNSCHEDULABLE = 2
+    UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+    WAIT = 4
+    SKIP = 5
+
+
+@dataclass(frozen=True)
+class Status:
+    code: StatusCode = StatusCode.SUCCESS
+    reasons: tuple[str, ...] = ()
+
+    @staticmethod
+    def success() -> "Status":
+        return Status()
+
+    @staticmethod
+    def unschedulable(*reasons: str) -> "Status":
+        return Status(StatusCode.UNSCHEDULABLE, tuple(reasons))
+
+    @staticmethod
+    def error(*reasons: str) -> "Status":
+        return Status(StatusCode.ERROR, tuple(reasons))
+
+    @property
+    def is_success(self) -> bool:
+        return self.code == StatusCode.SUCCESS
+
+    @property
+    def is_rejection(self) -> bool:
+        return self.code in (
+            StatusCode.UNSCHEDULABLE,
+            StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE,
+        )
+
+
+class CycleState:
+    """Per-scheduling-cycle keyed scratch (cycle_state.go#CycleState)."""
+
+    def __init__(self) -> None:
+        self._data: dict[str, Any] = {}
+
+    def read(self, key: str) -> Any:
+        if key not in self._data:
+            raise KeyError(key)  # cycle_state.go#ErrNotFound
+        return self._data[key]
+
+    def write(self, key: str, value: Any) -> None:
+        self._data[key] = value
+
+    def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+
+    def clone(self) -> "CycleState":
+        c = CycleState()
+        c._data = dict(self._data)
+        return c
+
+
+class Plugin:
+    """Base: every plugin has a Name (interface.go#Plugin)."""
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class PreFilterPlugin(Plugin):
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        return Status.success()
+
+
+class FilterPlugin(Plugin):
+    def filter(
+        self, state: CycleState, pod: Pod, node: Node,
+        placed: tuple[Pod, ...] = (),
+    ) -> Status:
+        """interface.go#FilterPlugin.Filter. ``placed`` carries the node's
+        resident pods (the NodeInfo view) for host-side runs; solver-path
+        plugins should ignore it (class-vectorized folding evaluates
+        against node state only)."""
+        raise NotImplementedError
+
+    def weight(self) -> int:  # parity with ScorePlugin for registries
+        return 0
+
+
+class ScorePlugin(Plugin):
+    def score(self, state: CycleState, pod: Pod, node: Node) -> int:
+        """interface.go#ScorePlugin.Score: 0..MAX_NODE_SCORE."""
+        raise NotImplementedError
+
+    def normalize_score(
+        self, state: CycleState, pod: Pod, scores: Mapping[str, int]
+    ) -> dict[str, int] | None:
+        """Optional ScoreExtensions#NormalizeScore: node name -> score.
+        Return None to keep raw scores."""
+        return None
+
+    def weight(self) -> int:
+        return 1
+
+
+@dataclass
+class Registry:
+    """plugins by extension point (runtime/registry.go shape)."""
+
+    pre_filter: list[PreFilterPlugin] = field(default_factory=list)
+    filter: list[FilterPlugin] = field(default_factory=list)
+    score: list[ScorePlugin] = field(default_factory=list)

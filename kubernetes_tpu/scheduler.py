@@ -58,6 +58,11 @@ class SchedulerConfig:
     profiles: dict[str, ExactSolverConfig] | None = None
     # component-base/featuregate analog (--feature-gates); None = defaults
     feature_gates: object = None
+    # out-of-tree Scheduling Framework plugins (framework/interface.py
+    # FilterPlugin / ScorePlugin): folded into the per-class device tables
+    # each batch (framework/runtime.py#fold_out_of_tree) — the in-process
+    # plugin registration point of SURVEY §8.2
+    out_of_tree_plugins: tuple = ()
 
 
 def _node_change_could_help(old, new) -> bool:
@@ -446,6 +451,24 @@ class Scheduler:
                     return None
                 return default_selector_key(p, services)
 
+        if self.config.out_of_tree_plugins:
+            # custom plugins read pod fields the in-tree class key doesn't
+            # cover (labels/annotations on spread-free pods): fold them
+            # into the class identity so two pods a plugin would treat
+            # differently never share one representative's verdicts.
+            # (Plugins must key off spec fields in the class identity —
+            # framework/interface.py documents the contract.)
+            base_extra = class_key_extra
+
+            def class_key_extra(p, _base=base_extra):
+                parts = (
+                    tuple(sorted(p.labels.items())),
+                    tuple(sorted(p.annotations.items())),
+                )
+                if _base is not None:
+                    return (parts, _base(p))
+                return parts
+
         static = _timed(
             "NodeAffinity",  # the static-mask family's dominant member
             build_static_tensors,
@@ -454,6 +477,20 @@ class Scheduler:
             added_affinity=solver.config.added_affinity,
             class_key_extra=class_key_extra,
         )
+        if self.config.out_of_tree_plugins:
+            # out-of-tree Scheduling Framework plugins: class-vectorized
+            # folding into the static mask / extra-score tables. A
+            # filter-only plugin set keeps extra_score=None so the fused
+            # kernel's extra-add (and its compile variant) never engages.
+            from .framework.runtime import fold_out_of_tree
+
+            extra = np.zeros(static.mask.shape, dtype=np.int32)
+            fold_out_of_tree(
+                self.config.out_of_tree_plugins, static.reps, slot_nodes,
+                static.mask, extra,
+            )
+            if extra.any():
+                static.extra_score = extra
         placed_by_slot: dict[int, list[Pod]] = {}
         if need_ports or need_spread or need_interpod:
             for slot, name in enumerate(self.snapshot.names):

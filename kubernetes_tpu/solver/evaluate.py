@@ -53,6 +53,7 @@ _PIPE_STATICS = (
     "spread_soft",
     "ipa_ident",
     "ipa_score",
+    "use_extra_score",
 )
 
 
@@ -193,6 +194,11 @@ class BatchEvaluator:
             "taint_cnt": jnp.asarray(static.taint_cnt),
             "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
             "image_score": jnp.asarray(static.image_score),
+            **(
+                {"extra_score": jnp.asarray(static.extra_score)}
+                if static.extra_score is not None
+                else {}
+            ),
             "spr": {
                 "dom": jnp.asarray(spread.dom),
                 "elig": jnp.asarray(spread.elig),
@@ -262,6 +268,7 @@ class BatchEvaluator:
             spread_soft=spread.has_soft,
             ipa_ident=interpod.ident,
             ipa_score=interpod.has_score,
+            use_extra_score=static.extra_score is not None,
         )
         scores = np.asarray(scores)[: pbatch.num_pods]
         # statically infeasible pods (unknown resource) never fit anywhere

@@ -199,6 +199,7 @@ def _mask_and_score(
     ipa_ident: bool = False,
     ipa_score: bool = True,
     use_nominated: bool = False,
+    use_extra_score: bool = False,
 ):
     """One pod's full filter+score pipeline over all nodes against node
     state ``st`` (runtime/framework.go#RunFilterPlugins + #RunScorePlugins,
@@ -277,6 +278,9 @@ def _mask_and_score(
         )
     if w_image:
         score = score + w_image * tables["image_score"][cls]
+    if use_extra_score:
+        # out-of-tree ScorePlugins, folded per class (weights pre-applied)
+        score = score + tables["extra_score"][cls]
     if use_spread and w_spread and spread_soft:
         score = score + w_spread * sp.soft_scores(
             spr, st["spr_cnt"], cls, mask, d_pad, fdtype=fdtype
@@ -447,6 +451,7 @@ def _solve_grouped(
 
     use_spread = kw["use_spread"]
     use_interpod = kw["use_interpod"]
+    use_extra = kw.get("use_extra_score", False)
     d_pad = kw["d_pad"]
     ipa_d_pad = kw["ipa_d_pad"]
     iota_n = jnp.arange(n, dtype=jnp.int32)
@@ -516,6 +521,10 @@ def _solve_grouped(
             s_table = s.astype(jnp.int32).reshape(group, n)
             if w_image:
                 s_table = s_table + w_image * tables["image_score"][cls][None, :]
+            if use_extra:
+                # out-of-tree scores are per-(class, node) constants, same
+                # shape as ImageLocality: fold into the frontier table
+                s_table = s_table + tables["extra_score"][cls][None, :]
 
             taint_row = tables["taint_cnt"][cls]
             nodeaff_row = tables["nodeaff_pref"][cls]
@@ -1036,6 +1045,7 @@ _run_packed_jit = jax.jit(
         "ipa_ident",
         "ipa_score",
         "use_nominated",
+        "use_extra_score",
     ),
     donate_argnums=(2,),
 )
@@ -1142,14 +1152,17 @@ class _DeviceSession:
         import hashlib
 
         h = hashlib.blake2b(digest_size=16)
-        for a in (
+        arrays = [
             static.mask, static.taint_cnt, static.nodeaff_pref,
             static.image_score, spread.dom, spread.elig, spread.max_skew,
             spread.min_domains, spread.self_match, spread.is_hostname,
             spread.hard, spread.soft, interpod.in_dom, interpod.in_pref_w,
             interpod.cls_req_aff, interpod.cls_req_anti, interpod.cls_pref,
             interpod.ex_dom, interpod.ex_anti,
-        ):
+        ]
+        if static.extra_score is not None:
+            arrays.append(static.extra_score)
+        for a in arrays:
             arr = np.ascontiguousarray(a)
             h.update(str(arr.shape).encode())
             h.update(arr.tobytes())
@@ -1163,6 +1176,11 @@ class _DeviceSession:
                 "taint_cnt": jnp.asarray(static.taint_cnt),
                 "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
                 "image_score": jnp.asarray(static.image_score),
+                **(
+                    {"extra_score": jnp.asarray(static.extra_score)}
+                    if static.extra_score is not None
+                    else {}
+                ),
                 "spr": {
                     "dom": jnp.asarray(spread.dom),
                     "elig": jnp.asarray(spread.elig),
@@ -1273,6 +1291,11 @@ class ExactSolver:
                 "taint_cnt": jnp.asarray(static.taint_cnt),
                 "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
                 "image_score": jnp.asarray(static.image_score),
+                **(
+                    {"extra_score": jnp.asarray(static.extra_score)}
+                    if static.extra_score is not None
+                    else {}
+                ),
                 "spr": {
                     "dom": jnp.asarray(spread.dom),
                     "elig": jnp.asarray(spread.elig),
@@ -1395,6 +1418,7 @@ class ExactSolver:
             ipa_ident=interpod.ident,
             ipa_score=interpod.has_score,
             use_nominated=use_nominated,
+            use_extra_score=static.extra_score is not None,
         )
         group = cfg.group_size
         grouped = grouped_eligible(

@@ -98,6 +98,9 @@ class StaticPluginTensors:
     # representative pod per class, for downstream per-class tensorizers
     # (spread, interpod affinity); not shipped to device
     reps: list = None
+    # out-of-tree ScorePlugin contributions, weight-premultiplied
+    # (framework/runtime.py#fold_out_of_tree); None = no custom plugins
+    extra_score: np.ndarray | None = None  # [Cp, Np] int32
 
     @property
     def c_pad(self) -> int:

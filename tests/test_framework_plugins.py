@@ -1,0 +1,199 @@
+"""The extension-point-shaped plugin API (SURVEY §8.2; VERDICT r2 L5c's
+"still missing" item): framework/interface.py + runtime.py as the
+upstream-test-shaped fixture, and out-of-tree plugins folded into the
+device solve via SchedulerConfig.out_of_tree_plugins."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.framework import (
+    CycleState,
+    FilterPlugin,
+    Framework,
+    ScorePlugin,
+    Status,
+)
+from kubernetes_tpu.framework.interface import Registry
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+class OddNodesOnly(FilterPlugin):
+    """Rejects nodes with an even trailing index."""
+
+    def filter(self, state, pod, node, placed=()):
+        if int(node.name.rsplit("-", 1)[-1]) % 2 == 0:
+            return Status.unschedulable("even node")
+        return Status.success()
+
+
+class PreferHighIndex(ScorePlugin):
+    def __init__(self, weight=5):
+        self._w = weight
+
+    def score(self, state, pod, node):
+        return min(int(node.name.rsplit("-", 1)[-1]) * 10, 100)
+
+    def weight(self):
+        return self._w
+
+
+def mk_nodes(n=6):
+    return [
+        MakeNode()
+        .name(f"n-{i}")
+        .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"})
+        .obj()
+        for i in range(n)
+    ]
+
+
+# -- the host-side runtime (the upstream-test fixture shape) ----------------
+
+
+def test_framework_run_all_with_custom_plugins():
+    fw = Framework(
+        nodes=mk_nodes(),
+        registry=Registry(
+            filter=[OddNodesOnly()], score=[PreferHighIndex()]
+        ),
+    )
+    pod = MakePod().name("p").req({"cpu": "1"}).obj()
+    feasible, scores, st = fw.run_all(pod)
+    assert st.is_success
+    assert [n.name for n in feasible] == ["n-1", "n-3", "n-5"]
+    # custom score steers toward the highest index among feasible
+    assert max(scores, key=scores.get) == "n-5"
+
+
+def test_framework_cycle_state_and_status():
+    state = CycleState()
+    state.write("k", {"x": 1})
+    assert state.read("k") == {"x": 1}
+    clone = state.clone()
+    clone.write("k", "other")
+    assert state.read("k") == {"x": 1}  # clone is independent
+    with pytest.raises(KeyError):
+        state.read("missing")
+    assert Status.unschedulable("r").is_rejection
+    assert not Status.error("boom").is_rejection
+
+
+def test_framework_rejects_out_of_range_scores():
+    class Bad(ScorePlugin):
+        def score(self, state, pod, node):
+            return 101
+
+    fw = Framework(nodes=mk_nodes(2), registry=Registry(score=[Bad()]))
+    pod = MakePod().name("p").req({"cpu": "1"}).obj()
+    with pytest.raises(ValueError):
+        fw.run_score_plugins(CycleState(), pod, list(fw.nodes))
+
+
+def test_framework_in_tree_pipeline_included():
+    """with_default_plugins: in-tree filters run before custom ones."""
+    nodes = mk_nodes(3)
+    fw = Framework(nodes=nodes)
+    big = MakePod().name("big").req({"cpu": "64"}).obj()
+    feasible, _, st = fw.run_all(big)
+    assert not feasible and st.is_rejection
+
+
+# -- out-of-tree plugins inside the device solve ----------------------------
+
+
+def _sched(cs, plugins, group=64):
+    return Scheduler(
+        cs,
+        SchedulerConfig(
+            solver=ExactSolverConfig(tie_break="first", group_size=group),
+            out_of_tree_plugins=tuple(plugins),
+        ),
+        clock=FakeClock(),
+    )
+
+
+def test_out_of_tree_filter_gates_the_solve():
+    cs = ClusterState()
+    for n in mk_nodes():
+        cs.create_node(n)
+    sched = _sched(cs, [OddNodesOnly()])
+    for i in range(4):
+        cs.create_pod(MakePod().name(f"p{i}").req({"cpu": "1"}).obj())
+    r = sched.schedule_batch()
+    assert len(r.scheduled) == 4
+    for _, node_name in r.scheduled:
+        assert int(node_name.rsplit("-", 1)[-1]) % 2 == 1
+
+
+def test_out_of_tree_score_steers_the_solve():
+    cs = ClusterState()
+    for n in mk_nodes():
+        cs.create_node(n)
+    # heavy custom weight dominates the default headroom scoring
+    sched = _sched(cs, [PreferHighIndex(weight=50)])
+    cs.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+    r = sched.schedule_batch()
+    assert dict(r.scheduled).get("default/p") == "n-5"
+
+
+class GoldOnly(FilterPlugin):
+    """Label-sensitive filter: only tier=gold pods may use node n-5."""
+
+    def filter(self, state, pod, node, placed=()):
+        if node.name == "n-5" and pod.labels.get("tier") != "gold":
+            return Status.unschedulable("n-5 reserved for gold")
+        return Status.success()
+
+
+def test_label_sensitive_plugin_splits_classes():
+    """Two pods identical except for a label a custom plugin reads must
+    NOT share one class representative's verdicts (review-caught)."""
+    cs = ClusterState()
+    for n in mk_nodes():
+        cs.create_node(n)
+    sched = _sched(cs, [GoldOnly(), PreferHighIndex(weight=50)])
+    cs.create_pod(
+        MakePod().name("gold").label("tier", "gold").req({"cpu": "1"}).obj()
+    )
+    cs.create_pod(
+        MakePod().name("bronze").label("tier", "bronze").req({"cpu": "1"}).obj()
+    )
+    r = sched.schedule_batch()
+    placed = dict(r.scheduled)
+    assert placed.get("default/gold") == "n-5"
+    assert placed.get("default/bronze") not in (None, "n-5")
+
+
+def test_error_status_aborts_instead_of_masking():
+    class Flaky(FilterPlugin):
+        def filter(self, state, pod, node, placed=()):
+            return Status.error("backend down")
+
+    cs = ClusterState()
+    for n in mk_nodes(2):
+        cs.create_node(n)
+    sched = _sched(cs, [Flaky()])
+    cs.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+    with pytest.raises(RuntimeError, match="backend down"):
+        sched.schedule_batch()
+
+
+def test_out_of_tree_plugins_work_with_grouped_path():
+    """Identical pods (grouped fast path) must also see custom tables —
+    extra scores fold into the frontier table like ImageLocality."""
+    cs = ClusterState()
+    for n in mk_nodes():
+        cs.create_node(n)
+    sched = _sched(cs, [OddNodesOnly(), PreferHighIndex(weight=50)], group=4)
+    for i in range(8):
+        cs.create_pod(MakePod().name(f"w{i}").req({"cpu": "1"}).obj())
+    r = sched.schedule_batch()
+    assert len(r.scheduled) == 8
+    landed = {node for _, node in r.scheduled}
+    assert all(int(n.rsplit("-", 1)[-1]) % 2 == 1 for n in landed)
+    # first pods go to n-5 until headroom drops below the custom margin
+    assert dict(r.scheduled)["default/w0"] == "n-5"
