@@ -26,7 +26,6 @@ from ..tensorize.plugins import (
     build_port_tensors,
     build_static_tensors,
     trivial_port_tensors,
-    trivial_static_tensors,
 )
 from ..tensorize.schema import build_node_batch, build_pod_batch
 from ..tensorize.spread import build_spread_tensors, trivial_spread_tensors
