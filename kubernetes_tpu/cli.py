@@ -63,6 +63,7 @@ def cmd_config(args) -> int:
             "batchSize": cfg.tpu_solver.batch_size,
             "tieBreak": cfg.tpu_solver.tie_break,
             "enablePreemption": cfg.tpu_solver.enable_preemption,
+            "groupSize": cfg.tpu_solver.group_size,
         },
         "warnings": cfg.warnings,
     }
