@@ -63,10 +63,11 @@ def least_allocated_score(
 ) -> jax.Array:  # [N] int — 0..100
     """(alloc - requested) * 100 // alloc per resource, weighted int mean.
 
-    ``div``: exact int64 floor division. The float-estimate trick wins on
-    per-step [R, N] shapes but LOSES on the grouped solver's bulk
-    [R, G*N] tables (measured 3x) — bulk callers pass jnp.floor_divide;
-    both are exact on these non-negative operands."""
+    ``div``: exact int64 floor division, injectable per call site. Every
+    current caller evaluates per-step-class shapes ([R, N] / [R, 2N])
+    where the float-estimate trick (floor_div_exact, the default) wins;
+    jnp.floor_divide is equally exact on these non-negative operands if
+    a future bulk-table caller measures better with it."""
     ok = (alloc > 0) & (requested <= alloc)
     per_res = jnp.where(
         ok,

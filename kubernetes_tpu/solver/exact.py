@@ -150,14 +150,12 @@ def grouped_eligible(
     )
 
 
-def _fit_scorer(scoring_strategy, rtc_shape, bulk: bool = False):
+def _fit_scorer(scoring_strategy, rtc_shape):
     """Scoring-strategy dispatch shared by the per-pod pipeline and the
-    grouped fast path (resource_allocation.go scorer selection).
-
-    ``bulk``: the grouped solver evaluates [R, G*N] tables, where plain
-    int64 `//` beats the float-estimate division used on per-step shapes
-    (both exact; see ops/fastmath.py)."""
-    div = jnp.floor_divide if bulk else fastmath.floor_div_exact
+    grouped fast path (resource_allocation.go scorer selection). All
+    callers evaluate per-step-class shapes ([R, N] / [R, 2N]) where the
+    float-estimate exact division wins (ops/fastmath.py)."""
+    div = fastmath.floor_div_exact
     if scoring_strategy == "RequestedToCapacityRatio" and rtc_shape:
         sx = jnp.asarray([int(p[0]) for p in rtc_shape], dtype=jnp.int64)
         sy = jnp.asarray([int(p[1]) for p in rtc_shape], dtype=jnp.int64)
@@ -445,7 +443,7 @@ def _solve_grouped(
     alloc = tables["alloc"]
     alloc2 = alloc[: MEM_IDX + 1]
     weights2 = jnp.asarray([w_cpu, w_mem], dtype=alloc.dtype)
-    fit_scorer = _fit_scorer(scoring_strategy, rtc_shape, bulk=True)
+    fit_scorer = _fit_scorer(scoring_strategy, rtc_shape)
     n = alloc.shape[1]
     step = _make_step(tables, **kw)
 
@@ -480,10 +478,18 @@ def _solve_grouped(
                 jnp.int32
             )
 
-            # capacity: how many MORE identical pods each node can take
+            # capacity: how many MORE identical pods each node can take.
+            # floor_div_exact is only exact below 2^23 quotients, but the
+            # result is clamped to [0, group] right after: a true quotient
+            # >= 2^23 has relative f32 error ~2^-23, so the estimate stays
+            # >> group and clamps identically; below 2^23 it is exact.
             free = alloc - st["used"]
             cap_res = jnp.where(
-                req_mask[:, None], free // jnp.maximum(req, 1)[:, None], group
+                req_mask[:, None],
+                fastmath.floor_div_exact(
+                    jnp.maximum(free, 0), jnp.maximum(req, 1)[:, None]
+                ),
+                group,
             )
             cap = jnp.min(cap_res, axis=0)
             cap = jnp.minimum(
@@ -503,28 +509,46 @@ def _solve_grouped(
                 jnp.int32
             )
 
-            # S[j-1, n]: fit+balanced (+static image) score for placing the
-            # j-th identical pod on node n, j = 1..group — same kernels as
-            # the per-pod pipeline, on the [2, G*N] flattened grid
-            j = jnp.arange(1, group + 1, dtype=alloc.dtype)
-            req_g = (
-                st["nonzero_used"][:, None, :]
-                + nz[:, None, None] * j[None, :, None]
-            ).reshape(2, group * n)
-            alloc_g = jnp.broadcast_to(
-                alloc2[:, None, :], (2, group, n)
-            ).reshape(2, group * n)
-            s = w_fit * fit_scorer(req_g, alloc_g, weights2)
-            s = s + w_balanced * nr.balanced_allocation_score(
-                req_g, alloc_g, fdtype=fdtype
-            )
-            s_table = s.astype(jnp.int32).reshape(group, n)
+            # Frontier scores are computed LAZILY per iteration instead of
+            # precomputing the full [group, N] table: the multi-placement
+            # loop typically runs 1-3 iterations per chunk and reads only
+            # the current and next frontier rows, so the eager table wasted
+            # ~group/2x the division work (measured 13 ms vs 0.5 ms per
+            # chunk at group=256 x 10k nodes on this device — it WAS the
+            # exact-parity north star's dominant cost).
+            static_row = jnp.zeros((n,), dtype=jnp.int32)
             if w_image:
-                s_table = s_table + w_image * tables["image_score"][cls][None, :]
+                static_row = static_row + w_image * tables["image_score"][cls]
             if use_extra:
                 # out-of-tree scores are per-(class, node) constants, same
-                # shape as ImageLocality: fold into the frontier table
-                s_table = s_table + tables["extra_score"][cls][None, :]
+                # shape as ImageLocality: fold into the frontier rows
+                static_row = static_row + tables["extra_score"][cls]
+
+            def frontier_rows(m, rows):
+                """fit+balanced (+static rows) score of placing the
+                (m+1)-th .. (m+rows)-th identical pod per node:
+                [rows, N] int32 — same kernels as the per-pod pipeline,
+                evaluated only at the frontier rows the loop body reads
+                (rows=2 for the random multi-place body, rows=1 for the
+                deterministic one-per-iteration body)."""
+                jj = jnp.stack(
+                    [m + 1 + i for i in range(rows)]
+                ).astype(alloc.dtype)  # [rows, N]
+                req_g = (
+                    st["nonzero_used"][:, None, :]
+                    + nz[:, None, None] * jj[None, :, :]
+                ).reshape(2, rows * n)
+                alloc_g = jnp.broadcast_to(
+                    alloc2[:, None, :], (2, rows, n)
+                ).reshape(2, rows * n)
+                s = w_fit * fit_scorer(req_g, alloc_g, weights2)
+                s = s + w_balanced * nr.balanced_allocation_score(
+                    req_g, alloc_g, fdtype=fdtype
+                )
+                return (
+                    s.astype(jnp.int32).reshape(rows, n)
+                    + static_row[None, :]
+                )
 
             taint_row = tables["taint_cnt"][cls]
             nodeaff_row = tables["nodeaff_pref"][cls]
@@ -593,11 +617,9 @@ def _solve_grouped(
                     ones_d,
                 )
 
-            def scores_at(m, extra_ok):
+            def scores_at(m, extra_ok, f):
+                """Total score at frontier row ``f`` (= frontier2(m)[0])."""
                 mask_t = (m < cap) & extra_ok
-                f = jnp.take_along_axis(
-                    s_table, jnp.clip(m, 0, group - 1)[None, :], axis=0
-                )[0]
                 total = f
                 # DefaultNormalizeScore, recomputed per iteration because
                 # the feasible mask shifts as nodes saturate. In quota
@@ -631,7 +653,9 @@ def _solve_grouped(
                 def body(state):
                     m, asg, placed, k = state
                     extra_ok, quota_d, charged, dc_now = domain_eval(m)
-                    total, mask_t = scores_at(m, extra_ok)
+                    fr = frontier_rows(m, 2)
+                    f_now, next_f = fr[0], fr[1]
+                    total, mask_t = scores_at(m, extra_ok, f_now)
                     best = jnp.max(total)
                     feasible = best >= 0
                     tie = (total == best) & mask_t
@@ -647,31 +671,16 @@ def _solve_grouped(
                     #   still required; saturation is harmless (constant
                     #   normalize rows by host precondition).
                     if mode is None:
-                        f_now = jnp.take_along_axis(
-                            s_table, jnp.clip(m, 0, group - 1)[None, :], axis=0
-                        )[0]
-                        next_f = jnp.take_along_axis(
-                            s_table,
-                            jnp.clip(m + 1, 0, group - 1)[None, :],
-                            axis=0,
-                        )[0]
                         eligible = tie & ((m + 1) < cap) & (next_f <= f_now)
                     elif mode == "spread":
-                        f_now = jnp.take_along_axis(
-                            s_table, jnp.clip(m, 0, group - 1)[None, :], axis=0
-                        )[0]
-                        next_f = jnp.take_along_axis(
-                            s_table,
-                            jnp.clip(m + 1, 0, group - 1)[None, :],
-                            axis=0,
-                        )[0]
                         eligible = tie & (next_f <= f_now)
                     else:  # anti
                         eligible = tie
 
-                    k, s1, s2 = jax.random.split(k, 3)
+                    k, s1 = jax.random.split(k)
                     if mode is None:
                         r = jax.random.uniform(s1, (n,))
+                        pick_key = jnp.where(tie, r, 2.0)
                         accept = eligible
                         order = jnp.argsort(
                             jnp.where(accept, r, 2.0)
@@ -844,13 +853,18 @@ def _solve_grouped(
                         )
 
                     # q == 0 but feasible: single placement on one tie node
-                    # (possibly saturating — next iteration recomputes)
-                    csum = jnp.cumsum(tie)
-                    pick_rank = (
-                        jax.random.randint(s2, (), 0, 1 << 30)
-                        % jnp.maximum(csum[-1], 1)
-                    )
-                    pick = jnp.argmax(csum > pick_rank).astype(jnp.int32)
+                    # (possibly saturating — next iteration recomputes).
+                    # Picked by extremal random key among ties (uniform,
+                    # since the keys are iid): min of `r` (non-ties padded
+                    # to 2.0) in plain mode, max of `rb` (non-ties -1) in
+                    # quota modes — reusing this iteration's draw instead
+                    # of a second [N] cumsum + randint.
+                    if mode is None:
+                        pick = jnp.argmin(pick_key).astype(jnp.int32)
+                    else:
+                        pick = jnp.argmax(
+                            jnp.where(tie, rb, jnp.int64(-1))
+                        ).astype(jnp.int32)
 
                     multi = q > 0
                     n_placed = jnp.where(
@@ -898,7 +912,9 @@ def _solve_grouped(
                 def body(t, acc):
                     m, asg = acc
                     extra_ok, _, _, _ = domain_eval(m)
-                    total, _ = scores_at(m, extra_ok)
+                    total, _ = scores_at(
+                        m, extra_ok, frontier_rows(m, 1)[0]
+                    )
                     best = jnp.max(total)
                     feasible = (best >= 0) & (t < vcnt)
                     pick = jnp.argmax(total).astype(jnp.int32)
@@ -1404,9 +1420,20 @@ class ExactSolver:
             disabled=tuple(sorted(cfg.disabled_filters)),
             w_fit=cfg.fit_weight,
             w_balanced=cfg.balanced_weight,
-            w_taint=cfg.taint_weight,
-            w_nodeaff=cfg.node_affinity_weight,
-            w_image=cfg.image_weight,
+            # batch-static dead-weight elimination: an all-zero preference
+            # row normalizes to the SAME value on every feasible node, and
+            # a constant term can't move an argmax or its tie set — so the
+            # plugin's weight is dropped at trace time, removing two [N]
+            # integer-division normalizes from every scan step / grouped
+            # iteration. Assignments are bit-identical either way; only
+            # internal (never returned) score values shift by a constant.
+            w_taint=cfg.taint_weight if np.any(static.taint_cnt) else 0,
+            w_nodeaff=(
+                cfg.node_affinity_weight
+                if np.any(static.nodeaff_pref)
+                else 0
+            ),
+            w_image=cfg.image_weight if np.any(static.image_score) else 0,
             w_spread=cfg.spread_weight,
             w_interpod=cfg.interpod_weight,
             use_spread=use_spread,
