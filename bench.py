@@ -13,16 +13,25 @@ Measures the FULL BASELINE.md target ladder (VERDICT r2 #3):
      nodes, required hostname anti-affinity.
   #5 Global rebalance north star: 50k pods x 10k nodes single-shot auction.
 
-Each ladder reports steady-state (warm-start) pods/s — compiles happen in a
-same-shaped warmup pass (persistent compile cache makes restarts cheap) —
-plus per-workload invariant checks (all placed; skew bound; exclusivity).
+Each ladder reports steady-state (warm-start) pods/s, best of 3 full
+passes — compiles happen in a same-shaped warmup pass (persistent compile
+cache makes restarts cheap) — plus per-workload invariant checks (all
+placed; skew bound; exclusivity).
+
+Measurement regime: the axon tunnel defers execution until the first
+device->host read, then prices every sync at ~1 RTT (~0.1 s). All rows
+here include per-batch assignment reads, so they are honest sync-mode
+end-to-end numbers; the ``tunnel`` entry records both dispatch regimes so
+the context is explicit. Batch/group sizes are large for the same reason
+(pods per sync is the first-order throughput knob).
 
 Prints ONE JSON line. ``value``/``vs_baseline`` headline ladder #2;
 ``vs_baseline`` divides by the TOP of the reference's in-proc band
 (O(1-5k) pods/s on scheduler_perf-style runs, BASELINE.md) — the strictest
 available comparator. The API-bound ~300 pods/s figure is reported
-separately as vs_api_bound. Labels say which solver path each ladder
-exercises; nothing is extrapolated from the easy regime.
+separately as vs_api_bound. Each ladder reports the solver's actual
+dispatch histogram (per-pod scan vs grouped chunk kinds) instead of a
+hardcoded path label; nothing is extrapolated from the easy regime.
 """
 
 from __future__ import annotations
@@ -69,16 +78,49 @@ def _mk_pod(i: int, kind: str):
     return b.obj()
 
 
+def _dispatch_label(sched) -> str:
+    """Derive the solver-path label from the solver's actual dispatch
+    histogram instead of asserting it (round-3's hardcoded labels claimed
+    grouping was disabled on workloads where the quota chunks engaged)."""
+    from collections import Counter
+
+    total: Counter = Counter()
+    for solver in sched.solvers.values():
+        total.update(getattr(solver, "dispatch_counts", {}))
+    if not total:
+        return "no solves dispatched"
+    names = {
+        "scan": "per-pod scan",
+        "kind0": "grouped slow-replay chunks",
+        "kind1": "grouped plain fast chunks",
+        "kind2": "grouped spread-quota chunks",
+        "kind3": "grouped anti-quota chunks",
+    }
+    parts = [
+        f"{names.get(k, k)}={v}" for k, v in sorted(total.items())
+    ]
+    return "; ".join(parts)
+
+
 def _run_ladder(
     n_nodes: int,
     n_pods: int,
     kind: str,
     batch: int,
     warm_pods: int,
+    group: int = 512,
+    reps: int = 3,
 ) -> dict:
-    """Warm-start end-to-end run: a same-shaped throwaway cluster compiles
-    every executable (incl. the device-session heal path), then the timed
-    cluster runs the production path only."""
+    """Warm-start end-to-end run, best of ``reps`` full passes (the axon
+    tunnel's throughput varies between runs on identical executables —
+    README "Performance"): a same-shaped throwaway cluster compiles every
+    executable (incl. the device-session heal path), then each timed pass
+    builds a fresh cluster and runs the production path only.
+
+    ``batch``/``group`` default large: the tunnel prices each
+    host<->device sync at ~0.1 s regardless of payload, so pods/solve-call
+    is the first-order throughput knob (the per-pod p99 latency cost of
+    the bigger batch is reported alongside)."""
     from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
     from kubernetes_tpu.solver.exact import ExactSolverConfig
     from kubernetes_tpu.state.cluster import ClusterState
@@ -90,7 +132,10 @@ def _run_ladder(
         sched = Scheduler(
             cs,
             SchedulerConfig(
-                batch_size=batch, solver=ExactSolverConfig(tie_break="random")
+                batch_size=batch,
+                solver=ExactSolverConfig(
+                    tie_break="random", group_size=group
+                ),
             ),
         )
         for i in range(n_p):
@@ -103,34 +148,47 @@ def _run_ladder(
     wsched.schedule_batch()
     warmup_s = time.perf_counter() - t0
 
-    cs, sched = build(n_pods)
-    batch_times: list[tuple[float, int]] = []
-    solve_s = 0.0
-    scheduled = 0
-    t0 = time.perf_counter()
-    while True:
-        tb = time.perf_counter()
-        r = sched.schedule_batch()
-        n = len(r.scheduled)
-        if not (r.scheduled or r.unschedulable or r.bind_failures):
-            break
-        batch_times.append((time.perf_counter() - tb, n))
-        solve_s += r.solve_seconds
-        scheduled += n
-    total = time.perf_counter() - t0
+    best = None
+    run_walls = []
+    for _ in range(reps):
+        cs, sched = build(n_pods)
+        batch_times: list[tuple[float, int]] = []
+        solve_s = 0.0
+        scheduled = 0
+        t0 = time.perf_counter()
+        while True:
+            tb = time.perf_counter()
+            r = sched.schedule_batch()
+            n = len(r.scheduled)
+            if not (r.scheduled or r.unschedulable or r.bind_failures):
+                break
+            batch_times.append((time.perf_counter() - tb, n))
+            solve_s += r.solve_seconds
+            scheduled += n
+        total = time.perf_counter() - t0
+        assert scheduled == n_pods, (
+            f"{kind}: only {scheduled}/{n_pods} scheduled"
+        )
+        _check_invariants(cs, kind)
+        run_walls.append(round(total, 3))
+        if best is None or total < best[0]:
+            best = (total, solve_s, batch_times, sched)
 
-    assert scheduled == n_pods, f"{kind}: only {scheduled}/{n_pods} scheduled"
-    _check_invariants(cs, kind)
+    total, solve_s, batch_times, sched = best
     per_pod = sorted(t for t, n in batch_times for _ in range(n))
     p99 = per_pod[int(0.99 * (len(per_pod) - 1))] if per_pod else 0.0
     return {
         "pods": n_pods,
         "nodes": n_nodes,
-        "pods_per_sec": round(scheduled / total, 1) if total else None,
+        "batch": batch,
+        "group": group,
+        "pods_per_sec": round(n_pods / total, 1) if total else None,
         "wall_s": round(total, 3),
+        "run_walls_s": run_walls,
         "device_solve_s": round(solve_s, 3),
         "p99_batch_latency_s": round(p99, 4),
         "warmup_s": round(warmup_s, 2),
+        "dispatch": _dispatch_label(sched),
     }
 
 
@@ -164,12 +222,20 @@ def ladder1_basic() -> dict:
         {"opcode": "createPods", "count": 500, "collectMetrics": True},
     ]
     runner = PerfRunner()
-    # warmup on the same shapes, then the measured run
+    # warmup on the same shapes, then best of 3 measured runs (tunnel
+    # throughput varies between runs on identical executables)
     runner.run_workload("SchedulingBasic", "warmup", ops, {})
-    t0 = time.perf_counter()
-    res = runner.run_workload("SchedulingBasic", "500Nodes", ops, {})
-    wall = time.perf_counter() - t0
-    assert res.scheduled == 500, f"#1: {res.scheduled}/500 scheduled"
+    best = None
+    run_walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res = runner.run_workload("SchedulingBasic", "500Nodes", ops, {})
+        wall = time.perf_counter() - t0
+        assert res.scheduled == 500, f"#1: {res.scheduled}/500 scheduled"
+        run_walls.append(round(wall, 3))
+        if best is None or wall < best[0]:
+            best = (wall, res)
+    wall, res = best
     thr = res.throughput_summary()
     return {
         "pods": 500,
@@ -178,6 +244,7 @@ def ladder1_basic() -> dict:
         if res.measure_seconds
         else None,
         "wall_s": round(wall, 3),
+        "run_walls_s": run_walls,
         "device_solve_s": round(res.solve_seconds, 3),
         "throughput_summary": thr,
     }
@@ -335,18 +402,27 @@ def _north_star_exact() -> dict:
     cpu = np.full(NS_PODS, 1000, np.int64)
     mem = np.full(NS_PODS, 2 << 30, np.int64)
     pb = columnar_pod_batch(cpu, mem, None, vocab)
-    # group=256 measured best at this scale (fewer dispatch-bound chunk
-    # boundaries; larger S-tables stay memory-cheap)
+    # group=256 measured most consistent at this scale since the lazy
+    # frontier rework (round 4): per-chunk cost no longer scales with
+    # group, and 200 chunks amortize the per-call sync overhead
     solver = ExactSolver(ExactSolverConfig(tie_break="random", group_size=256))
     solver.solve(fresh_batch(), pb)  # compile + warm the session shapes
-    t0 = time.perf_counter()
-    a = solver.solve(fresh_batch(), pb)
-    exact_s = time.perf_counter() - t0
+    exact_s = float("inf")
+    for _ in range(3):
+        # one solve's histogram, not the warmup+reps lifetime total
+        solver.dispatch_counts.clear()
+        t0 = time.perf_counter()
+        a = solver.solve(fresh_batch(), pb)
+        exact_s = min(exact_s, time.perf_counter() - t0)
     placed = int((a >= 0).sum())
     assert placed == NS_PODS, f"exact north star placed {placed}/{NS_PODS}"
     return {
         "exact_parity_solve_s": round(exact_s, 2),
         "exact_parity_pods_per_sec": round(placed / exact_s, 1),
+        "exact_parity_vs_1s_target": round(NS_TARGET_S / exact_s, 2),
+        "exact_parity_dispatch": "; ".join(
+            f"{k}={v}" for k, v in sorted(solver.dispatch_counts.items())
+        ),
     }
 
 
@@ -403,32 +479,58 @@ def main() -> None:
 
     enable_persistent_cache()
 
+    # tunnel canary: the axon client defers execution until the first
+    # device->host read, after which every sync costs ~1 RTT (~0.1 s).
+    # Record the trivial-dispatch time before and after the first read so
+    # the regime every number below was measured in is explicit.
+    import numpy as _np
+    import jax.numpy as _jnp
+
+    _triv = jax.jit(lambda x: x * 3 + 1)
+    _x = _jnp.arange(8)
+    _triv(_x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        _triv(_x).block_until_ready()
+    pre_read_ms = (time.perf_counter() - t0) / 5 * 1e3
+    _np.asarray(_triv(_x))  # first D2H read: switches to sync mode
+    t0 = time.perf_counter()
+    for _ in range(5):
+        _triv(_x).block_until_ready()
+    rtt_ms = (time.perf_counter() - t0) / 5 * 1e3
+
     ladders = {}
     ladders["1_basic_500x500"] = {
         "config": "SchedulingBasic, default plugins, YAML-runner path",
-        "solver_path": "exact scan (grouped fast path)",
         **ladder1_basic(),
     }
     ladders["2_fit_5kx1k"] = {
         "config": "Fit+BalancedAllocation, homogeneous",
-        "solver_path": "exact scan (grouped fast path)",
         **_run_ladder(1_000, 5_000, "plain", batch=4_096, warm_pods=6_144),
     }
     ladders["3_spread_10kx5k"] = {
         "config": "PodTopologySpread hard maxSkew=1, 3 zones",
-        "solver_path": "exact per-pod scan (spread disables grouping)",
-        **_run_ladder(5_000, 10_000, "spread", batch=512, warm_pods=768),
+        **_run_ladder(5_000, 10_000, "spread", batch=4_096, warm_pods=4_096),
     }
     ladders["4_interpod_5kx5k"] = {
         "config": "InterPodAffinity required hostname anti-affinity",
-        "solver_path": "exact per-pod scan (interpod disables grouping)",
-        **_run_ladder(5_000, 5_000, "anti", batch=512, warm_pods=768),
+        **_run_ladder(5_000, 5_000, "anti", batch=4_096, warm_pods=4_096),
     }
     ladders["5_rebalance_50kx10k"] = {
         "config": "global rebalance, single batched auction solve",
         **ladder5_north_star(),
     }
     ladders["served_grpc_5kx1k"] = served_grpc()
+    ladders["tunnel"] = {
+        "pre_first_read_dispatch_ms": round(pre_read_ms, 3),
+        "post_first_read_dispatch_ms": round(rtt_ms, 1),
+        "note": (
+            "axon defers execution until the first device->host read; "
+            "after it every host<->device sync costs ~1 tunnel RTT. All "
+            "ladder numbers above include per-batch assignment reads, "
+            "i.e. they are post-first-read (sync-mode) numbers."
+        ),
+    }
 
     headline = ladders["2_fit_5kx1k"]["pods_per_sec"]
     print(
