@@ -23,7 +23,51 @@ from .interface import (
     ScorePlugin,
     Status,
     StatusCode,
+    run_pre_filter,
 )
+
+
+class WaitingPod:
+    """runtime/waiting_pods_map.go#waitingPod: a pod parked at the Permit
+    point. Each waiting Permit plugin holds its own timeout; the pod is
+    rejected when the earliest one expires, allowed when every pending
+    plugin calls allow(). allow/reject only record the verdict — the
+    scheduler applies it (finishes or rolls back the binding) on its next
+    cycle, the batched analog of the binding goroutine's WaitOnPermit."""
+
+    def __init__(
+        self, pod: Pod, node_name: str,
+        plugin_timeouts: Mapping[str, float], now: float,
+    ) -> None:
+        self.pod = pod
+        self.node_name = node_name
+        self.deadlines = {
+            name: now + timeout for name, timeout in plugin_timeouts.items()
+        }
+        self.pending = set(self.deadlines)
+        self.rejected_by: str | None = None
+        self.reject_message = ""
+
+    def get_pending_plugins(self) -> list[str]:
+        return sorted(self.pending)
+
+    def allow(self, plugin_name: str) -> None:
+        self.pending.discard(plugin_name)
+
+    def reject(self, plugin_name: str, msg: str = "") -> None:
+        self.rejected_by = plugin_name
+        self.reject_message = msg
+
+    @property
+    def allowed(self) -> bool:
+        return not self.pending and self.rejected_by is None
+
+    def expired(self, now: float) -> "str | None":
+        """Name of the first timed-out pending plugin, or None."""
+        for name in sorted(self.pending):
+            if now >= self.deadlines[name]:
+                return name
+        return None
 
 
 @dataclass
@@ -52,10 +96,22 @@ class Framework:
     # -- extension points (framework.go#Run*Plugins) --
 
     def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Status:
+        """framework.go#RunPreFilterPlugins: statuses short-circuit;
+        PreFilterResult allowlists intersect, stored in the cycle state
+        under "PreFilterResult" (run_all consumes it)."""
+        allow = None
         for p in self.registry.pre_filter:
-            st = p.pre_filter(state, pod)
+            st, result = run_pre_filter(p, state, pod)
             if not st.is_success:
                 return st
+            if result is not None and not result.all_nodes():
+                allow = (
+                    result.node_names
+                    if allow is None
+                    else allow & result.node_names
+                )
+        if allow is not None:
+            state.write("PreFilterResult", frozenset(allow))
         return Status.success()
 
     def run_filter_plugins(
@@ -114,10 +170,15 @@ class Framework:
         st = self.run_pre_filter_plugins(state, pod)
         if not st.is_success:
             return [], {}, st
+        try:
+            allow = state.read("PreFilterResult")
+        except KeyError:
+            allow = None
         feasible = [
             n
             for n in self.nodes
-            if self.run_filter_plugins(state, pod, n).is_success
+            if (allow is None or n.name in allow)
+            and self.run_filter_plugins(state, pod, n).is_success
         ]
         if not feasible:
             return [], {}, Status(StatusCode.UNSCHEDULABLE)
@@ -155,18 +216,32 @@ def fold_out_of_tree(
 
     for c, rep in enumerate(reps):
         state = CycleState()  # per scheduling class == per cycle here
-        for p in plugins:
-            if isinstance(p, PreFilterPlugin):
-                st = p.pre_filter(state, rep)
-                if st.code == StatusCode.ERROR:
-                    raise RuntimeError(
-                        f"plugin {p.name()} PreFilter error: {st.reasons}"
-                    )
+        rejected = False
         nodes = [
             (slot, node)
             for slot, node in enumerate(slot_nodes)
             if node is not None
         ]
+        for p in plugins:
+            if isinstance(p, PreFilterPlugin):
+                st, result = run_pre_filter(p, state, rep)
+                if st.code == StatusCode.ERROR:
+                    raise RuntimeError(
+                        f"plugin {p.name()} PreFilter error: {st.reasons}"
+                    )
+                if st.is_rejection:
+                    # PreFilter rejection fails the pod on every node
+                    # (schedule_one.go#schedulePod's early return)
+                    mask[c, :] = False
+                    rejected = True
+                    break
+                if result is not None and not result.all_nodes():
+                    # PreFilterResult node-name allowlist -> static mask
+                    for slot, node in nodes:
+                        if node.name not in result.node_names:
+                            mask[c, slot] = False
+        if rejected:
+            continue
         for p in plugins:
             if isinstance(p, FilterPlugin):
                 for slot, node in nodes:

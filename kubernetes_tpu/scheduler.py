@@ -26,6 +26,8 @@ import numpy as np
 
 from . import metrics
 from .api.objects import Pod
+from .framework.interface import CycleState, StatusCode
+from .framework.runtime import WaitingPod
 from .solver.exact import ExactSolver, ExactSolverConfig
 from .solver.preemption import PreemptionEvaluator
 from .state.cache import SchedulerCache
@@ -58,11 +60,21 @@ class SchedulerConfig:
     profiles: dict[str, ExactSolverConfig] | None = None
     # component-base/featuregate analog (--feature-gates); None = defaults
     feature_gates: object = None
-    # out-of-tree Scheduling Framework plugins (framework/interface.py
-    # FilterPlugin / ScorePlugin): folded into the per-class device tables
-    # each batch (framework/runtime.py#fold_out_of_tree) — the in-process
-    # plugin registration point of SURVEY §8.2
+    # out-of-tree Scheduling Framework plugins (framework/interface.py),
+    # classified by the extension-point protocols each implements:
+    # Filter/Score (+ PreFilter incl. PreFilterResult allowlists) fold
+    # into the per-class device tables each batch
+    # (framework/runtime.py#fold_out_of_tree); PreEnqueue/QueueSort hook
+    # the scheduling queue; PostFilter runs on the failure path after
+    # default preemption; Reserve/Permit/PreBind/PostBind run host-side
+    # around the bind, with Permit's WaitingPods map parking pods across
+    # cycles — the in-process plugin registration point of SURVEY §8.2.
     out_of_tree_plugins: tuple = ()
+
+
+class _Rejected(Exception):
+    """An out-of-tree Reserve/PreBind plugin returned a non-success
+    status: the binding rolls back and the pod requeues with backoff."""
 
 
 def _node_change_could_help(old, new) -> bool:
@@ -104,12 +116,31 @@ class Scheduler:
 
         self.feature_gates = self.config.feature_gates or FeatureGates()
         self.cache = SchedulerCache(self.clock, assume_ttl=self.config.assume_ttl)
+        # classify the flat out-of-tree plugin set by extension point
+        from .framework.interface import Registry
+
+        self.registry = Registry.classify(self.config.out_of_tree_plugins)
+
+        def _pre_enqueue(pod: Pod) -> bool:
+            for p in self.registry.pre_enqueue:
+                if not p.pre_enqueue(pod).is_success:
+                    return False
+            return True
+
+        qs = self.registry.queue_sort
         self.queue = PriorityQueue(
             self.clock,
             honor_scheduling_gates=self.feature_gates.enabled(
                 "PodSchedulingReadiness"
             ),
+            pre_enqueue=_pre_enqueue if self.registry.pre_enqueue else None,
+            less=qs[0].less if qs else None,
         )
+        # Permit WaitingPods map (runtime/waiting_pods_map.go): pod key ->
+        # (WaitingPod, its QueuedPodInfo, scheduling cycle, CycleState,
+        # pop timestamp). Verdicts recorded via WaitingPod.allow/reject
+        # apply at the start of the next scheduling cycle.
+        self._waiting: dict[str, tuple] = {}
         self.snapshot = Snapshot()
         from .state.volume_binder import VolumeBinder
 
@@ -183,6 +214,12 @@ class Scheduler:
                     )
                 else:
                     self.queue.delete(pod.key)
+                    # a pod deleted while parked at Permit: roll back its
+                    # reservation (next cycle would otherwise bind it)
+                    entry = self._waiting.pop(pod.key, None)
+                    if entry is not None:
+                        wp, _info, _cycle, state, _t0 = entry
+                        self._unreserve_all(state, wp.pod, wp.node_name)
         else:  # Node
             if ev.type == "ADDED":
                 self.cache.add_node(ev.obj)
@@ -270,6 +307,10 @@ class Scheduler:
     def _schedule_batch_locked(self) -> BatchResult:
         res = BatchResult()
         t0 = time.perf_counter()
+        # WaitOnPermit analog: settle WaitingPods whose verdict or
+        # deadline arrived since the last cycle, before popping new work
+        if self._waiting:
+            self._process_waiting(res)
         # #flushUnschedulablePodsLeftover: the reference runs this on a 30s
         # timer goroutine; batching gives a natural tick — pods parked
         # longer than 5 min force back into rotation before each pop
@@ -567,13 +608,16 @@ class Scheduler:
         preempt_placed: dict[int, list[Pod]] | None = None
         preempt_pdbs: list = []
         cluster_has_affinity = False
+        postfilter_reasons: dict | None = None
         preempt_dt = 0.0
         bind_dt = 0.0
         for idx, (info, a) in enumerate(zip(infos, assignments)):
             pod = info.pod
             cycle = base_cycle + cycle_offsets[idx] + 1
             if a < 0:
-                # failure path: PostFilter (defaultpreemption) -> park
+                # failure path: PostFilter — defaultpreemption first, then
+                # out-of-tree PostFilter plugins (first success nominates)
+                nominated_node = None
                 if self.config.enable_preemption:
                     if preempt_placed is None:
                         # shared across this batch's failures: occupancy
@@ -587,10 +631,27 @@ class Scheduler:
                             if i2.node is not None
                         )
                     tpf = time.perf_counter()
-                    self._try_preempt(
+                    nominated_node = self._try_preempt(
                         pod, static, idx, res, preempt_placed, slot_nodes,
                         preempt_pdbs, cluster_has_affinity, solver,
                     )
+                    preempt_dt += time.perf_counter() - tpf
+                if nominated_node is None and self.registry.post_filter:
+                    if postfilter_reasons is None:
+                        # NodeToStatusMap analog, shared across this
+                        # batch's failures: per-node reasons don't exist
+                        # inside the fused pipeline, so every candidate
+                        # carries the batch-level rejection
+                        postfilter_reasons = {
+                            n.name: "node did not satisfy the batched "
+                            "filter pipeline"
+                            for n in slot_nodes
+                            if n is not None
+                        }
+                    tpf = time.perf_counter()
+                    # fresh copy per pod: upstream's NodeToStatusMap is
+                    # per-pod scratch a plugin may legitimately mutate
+                    self._run_post_filter(pod, dict(postfilter_reasons))
                     preempt_dt += time.perf_counter() - tpf
                 res.unschedulable.append(pod.key)
                 self.queue.add_unschedulable(info, cycle)
@@ -605,12 +666,14 @@ class Scheduler:
                 res.bind_failures.append((pod.key, str(e)))
                 self.queue.add_unschedulable(info, cycle)
                 continue
+
+            # Reserve point: in-tree volumebinding Reserve
+            # (AssumePodVolumes) then out-of-tree ReservePlugins in
+            # registration order; any failure unreserves everything
+            # (reverse order), forgets the assume, and requeues
+            state = CycleState()
             try:
                 tb = time.perf_counter()
-                # volumebinding Reserve + PreBind (AssumePodVolumes ->
-                # BindPodVolumes) run before the binding subresource call,
-                # exactly the reference's cycle order; any failure below
-                # unreserves (rolls back committed PV/PVC writes)
                 if pod.pvc_names:
                     ninfo = self.cache.nodes.get(node_name)
                     if ninfo is None or ninfo.node is None:
@@ -618,46 +681,44 @@ class Scheduler:
                             f"node {node_name} vanished before volume binding"
                         )
                     self.volume_binder.assume_pod_volumes(pod, ninfo.node)
-                    self.volume_binder.bind_pod_volumes(pod)
-                self.cluster.bind(pod.namespace, pod.name, node_name)
+                for p in self.registry.reserve:
+                    st = p.reserve(state, pod, node_name)
+                    if not st.is_success:
+                        raise _Rejected(
+                            f"Reserve plugin {p.name()} rejected: "
+                            + "; ".join(st.reasons)
+                        )
                 bind_dt += time.perf_counter() - tb
-                self.cache.finish_binding(pod.key)
-                self.volume_binder.finish(pod.key)
-                res.scheduled.append((pod.key, node_name))
-                res.latencies.append(time.perf_counter() - t0)
-                # pod-level SLIs: attempts-to-success histogram and e2e
-                # latency from first queue entry, labeled by attempt count
-                metrics.pod_scheduling_attempts.observe(info.attempts)
-                metrics.pod_scheduling_sli_duration_seconds.labels(
-                    str(min(info.attempts, 16))
-                ).observe(
-                    max(self.clock.now() - info.initial_attempt_timestamp, 0.0)
-                )
-                # keep the lazily-snapshotted preemption view in sync with
-                # binds made later in this batch, so a subsequent failing
-                # pod's dry-run sees current node occupancy
-                if preempt_placed is not None:
-                    preempt_placed.setdefault(int(a), []).append(pod)
-            except ApiError as e:
-                # bindingCycle failure path: Unreserve -> ForgetPod -> requeue
-                self.volume_binder.unreserve(pod.key)
-                try:
-                    self.cache.forget_pod(pod.key)
-                except Exception:
-                    pass
-                res.bind_failures.append((pod.key, e.reason))
-                self.queue.add_unschedulable(info, cycle)
-            except VolumeBindingError as e:
-                # Reserve failed (e.g. a WaitForFirstConsumer claim with no
-                # PV on the chosen node — it passed Filter by design):
-                # Unreserve -> ForgetPod -> requeue with backoff
-                self.volume_binder.unreserve(pod.key)
-                try:
-                    self.cache.forget_pod(pod.key)
-                except Exception:
-                    pass
+            except (VolumeBindingError, _Rejected) as e:
+                self._unreserve_all(state, pod, node_name)
                 res.bind_failures.append((pod.key, str(e)))
                 self.queue.add_unschedulable(info, cycle)
+                continue
+
+            # Permit point: approve / reject / wait
+            # (framework.go#RunPermitPlugins); WAIT parks the pod in the
+            # WaitingPods map — it stays assumed (+reserved) and the
+            # binding completes or rolls back in a later cycle
+            verdict = self._run_permit(state, pod, node_name)
+            if isinstance(verdict, dict):
+                wp = WaitingPod(pod, node_name, verdict, self.clock.now())
+                self._waiting[pod.key] = (wp, info, cycle, state, t0)
+                continue
+            if verdict is not None:  # (plugin name, Status) rejection
+                self._unreserve_all(state, pod, node_name)
+                res.unschedulable.append(pod.key)
+                self.queue.add_unschedulable(info, cycle)
+                continue
+
+            ok, dt = self._finish_binding(
+                state, info, pod, node_name, cycle, res, t0
+            )
+            bind_dt += dt
+            # keep the lazily-snapshotted preemption view in sync with
+            # binds made later in this batch, so a subsequent failing
+            # pod's dry-run sees current node occupancy
+            if ok and preempt_placed is not None:
+                preempt_placed.setdefault(int(a), []).append(pod)
         if preempt_dt:
             metrics.framework_extension_point_duration_seconds.labels(
                 "PostFilter", "Success", profile
@@ -683,6 +744,132 @@ class Scheduler:
             )
         if n_fail:
             metrics.schedule_attempts_total.labels("error", profile).inc(n_fail)
+
+    # -- Reserve / Permit / Bind extension points (host-side, around the
+    # device solve — framework.go#RunReservePluginsReserve,
+    # #RunPermitPlugins, #WaitOnPermit, #RunPreBindPlugins,
+    # #RunPostBindPlugins) --
+
+    def _unreserve_all(self, state, pod: Pod, node_name: str) -> None:
+        """Roll back a reservation: out-of-tree Unreserve in reverse
+        registration order (idempotent by contract), volume unreserve,
+        forget the assumed pod."""
+        for p in reversed(self.registry.reserve):
+            p.unreserve(state, pod, node_name)
+        self.volume_binder.unreserve(pod.key)
+        try:
+            self.cache.forget_pod(pod.key)
+        except Exception:
+            pass
+
+    def _run_permit(self, state, pod: Pod, node_name: str):
+        """None = approved; {plugin: timeout} = wait; (plugin, Status) =
+        rejected. A rejection short-circuits, like RunPermitPlugins."""
+        waits: dict[str, float] = {}
+        for p in self.registry.permit:
+            st, timeout = p.permit(state, pod, node_name)
+            if st.code == StatusCode.WAIT:
+                waits[p.name()] = max(float(timeout), 0.0)
+            elif not st.is_success:
+                return (p.name(), st)
+        return waits or None
+
+    def _finish_binding(
+        self,
+        state,
+        info: QueuedPodInfo,
+        pod: Pod,
+        node_name: str,
+        cycle: int,
+        res: BatchResult,
+        t_start: float,
+    ) -> tuple[bool, float]:
+        """PreBind (out-of-tree plugins, then volumebinding's
+        BindPodVolumes) -> Bind -> PostBind. Any failure unreserves and
+        requeues with backoff (the bindingCycle failure path). Returns
+        (bound, wall seconds)."""
+        tb = time.perf_counter()
+        try:
+            for p in self.registry.pre_bind:
+                st = p.pre_bind(state, pod, node_name)
+                if not st.is_success:
+                    raise _Rejected(
+                        f"PreBind plugin {p.name()} rejected: "
+                        + "; ".join(st.reasons)
+                    )
+            if pod.pvc_names:
+                self.volume_binder.bind_pod_volumes(pod)
+            self.cluster.bind(pod.namespace, pod.name, node_name)
+        except (ApiError, VolumeBindingError, _Rejected) as e:
+            self._unreserve_all(state, pod, node_name)
+            reason = e.reason if isinstance(e, ApiError) else str(e)
+            res.bind_failures.append((pod.key, reason))
+            self.queue.add_unschedulable(info, cycle)
+            return False, time.perf_counter() - tb
+        self.cache.finish_binding(pod.key)
+        self.volume_binder.finish(pod.key)
+        res.scheduled.append((pod.key, node_name))
+        res.latencies.append(time.perf_counter() - t_start)
+        # pod-level SLIs: attempts-to-success histogram and e2e latency
+        # from first queue entry, labeled by attempt count
+        metrics.pod_scheduling_attempts.observe(info.attempts)
+        metrics.pod_scheduling_sli_duration_seconds.labels(
+            str(min(info.attempts, 16))
+        ).observe(
+            max(self.clock.now() - info.initial_attempt_timestamp, 0.0)
+        )
+        for p in self.registry.post_bind:
+            p.post_bind(state, pod, node_name)
+        return True, time.perf_counter() - tb
+
+    def _process_waiting(self, res: BatchResult) -> None:
+        """Settle WaitingPods (the batched WaitOnPermit): rejected or
+        timed-out pods unreserve and requeue; fully-allowed pods complete
+        their binding cycle."""
+        now = self.clock.now()
+        for key, (wp, info, cycle, state, t_start) in list(
+            self._waiting.items()
+        ):
+            expired = wp.expired(now)
+            if wp.rejected_by is not None or expired is not None:
+                del self._waiting[key]
+                self._unreserve_all(state, wp.pod, wp.node_name)
+                res.unschedulable.append(key)
+                self.queue.add_unschedulable(info, cycle)
+            elif wp.allowed:
+                del self._waiting[key]
+                self._finish_binding(
+                    state, info, wp.pod, wp.node_name, cycle, res, t_start
+                )
+
+    def waiting_pods(self) -> dict[str, WaitingPod]:
+        """GetWaitingPod/IterateOverWaitingPods surface: pod key ->
+        WaitingPod; call .allow(plugin)/.reject(plugin, msg) on entries —
+        verdicts apply at the start of the next scheduling cycle."""
+        return {k: entry[0] for k, entry in self._waiting.items()}
+
+    def _run_post_filter(self, pod: Pod, filtered: dict) -> str | None:
+        """Out-of-tree PostFilter plugins, after default preemption found
+        nothing: first success nominates (schedule_one.go's PostFilter
+        loop semantics)."""
+        state = CycleState()
+        for p in self.registry.post_filter:
+            node_name, st = p.post_filter(state, pod, filtered)
+            if st.code == StatusCode.ERROR:
+                raise RuntimeError(
+                    f"PostFilter plugin {p.name()} error: {st.reasons}"
+                )
+            if st.is_success and node_name:
+                try:
+                    self.cluster.patch_pod_status(
+                        pod.namespace, pod.name,
+                        nominated_node_name=node_name,
+                    )
+                except ApiError:
+                    return None
+                pod.nominated_node_name = node_name
+                return node_name
+        return None
 
     def _record_metrics(self, res: BatchResult, n_pods: int) -> None:
         """Batch-level metrics (per-profile attempt counters record in
@@ -803,4 +990,8 @@ class Scheduler:
 
     @property
     def pending(self) -> int:
-        return len(self.queue)
+        """Work the loop must still drive: queued pods AND pods parked at
+        Permit — without the latter, a serve drain loop gated on pending
+        would stop ticking while WaitingPods still need their timeout or
+        verdict settled by the next schedule_batch."""
+        return len(self.queue) + len(self._waiting)

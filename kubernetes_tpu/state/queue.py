@@ -36,6 +36,28 @@ from .. import metrics
 from ..api.objects import Pod
 from ..utils.clock import Clock
 
+
+class _SortKey:
+    """Heap key adapter for a custom QueueSort comparator
+    (interface.go#QueueSortPlugin.Less). __eq__ reports comparator ties
+    so tuple comparison falls through to the FIFO seq tiebreaker."""
+
+    __slots__ = ("info", "less")
+
+    def __init__(self, info: "QueuedPodInfo", less) -> None:
+        self.info = info
+        self.less = less
+
+    def __lt__(self, other: "_SortKey") -> bool:
+        return self.less(self.info, other.info)
+
+    def __eq__(self, other) -> bool:
+        return not self.less(self.info, other.info) and not self.less(
+            other.info, self.info
+        )
+
+    __hash__ = None
+
 DEFAULT_POD_INITIAL_BACKOFF = 1.0
 DEFAULT_POD_MAX_BACKOFF = 10.0
 UNSCHEDULABLE_FLUSH_INTERVAL = 30.0
@@ -63,6 +85,8 @@ class PriorityQueue:
         pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
         pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
         honor_scheduling_gates: bool = True,
+        pre_enqueue=None,
+        less=None,
     ):
         self._clock = clock or Clock()
         self._initial_backoff = pod_initial_backoff
@@ -70,6 +94,13 @@ class PriorityQueue:
         # PodSchedulingReadiness feature gate: when off, schedulingGates
         # are ignored (pre-1.26 behavior) and nothing parks as gated
         self._honor_gates = honor_scheduling_gates
+        # out-of-tree PreEnqueue point (interface.go#PreEnqueuePlugin):
+        # pod -> bool; False parks the pod as gated exactly like
+        # schedulingGates, re-evaluated on pod update
+        self._pre_enqueue = pre_enqueue
+        # out-of-tree QueueSort point: QueuedPodInfo x2 -> bool ("pops
+        # first"); replaces the default PrioritySort heap key
+        self._less = less
         self._seq = itertools.count()
 
         self._active: list[tuple[int, float, int, str]] = []  # (-prio, ts, seq, key)
@@ -103,11 +134,46 @@ class PriorityQueue:
         return out
 
     def _push_active(self, info: QueuedPodInfo) -> None:
-        heapq.heappush(
-            self._active,
-            (-info.pod.effective_priority, info.timestamp, next(self._seq), info.key),
-        )
+        if self._less is not None:
+            key0 = _SortKey(info, self._less)
+            heapq.heappush(
+                self._active, (key0, 0.0, next(self._seq), info.key)
+            )
+        else:
+            heapq.heappush(
+                self._active,
+                (
+                    -info.pod.effective_priority,
+                    info.timestamp,
+                    next(self._seq),
+                    info.key,
+                ),
+            )
         self._where[info.key] = "active"
+
+    def _gate(self, pod: Pod) -> bool:
+        """PreEnqueue verdict: True = park as gated. The in-tree
+        schedulinggates check and any out-of-tree PreEnqueue plugin both
+        gate here (scheduling_queue.go#runPreEnqueuePlugins)."""
+        if pod.scheduling_gates and self._honor_gates:
+            return True
+        return self._pre_enqueue is not None and not self._pre_enqueue(pod)
+
+    def _activate(self, info: QueuedPodInfo) -> bool:
+        """EVERY path into the active heap funnels through the PreEnqueue
+        gate (scheduling_queue.go#moveToActiveQ): a mutable out-of-tree
+        PreEnqueue plugin may have closed since the pod last entered, and
+        unlike schedulingGates (which are never re-added) that verdict is
+        not monotone. Returns False when the pod parked as gated."""
+        if self._gate(info.pod):
+            info.gated = True
+            self._gated[info.key] = info
+            self._info[info.key] = info
+            self._where[info.key] = "gated"
+            return False
+        info.gated = False
+        self._push_active(info)
+        return True
 
     def _backoff_duration(self, attempts: int) -> float:
         """#calculateBackoffDuration: 1s doubling per prior attempt, capped."""
@@ -134,8 +200,8 @@ class PriorityQueue:
         info = QueuedPodInfo(
             pod=pod, timestamp=now, initial_attempt_timestamp=now
         )
-        if pod.scheduling_gates and self._honor_gates:
-            # PreEnqueue rejection (schedulinggates plugin)
+        if self._gate(pod):
+            # PreEnqueue rejection (schedulinggates or out-of-tree plugin)
             info.gated = True
             self._gated[pod.key] = info
             self._info[pod.key] = info
@@ -153,7 +219,7 @@ class PriorityQueue:
             return
         info.pod = pod
         where = self._where[pod.key]
-        if where == "gated" and not pod.scheduling_gates:
+        if where == "gated" and not self._gate(pod):
             info.gated = False
             del self._gated[pod.key]
             info.timestamp = self._clock.now()
@@ -216,7 +282,7 @@ class PriorityQueue:
             self._push_backoff(info)
         else:
             info.timestamp = now
-            self._push_active(info)
+            self._activate(info)
 
     def move_all_to_active_or_backoff(self, event: str = "", worth=None) -> None:
         """#MoveAllToActiveOrBackoffQueue with QueueingHints: ``worth`` is
@@ -248,9 +314,9 @@ class PriorityQueue:
             heapq.heappop(self._backoff)
             info = self._info[key]
             info.timestamp = now
-            self._push_active(info)
+            self._activate(info)
             metrics.queue_incoming_pods_total.labels(
-                "active", "BackoffComplete"
+                self._where[key], "BackoffComplete"
             ).inc()
 
     def flush_unschedulable_leftover(self) -> None:
