@@ -181,6 +181,8 @@ class Scheduler:
     # -- eventhandlers.go#addAllEventHandlers routing --
 
     def _on_event(self, ev: Event) -> None:
+        if ev.kind == "Event":
+            return  # the scheduler's own recorder output
         if ev.kind == "Pod":
             pod = ev.obj
             # nominator-map maintenance: an unbound pod with a nomination is
@@ -200,6 +202,12 @@ class Scheduler:
                     self.cache.update_pod(pod) if not self.cache.is_assumed(
                         pod.key
                     ) else self.cache.add_pod(pod)
+                elif pod.key in self._waiting:
+                    # parked at Permit: the pod is in flight (assumed +
+                    # reserved), NOT queued — re-adding it here would
+                    # double-schedule it. Refresh the waiting copy so the
+                    # eventual bind uses current metadata.
+                    self._waiting[pod.key][0].pod = pod
                 elif pod.scheduler_name in self.solvers:
                     self.queue.update(pod)
             else:  # DELETED
@@ -655,6 +663,13 @@ class Scheduler:
                     preempt_dt += time.perf_counter() - tpf
                 res.unschedulable.append(pod.key)
                 self.queue.add_unschedulable(info, cycle)
+                n_nodes = sum(1 for n in slot_nodes if n is not None)
+                self._event(
+                    pod, "FailedScheduling",
+                    f"0/{n_nodes} nodes are available: the batched "
+                    "filter pipeline rejected every candidate",
+                    type_="Warning",
+                )
                 continue
             node_name = self.snapshot.name_of(int(a))
             try:
@@ -693,6 +708,9 @@ class Scheduler:
                 self._unreserve_all(state, pod, node_name)
                 res.bind_failures.append((pod.key, str(e)))
                 self.queue.add_unschedulable(info, cycle)
+                self._event(
+                    pod, "FailedScheduling", str(e), type_="Warning",
+                )
                 continue
 
             # Permit point: approve / reject / wait
@@ -708,6 +726,12 @@ class Scheduler:
                 self._unreserve_all(state, pod, node_name)
                 res.unschedulable.append(pod.key)
                 self.queue.add_unschedulable(info, cycle)
+                self._event(
+                    pod, "FailedScheduling",
+                    f"permit plugin {verdict[0]} rejected: "
+                    + "; ".join(verdict[1].reasons),
+                    type_="Warning", action="Permit",
+                )
                 continue
 
             ok, dt = self._finish_binding(
@@ -744,6 +768,18 @@ class Scheduler:
             )
         if n_fail:
             metrics.schedule_attempts_total.labels("error", profile).inc(n_fail)
+
+    def _event(
+        self, obj, reason: str, note: str,
+        type_: str = "Normal", action: str = "Scheduling",
+    ) -> None:
+        """Events recorder (SURVEY §6.5): the broadcaster the reference
+        wires through EventsToRegister, collapsed to direct records on
+        the state service (the [BOUNDARY] apiserver stand-in dedups)."""
+        self.cluster.record_event(
+            obj, reason, note, type_=type_, action=action,
+            timestamp=self.clock.now(),
+        )
 
     # -- Reserve / Permit / Bind extension points (host-side, around the
     # device solve — framework.go#RunReservePluginsReserve,
@@ -805,9 +841,19 @@ class Scheduler:
             reason = e.reason if isinstance(e, ApiError) else str(e)
             res.bind_failures.append((pod.key, reason))
             self.queue.add_unschedulable(info, cycle)
+            self._event(
+                pod, "FailedScheduling",
+                f"binding rejected: {reason}", type_="Warning",
+                action="Binding",
+            )
             return False, time.perf_counter() - tb
         self.cache.finish_binding(pod.key)
         self.volume_binder.finish(pod.key)
+        self._event(
+            pod, "Scheduled",
+            f"Successfully assigned {pod.key} to {node_name}",
+            action="Binding",
+        )
         res.scheduled.append((pod.key, node_name))
         res.latencies.append(time.perf_counter() - t_start)
         # pod-level SLIs: attempts-to-success histogram and e2e latency
@@ -836,6 +882,16 @@ class Scheduler:
                 self._unreserve_all(state, wp.pod, wp.node_name)
                 res.unschedulable.append(key)
                 self.queue.add_unschedulable(info, cycle)
+                why = (
+                    f"permit plugin {wp.rejected_by} rejected: "
+                    f"{wp.reject_message}"
+                    if wp.rejected_by is not None
+                    else f"permit plugin {expired} timed out"
+                )
+                self._event(
+                    wp.pod, "FailedScheduling", why,
+                    type_="Warning", action="Permit",
+                )
             elif wp.allowed:
                 del self._waiting[key]
                 self._finish_binding(
@@ -946,6 +1002,11 @@ class Scheduler:
         # evictions (the cache also updates via the DELETED watch events).
         victim_keys = {v.key for v in result.victims}
         for victim in result.victims:
+            self._event(
+                victim, "Preempted",
+                f"Preempted by {pod.key} on node {result.node_name}",
+                type_="Warning", action="Preempting",
+            )
             try:
                 self.cluster.delete_pod(victim.namespace, victim.name)
             except ApiError:
@@ -973,6 +1034,12 @@ class Scheduler:
         except ApiError:
             return None  # pod vanished mid-preemption
         pod.nominated_node_name = result.node_name
+        self._event(
+            pod, "Nominated",
+            f"preemption made room on {result.node_name}: nominated "
+            f"({len(result.victims)} victim(s) evicted)",
+            action="Preempting",
+        )
         res.preemptions.append(
             (pod.key, result.node_name, [v.key for v in result.victims])
         )

@@ -46,12 +46,65 @@ class ApiError(Exception):
 @dataclass
 class Event:
     type: EventType
-    kind: str  # "Pod" | "Node"
-    obj: Pod | Node
+    kind: str  # "Pod" | "Node" | "Event"
+    obj: object  # Pod | Node | EventRecord
     resource_version: int
 
 
 Watcher = Callable[[Event], None]
+
+
+@dataclass
+class EventRecord:
+    """events.k8s.io/v1 Event analog (the scheduler's operator-facing
+    history: staging/src/k8s.io/api/events/v1/types.go#Event). The
+    broadcaster's correlator dedup collapses repeats of the same
+    (regarding, reason, note) into one record with a bumped count, like
+    the reference's EventAggregator."""
+
+    namespace: str
+    regarding_kind: str  # "Pod" | "Node"
+    regarding_namespace: str
+    regarding_name: str
+    reason: str  # Scheduled | FailedScheduling | Preempted | Nominated...
+    note: str
+    type: str = "Normal"  # Normal | Warning
+    action: str = "Scheduling"
+    reporting_controller: str = "kubernetes-tpu-scheduler"
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+    name: str = ""  # generated: <regarding>.<seq>
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def to_dict(self) -> dict:
+        """events.k8s.io/v1 wire shape."""
+        return {
+            "apiVersion": "events.k8s.io/v1",
+            "kind": "Event",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "resourceVersion": str(self.resource_version),
+            },
+            "regarding": {
+                "kind": self.regarding_kind,
+                "namespace": self.regarding_namespace,
+                "name": self.regarding_name,
+            },
+            "reason": self.reason,
+            "note": self.note,
+            "type": self.type,
+            "action": self.action,
+            "reportingController": self.reporting_controller,
+            "deprecatedCount": self.count,
+            "deprecatedFirstTimestamp": self.first_timestamp,
+            "deprecatedLastTimestamp": self.last_timestamp,
+        }
 
 
 class ClusterState:
@@ -73,6 +126,10 @@ class ClusterState:
         self._pvs: dict[str, PersistentVolume] = {}
         self._pvcs: dict[str, PersistentVolumeClaim] = {}
         self._services: dict[str, object] = {}
+        self._events: dict[str, EventRecord] = {}
+        self._events_by_agg: dict[tuple, EventRecord] = {}
+        self._event_seq = 0
+        self.event_ttl = 3600.0  # reference --event-ttl default
         self._watchers: list[Watcher] = []
         # fault injection: called with (pod, node_name) before a bind commits;
         # raise ApiError to simulate apiserver-side rejection
@@ -275,6 +332,91 @@ class ClusterState:
     def create_pods(self, pods: Iterable[Pod]) -> None:
         for p in pods:
             self.create_pod(p)
+
+    # -- events (events.k8s.io/v1 subset; SURVEY §6.5 events row) --
+
+    def record_event(
+        self,
+        regarding: "Pod | Node",
+        reason: str,
+        note: str,
+        type_: str = "Normal",
+        action: str = "Scheduling",
+        timestamp: float | None = None,
+    ) -> EventRecord:
+        """EventBroadcaster + correlator analog: repeats of the same
+        (regarding, reason, note) bump count/lastTimestamp on the existing
+        record (EventAggregator's dedup key, minus source — one scheduler
+        here); new tuples create a record. Emits on the watch bus with
+        kind="Event" either way."""
+        import time as _time
+
+        ts = _time.time() if timestamp is None else timestamp
+        # reference apiserver gives Events a TTL (1h default) instead of
+        # durable storage; prune lazily from the front of insertion order
+        # so a serve process streaming short-lived pods stays bounded. A
+        # count-bumped old record stops the sweep early — conservative.
+        cutoff = ts - self.event_ttl
+        while self._events:
+            first = next(iter(self._events.values()))
+            if first.last_timestamp >= cutoff:
+                break
+            del self._events[first.key]
+            self._events_by_agg.pop(
+                (
+                    first.regarding_kind, first.namespace,
+                    first.regarding_name, first.reason, first.note,
+                ),
+                None,
+            )
+        ns = getattr(regarding, "namespace", "") or "default"
+        kind = "Pod" if isinstance(regarding, Pod) else "Node"
+        agg_key = (kind, ns, regarding.name, reason, note)
+        rec = self._events_by_agg.get(agg_key)
+        if rec is not None:
+            rec.count += 1
+            rec.last_timestamp = ts
+            rec.resource_version = self._next_rv()
+            self._emit("MODIFIED", "Event", rec)
+            return rec
+        self._event_seq += 1
+        rec = EventRecord(
+            namespace=ns,
+            regarding_kind=kind,
+            regarding_namespace=ns if kind == "Pod" else "",
+            regarding_name=regarding.name,
+            reason=reason,
+            note=note,
+            type=type_,
+            action=action,
+            first_timestamp=ts,
+            last_timestamp=ts,
+            name=f"{regarding.name}.{self._event_seq:x}",
+            resource_version=self._next_rv(),
+        )
+        self._events[rec.key] = rec
+        self._events_by_agg[agg_key] = rec
+        self._emit("ADDED", "Event", rec)
+        return rec
+
+    def list_events(
+        self,
+        namespace: str | None = None,
+        regarding_name: str | None = None,
+    ) -> list[EventRecord]:
+        """List in creation order, optionally field-selected the way
+        kubectl describe does (involvedObject.name=...)."""
+        out = []
+        for rec in self._events.values():
+            if namespace is not None and rec.namespace != namespace:
+                continue
+            if (
+                regarding_name is not None
+                and rec.regarding_name != regarding_name
+            ):
+                continue
+            out.append(rec)
+        return out
 
 
 def _locked(fn):

@@ -460,3 +460,27 @@ def test_pre_enqueue_regates_on_requeue():
     r2 = sched.schedule_batch()
     assert not r2.scheduled and not r2.unschedulable
     assert sched.queue.pending_counts()["gated"] == 1
+
+
+def test_modified_event_does_not_requeue_permit_waiting_pod():
+    """A watch MODIFIED for a pod parked at Permit must not re-enter the
+    queue (it is in flight: assumed + reserved — review-caught repro
+    showed double-scheduling and a stale queue entry)."""
+    cs = ClusterState()
+    for n in mk_nodes(2):
+        cs.create_node(n)
+    sched = _sched(cs, [HoldAtPermit()])
+    pod = MakePod().name("p").req({"cpu": "1"}).obj()
+    cs.create_pod(pod)
+    sched.schedule_batch()
+    assert list(sched.waiting_pods()) == ["default/p"]
+    # external label update while waiting
+    updated = cs.get_pod("default", "p")
+    updated.labels = dict(updated.labels, touched="yes")
+    cs.update_pod(updated)
+    assert len(sched.queue) == 0  # NOT re-queued
+    sched.waiting_pods()["default/p"].allow("HoldAtPermit")
+    r = sched.schedule_batch()
+    assert [k for k, _ in r.scheduled] == ["default/p"]
+    assert sched.queue.pending_counts()["unschedulable"] == 0
+    assert sched.pending == 0

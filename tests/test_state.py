@@ -282,3 +282,18 @@ class TestPriorityQueue:
         q.add(pod("p1"))
         q.delete("default/p1")
         assert q.pop_batch(1) == []
+
+
+def test_event_store_ttl_prunes_old_records():
+    """Events expire after event_ttl (the reference apiserver's 1h TTL)
+    instead of accumulating forever."""
+    from kubernetes_tpu.api.wrappers import MakeNode
+
+    cs = ClusterState()
+    n = cs.create_node(MakeNode().name("n1").capacity({"cpu": "1"}).obj())
+    cs.event_ttl = 100.0
+    cs.record_event(n, "Old", "stale note", timestamp=0.0)
+    cs.record_event(n, "Newer", "fresh note", timestamp=150.0)
+    cs.record_event(n, "Latest", "now", timestamp=200.0)
+    reasons = {e.reason for e in cs.list_events()}
+    assert "Old" not in reasons and {"Newer", "Latest"} <= reasons
