@@ -377,4 +377,7 @@ def scheduler_config(cfg: KubeSchedulerConfiguration):
         enable_preemption=cfg.tpu_solver.enable_preemption,
         solver=profiles[cfg.profiles[0].scheduler_name],
         profiles=profiles,
+        # honored, not just parsed: the scheduler consults these via the
+        # outbound HTTP client during every solve
+        extenders=tuple(cfg.extenders),
     )

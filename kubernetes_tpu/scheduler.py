@@ -28,6 +28,7 @@ from . import metrics
 from .api.objects import Pod
 from .framework.interface import CycleState, StatusCode
 from .framework.runtime import WaitingPod
+from .server.extender_client import ExtenderError
 from .solver.exact import ExactSolver, ExactSolverConfig
 from .solver.preemption import PreemptionEvaluator
 from .state.cache import SchedulerCache
@@ -60,6 +61,11 @@ class SchedulerConfig:
     profiles: dict[str, ExactSolverConfig] | None = None
     # component-base/featuregate analog (--feature-gates); None = defaults
     feature_gates: object = None
+    # KubeSchedulerConfiguration.extenders[] (config/types.py#Extender):
+    # consulted during each solve via the outbound HTTP client
+    # (server/extender_client.py) — filter/prioritize verdicts fold into
+    # the per-class device tables; a bind-verb extender owns the binding
+    extenders: tuple = ()
     # out-of-tree Scheduling Framework plugins (framework/interface.py),
     # classified by the extension-point protocols each implements:
     # Filter/Score (+ PreFilter incl. PreFilterResult allowlists) fold
@@ -141,6 +147,12 @@ class Scheduler:
         # pop timestamp). Verdicts recorded via WaitingPod.allow/reject
         # apply at the start of the next scheduling cycle.
         self._waiting: dict[str, tuple] = {}
+        # outbound extender clients, configured order (extender.go)
+        from .server.extender_client import HTTPExtenderClient
+
+        self.extender_clients = tuple(
+            HTTPExtenderClient(e) for e in self.config.extenders
+        )
         self.snapshot = Snapshot()
         from .state.volume_binder import VolumeBinder
 
@@ -205,9 +217,13 @@ class Scheduler:
                 elif pod.key in self._waiting:
                     # parked at Permit: the pod is in flight (assumed +
                     # reserved), NOT queued — re-adding it here would
-                    # double-schedule it. Refresh the waiting copy so the
-                    # eventual bind uses current metadata.
-                    self._waiting[pod.key][0].pod = pod
+                    # double-schedule it. Refresh BOTH in-flight copies
+                    # (the WaitingPod for the eventual bind and the
+                    # QueuedPodInfo a rejection/timeout would requeue) so
+                    # neither path resurrects the stale spec.
+                    entry = self._waiting[pod.key]
+                    entry[0].pod = pod
+                    entry[1].pod = pod
                 elif pod.scheduler_name in self.solvers:
                     self.queue.update(pod)
             else:  # DELETED
@@ -300,32 +316,79 @@ class Scheduler:
         (schedule_one.go#frameworkForPod) and sub-batches solve in pop
         order.
 
-        Holds the cluster RLock for the whole cycle: queue/cache mutate via
-        watch events (fired under that lock), so the serve path's ingest
-        and gRPC threads are serialized against pop -> solve -> bind."""
+        Lock discipline (schedule_one.go's schedulingCycle/bindingCycle
+        decoupling, batched): the cluster RLock is held in three short
+        phases — (1) waiting-pod settlement + pop, (2) per group:
+        snapshot + tensorize, then again for assume/Reserve/Permit after
+        the solve — and NOT across the device solve or the bind commits.
+        Ingest threads and a same-process extender server can therefore
+        take the lock while the device works or a bind crosses the wire.
+        The assume/forget protocol fences every gap: assumed pods are in
+        the cache before the lock drops, so any concurrent snapshot
+        counts them, and a mid-solve cache mutation lands in the NEXT
+        cycle's snapshot (the same staleness window the reference's
+        binding goroutines accept)."""
         from .utils import tracing
 
-        with self.cluster.lock:
-            if tracing.enabled():
-                self._trace_step = getattr(self, "_trace_step", 0) + 1
-                with tracing.step("schedule_batch", self._trace_step):
-                    return self._schedule_batch_locked()
-            return self._schedule_batch_locked()
+        if tracing.enabled():
+            self._trace_step = getattr(self, "_trace_step", 0) + 1
+            with tracing.step("schedule_batch", self._trace_step):
+                return self._schedule_cycle()
+        return self._schedule_cycle()
 
-    def _schedule_batch_locked(self) -> BatchResult:
+    def _schedule_cycle(self) -> BatchResult:
+        pending: list[tuple] = []
         res = BatchResult()
         t0 = time.perf_counter()
-        # WaitOnPermit analog: settle WaitingPods whose verdict or
-        # deadline arrived since the last cycle, before popping new work
-        if self._waiting:
-            self._process_waiting(res)
-        # #flushUnschedulablePodsLeftover: the reference runs this on a 30s
-        # timer goroutine; batching gives a natural tick — pods parked
-        # longer than 5 min force back into rotation before each pop
-        self.queue.flush_unschedulable_leftover()
-        infos = self.queue.pop_batch(self.config.batch_size)
-        if not infos:
-            return res
+        with self.cluster.lock:
+            # WaitOnPermit analog: settle WaitingPods whose verdict or
+            # deadline arrived since the last cycle, before popping new
+            # work
+            if self._waiting:
+                self._process_waiting(res, pending)
+            # #flushUnschedulablePodsLeftover: the reference runs this on
+            # a 30s timer goroutine; batching gives a natural tick — pods
+            # parked longer than 5 min force back into rotation
+            self.queue.flush_unschedulable_leftover()
+            infos = self.queue.pop_batch(self.config.batch_size)
+        try:
+            if infos:
+                self._run_groups(infos, res, pending, t0)
+                res.host_seconds = (
+                    time.perf_counter() - t0 - res.solve_seconds
+                )
+                self._record_metrics(res, len(infos))
+        except Exception:
+            # a mid-cycle outage (non-ignorable extender down, plugin
+            # ERROR) surfaces to the caller, but must not strand work:
+            # popped pods that were neither approved, parked, nor already
+            # requeued go back to the queue with backoff, and approved
+            # binds still commit (the finally below).
+            handled = (
+                {e[2].key for e in pending}
+                | set(res.unschedulable)
+                | {k for k, _ in res.bind_failures}
+                | set(self._waiting)
+            )
+            with self.cluster.lock:
+                base = self.queue.scheduling_cycle
+                for info in infos:
+                    if info.key not in handled:
+                        self.queue.add_unschedulable(info, base)
+            raise
+        finally:
+            if pending:
+                tb = time.perf_counter()
+                for entry in pending:
+                    self._commit_binding(entry, res)
+                metrics.framework_extension_point_duration_seconds.labels(
+                    "Bind", "Success", "all"
+                ).observe(time.perf_counter() - tb)
+        return res
+
+    def _run_groups(
+        self, infos: list, res: BatchResult, pending: list, t0: float
+    ) -> None:
         base_cycle = self.queue.scheduling_cycle - len(infos)
 
         if len(self.solvers) == 1:
@@ -350,12 +413,9 @@ class Scheduler:
             ]
         for name, group_infos, cycle_offsets in groups:
             self._solve_group(
-                name, group_infos, cycle_offsets, base_cycle, res, t0
+                name, group_infos, cycle_offsets, base_cycle, res, t0,
+                pending,
             )
-
-        res.host_seconds = time.perf_counter() - t0 - res.solve_seconds
-        self._record_metrics(res, len(infos))
-        return res
 
     def _solve_group(
         self,
@@ -365,167 +425,225 @@ class Scheduler:
         base_cycle: int,
         res: BatchResult,
         t0: float,
+        pending: list,
     ) -> None:
         solver = self.solvers[profile]
         gs = time.perf_counter()
-        scheduled_before = len(res.scheduled)
+        pending_before = len(pending)
         unsched_before = len(res.unschedulable)
         failures_before = len(res.bind_failures)
-        batch = self.snapshot.update(self.cache)
-        pods = [i.pod for i in infos]
+        with self.cluster.lock:
+            # phase 2a: snapshot + tensorize against a consistent view
+            batch = self.snapshot.update(self.cache)
+            pods = [i.pod for i in infos]
 
-        def has_pod_affinity(p: Pod) -> bool:
-            return p.affinity is not None and (
-                p.affinity.pod_affinity is not None
-                or p.affinity.pod_anti_affinity is not None
+            def has_pod_affinity(p: Pod) -> bool:
+                return p.affinity is not None and (
+                    p.affinity.pod_affinity is not None
+                    or p.affinity.pod_anti_affinity is not None
+                )
+
+            need_ports = any(p.host_ports() for p in pods)
+            need_spread = any(p.topology_spread_constraints for p in pods)
+            # PodTopologySpread defaultingType=System: service-selected pods
+            # without explicit constraints get soft cluster defaults
+            services = (
+                self.cluster.list_services()
+                if solver.config.spread_defaulting == "System"
+                else []
             )
+            if services and not need_spread:
+                from .ops.oracle.spread import default_selector
 
-        need_ports = any(p.host_ports() for p in pods)
-        need_spread = any(p.topology_spread_constraints for p in pods)
-        # PodTopologySpread defaultingType=System: service-selected pods
-        # without explicit constraints get soft cluster defaults
-        services = (
-            self.cluster.list_services()
-            if solver.config.spread_defaulting == "System"
-            else []
-        )
-        if services and not need_spread:
-            from .ops.oracle.spread import default_selector
+                need_spread = any(
+                    not p.topology_spread_constraints
+                    and default_selector(p, services) is not None
+                    for p in pods
+                )
+            need_interpod = any(has_pod_affinity(p) for p in pods) or any(
+                info.pods_with_affinity
+                for info in self.cache.nodes.values()
+                if info.node is not None
+            )
+            # Pad the pod axis to the configured batch size so every cycle —
+            # including the final partial batch — reuses ONE compiled shape
+            # (§8.8 recompile storms). All-padding chunks are near-free in the
+            # grouped solver's fast path, so the fixed bucket only pays off when
+            # that path can engage (mirror of the solver's dispatch condition);
+            # otherwise the per-pod scan would walk every padding step, so keep
+            # the tight pow2 bucket.
+            from .solver.exact import grouped_eligible
 
-            need_spread = any(
-                not p.topology_spread_constraints
-                and default_selector(p, services) is not None
+            # nominated pods force the per-pod scan (grouped_eligible), so
+            # detect them before committing to the fixed pod-axis bucket
+            nom_pairs = []
+            for q in self.nominated_pods.values():
+                try:
+                    nom_pairs.append(
+                        (q, self.snapshot.slot_of(q.nominated_node_name))
+                    )
+                except KeyError:
+                    continue  # nominated node no longer in the snapshot
+
+            # mirror the tensor-level groupable facts from the pods (solve
+            # recomputes them from the tensors; disagreement degrades to
+            # padded-slow, never wrong): hard-only spread with no soft
+            # constraints / no service defaults; anti-affinity-only interpod
+            spread_groupable = need_spread and not services and all(
+                all(
+                    c.when_unsatisfiable == "DoNotSchedule"
+                    for c in p.topology_spread_constraints
+                )
                 for p in pods
             )
-        need_interpod = any(has_pod_affinity(p) for p in pods) or any(
-            info.pods_with_affinity
-            for info in self.cache.nodes.values()
-            if info.node is not None
-        )
-        # Pad the pod axis to the configured batch size so every cycle —
-        # including the final partial batch — reuses ONE compiled shape
-        # (§8.8 recompile storms). All-padding chunks are near-free in the
-        # grouped solver's fast path, so the fixed bucket only pays off when
-        # that path can engage (mirror of the solver's dispatch condition);
-        # otherwise the per-pod scan would walk every padding step, so keep
-        # the tight pow2 bucket.
-        from .solver.exact import grouped_eligible
-
-        # nominated pods force the per-pod scan (grouped_eligible), so
-        # detect them before committing to the fixed pod-axis bucket
-        nom_pairs = []
-        for q in self.nominated_pods.values():
-            try:
-                nom_pairs.append(
-                    (q, self.snapshot.slot_of(q.nominated_node_name))
+            interpod_groupable = need_interpod and all(
+                p.affinity is None
+                or (
+                    p.affinity.pod_affinity is None
+                    and (
+                        p.affinity.pod_anti_affinity is None
+                        or not p.affinity.pod_anti_affinity.preferred
+                    )
                 )
-            except KeyError:
-                continue  # nominated node no longer in the snapshot
-
-        # mirror the tensor-level groupable facts from the pods (solve
-        # recomputes them from the tensors; disagreement degrades to
-        # padded-slow, never wrong): hard-only spread with no soft
-        # constraints / no service defaults; anti-affinity-only interpod
-        spread_groupable = need_spread and not services and all(
-            all(
-                c.when_unsatisfiable == "DoNotSchedule"
-                for c in p.topology_spread_constraints
+                for p in pods
             )
-            for p in pods
-        )
-        interpod_groupable = need_interpod and all(
-            p.affinity is None
-            or (
-                p.affinity.pod_affinity is None
-                and (
-                    p.affinity.pod_anti_affinity is None
-                    or not p.affinity.pod_anti_affinity.preferred
+            grouped_ok = grouped_eligible(
+                solver.config, self.config.batch_size, batch.padded,
+                need_spread, need_interpod, bool(nom_pairs),
+                spread_groupable=spread_groupable,
+                interpod_groupable=interpod_groupable,
+            )
+            pod_pad = (
+                self.config.batch_size
+                if grouped_ok and len(pods) <= self.config.batch_size
+                else None
+            )
+            # per-plugin host tensorization timings feed the reference's
+            # plugin_execution_duration_seconds series: inside the fused device
+            # program per-plugin attribution doesn't exist, but the host-side
+            # per-plugin-family tensorizers are real measured work
+            def _timed(plugin: str, fn, *a, **kw):
+                tp = time.perf_counter()
+                out = fn(*a, **kw)
+                metrics.plugin_execution_duration_seconds.labels(
+                    plugin, "PreFilter", "Success"
+                ).observe(time.perf_counter() - tp)
+                return out
+
+            pbatch = _timed(
+                "NodeResourcesFit", build_pod_batch, pods, batch.vocab, pad=pod_pad
+            )
+
+            # Node objects in snapshot-slot order, for the plugin tensorizers
+            # (share the solver's node index space).
+            slot_nodes = []
+            for name in self.snapshot.names:
+                info = self.cache.nodes.get(name) if name else None
+                slot_nodes.append(info.node if info is not None else None)
+
+            volume_ctx = None
+            if any(p.pvc_names for p in pods):
+                from .ops.oracle.volumes import VolumeContext
+
+                volume_ctx = VolumeContext.build(
+                    self.cluster.list_pvs(),
+                    self.cluster.list_pvcs(),
+                    {
+                        info.node.name: list(info.pods.values())
+                        for info in self.cache.nodes.values()
+                        if info.node is not None and info.pods
+                    },
                 )
+            class_key_extra = None
+            if services:
+                from .ops.oracle.spread import default_selector_key
+
+                def class_key_extra(p):
+                    if p.topology_spread_constraints:
+                        return None
+                    return default_selector_key(p, services)
+
+            if self.config.out_of_tree_plugins or self.extender_clients:
+                # custom plugins and extenders read pod fields the in-tree
+                # class key doesn't cover (labels/annotations on spread-free
+                # pods): fold them into the class identity so two pods such a
+                # consumer would treat differently never share one
+                # representative's verdicts. (Plugins must key off spec
+                # fields in the class identity — framework/interface.py
+                # documents the contract; extenders see the rep's full JSON.)
+                base_extra = class_key_extra
+
+                def class_key_extra(p, _base=base_extra):
+                    parts = (
+                        tuple(sorted(p.labels.items())),
+                        tuple(sorted(p.annotations.items())),
+                    )
+                    if _base is not None:
+                        return (parts, _base(p))
+                    return parts
+
+            static = _timed(
+                "NodeAffinity",  # the static-mask family's dominant member
+                build_static_tensors,
+                pods, pbatch, slot_nodes, batch.padded, volume_ctx,
+                disabled=frozenset(solver.config.disabled_filters),
+                added_affinity=solver.config.added_affinity,
+                class_key_extra=class_key_extra,
             )
-            for p in pods
-        )
-        grouped_ok = grouped_eligible(
-            solver.config, self.config.batch_size, batch.padded,
-            need_spread, need_interpod, bool(nom_pairs),
-            spread_groupable=spread_groupable,
-            interpod_groupable=interpod_groupable,
-        )
-        pod_pad = (
-            self.config.batch_size
-            if grouped_ok and len(pods) <= self.config.batch_size
-            else None
-        )
-        # per-plugin host tensorization timings feed the reference's
-        # plugin_execution_duration_seconds series: inside the fused device
-        # program per-plugin attribution doesn't exist, but the host-side
-        # per-plugin-family tensorizers are real measured work
-        def _timed(plugin: str, fn, *a, **kw):
-            tp = time.perf_counter()
-            out = fn(*a, **kw)
-            metrics.plugin_execution_duration_seconds.labels(
-                plugin, "PreFilter", "Success"
-            ).observe(time.perf_counter() - tp)
-            return out
-
-        pbatch = _timed(
-            "NodeResourcesFit", build_pod_batch, pods, batch.vocab, pad=pod_pad
-        )
-
-        # Node objects in snapshot-slot order, for the plugin tensorizers
-        # (share the solver's node index space).
-        slot_nodes = []
-        for name in self.snapshot.names:
-            info = self.cache.nodes.get(name) if name else None
-            slot_nodes.append(info.node if info is not None else None)
-
-        volume_ctx = None
-        if any(p.pvc_names for p in pods):
-            from .ops.oracle.volumes import VolumeContext
-
-            volume_ctx = VolumeContext.build(
-                self.cluster.list_pvs(),
-                self.cluster.list_pvcs(),
-                {
-                    info.node.name: list(info.pods.values())
-                    for info in self.cache.nodes.values()
-                    if info.node is not None and info.pods
-                },
-            )
-        class_key_extra = None
-        if services:
-            from .ops.oracle.spread import default_selector_key
-
-            def class_key_extra(p):
-                if p.topology_spread_constraints:
-                    return None
-                return default_selector_key(p, services)
-
-        if self.config.out_of_tree_plugins:
-            # custom plugins read pod fields the in-tree class key doesn't
-            # cover (labels/annotations on spread-free pods): fold them
-            # into the class identity so two pods a plugin would treat
-            # differently never share one representative's verdicts.
-            # (Plugins must key off spec fields in the class identity —
-            # framework/interface.py documents the contract.)
-            base_extra = class_key_extra
-
-            def class_key_extra(p, _base=base_extra):
-                parts = (
-                    tuple(sorted(p.labels.items())),
-                    tuple(sorted(p.annotations.items())),
+            placed_by_slot: dict[int, list[Pod]] = {}
+            if need_ports or need_spread or need_interpod:
+                for slot, name in enumerate(self.snapshot.names):
+                    info = self.cache.nodes.get(name) if name else None
+                    if info is not None and info.node is not None and info.pods:
+                        placed_by_slot[slot] = list(info.pods.values())
+            if need_ports:
+                ports = _timed(
+                    "NodePorts", build_port_tensors,
+                    pods, pbatch, slot_nodes, placed_by_slot, batch.padded,
                 )
-                if _base is not None:
-                    return (parts, _base(p))
-                return parts
+            else:
+                ports = trivial_port_tensors(pbatch, batch.padded)
+            spread = None
+            if need_spread:
+                spread = _timed(
+                    "PodTopologySpread", build_spread_tensors,
+                    pods, static.reps, pbatch, slot_nodes,
+                    placed_by_slot, batch.padded, static.c_pad,
+                    services=services,
+                    defaulting=solver.config.spread_defaulting,
+                )
+            interpod = None
+            if need_interpod:
+                interpod = _timed(
+                    "InterPodAffinity", build_interpod_tensors,
+                    pods, static.reps, pbatch, slot_nodes,
+                    placed_by_slot, batch.padded, static.c_pad,
+                    hard_pod_affinity_weight=solver.config.hard_pod_affinity_weight,
+                )
 
-        static = _timed(
-            "NodeAffinity",  # the static-mask family's dominant member
-            build_static_tensors,
-            pods, pbatch, slot_nodes, batch.padded, volume_ctx,
-            disabled=frozenset(solver.config.disabled_filters),
-            added_affinity=solver.config.added_affinity,
-            class_key_extra=class_key_extra,
-        )
+            # nominated-pod load (RunFilterPluginsWithNominatedPods analog):
+            # unbound pods carrying a nomination count as placed on their
+            # nominated node for higher/equal-priority peers; pods in THIS
+            # batch that are themselves nominated get a per-pod slot for the
+            # evaluateNominatedNode-first pick and self-exclusion
+            from .tensorize.schema import build_nominated_tensors
+
+            nominated = build_nominated_tensors(
+                nom_pairs, batch.vocab, batch.padded
+            )
+            nominated_slot = None
+            if not nominated.empty:
+                # batch pods carrying a nomination are in nom_pairs (same
+                # objects, same slot resolution) — reuse, don't re-resolve
+                slot_by_key = {p.key: slot for p, slot in nom_pairs}
+                nominated_slot = np.full(len(pods), -1, dtype=np.int32)
+                for i, p in enumerate(pods):
+                    nominated_slot[i] = slot_by_key.get(p.key, -1)
+
+        # Out-of-tree plugin + extender folding runs OUTSIDE the
+        # cluster lock (arbitrary user code / HTTP round trips must
+        # not block ingest); it only touches the host-side static
+        # tables and immutable Node snapshots gathered above.
         if self.config.out_of_tree_plugins:
             # out-of-tree Scheduling Framework plugins: class-vectorized
             # folding into the static mask / extra-score tables. A
@@ -540,56 +658,23 @@ class Scheduler:
             )
             if extra.any():
                 static.extra_score = extra
-        placed_by_slot: dict[int, list[Pod]] = {}
-        if need_ports or need_spread or need_interpod:
-            for slot, name in enumerate(self.snapshot.names):
-                info = self.cache.nodes.get(name) if name else None
-                if info is not None and info.node is not None and info.pods:
-                    placed_by_slot[slot] = list(info.pods.values())
-        if need_ports:
-            ports = _timed(
-                "NodePorts", build_port_tensors,
-                pods, pbatch, slot_nodes, placed_by_slot, batch.padded,
-            )
-        else:
-            ports = trivial_port_tensors(pbatch, batch.padded)
-        spread = None
-        if need_spread:
-            spread = _timed(
-                "PodTopologySpread", build_spread_tensors,
-                pods, static.reps, pbatch, slot_nodes,
-                placed_by_slot, batch.padded, static.c_pad,
-                services=services,
-                defaulting=solver.config.spread_defaulting,
-            )
-        interpod = None
-        if need_interpod:
-            interpod = _timed(
-                "InterPodAffinity", build_interpod_tensors,
-                pods, static.reps, pbatch, slot_nodes,
-                placed_by_slot, batch.padded, static.c_pad,
-                hard_pod_affinity_weight=solver.config.hard_pod_affinity_weight,
-            )
+        if self.extender_clients:
+            # findNodesThatPassExtenders + prioritizeNodes' extender pass,
+            # folded per scheduling class like out-of-tree plugins (one
+            # wire round trip per class+extender+verb per batch)
+            from .server.extender_client import fold_extenders
 
-        # nominated-pod load (RunFilterPluginsWithNominatedPods analog):
-        # unbound pods carrying a nomination count as placed on their
-        # nominated node for higher/equal-priority peers; pods in THIS
-        # batch that are themselves nominated get a per-pod slot for the
-        # evaluateNominatedNode-first pick and self-exclusion
-        from .tensorize.schema import build_nominated_tensors
-
-        nominated = build_nominated_tensors(
-            nom_pairs, batch.vocab, batch.padded
-        )
-        nominated_slot = None
-        if not nominated.empty:
-            # batch pods carrying a nomination are in nom_pairs (same
-            # objects, same slot resolution) — reuse, don't re-resolve
-            slot_by_key = {p.key: slot for p, slot in nom_pairs}
-            nominated_slot = np.full(len(pods), -1, dtype=np.int32)
-            for i, p in enumerate(pods):
-                nominated_slot[i] = slot_by_key.get(p.key, -1)
-
+            extra = (
+                static.extra_score
+                if static.extra_score is not None
+                else np.zeros(static.mask.shape, dtype=np.int32)
+            )
+            fold_extenders(
+                self.extender_clients, static.reps, slot_nodes,
+                static.mask, extra,
+            )
+            if extra.any():
+                static.extra_score = extra
         t1 = time.perf_counter()
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
@@ -613,148 +698,157 @@ class Scheduler:
             "Filter", "Success", profile
         ).observe(solve_dt)
 
-        preempt_placed: dict[int, list[Pod]] | None = None
-        preempt_pdbs: list = []
-        cluster_has_affinity = False
-        postfilter_reasons: dict | None = None
-        preempt_dt = 0.0
-        bind_dt = 0.0
-        for idx, (info, a) in enumerate(zip(infos, assignments)):
-            pod = info.pod
-            cycle = base_cycle + cycle_offsets[idx] + 1
-            if a < 0:
-                # failure path: PostFilter — defaultpreemption first, then
-                # out-of-tree PostFilter plugins (first success nominates)
-                nominated_node = None
-                if self.config.enable_preemption:
-                    if preempt_placed is None:
-                        # shared across this batch's failures: occupancy
-                        # snapshot, PDB list, and the cluster-wide
-                        # pods-with-affinity flag (avoid per-pod rescans)
-                        preempt_placed = self._placed_by_slot()
-                        preempt_pdbs = self.cluster.list_pdbs()
-                        cluster_has_affinity = any(
-                            i2.pods_with_affinity
-                            for i2 in self.cache.nodes.values()
-                            if i2.node is not None
+        with self.cluster.lock:
+            # phase 2b: apply assignments — assume / Reserve / Permit /
+            # PostFilter — atomically with the watch-event consumers
+            preempt_placed: dict[int, list[Pod]] | None = None
+            preempt_pdbs: list = []
+            cluster_has_affinity = False
+            postfilter_reasons: dict | None = None
+            preempt_dt = 0.0
+            bind_dt = 0.0
+            for idx, (info, a) in enumerate(zip(infos, assignments)):
+                pod = info.pod
+                cycle = base_cycle + cycle_offsets[idx] + 1
+                if a < 0:
+                    # failure path: PostFilter — defaultpreemption first, then
+                    # out-of-tree PostFilter plugins (first success nominates)
+                    nominated_node = None
+                    if self.config.enable_preemption:
+                        if preempt_placed is None:
+                            # shared across this batch's failures: occupancy
+                            # snapshot, PDB list, and the cluster-wide
+                            # pods-with-affinity flag (avoid per-pod rescans)
+                            preempt_placed = self._placed_by_slot()
+                            preempt_pdbs = self.cluster.list_pdbs()
+                            cluster_has_affinity = any(
+                                i2.pods_with_affinity
+                                for i2 in self.cache.nodes.values()
+                                if i2.node is not None
+                            )
+                        tpf = time.perf_counter()
+                        nominated_node = self._try_preempt(
+                            pod, static, idx, res, preempt_placed, slot_nodes,
+                            preempt_pdbs, cluster_has_affinity, solver,
                         )
-                    tpf = time.perf_counter()
-                    nominated_node = self._try_preempt(
-                        pod, static, idx, res, preempt_placed, slot_nodes,
-                        preempt_pdbs, cluster_has_affinity, solver,
+                        preempt_dt += time.perf_counter() - tpf
+                    if nominated_node is None and self.registry.post_filter:
+                        if postfilter_reasons is None:
+                            # NodeToStatusMap analog, shared across this
+                            # batch's failures: per-node reasons don't exist
+                            # inside the fused pipeline, so every candidate
+                            # carries the batch-level rejection
+                            postfilter_reasons = {
+                                n.name: "node did not satisfy the batched "
+                                "filter pipeline"
+                                for n in slot_nodes
+                                if n is not None
+                            }
+                        tpf = time.perf_counter()
+                        # fresh copy per pod: upstream's NodeToStatusMap is
+                        # per-pod scratch a plugin may legitimately mutate
+                        self._run_post_filter(pod, dict(postfilter_reasons))
+                        preempt_dt += time.perf_counter() - tpf
+                    res.unschedulable.append(pod.key)
+                    self.queue.add_unschedulable(info, cycle)
+                    n_nodes = sum(1 for n in slot_nodes if n is not None)
+                    self._event(
+                        pod, "FailedScheduling",
+                        f"0/{n_nodes} nodes are available: the batched "
+                        "filter pipeline rejected every candidate",
+                        type_="Warning",
                     )
-                    preempt_dt += time.perf_counter() - tpf
-                if nominated_node is None and self.registry.post_filter:
-                    if postfilter_reasons is None:
-                        # NodeToStatusMap analog, shared across this
-                        # batch's failures: per-node reasons don't exist
-                        # inside the fused pipeline, so every candidate
-                        # carries the batch-level rejection
-                        postfilter_reasons = {
-                            n.name: "node did not satisfy the batched "
-                            "filter pipeline"
-                            for n in slot_nodes
-                            if n is not None
-                        }
-                    tpf = time.perf_counter()
-                    # fresh copy per pod: upstream's NodeToStatusMap is
-                    # per-pod scratch a plugin may legitimately mutate
-                    self._run_post_filter(pod, dict(postfilter_reasons))
-                    preempt_dt += time.perf_counter() - tpf
-                res.unschedulable.append(pod.key)
-                self.queue.add_unschedulable(info, cycle)
-                n_nodes = sum(1 for n in slot_nodes if n is not None)
-                self._event(
-                    pod, "FailedScheduling",
-                    f"0/{n_nodes} nodes are available: the batched "
-                    "filter pipeline rejected every candidate",
-                    type_="Warning",
-                )
-                continue
-            node_name = self.snapshot.name_of(int(a))
-            try:
-                self.cache.assume_pod(pod, node_name)
-            except Exception as e:  # cache inconsistency: requeue
-                # the device-resident solve DID place the pod; mark the
-                # column dirty so the session re-heals it from cache truth
-                self.snapshot.touch(int(a))
-                res.bind_failures.append((pod.key, str(e)))
-                self.queue.add_unschedulable(info, cycle)
-                continue
+                    continue
+                node_name = self.snapshot.name_of(int(a))
+                try:
+                    self.cache.assume_pod(pod, node_name)
+                except Exception as e:  # cache inconsistency: requeue
+                    # the device-resident solve DID place the pod; mark the
+                    # column dirty so the session re-heals it from cache truth
+                    self.snapshot.touch(int(a))
+                    res.bind_failures.append((pod.key, str(e)))
+                    self.queue.add_unschedulable(info, cycle)
+                    continue
 
-            # Reserve point: in-tree volumebinding Reserve
-            # (AssumePodVolumes) then out-of-tree ReservePlugins in
-            # registration order; any failure unreserves everything
-            # (reverse order), forgets the assume, and requeues
-            state = CycleState()
-            try:
-                tb = time.perf_counter()
-                if pod.pvc_names:
-                    ninfo = self.cache.nodes.get(node_name)
-                    if ninfo is None or ninfo.node is None:
-                        raise VolumeBindingError(
-                            f"node {node_name} vanished before volume binding"
-                        )
-                    self.volume_binder.assume_pod_volumes(pod, ninfo.node)
-                for p in self.registry.reserve:
-                    st = p.reserve(state, pod, node_name)
-                    if not st.is_success:
-                        raise _Rejected(
-                            f"Reserve plugin {p.name()} rejected: "
-                            + "; ".join(st.reasons)
-                        )
-                bind_dt += time.perf_counter() - tb
-            except (VolumeBindingError, _Rejected) as e:
-                self._unreserve_all(state, pod, node_name)
-                res.bind_failures.append((pod.key, str(e)))
-                self.queue.add_unschedulable(info, cycle)
-                self._event(
-                    pod, "FailedScheduling", str(e), type_="Warning",
-                )
-                continue
+                # Reserve point: in-tree volumebinding Reserve
+                # (AssumePodVolumes) then out-of-tree ReservePlugins in
+                # registration order; any failure unreserves everything
+                # (reverse order), forgets the assume, and requeues
+                state = CycleState()
+                try:
+                    tb = time.perf_counter()
+                    if pod.pvc_names:
+                        ninfo = self.cache.nodes.get(node_name)
+                        if ninfo is None or ninfo.node is None:
+                            raise VolumeBindingError(
+                                f"node {node_name} vanished before volume binding"
+                            )
+                        self.volume_binder.assume_pod_volumes(pod, ninfo.node)
+                    for p in self.registry.reserve:
+                        st = p.reserve(state, pod, node_name)
+                        if not st.is_success:
+                            raise _Rejected(
+                                f"Reserve plugin {p.name()} rejected: "
+                                + "; ".join(st.reasons)
+                            )
+                    bind_dt += time.perf_counter() - tb
+                except (VolumeBindingError, _Rejected) as e:
+                    self._unreserve_all(state, pod, node_name)
+                    res.bind_failures.append((pod.key, str(e)))
+                    self.queue.add_unschedulable(info, cycle)
+                    self._event(
+                        pod, "FailedScheduling", str(e), type_="Warning",
+                    )
+                    continue
 
-            # Permit point: approve / reject / wait
-            # (framework.go#RunPermitPlugins); WAIT parks the pod in the
-            # WaitingPods map — it stays assumed (+reserved) and the
-            # binding completes or rolls back in a later cycle
-            verdict = self._run_permit(state, pod, node_name)
-            if isinstance(verdict, dict):
-                wp = WaitingPod(pod, node_name, verdict, self.clock.now())
-                self._waiting[pod.key] = (wp, info, cycle, state, t0)
-                continue
-            if verdict is not None:  # (plugin name, Status) rejection
-                self._unreserve_all(state, pod, node_name)
-                res.unschedulable.append(pod.key)
-                self.queue.add_unschedulable(info, cycle)
-                self._event(
-                    pod, "FailedScheduling",
-                    f"permit plugin {verdict[0]} rejected: "
-                    + "; ".join(verdict[1].reasons),
-                    type_="Warning", action="Permit",
-                )
-                continue
+                # Permit point: approve / reject / wait
+                # (framework.go#RunPermitPlugins); WAIT parks the pod in the
+                # WaitingPods map — it stays assumed (+reserved) and the
+                # binding completes or rolls back in a later cycle
+                verdict = self._run_permit(state, pod, node_name)
+                if isinstance(verdict, dict):
+                    wp = WaitingPod(pod, node_name, verdict, self.clock.now())
+                    self._waiting[pod.key] = (wp, info, cycle, state, t0)
+                    continue
+                if verdict is not None:  # (plugin name, Status) rejection
+                    self._unreserve_all(state, pod, node_name)
+                    res.unschedulable.append(pod.key)
+                    self.queue.add_unschedulable(info, cycle)
+                    self._event(
+                        pod, "FailedScheduling",
+                        f"permit plugin {verdict[0]} rejected: "
+                        + "; ".join(verdict[1].reasons),
+                        type_="Warning", action="Permit",
+                    )
+                    continue
 
-            ok, dt = self._finish_binding(
-                state, info, pod, node_name, cycle, res, t0
-            )
-            bind_dt += dt
-            # keep the lazily-snapshotted preemption view in sync with
-            # binds made later in this batch, so a subsequent failing
-            # pod's dry-run sees current node occupancy
-            if ok and preempt_placed is not None:
-                preempt_placed.setdefault(int(a), []).append(pod)
+                # approved: the binding cycle commits AFTER the lock drops
+                # (schedule_batch's pending pass)
+                pending.append((state, info, pod, node_name, cycle, t0))
+                # keep the lazily-snapshotted preemption view in sync with
+                # assumes made later in this batch, so a subsequent failing
+                # pod's dry-run sees current node occupancy (the cache-backed
+                # view already counts the assume; a later bind failure
+                # forgets it, making this at worst conservative)
+                if preempt_placed is not None:
+                    preempt_placed.setdefault(int(a), []).append(pod)
         if preempt_dt:
             metrics.framework_extension_point_duration_seconds.labels(
                 "PostFilter", "Success", profile
             ).observe(preempt_dt)
         if bind_dt:
+            # reserve-phase time (binds now commit post-lock and report
+            # under the Bind point from schedule_batch)
             metrics.framework_extension_point_duration_seconds.labels(
-                "Bind", "Success", profile
+                "Reserve", "Success", profile
             ).observe(bind_dt)
 
         # per-profile attempt metrics (this group's own wall time)
         attempt_avg = (time.perf_counter() - gs) / max(len(infos), 1)
-        n_sched = len(res.scheduled) - scheduled_before
+        # "scheduled" attempts = this group's approved bindings (upstream
+        # observes at scheduling-cycle end; a later bind failure records
+        # separately under the error paths, like the binding goroutine)
+        n_sched = len(pending) - pending_before
         n_unsched = len(res.unschedulable) - unsched_before
         n_fail = len(res.bind_failures) - failures_before
         if n_sched:
@@ -810,21 +904,14 @@ class Scheduler:
                 return (p.name(), st)
         return waits or None
 
-    def _finish_binding(
-        self,
-        state,
-        info: QueuedPodInfo,
-        pod: Pod,
-        node_name: str,
-        cycle: int,
-        res: BatchResult,
-        t_start: float,
-    ) -> tuple[bool, float]:
-        """PreBind (out-of-tree plugins, then volumebinding's
-        BindPodVolumes) -> Bind -> PostBind. Any failure unreserves and
-        requeues with backoff (the bindingCycle failure path). Returns
-        (bound, wall seconds)."""
-        tb = time.perf_counter()
+    def _commit_binding(self, entry: tuple, res: BatchResult) -> None:
+        """The binding cycle for one approved pod — PreBind (out-of-tree
+        plugins, then volumebinding's BindPodVolumes) -> Bind (extender
+        delegate or the binding subresource) -> PostBind. Runs WITHOUT
+        the cluster lock held (the bind may cross a wire); cache/queue
+        bookkeeping re-acquires it briefly. Any failure unreserves and
+        requeues with backoff (the bindingCycle failure path)."""
+        state, info, pod, node_name, cycle, t_start = entry
         try:
             for p in self.registry.pre_bind:
                 st = p.pre_bind(state, pod, node_name)
@@ -835,26 +922,47 @@ class Scheduler:
                     )
             if pod.pvc_names:
                 self.volume_binder.bind_pod_volumes(pod)
-            self.cluster.bind(pod.namespace, pod.name, node_name)
-        except (ApiError, VolumeBindingError, _Rejected) as e:
-            self._unreserve_all(state, pod, node_name)
+            binder = next(
+                (
+                    cl
+                    for cl in self.extender_clients
+                    if cl.is_binder and cl.is_interested(pod)
+                ),
+                None,
+            )
+            if binder is not None:
+                # extender.go#Bind: the first interested binder extender
+                # owns the binding subresource call
+                binder.bind(pod, node_name)
+            else:
+                self.cluster.bind(pod.namespace, pod.name, node_name)
+        except (ApiError, VolumeBindingError, _Rejected, ExtenderError) as e:
             reason = e.reason if isinstance(e, ApiError) else str(e)
-            res.bind_failures.append((pod.key, reason))
-            self.queue.add_unschedulable(info, cycle)
+            with self.cluster.lock:
+                self._unreserve_all(state, pod, node_name)
+                res.bind_failures.append((pod.key, reason))
+                try:
+                    self.cluster.get_pod(pod.namespace, pod.name)
+                except ApiError:
+                    # deleted while the bind was in flight (the unlocked
+                    # window): don't requeue a pod that no longer exists
+                    return
+                self.queue.add_unschedulable(info, cycle)
+                self._event(
+                    pod, "FailedScheduling",
+                    f"binding rejected: {reason}", type_="Warning",
+                    action="Binding",
+                )
+            return
+        with self.cluster.lock:
+            self.cache.finish_binding(pod.key)
+            self.volume_binder.finish(pod.key)
             self._event(
-                pod, "FailedScheduling",
-                f"binding rejected: {reason}", type_="Warning",
+                pod, "Scheduled",
+                f"Successfully assigned {pod.key} to {node_name}",
                 action="Binding",
             )
-            return False, time.perf_counter() - tb
-        self.cache.finish_binding(pod.key)
-        self.volume_binder.finish(pod.key)
-        self._event(
-            pod, "Scheduled",
-            f"Successfully assigned {pod.key} to {node_name}",
-            action="Binding",
-        )
-        res.scheduled.append((pod.key, node_name))
+            res.scheduled.append((pod.key, node_name))
         res.latencies.append(time.perf_counter() - t_start)
         # pod-level SLIs: attempts-to-success histogram and e2e latency
         # from first queue entry, labeled by attempt count
@@ -866,12 +974,11 @@ class Scheduler:
         )
         for p in self.registry.post_bind:
             p.post_bind(state, pod, node_name)
-        return True, time.perf_counter() - tb
 
-    def _process_waiting(self, res: BatchResult) -> None:
+    def _process_waiting(self, res: BatchResult, pending: list) -> None:
         """Settle WaitingPods (the batched WaitOnPermit): rejected or
         timed-out pods unreserve and requeue; fully-allowed pods complete
-        their binding cycle."""
+        their binding cycle in the post-lock pending pass."""
         now = self.clock.now()
         for key, (wp, info, cycle, state, t_start) in list(
             self._waiting.items()
@@ -894,8 +1001,8 @@ class Scheduler:
                 )
             elif wp.allowed:
                 del self._waiting[key]
-                self._finish_binding(
-                    state, info, wp.pod, wp.node_name, cycle, res, t_start
+                pending.append(
+                    (state, info, wp.pod, wp.node_name, cycle, t_start)
                 )
 
     def waiting_pods(self) -> dict[str, WaitingPod]:

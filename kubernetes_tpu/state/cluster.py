@@ -130,6 +130,7 @@ class ClusterState:
         self._events_by_agg: dict[tuple, EventRecord] = {}
         self._event_seq = 0
         self.event_ttl = 3600.0  # reference --event-ttl default
+        self._events_sweep_at = 256  # next TTL sweep threshold
         self._watchers: list[Watcher] = []
         # fault injection: called with (pod, node_name) before a bind commits;
         # raise ApiError to simulate apiserver-side rejection
@@ -353,22 +354,27 @@ class ClusterState:
 
         ts = _time.time() if timestamp is None else timestamp
         # reference apiserver gives Events a TTL (1h default) instead of
-        # durable storage; prune lazily from the front of insertion order
-        # so a serve process streaming short-lived pods stays bounded. A
-        # count-bumped old record stops the sweep early — conservative.
-        cutoff = ts - self.event_ttl
-        while self._events:
-            first = next(iter(self._events.values()))
-            if first.last_timestamp >= cutoff:
-                break
-            del self._events[first.key]
-            self._events_by_agg.pop(
-                (
-                    first.regarding_kind, first.namespace,
-                    first.regarding_name, first.reason, first.note,
-                ),
-                None,
-            )
+        # durable storage. Pruning must not trust insertion order: a
+        # count-bumped old record keeps a FRESH last_timestamp at the
+        # head, so a head-stop sweep would block forever (review-caught).
+        # Instead run a full sweep whenever the store doubles past the
+        # last sweep's size — amortized O(1) per record, bounded memory.
+        if len(self._events) >= self._events_sweep_at:
+            cutoff = ts - self.event_ttl
+            for rec in [
+                r
+                for r in self._events.values()
+                if r.last_timestamp < cutoff
+            ]:
+                del self._events[rec.key]
+                self._events_by_agg.pop(
+                    (
+                        rec.regarding_kind, rec.namespace,
+                        rec.regarding_name, rec.reason, rec.note,
+                    ),
+                    None,
+                )
+            self._events_sweep_at = max(256, 2 * len(self._events))
         ns = getattr(regarding, "namespace", "") or "default"
         kind = "Pod" if isinstance(regarding, Pod) else "Node"
         agg_key = (kind, ns, regarding.name, reason, note)

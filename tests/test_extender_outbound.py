@@ -1,0 +1,192 @@
+"""Outbound extender client (VERDICT r3 #5): the scheduler consults
+configured extenders[] during the solve, golden-tested against THIS
+repo's own extender server — the self-hosting loop that closes both
+halves of the boundary (pkg/scheduler/extender.go client semantics vs
+server/extender.py wire shapes)."""
+
+import asyncio
+import threading
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.config.types import Extender
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.server.extender import ExtenderCore, make_app
+from kubernetes_tpu.server.extender_client import (
+    ExtenderError,
+    HTTPExtenderClient,
+)
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+
+
+def _serve(app):
+    """Run an aiohttp app on a real socket in a daemon thread; returns
+    (base_url, stop). The scheduler's client is synchronous urllib, so
+    TestClient won't do."""
+    from aiohttp import web
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+
+    def stop():
+        loop.call_soon_threadsafe(loop.stop)
+
+    return f"http://127.0.0.1:{holder['port']}", stop
+
+
+def mk_node(name):
+    return (
+        MakeNode()
+        .name(name)
+        .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"})
+        .obj()
+    )
+
+
+def _sched(cs, extenders):
+    return Scheduler(
+        cs,
+        SchedulerConfig(
+            solver=ExactSolverConfig(tie_break="first"),
+            extenders=tuple(extenders),
+        ),
+    )
+
+
+def test_outbound_filter_changes_bindings():
+    """A live extender whose watch-fed view holds ONLY node-1 restricts
+    the solve: unknown names come back as failedNodes (nodeCacheCapable)
+    and the pod lands where the extender allows, not where the default
+    tie-break would."""
+    cs = ClusterState()
+    for i in range(4):
+        cs.create_node(mk_node(f"node-{i}"))
+    ext_view = ClusterState()
+    ext_view.create_node(mk_node("node-1"))
+    url, stop = _serve(make_app(ExtenderCore(ext_view, node_cache_capable=True)))
+    try:
+        sched = _sched(
+            cs,
+            [Extender(url_prefix=url, filter_verb="filter",
+                      node_cache_capable=True)],
+        )
+        cs.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+        r = sched.schedule_batch()
+        assert dict(r.scheduled) == {"default/p": "node-1"}
+    finally:
+        stop()
+
+
+def test_outbound_prioritize_steers_bindings():
+    """Extender prioritize scores rescale by weight * MaxNodeScore /
+    MaxExtenderPriority and accumulate into the device tables: a
+    high-weight extender that only knows node-2 out-pulls the in-tree
+    tie-break."""
+    cs = ClusterState()
+    for i in range(4):
+        cs.create_node(mk_node(f"node-{i}"))
+    ext_view = ClusterState()
+    ext_view.create_node(mk_node("node-2"))
+    url, stop = _serve(make_app(ExtenderCore(ext_view, node_cache_capable=True)))
+    try:
+        sched = _sched(
+            cs,
+            [Extender(url_prefix=url, prioritize_verb="prioritize",
+                      node_cache_capable=True, weight=5)],
+        )
+        cs.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+        r = sched.schedule_batch()
+        assert dict(r.scheduled) == {"default/p": "node-2"}
+    finally:
+        stop()
+
+
+def test_outbound_bind_delegation():
+    """A bind-verb extender owns the binding subresource call: the
+    scheduler delegates and the bind lands through the server (same
+    state service = the watch confirms it, like the reference's
+    apiserver round trip)."""
+    cs = ClusterState()
+    for i in range(2):
+        cs.create_node(mk_node(f"node-{i}"))
+    url, stop = _serve(make_app(ExtenderCore(cs)))
+    try:
+        sched = _sched(
+            cs, [Extender(url_prefix=url, bind_verb="bind")]
+        )
+        cs.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+        r = sched.schedule_batch()
+        assert len(r.scheduled) == 1
+        assert cs.get_pod("default", "p").node_name == "node-0"
+    finally:
+        stop()
+
+
+def test_ignorable_extender_outage_is_skipped():
+    cs = ClusterState()
+    for i in range(2):
+        cs.create_node(mk_node(f"node-{i}"))
+    dead = Extender(
+        url_prefix="http://127.0.0.1:1", filter_verb="filter",
+        ignorable=True,
+    )
+    sched = _sched(cs, [dead])
+    cs.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+    r = sched.schedule_batch()
+    assert len(r.scheduled) == 1  # outage ignored, in-tree verdicts hold
+
+
+def test_non_ignorable_extender_outage_aborts_without_stranding():
+    """The outage surfaces as an error, but the popped pod must not be
+    lost: it requeues with backoff and schedules once the extender
+    recovers (review-caught: the raise used to strand the whole batch)."""
+    cs = ClusterState()
+    cs.create_node(mk_node("node-0"))
+    dead = Extender(
+        url_prefix="http://127.0.0.1:1", filter_verb="filter",
+    )
+    sched = _sched(cs, [dead])
+    cs.create_pod(MakePod().name("p").req({"cpu": "1"}).obj())
+    with pytest.raises(ExtenderError):
+        sched.schedule_batch()
+    assert len(sched.queue) == 1, "popped pod requeued, not stranded"
+    # 'recovery': swap the client set for a healthy (empty) one
+    sched.extender_clients = ()
+    sched.queue.flush_unschedulable_leftover()
+    sched.queue.move_all_to_active_or_backoff("ExtenderRecovered")
+    sched.clock = sched.clock  # backoff is wall-clock; force-flush below
+    import time as _t
+
+    _t.sleep(1.1)  # initial backoff 1s
+    r = sched.schedule_batch()
+    assert len(r.scheduled) == 1
+
+
+def test_managed_resources_gate_is_interested():
+    gpu_only = Extender(
+        url_prefix="http://x", filter_verb="filter",
+        managed_resources=[{"name": "example.com/gpu"}],
+    )
+    cl = HTTPExtenderClient(gpu_only)
+    plain = MakePod().name("plain").req({"cpu": "1"}).obj()
+    gpu = MakePod().name("gpu").req(
+        {"cpu": "1", "example.com/gpu": "2"}
+    ).obj()
+    assert not cl.is_interested(plain)
+    assert cl.is_interested(gpu)

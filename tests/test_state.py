@@ -286,14 +286,22 @@ class TestPriorityQueue:
 
 def test_event_store_ttl_prunes_old_records():
     """Events expire after event_ttl (the reference apiserver's 1h TTL)
-    instead of accumulating forever."""
+    instead of accumulating forever — and a count-bumped OLD record with
+    a fresh last_timestamp must not block the sweep (review-caught: the
+    sweep scans the whole store, not just the insertion-order head)."""
     from kubernetes_tpu.api.wrappers import MakeNode
 
     cs = ClusterState()
     n = cs.create_node(MakeNode().name("n1").capacity({"cpu": "1"}).obj())
     cs.event_ttl = 100.0
-    cs.record_event(n, "Old", "stale note", timestamp=0.0)
-    cs.record_event(n, "Newer", "fresh note", timestamp=150.0)
+    cs._events_sweep_at = 3  # sweep once the store holds 3 records
+    cs.record_event(n, "HotHead", "recurring", timestamp=0.0)
+    cs.record_event(n, "Old", "stale note", timestamp=10.0)
+    # the head record keeps recurring: fresh last_timestamp, oldest slot
+    cs.record_event(n, "HotHead", "recurring", timestamp=190.0)
+    cs.record_event(n, "Newer", "fresh note", timestamp=195.0)
     cs.record_event(n, "Latest", "now", timestamp=200.0)
     reasons = {e.reason for e in cs.list_events()}
-    assert "Old" not in reasons and {"Newer", "Latest"} <= reasons
+    assert "Old" not in reasons, "expired record behind a hot head"
+    assert {"HotHead", "Newer", "Latest"} <= reasons
+    assert cs.list_events(regarding_name="n1")[0].count >= 2
