@@ -83,6 +83,13 @@ preemption_attempts_total = Counter(
     "Total preemption attempts in the cluster.",
     registry=REGISTRY,
 )
+fold_cache_total = Counter(
+    "scheduler_plugin_fold_cache_total",
+    "Out-of-tree plugin fold results served from the per-batch memo "
+    "cache vs recomputed (result=hit|miss).",
+    ["result"],
+    registry=REGISTRY,
+)
 preemption_victims = Histogram(
     "scheduler_preemption_victims",
     "Number of selected preemption victims.",

@@ -271,46 +271,87 @@ class ExtenderCore:
         candidates = args.get("nodeNameToVictims") or args.get(
             "nodeNameToMetaVictims"
         ) or {}
-        out: dict[str, dict] = {}
+        # static gate: preemption cannot resolve taints/affinity/
+        # nodeName/unschedulable failures (the dry-run is fit-only) —
+        # never offer such nodes
+        live: list = []
         for node_name in candidates:
             try:
                 node = self.cluster.get_node(node_name)
             except ApiError:
                 continue
-            # static gate: preemption cannot resolve taints/affinity/
-            # nodeName/unschedulable failures (select_victims_on_node is
-            # fit-only; see its docstring) — never offer such nodes
-            if not (
+            if (
                 opl.node_name_filter(pod, node)
                 and opl.node_unschedulable_filter(pod, node)
                 and opl.taint_toleration_filter(pod, node)
                 and opl.node_affinity_filter(pod, node)
             ):
-                continue
-            nv = opr.select_victims_on_node(
-                pod,
-                node.allocatable,
-                node.allowed_pod_number,
-                pods_by_node.get(node_name, []),
-                pdbs,
-            )
-            if nv is None:
-                continue  # node dropped from the result = not a candidate
+                live.append(node)
+
+        if self.backend == "device" and live:
+            victims_map = self._preempt_device(pod, live, pods_by_node, pdbs)
+        else:
+            victims_map = {}
+            for node in live:
+                nv = opr.select_victims_on_node(
+                    pod,
+                    node.allocatable,
+                    node.allowed_pod_number,
+                    pods_by_node.get(node.name, []),
+                    pdbs,
+                )
+                if nv is None:
+                    continue  # dropped from the result = not a candidate
+                victims_map[node.name] = (list(nv.victims), nv.num_violating)
+
+        out: dict[str, dict] = {}
+        for node_name, (victims, n_viol) in victims_map.items():
             if self.node_cache_capable:
                 out[node_name] = {
-                    "pods": [{"uid": v.uid or v.key} for v in nv.victims],
-                    "numPDBViolations": nv.num_violating,
+                    "pods": [{"uid": v.uid or v.key} for v in victims],
+                    "numPDBViolations": n_viol,
                 }
             else:
                 out[node_name] = {
-                    "pods": [v.to_dict() for v in nv.victims],
-                    "numPDBViolations": nv.num_violating,
+                    "pods": [v.to_dict() for v in victims],
+                    "numPDBViolations": n_viol,
                 }
         # extender.go#ProcessPreemption reads NodeNameToMetaVictims only for
         # nodeCacheCapable extenders, NodeNameToVictims (full pods) otherwise
         if self.node_cache_capable:
             return {"nodeNameToMetaVictims": out}
         return {"nodeNameToVictims": out}
+
+    def _preempt_device(
+        self, pod: Pod, nodes: list[Node], pods_by_node, pdbs
+    ) -> dict:
+        """Device-backed /preempt (VERDICT r3 #8): ONE batched dry-run
+        over all statically-feasible candidates instead of a scalar
+        per-node loop — the in-process PostFilter's pre-screen behind the
+        wire. Fit-only semantics identical to select_victims_on_node; a
+        zero-victim fit means the pod fits WITHOUT eviction and upstream
+        treats that as 'not a preemption candidate', so those nodes drop
+        like the scalar path's None."""
+        from ..solver.preemption import PreemptionEvaluator
+        from ..tensorize.schema import build_node_batch
+
+        if not hasattr(self, "_preemptor"):
+            self._preemptor = PreemptionEvaluator()
+        batch = build_node_batch(nodes)
+        placed_by_slot = {
+            i: pods_by_node.get(nd.name, []) for i, nd in enumerate(nodes)
+        }
+        static_row = np.zeros(batch.padded, dtype=bool)
+        static_row[: len(nodes)] = True  # static gate already applied
+        return self._preemptor.victims_by_node(
+            pod,
+            batch,
+            [nd.name for nd in nodes],
+            placed_by_slot,
+            static_row,
+            pdbs,
+            candidate_slots=list(range(len(nodes))),
+        )
 
     def bind(self, args: Mapping) -> dict:
         try:

@@ -128,21 +128,18 @@ class PreemptionEvaluator:
         # lost to the cutoff).
         self.refine_k = refine_k
 
-    def evaluate(
+    def _dry_run(
         self,
         pod: Pod,
         nodes: NodeBatch,
-        slot_names: list[str],
         placed_by_slot: dict[int, list[Pod]],
-        static_row: np.ndarray,  # [Np] bool — pod's static feasibility
-        pdbs: list[PodDisruptionBudget] | None = None,
-        slot_nodes: list | None = None,  # [Np] Node|None, for full filters
-        beyond_fit: bool = False,
-        disabled: frozenset = frozenset(),  # profile's disabled filters
-    ) -> PreemptionResult | None:
-        if pod.preemption_policy == "Never":
-            return None
-        pdbs = pdbs or []
+        static_row: np.ndarray,
+        pdbs: list[PodDisruptionBudget],
+    ):
+        """The batched device dry-run shared by the in-process PostFilter
+        path (evaluate) and the served /preempt verb (victims_by_node):
+        returns (fits_all, victims [S, N], n_victims, n_viol, max_prio,
+        sum_prio, latest, slot_candidates)."""
         n_pad = nodes.padded
         k = len(nodes.vocab)
         prio = pod.effective_priority
@@ -204,6 +201,71 @@ class PreemptionEvaluator:
         fits_all, victims, n_victims, n_viol, max_prio, sum_prio, latest = (
             np.asarray(x) for x in out
         )
+        return (
+            fits_all, victims, n_victims, n_viol, max_prio, sum_prio,
+            latest, slot_candidates,
+        )
+
+    def victims_by_node(
+        self,
+        pod: Pod,
+        nodes: NodeBatch,
+        slot_names: list[str],
+        placed_by_slot: dict[int, list[Pod]],
+        static_row: np.ndarray,
+        pdbs: list[PodDisruptionBudget] | None = None,
+        candidate_slots: list[int] | None = None,
+    ) -> dict[str, tuple[list[Pod], int]]:
+        """Per-candidate victim sets for the served /preempt verb
+        (extender.go#ProcessPreemption's nodeNameToVictims map): node name
+        -> (victims in reprieve order, PDB violations). Fit-only
+        semantics, same as the scalar select_victims_on_node the verb
+        previously used per node — but ONE device dry-run covers every
+        candidate. A node where the pod fits WITHOUT evictions stays in
+        the result with an empty victim list (the wire contract keeps
+        it; extender.go#ProcessPreemption treats it as a free
+        candidate), while infeasible nodes drop."""
+        if pod.preemption_policy == "Never":
+            return {}
+        pdbs = pdbs or []
+        (
+            fits_all, victims, n_victims, n_viol, _mx, _sm, _lt,
+            slot_candidates,
+        ) = self._dry_run(pod, nodes, placed_by_slot, static_row, pdbs)
+        slots = (
+            candidate_slots
+            if candidate_slots is not None
+            else list(range(len(slot_names)))
+        )
+        out: dict[str, tuple[list[Pod], int]] = {}
+        for slot in slots:
+            if not fits_all[slot]:
+                continue
+            ordered, _ = slot_candidates.get(slot, ([], set()))
+            chosen = [q for s, q in enumerate(ordered) if victims[s, slot]]
+            out[slot_names[slot]] = (chosen, int(n_viol[slot]))
+        return out
+
+    def evaluate(
+        self,
+        pod: Pod,
+        nodes: NodeBatch,
+        slot_names: list[str],
+        placed_by_slot: dict[int, list[Pod]],
+        static_row: np.ndarray,  # [Np] bool — pod's static feasibility
+        pdbs: list[PodDisruptionBudget] | None = None,
+        slot_nodes: list | None = None,  # [Np] Node|None, for full filters
+        beyond_fit: bool = False,
+        disabled: frozenset = frozenset(),  # profile's disabled filters
+    ) -> PreemptionResult | None:
+        if pod.preemption_policy == "Never":
+            return None
+        pdbs = pdbs or []
+        n_pad = nodes.padded
+        (
+            fits_all, victims, n_victims, n_viol, max_prio, sum_prio,
+            latest, slot_candidates,
+        ) = self._dry_run(pod, nodes, placed_by_slot, static_row, pdbs)
 
         if beyond_fit and slot_nodes is not None:
             # Beyond-fit filters in play: a node where the pod fits with

@@ -130,7 +130,8 @@ class ClusterState:
         self._events_by_agg: dict[tuple, EventRecord] = {}
         self._event_seq = 0
         self.event_ttl = 3600.0  # reference --event-ttl default
-        self._events_sweep_at = 256  # next TTL sweep threshold
+        self._events_sweep_at = 256  # next TTL size-sweep threshold
+        self._events_last_sweep = 0.0
         self._watchers: list[Watcher] = []
         # fault injection: called with (pod, node_name) before a bind commits;
         # raise ApiError to simulate apiserver-side rejection
@@ -358,8 +359,14 @@ class ClusterState:
         # count-bumped old record keeps a FRESH last_timestamp at the
         # head, so a head-stop sweep would block forever (review-caught).
         # Instead run a full sweep whenever the store doubles past the
-        # last sweep's size — amortized O(1) per record, bounded memory.
-        if len(self._events) >= self._events_sweep_at:
+        # last sweep's size — amortized O(1) per record, bounded memory —
+        # OR when a full TTL has elapsed since the last sweep, so small
+        # stores (below the size threshold) still expire records at most
+        # one TTL late.
+        if len(self._events) >= self._events_sweep_at or (
+            self._events and ts - self._events_last_sweep > self.event_ttl
+        ):
+            self._events_last_sweep = ts
             cutoff = ts - self.event_ttl
             for rec in [
                 r

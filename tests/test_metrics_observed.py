@@ -62,6 +62,30 @@ def test_all_declared_series_observed():
     clock.advance(15.0)
     sched.schedule_batch()
 
+    # an out-of-tree plugin scheduler: drives the fold memo counter
+    from kubernetes_tpu.framework.interface import FilterPlugin, Status
+
+    class AnyNode(FilterPlugin):
+        def filter(self, state, pod, node, placed=()):
+            return Status.success()
+
+    cs2 = ClusterState()
+    cs2.create_node(
+        MakeNode().name("m0").capacity({"cpu": "4", "memory": "8Gi", "pods": "10"}).obj()
+    )
+    sched2 = Scheduler(
+        cs2,
+        SchedulerConfig(
+            solver=ExactSolverConfig(tie_break="first"),
+            out_of_tree_plugins=(AnyNode(),),
+        ),
+        clock=clock,
+    )
+    cs2.create_pod(MakePod().name("f1").req({"cpu": "100m"}).obj())
+    sched2.schedule_batch()  # fold miss
+    cs2.create_pod(MakePod().name("f2").req({"cpu": "100m"}).obj())
+    sched2.schedule_batch()  # fold hit
+
     text = metrics.render().decode()
     declared = [
         "scheduler_schedule_attempts_total",
@@ -73,6 +97,7 @@ def test_all_declared_series_observed():
         "scheduler_pending_pods",
         "scheduler_queue_incoming_pods_total",
         "scheduler_preemption_attempts_total",
+        "scheduler_plugin_fold_cache_total",
         "scheduler_preemption_victims",
         "scheduler_tpu_solve_latency_seconds",
         "scheduler_tpu_solve_batch_size",

@@ -297,11 +297,27 @@ def test_event_store_ttl_prunes_old_records():
     cs._events_sweep_at = 3  # sweep once the store holds 3 records
     cs.record_event(n, "HotHead", "recurring", timestamp=0.0)
     cs.record_event(n, "Old", "stale note", timestamp=10.0)
-    # the head record keeps recurring: fresh last_timestamp, oldest slot
-    cs.record_event(n, "HotHead", "recurring", timestamp=190.0)
+    # the head record keeps recurring within its TTL: fresh
+    # last_timestamp, oldest insertion slot
+    cs.record_event(n, "HotHead", "recurring", timestamp=95.0)
     cs.record_event(n, "Newer", "fresh note", timestamp=195.0)
     cs.record_event(n, "Latest", "now", timestamp=200.0)
     reasons = {e.reason for e in cs.list_events()}
     assert "Old" not in reasons, "expired record behind a hot head"
     assert {"HotHead", "Newer", "Latest"} <= reasons
     assert cs.list_events(regarding_name="n1")[0].count >= 2
+
+
+def test_event_store_ttl_small_store_still_prunes():
+    """A store below the size-sweep threshold still expires records once
+    a full TTL elapses since the last sweep (review-caught: the size-only
+    trigger never fired for small stores)."""
+    from kubernetes_tpu.api.wrappers import MakeNode
+
+    cs = ClusterState()
+    n = cs.create_node(MakeNode().name("n1").capacity({"cpu": "1"}).obj())
+    cs.event_ttl = 100.0  # default sweep threshold (256) untouched
+    cs.record_event(n, "Old", "stale", timestamp=0.0)
+    cs.record_event(n, "Fresh", "new", timestamp=150.0)
+    reasons = {e.reason for e in cs.list_events()}
+    assert "Old" not in reasons and "Fresh" in reasons
