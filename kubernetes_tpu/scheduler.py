@@ -281,23 +281,28 @@ class Scheduler:
                     )
                     self.queue.move_all_to_active_or_backoff(
                         "NodeUpdate",
-                        worth=self._fit_hint(ev.obj.name)
+                        worth=self._fit_hint(ev.obj.name, old=old_node)
                         if resource_only
                         else None,
                     )
             else:
                 self.cache.remove_node(ev.obj.name)
 
-    def _fit_hint(self, node_name: str):
+    def _fit_hint(self, node_name: str, old=None):
         """isPodWorthRequeuing gate for fit-shaped events (NodeAdd, a pure
         allocatable NodeUpdate, AssignedPodDelete): the event changed ONE
         node's capacity, so a parked pod is worth requeuing only if its
         requests fit that node's new free capacity (noderesources/fit.go
         #isSchedulableAfterNodeChange). Requests that don't fit there
-        cannot have been unblocked by this event. Other filters (taints,
-        selectors) are NOT checked — failing them here could only cause a
-        missed wakeup if they also changed, which routes through the
-        worth=None path. Returns None (move everything) when the
+        cannot have been unblocked by this event. With ``old`` (the
+        pre-update Node on a resource-only NodeUpdate) the hint also
+        checks the DELTA direction: a pod that already fit the old
+        allocatable was not unblocked by this change — e.g. a shrink that
+        still fits wakes nothing (the reference's hint compares old and
+        new node infos the same way). Other filters (taints, selectors)
+        are NOT checked — failing them here could only cause a missed
+        wakeup if they also changed, which routes through the worth=None
+        path. Returns None (move everything) when the
         SchedulerQueueingHints feature gate is off."""
         if not self.feature_gates.enabled("SchedulerQueueingHints"):
             return None
@@ -316,6 +321,20 @@ class Scheduler:
                     continue
                 if ninfo.used.get(r, 0) + v > node.allocatable.get(r, 0):
                     return False
+            if old is not None:
+                # fits the new capacity — but did it fail the OLD one?
+                fits_old = len(ninfo.pods) + 1 <= old.allowed_pod_number
+                if fits_old:
+                    for r, v in info.pod.resource_request().items():
+                        if v <= 0 or r == "pods":
+                            continue
+                        if ninfo.used.get(r, 0) + v > old.allocatable.get(
+                            r, 0
+                        ):
+                            fits_old = False
+                            break
+                if fits_old:
+                    return False  # change could not have unblocked it
             return True
 
         return worth
@@ -941,6 +960,12 @@ class Scheduler:
         for p in self.config.out_of_tree_plugins:
             h.update(str(id(p)).encode())
         for rep in static.reps:
+            # every field the solver-path plugin contract allows a plugin
+            # to read (framework/interface.py): labels, annotations, and
+            # the in-tree spec fields — selectors, affinity, tolerations,
+            # requests, ports, spread. The in-tree mask does NOT encode
+            # all of these (e.g. a toleration on an untainted cluster),
+            # so they hash explicitly.
             h.update(
                 repr(
                     (
@@ -948,6 +973,11 @@ class Scheduler:
                         sorted(rep.annotations.items()),
                         rep.namespace,
                         sorted(rep.resource_request().items()),
+                        sorted(rep.node_selector.items()),
+                        rep.affinity,
+                        rep.tolerations,
+                        rep.host_ports(),
+                        rep.topology_spread_constraints,
                     )
                 ).encode()
             )
@@ -1097,6 +1127,10 @@ class Scheduler:
                 )
             elif wp.allowed:
                 del self._waiting[key]
+                # back under the in-flight fence until the bind commits:
+                # a MODIFIED event during the unlocked windows must not
+                # re-enqueue a pod that is about to bind (review-caught)
+                self._in_flight[key] = info
                 pending.append(
                     (state, info, wp.pod, wp.node_name, cycle, t_start)
                 )

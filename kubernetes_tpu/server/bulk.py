@@ -179,11 +179,16 @@ class BulkCore:
             if commit and names:
                 from ..api.objects import Container
 
-                ns = meta.get("namespace") or "default"
+                default_ns = meta.get("namespace") or "default"
                 for i, (key, a) in enumerate(zip(names, assignments)):
                     if a < 0:
                         continue
-                    pod_name = key.split("/", 1)[-1]
+                    # an "ns/name"-shaped key carries its own namespace;
+                    # bare names fall back to the request's (a caller
+                    # mixing namespaces must not land pods in the wrong
+                    # one — ADVICE r3)
+                    ns, _, pod_name = key.rpartition("/")
+                    ns = ns or default_ns
                     # one create+bind per placed pod; advisory callers skip.
                     # Failures are reported per pod so the reply can never
                     # silently diverge from committed state; a bind failure
@@ -346,7 +351,7 @@ class BulkClient:
         return tensorcodec.decode(reply)[0]
 
     def solve(self, cpu_milli, mem_bytes, priority=None, mode="exact",
-              names=None, commit=False):
+              names=None, commit=False, namespace=None):
         arrays = {
             "cpu_milli": np.asarray(cpu_milli, dtype=np.int64),
             "mem_bytes": np.asarray(mem_bytes, dtype=np.int64),
@@ -356,6 +361,10 @@ class BulkClient:
         meta = {"mode": mode, "commit": commit}
         if names is not None:
             meta["names"] = list(names)
+        if namespace is not None:
+            # commit fallback namespace for bare (un-prefixed) names;
+            # "ns/name"-shaped names carry their own
+            meta["namespace"] = namespace
         reply = self._solve(tensorcodec.encode(meta, arrays))
         return tensorcodec.decode(reply)
 

@@ -328,16 +328,20 @@ class ExtenderCore:
         """Device-backed /preempt (VERDICT r3 #8): ONE batched dry-run
         over all statically-feasible candidates instead of a scalar
         per-node loop — the in-process PostFilter's pre-screen behind the
-        wire. Fit-only semantics identical to select_victims_on_node; a
-        zero-victim fit means the pod fits WITHOUT eviction and upstream
-        treats that as 'not a preemption candidate', so those nodes drop
-        like the scalar path's None."""
+        wire. Fit-only semantics identical to select_victims_on_node,
+        including zero-victim fits: a node where the pod fits without
+        eviction STAYS in the result with an empty victim list, exactly
+        like the scalar path's NodeVictims([], 0). The vocab is built
+        over the pod AND the candidate nodes so an extended resource the
+        nodes don't advertise stays visible (fit then fails on its zero
+        allocatable instead of being silently dropped)."""
         from ..solver.preemption import PreemptionEvaluator
-        from ..tensorize.schema import build_node_batch
+        from ..tensorize.schema import ResourceVocab, build_node_batch
 
         if not hasattr(self, "_preemptor"):
             self._preemptor = PreemptionEvaluator()
-        batch = build_node_batch(nodes)
+        vocab = ResourceVocab.build([pod], nodes)
+        batch = build_node_batch(nodes, vocab=vocab)
         placed_by_slot = {
             i: pods_by_node.get(nd.name, []) for i, nd in enumerate(nodes)
         }

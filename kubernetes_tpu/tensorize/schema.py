@@ -250,6 +250,16 @@ class NominatedTensors:
     can only shrink the feasible set, so the reference's run-twice
     protocol collapses to one run for them; the non-monotone plugins
     (affinity symmetry from nominated pods) are documented out of scope.
+
+    Scope note on NodePorts (ADVICE r3): port conflicts are as monotone
+    as resources, but a nominated pod's hostPorts would have to be
+    re-encoded into each BATCH's port vocabulary (PortTensors builds the
+    conflict rows from the batch's own pods + placed pods), coupling this
+    batch-independent structure to every batch's vocab. Until that
+    plumbing exists, a conflicting pod can still find a preemptor's
+    reserved node port-feasible during the nomination window; the window
+    closes when the nominated pod binds. Resources/count — the filters
+    preemption actually frees — are covered.
     """
 
     levels: np.ndarray  # [L] int32 distinct nominated priorities, desc

@@ -201,3 +201,22 @@ def test_preempt_device_matches_oracle():
     orc = ExtenderCore(cs, backend="oracle").preempt(args)
     assert dev == orc
     assert "node-1" in dev["nodeNameToVictims"]
+
+
+def test_preempt_device_sees_extended_resources():
+    """A preemptor requesting an extended resource no candidate node
+    advertises must get NO candidates from the device path, matching the
+    oracle (review-caught: the node-only vocab silently dropped the
+    request and offered infeasible nodes)."""
+    cs = make_cluster()
+    gpu_pod = MakePod().name("gpu").priority(100).req(
+        {"cpu": "1", "example.com/gpu": "1"}
+    ).obj()
+    args = {
+        "pod": gpu_pod.to_dict(),
+        "nodeNameToVictims": {"node-1": {"pods": []}, "node-2": {"pods": []}},
+    }
+    dev = ExtenderCore(cs, backend="device").preempt(args)
+    orc = ExtenderCore(cs, backend="oracle").preempt(args)
+    assert dev == orc
+    assert dev["nodeNameToVictims"] == {}

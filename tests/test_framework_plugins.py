@@ -514,3 +514,30 @@ def test_fold_cache_hits_on_identical_batches():
     assert m.fold_cache_total.labels("hit")._value.get() == before_hits + 1
     for _, node in r1.scheduled + r2.scheduled:
         assert int(node.rsplit("-", 1)[-1]) % 2 == 1
+
+
+def test_fold_cache_distinguishes_tolerations():
+    """Two batches whose reps differ only in a toleration (invisible in
+    the in-tree mask on an untainted cluster) must NOT share fold
+    verdicts (review-caught under-keyed signature)."""
+    class TolerationGate(FilterPlugin):
+        def filter(self, state, pod, node, placed=()):
+            if any(t.key == "vip" for t in pod.tolerations):
+                return Status.success()
+            return Status.unschedulable("needs vip toleration")
+
+    cs = ClusterState()
+    for n in mk_nodes(2):
+        cs.create_node(n)
+    sched = _sched(cs, [TolerationGate()])
+    cs.create_pod(
+        MakePod().name("tolerant").toleration("vip", "true", "NoSchedule")
+        .req({"cpu": "1"}).obj()
+    )
+    r1 = sched.schedule_batch()
+    assert len(r1.scheduled) == 1
+    cs.create_pod(MakePod().name("plain").req({"cpu": "1"}).obj())
+    r2 = sched.schedule_batch()
+    assert r2.unschedulable == ["default/plain"], (
+        "plain pod must not inherit the tolerant rep's cached verdicts"
+    )

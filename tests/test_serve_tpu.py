@@ -395,3 +395,27 @@ def test_bulk_grpc_socket_roundtrip():
         client.close()
     finally:
         server.stop(grace=None)
+
+
+def test_bulk_commit_honors_namespaced_keys():
+    """ADVICE r3: 'ns/name'-shaped commit keys bind into THEIR namespace;
+    bare names fall back to the request's meta namespace."""
+    cs = make_cluster(4)
+    core = BulkCore(cs)
+    cpu = np.full(3, 500, dtype=np.int64)
+    mem = np.full(3, 1 << 29, dtype=np.int64)
+    names = ["team-a/web", "team-b/web", "bare"]
+    reply = core.solve(
+        tensorcodec.encode(
+            {
+                "mode": "single_shot", "commit": True, "names": names,
+                "namespace": "fallback-ns",
+            },
+            {"cpu_milli": cpu, "mem_bytes": mem},
+        )
+    )
+    meta, arrays = tensorcodec.decode(reply)
+    assert int((arrays["assignments"] >= 0).sum()) == 3
+    assert cs.get_pod("team-a", "web").node_name
+    assert cs.get_pod("team-b", "web").node_name
+    assert cs.get_pod("fallback-ns", "bare").node_name

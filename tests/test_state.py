@@ -321,3 +321,46 @@ def test_event_store_ttl_small_store_still_prunes():
     cs.record_event(n, "Fresh", "new", timestamp=150.0)
     reasons = {e.reason for e in cs.list_events()}
     assert "Old" not in reasons and "Fresh" in reasons
+
+
+def test_fit_hint_ignores_capacity_shrink_that_still_fits():
+    """VERDICT r3 weak #8: a resource-only NodeUpdate that SHRINKS
+    allocatable must not wake parked pods that already fit the old
+    capacity — the change cannot have unblocked them."""
+    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.solver.exact import ExactSolverConfig
+
+    clock = FakeClock()
+    cs = ClusterState()
+    n1 = node("n1", cpu="8")
+    cs.create_node(n1)
+    sched = Scheduler(
+        cs,
+        SchedulerConfig(solver=ExactSolverConfig(tie_break="first")),
+        clock=clock,
+    )
+    # park two pods as unschedulable: one that always fit n1's resources
+    # (rejected elsewhere) and one genuinely resource-blocked
+    cs.create_pod(pod("small", cpu="100m"))
+    cs.create_pod(pod("big", cpu="6000m"))
+    infos = sched.queue.pop_batch(2)
+    for info in infos:
+        sched.queue.add_unschedulable(info, sched.queue.scheduling_cycle)
+    assert sched.queue.pending_counts()["unschedulable"] == 2
+    # shrink allocatable 8 -> 4 cpu: small still fits old AND new (the
+    # change cannot have unblocked it), big fits neither -> no wakeups
+    shrunk = node("n1", cpu="4")
+    shrunk.resource_version = cs.get_node("n1").resource_version
+    cs.update_node(shrunk)
+    assert sched.queue.pending_counts()["unschedulable"] == 2, (
+        "a shrink that changes no verdict must wake nothing"
+    )
+    # grow 4 -> 16 cpu: big fits new but NOT old -> exactly it wakes
+    grown = node("n1", cpu="16")
+    grown.resource_version = cs.get_node("n1").resource_version
+    cs.update_node(grown)
+    counts = sched.queue.pending_counts()
+    assert counts["unschedulable"] == 1  # small stays parked
+    clock.advance(1.1)  # let the moved pod clear its backoff window
+    woken = [i.pod.name for i in sched.queue.pop_batch(10)]
+    assert woken == ["big"]
