@@ -381,27 +381,16 @@ def _quality_table() -> dict:
     up against it, and vice versa). Scale is cut vs the headline run so
     the table costs seconds, not minutes."""
     import numpy as np
-    import jax.numpy as jnp
 
     from kubernetes_tpu.server.bulk import columnar_pod_batch
     from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
-    from kubernetes_tpu.solver.single_shot import (
-        SingleShotConfig,
-        _single_shot_jit,
-    )
-    from kubernetes_tpu.tensorize.schema import (
-        NodeBatch, ResourceVocab, pad_to,
-    )
+    from kubernetes_tpu.solver.single_shot import SingleShotSolver
+    from kubernetes_tpu.tensorize.schema import ResourceVocab, pad_to
 
     n_nodes, n_pods = 2_048, 8_192
     vocab = ResourceVocab(("cpu", "memory", "ephemeral-storage"))
     npad = pad_to(n_nodes)
     rng = np.random.default_rng(7)
-    cfg = SingleShotConfig()
-    kw = dict(
-        max_rounds=cfg.max_rounds, price_step=cfg.price_step,
-        top_t=cfg.top_t,
-    )
 
     def preloaded_nodes():
         alloc = np.zeros((3, npad), np.int64)
@@ -438,40 +427,27 @@ def _quality_table() -> dict:
         rc_req = np.zeros((rc, 3), np.int64)
         rc_req[:, 0] = rc_cpu
         rc_req[:, 1] = rc_mem
-        # auction
-        out = _single_shot_jit(
-            jnp.asarray(alloc),
-            jnp.asarray(used.copy()),
-            jnp.asarray(cnt.copy()),
-            jnp.asarray(np.where(np.arange(npad) < n_nodes, 110, 0).astype(np.int32)),
-            jnp.asarray(np.arange(npad) < n_nodes),
-            jnp.asarray(np.ones((8, npad), bool) & (np.arange(npad) < n_nodes)),
-            jnp.asarray(rc_req),
-            jnp.asarray((np.arange(rc) % 8).astype(np.int32)),
-            jnp.asarray(rc_of.astype(np.int32)),
-            jnp.asarray(prio),
-            jnp.asarray(np.ones(n_pods, bool)),
-            **kw,
-        )
-        a_auction = np.asarray(out[0])
-        # exact sequential scan on the same queue order
-        nb = NodeBatch(
-            vocab=vocab, names=[f"n{i}" for i in range(n_nodes)],
-            num_nodes=n_nodes, padded=npad, allocatable=alloc.copy(),
-            used=used.copy(),
-            nonzero_used=used[:2].copy(),
-            pod_count=cnt.copy(),
-            max_pods=np.where(np.arange(npad) < n_nodes, 110, 0).astype(np.int32),
-            valid=np.arange(npad) < n_nodes,
-            schedulable=np.arange(npad) < n_nodes,
-        )
-        pb = columnar_pod_batch(
-            rc_req[rc_of, 0].copy(), rc_req[rc_of, 1].copy(), None, vocab
+
+        def pod_batch():
+            return columnar_pod_batch(
+                rc_req[rc_of, 0].copy(), rc_req[rc_of, 1].copy(),
+                prio.copy(), vocab,
+            )
+
+        # both solvers go through their PUBLIC entry points on the same
+        # pre-loaded cluster and queue order — the quality table measures
+        # the production code paths, not a hand-marshaled replica
+        a_auction = SingleShotSolver().solve(
+            _synthetic_node_batch(vocab, n_nodes, alloc, used, cnt),
+            pod_batch(),
         )
         solver = ExactSolver(
             ExactSolverConfig(tie_break="random", group_size=256)
         )
-        a_exact = solver.solve(nb, pb)
+        a_exact = solver.solve(
+            _synthetic_node_batch(vocab, n_nodes, alloc, used, cnt),
+            pod_batch(),
+        )
 
         # snapshot-headroom objective (the auction's own): identical
         # formula for both assignment vectors
@@ -503,6 +479,33 @@ def _quality_table() -> dict:
     return table
 
 
+
+def _synthetic_node_batch(vocab, n_nodes, alloc, used=None, cnt=None):
+    """One uniform synthetic NodeBatch builder for the bench workloads
+    (shared by the exact north star and the quality table)."""
+    import numpy as np
+
+    from kubernetes_tpu.tensorize.schema import NodeBatch, pad_to
+
+    npad = pad_to(n_nodes)
+    live = np.arange(npad) < n_nodes
+    used = np.zeros((3, npad), np.int64) if used is None else used.copy()
+    cnt = np.zeros(npad, np.int32) if cnt is None else cnt.copy()
+    return NodeBatch(
+        vocab=vocab,
+        names=[f"n{i}" for i in range(n_nodes)],
+        num_nodes=n_nodes,
+        padded=npad,
+        allocatable=alloc.copy(),
+        used=used,
+        nonzero_used=used[:2].copy(),
+        pod_count=cnt,
+        max_pods=np.where(live, 110, 0).astype(np.int32),
+        valid=live,
+        schedulable=live.copy(),
+    )
+
+
 def _north_star_exact() -> dict:
     """The same 50k x 10k workload through the EXACT-parity grouped scan —
     the honest companion number: full sequential binding semantics at
@@ -520,21 +523,7 @@ def _north_star_exact() -> dict:
     alloc[1, :NS_NODES] = 64 << 30
 
     def fresh_batch():
-        return NodeBatch(
-            vocab=vocab,
-            names=[f"n{i}" for i in range(NS_NODES)],
-            num_nodes=NS_NODES,
-            padded=npad,
-            allocatable=alloc.copy(),
-            used=np.zeros((3, npad), np.int64),
-            nonzero_used=np.zeros((2, npad), np.int64),
-            pod_count=np.zeros(npad, np.int32),
-            max_pods=np.where(np.arange(npad) < NS_NODES, 110, 0).astype(
-                np.int32
-            ),
-            valid=np.arange(npad) < NS_NODES,
-            schedulable=np.arange(npad) < NS_NODES,
-        )
+        return _synthetic_node_batch(vocab, NS_NODES, alloc)
 
     cpu = np.full(NS_PODS, 1000, np.int64)
     mem = np.full(NS_PODS, 2 << 30, np.int64)
