@@ -1004,6 +1004,7 @@ def _run_packed(
     group: int,
     **kw,
 ):
+    pack_result = kw.pop("pack_result", False)
     tables = {**nt, **ct}
     state0 = dict(persist)
     for name, s, w in bspec:
@@ -1027,43 +1028,67 @@ def _run_packed(
         )
     else:
         assignments, state = _solve_scan(tables, state0, xs, key, **kw)
-    return assignments, {
+    out_state = {
         k: state[k] for k in ("used", "nonzero_used", "pod_count")
     }
+    if pack_result:
+        # Standalone mode downloads everything host-side; on the axon
+        # tunnel EACH device->host read costs ~0.25 s regardless of size
+        # (measured round 4), so the four result arrays are flattened into
+        # ONE int64 buffer for a single read. Session mode keeps the dict
+        # (state stays device-resident; only assignments download).
+        return jnp.concatenate(
+            [
+                out_state["used"].reshape(-1),
+                out_state["nonzero_used"].reshape(-1),
+                out_state["pod_count"].astype(jnp.int64),
+                assignments.astype(jnp.int64),
+            ]
+        )
+    return assignments, out_state
 
 
+_RUN_PACKED_STATICS = (
+    "bspec",
+    "xspec",
+    "grouped",
+    "group",
+    "tie_break",
+    "scoring_strategy",
+    "w_cpu",
+    "w_mem",
+    "rtc_shape",
+    "disabled",
+    "w_fit",
+    "w_balanced",
+    "w_taint",
+    "w_nodeaff",
+    "w_image",
+    "w_spread",
+    "w_interpod",
+    "use_spread",
+    "use_interpod",
+    "d_pad",
+    "ipa_d_pad",
+    "fdtype",
+    "spread_soft",
+    "ipa_ident",
+    "ipa_score",
+    "use_nominated",
+    "use_extra_score",
+    "pack_result",
+)
+
+# Session mode donates the device-resident persist buffers through each call.
 _run_packed_jit = jax.jit(
-    _run_packed,
-    static_argnames=(
-        "bspec",
-        "xspec",
-        "grouped",
-        "group",
-        "tie_break",
-        "scoring_strategy",
-        "w_cpu",
-        "w_mem",
-        "rtc_shape",
-        "disabled",
-        "w_fit",
-        "w_balanced",
-        "w_taint",
-        "w_nodeaff",
-        "w_image",
-        "w_spread",
-        "w_interpod",
-        "use_spread",
-        "use_interpod",
-        "d_pad",
-        "ipa_d_pad",
-        "fdtype",
-        "spread_soft",
-        "ipa_ident",
-        "ipa_score",
-        "use_nominated",
-        "use_extra_score",
-    ),
-    donate_argnums=(2,),
+    _run_packed, static_argnames=_RUN_PACKED_STATICS, donate_argnums=(2,)
+)
+
+# Standalone (pack_result) solves flatten the result, so the donated persist
+# buffers could never be reused as outputs — a non-donating wrapper avoids
+# the spurious donation warning on every standalone call.
+_run_packed_jit_nodonate = jax.jit(
+    _run_packed, static_argnames=_RUN_PACKED_STATICS
 )
 
 
@@ -1477,7 +1502,8 @@ class ExactSolver:
             kinds = jnp.zeros(1, dtype=jnp.int32)
             self.dispatch_counts["scan"] += 1
 
-        assignments, new_persist = _run_packed_jit(
+        run = _run_packed_jit if session else _run_packed_jit_nodonate
+        out = run(
             nt,
             ct,
             persist,
@@ -1492,17 +1518,27 @@ class ExactSolver:
             xspec=xspec,
             grouped=grouped,
             group=group,
+            pack_result=not session,
             **kw,
         )
         if session:
+            assignments, new_persist = out
             self._session.persist = new_persist
-        else:
-            # np.array(copy=True): np.asarray on a jax array yields a
-            # READ-ONLY view, which would freeze later dirty-column writes
-            nodes.used = np.array(new_persist["used"])
-            nodes.nonzero_used = np.array(new_persist["nonzero_used"])
-            nodes.pod_count = np.array(new_persist["pod_count"])
-        return np.asarray(assignments)[: pods.num_pods]
+            return np.asarray(assignments)[: pods.num_pods]
+        # standalone: ONE packed download (np.array = writable copy; the
+        # unpacked slices below are views of it, so later in-place
+        # dirty-column writes to ``nodes`` stay legal)
+        flat = np.array(out)
+        k = nodes.allocatable.shape[0]
+        npad = nodes.padded
+        o = 0
+        nodes.used = flat[o : o + k * npad].reshape(k, npad)
+        o += k * npad
+        nodes.nonzero_used = flat[o : o + 2 * npad].reshape(2, npad)
+        o += 2 * npad
+        nodes.pod_count = flat[o : o + npad].astype(np.int32)
+        o += npad
+        return flat[o:].astype(np.int32)[: pods.num_pods]
 
     @staticmethod
     def _chunk_kinds(

@@ -1,0 +1,100 @@
+"""Post-packed-download sweep: group size x pod padding at the north star."""
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+from kubernetes_tpu.server.bulk import columnar_pod_batch
+from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+from kubernetes_tpu.tensorize.schema import NodeBatch, ResourceVocab, pad_to
+
+NS_NODES = 10_240
+NS_PODS = 51_200
+vocab = ResourceVocab(("cpu", "memory", "ephemeral-storage"))
+
+
+def fresh_batch():
+    npad = pad_to(NS_NODES)
+    alloc = np.zeros((3, npad), dtype=np.int64)
+    alloc[0, :NS_NODES] = 16_000
+    alloc[1, :NS_NODES] = 64 << 30
+    live = np.arange(npad) < NS_NODES
+    used = np.zeros((3, npad), np.int64)
+    return NodeBatch(
+        vocab=vocab,
+        names=[f"n{i}" for i in range(NS_NODES)],
+        num_nodes=NS_NODES,
+        padded=npad,
+        allocatable=alloc,
+        used=used,
+        nonzero_used=used[:2].copy(),
+        pod_count=np.zeros(npad, np.int32),
+        max_pods=np.where(live, 110, 0).astype(np.int32),
+        valid=live,
+        schedulable=live.copy(),
+    )
+
+
+def pb_exact_pad():
+    """PodBatch padded to exactly NS_PODS (multiple of every group tested)."""
+    pb = columnar_pod_batch(
+        np.full(NS_PODS, 1000, np.int64),
+        np.full(NS_PODS, 2 << 30, np.int64),
+        None,
+        vocab,
+    )
+    import dataclasses
+
+    return dataclasses.replace(
+        pb,
+        padded=NS_PODS,
+        req=pb.req[:NS_PODS],
+        req_mask=pb.req_mask[:NS_PODS],
+        nonzero_req=pb.nonzero_req[:NS_PODS],
+        valid=pb.valid[:NS_PODS],
+        feasible_static=pb.feasible_static[:NS_PODS],
+        priority=pb.priority[:NS_PODS],
+    )
+
+
+_ = np.asarray(jax.jit(lambda x: x * 2)(jnp.arange(8)))  # sync mode
+
+for pad_mode in ("pow2", "exact"):
+    for g in (1024, 2048, 4096):
+        if pad_mode == "exact" and NS_PODS % g:
+            continue  # grouped_eligible needs pod_pad % group == 0
+        pb = (
+            columnar_pod_batch(
+                np.full(NS_PODS, 1000, np.int64),
+                np.full(NS_PODS, 2 << 30, np.int64),
+                None,
+                vocab,
+            )
+            if pad_mode == "pow2"
+            else pb_exact_pad()
+        )
+        solver = ExactSolver(
+            ExactSolverConfig(tie_break="random", group_size=g)
+        )
+        t0 = time.perf_counter()
+        a = solver.solve(fresh_batch(), pb)
+        warm = time.perf_counter() - t0
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            a = solver.solve(fresh_batch(), pb)
+            times.append(round(time.perf_counter() - t0, 3))
+        placed = int((a >= 0).sum())
+        assert placed == NS_PODS, f"{placed}/{NS_PODS}"
+        print(
+            f"pad={pad_mode:5s} g={g:4d} warm={warm:5.1f}s times={times} "
+            f"best={min(times):.3f} med={sorted(times)[2]:.3f}",
+            flush=True,
+        )
