@@ -102,6 +102,12 @@ class ExactSolverConfig:
     # random-mode runs are not reproducible across group_size settings.
     # tie_break="first" is bit-identical either way.
     group_size: int = 64
+    # Compact wire mode: when every chunk of a grouped batch is uniform
+    # (host-verified; see _solve_grouped), upload one representative row
+    # per chunk instead of [P, *] per-pod arrays. Results are bit-identical
+    # to the full upload; this knob exists as an escape hatch and for the
+    # equivalence tests.
+    compact_wire: bool = True
     # plugins.filter.disabled for this profile (runtime/framework.go):
     # names whose Filter stage is skipped. Static-mask plugins are handled
     # by the tensorizer; these flags gate the in-scan filters. A non-empty
@@ -384,11 +390,14 @@ def _solve_scan(
 def _solve_grouped(
     tables,
     state0,
-    xs,  # per-pod scanned inputs, leading axis P (P % group == 0)
-    kinds,  # [P // group] int32 chunk dispatch (see _chunk_kinds)
+    xs,  # per-pod scanned inputs: leading axis P (P % group == 0), or —
+    #      compact mode — one representative row per chunk, leading axis C
+    kinds,  # [C] int32 chunk dispatch (see _chunk_kinds)
     key,
     *,
     group: int,
+    vcnt=None,  # [C] int32 valid-pod count per chunk (compact mode)
+    compact: bool = False,
     **kw,
 ):
     """Grouped exact scan (SURVEY §8.4 'batched variant').
@@ -423,6 +432,15 @@ def _solve_grouped(
     VALID outcome whose distribution differs from the per-pod scan for the
     same seed (ExactSolverConfig.group_size documents this); "first" mode
     places one pod per iteration and is bit-identical to the scan.
+
+    COMPACT mode (host-verified precondition: within every chunk, validity
+    is a prefix and all valid rows are identical): ``xs`` carries ONE
+    representative row per chunk plus ``vcnt`` valid counts instead of P
+    per-pod rows — the fast branches only ever read row 0, and the slow
+    branch replays the representative broadcast ``group`` times with
+    ``pod_valid = iota < vcnt``, which is bit-identical to the full-row
+    replay for uniform chunks. This exists because per-pod uploads
+    dominate the 50k-pod solve's wire cost on the axon tunnel.
     """
     tie_break = kw["tie_break"]
     w_cpu = kw["w_cpu"]
@@ -450,7 +468,20 @@ def _solve_grouped(
     ipa_d_pad = kw["ipa_d_pad"]
     iota_n = jnp.arange(n, dtype=jnp.int32)
 
-    def slow_chunk(st, k, cxs):
+    iota_group = jnp.arange(group, dtype=jnp.int32)
+
+    def row(a):
+        """Chunk-representative row: leading pod axis already stripped in
+        compact mode."""
+        return a if compact else a[0]
+
+    def slow_chunk(st, k, cxs, vc):
+        if compact:
+            cxs = {
+                n: jnp.broadcast_to(a[None], (group,) + a.shape)
+                for n, a in cxs.items()
+            }
+            cxs["pod_valid"] = iota_group < vc
         (st, k), asg = jax.lax.scan(step, (st, k), cxs)
         return st, k, asg
 
@@ -460,18 +491,22 @@ def _solve_grouped(
         _chunk_kinds guarantee each branch only sees chunks it is exact
         for)."""
 
-        def fast_chunk(st, k, cxs):
-            req = cxs["req"][0]  # [K] int64
-            req_mask = cxs["req_mask"][0]
-            nz = cxs["nonzero_req"][0]  # [2] int64
-            takes = cxs["pod_takes"][0]
-            conflict_row = cxs["pod_conflict"][0]
-            cls = cxs["class_of"][0]
+        def fast_chunk(st, k, cxs, vc):
+            req = row(cxs["req"])  # [K] int64
+            req_mask = row(cxs["req_mask"])
+            nz = row(cxs["nonzero_req"])  # [2] int64
+            takes = row(cxs["pod_takes"])
+            conflict_row = row(cxs["pod_conflict"])
+            cls = row(cxs["class_of"])
             # number of pods to place: `group` for a uniform chunk, 0 for
             # an all-padding chunk (kinds marks both; this makes
             # fixed-bucket pod padding nearly free)
-            vcnt = jnp.sum(cxs["pod_valid"].astype(jnp.int32)).astype(
-                jnp.int32
+            vcnt = (
+                vc
+                if compact
+                else jnp.sum(cxs["pod_valid"].astype(jnp.int32)).astype(
+                    jnp.int32
+                )
             )
 
             # capacity: how many MORE identical pods each node can take.
@@ -574,9 +609,9 @@ def _solve_grouped(
                 dd = jnp.where(hk, dom_row, 0)
                 # own symmetric ex term (host precondition: exactly one,
                 # same topology/domain row): its counts also block
-                ex_owned_row = cxs["ipa_ex_owned"][0]  # [Te]
+                ex_owned_row = row(cxs["ipa_ex_owned"])  # [Te]
                 ee = jnp.argmax(ex_owned_row > 0).astype(jnp.int32)
-                v_in = cxs["ipa_in_match"][0][jj]
+                v_in = row(cxs["ipa_in_match"])[jj]
                 v_ex = ex_owned_row[ee]
                 base_cnt = st["ipa_in"][jj] + st["ipa_ex"][ee]
                 dpad_local = ipa_d_pad
@@ -935,14 +970,14 @@ def _solve_grouped(
             # family occupancy updates (rows are zero for neutral chunks,
             # making these no-ops for kind-1 chunks in active batches)
             if use_spread:
-                st["spr_cnt"] = st["spr_cnt"] + cxs["spr_placed"][0].astype(
-                    jnp.int32
-                )[:, None] * m[None, :]
+                st["spr_cnt"] = st["spr_cnt"] + row(
+                    cxs["spr_placed"]
+                ).astype(jnp.int32)[:, None] * m[None, :]
             if use_interpod:
-                st["ipa_in"] = st["ipa_in"] + cxs["ipa_in_match"][0][
+                st["ipa_in"] = st["ipa_in"] + row(cxs["ipa_in_match"])[
                     :, None
                 ] * m[None, :]
-                st["ipa_ex"] = st["ipa_ex"] + cxs["ipa_ex_owned"][0][
+                st["ipa_ex"] = st["ipa_ex"] + row(cxs["ipa_ex_owned"])[
                     :, None
                 ] * m[None, :]
             return st, k, asg
@@ -955,18 +990,22 @@ def _solve_grouped(
 
     def chunk_step(carry, x):
         st, k = carry
-        cxs, kind = x
-        st, k, asg = jax.lax.switch(kind, branches, st, k, cxs)
+        cxs, kind, vc = x
+        st, k, asg = jax.lax.switch(kind, branches, st, k, cxs, vc)
         return (st, k), asg
 
-    p = next(iter(xs.values())).shape[0]
-    cxs_all = jax.tree.map(
-        lambda a: a.reshape((p // group, group) + a.shape[1:]), xs
-    )
+    c = kinds.shape[0]
+    if compact:
+        cxs_all = xs  # already one representative row per chunk
+    else:
+        cxs_all = jax.tree.map(
+            lambda a: a.reshape((c, group) + a.shape[1:]), xs
+        )
+        vcnt = jnp.zeros(c, dtype=jnp.int32)  # unread by the branches
     (state, _), assignments = jax.lax.scan(
-        chunk_step, (state0, key), (cxs_all, kinds)
+        chunk_step, (state0, key), (cxs_all, kinds, vcnt)
     )
-    return assignments.reshape(p), state
+    return assignments.reshape(c * group), state
 
 
 # -- packed transfer layer ---------------------------------------------------
@@ -991,10 +1030,11 @@ def _run_packed(
     ct,  # class tables {static_mask, taint_cnt, nodeaff_pref, image_score, spr, ipa}
     persist,  # {used, nonzero_used, pod_count} — donated
     bstate,  # [B, N] int32 packed per-batch state
-    xi64,  # [P, *] int64 packed per-pod inputs
+    xi64,  # [P, *] int64 packed per-pod inputs ([C, *] in compact mode)
     xi32,  # [P, *] int32
     xbool,  # [P, *] bool
     kinds,  # [P // group] int32 chunk kinds (grouped) or [1] dummy
+    vcnt,  # [C] int32 per-chunk valid counts (compact mode) or [1] dummy
     nom_used,  # [L+1, K, N] int64 cumulative nominated load ([1,1,1] unused)
     key,
     *,
@@ -1005,6 +1045,7 @@ def _run_packed(
     **kw,
 ):
     pack_result = kw.pop("pack_result", False)
+    compact = kw.pop("compact", False)
     tables = {**nt, **ct}
     state0 = dict(persist)
     for name, s, w in bspec:
@@ -1024,7 +1065,8 @@ def _run_packed(
         xs[name] = a[:, 0] if squeeze else a
     if grouped:
         assignments, state = _solve_grouped(
-            tables, state0, xs, kinds, key, group=group, **kw
+            tables, state0, xs, kinds, key, group=group, vcnt=vcnt,
+            compact=compact, **kw,
         )
     else:
         assignments, state = _solve_scan(tables, state0, xs, key, **kw)
@@ -1077,6 +1119,7 @@ _RUN_PACKED_STATICS = (
     "use_nominated",
     "use_extra_score",
     "pack_result",
+    "compact",
 )
 
 # Session mode donates the device-resident persist buffers through each call.
@@ -1489,6 +1532,8 @@ class ExactSolver:
             spread_groupable=not spread.has_soft,
             interpod_groupable=interpod.anti_only,
         )
+        compact = False
+        vcnt_host = np.zeros(1, dtype=np.int32)
         if grouped:
             kinds_host = self._chunk_kinds(
                 pods, static, ports, spread, interpod, group,
@@ -1497,6 +1542,42 @@ class ExactSolver:
             for v, cnt in zip(*np.unique(kinds_host, return_counts=True)):
                 self.dispatch_counts[f"kind{int(v)}"] += int(cnt)
             kinds = jnp.asarray(kinds_host)
+            # COMPACT eligibility (wire-cost fast path, _solve_grouped
+            # docstring): every chunk's validity is a prefix and its valid
+            # per-pod rows are identical — then one representative row per
+            # chunk + a valid count replaces the [P, *] uploads, and even
+            # kind-0 chunks replay bit-identically from the broadcast.
+            c = pods.padded // group
+            pvc = pod_valid[:, 0].reshape(c, group)
+            vc = pvc.sum(axis=1).astype(np.int32)
+            if cfg.compact_wire and bool(
+                (pvc == (np.arange(group)[None, :] < vc[:, None])).all()
+            ):
+                pv_off = next(
+                    s for n, s, w, _ in specb if n == "pod_valid"
+                )
+                xb_cmp = xbool.copy()
+                xb_cmp[:, pv_off] = True  # reconstructed from vcnt on device
+
+                def _uniform(x):
+                    a = x.reshape(c, group, -1)
+                    return bool(
+                        ((a == a[:, :1]) | ~pvc[:, :, None]).all()
+                    )
+
+                if _uniform(xi64) and _uniform(xi32) and _uniform(xb_cmp):
+                    compact = True
+                    vcnt_host = vc
+                    xi64 = np.ascontiguousarray(
+                        xi64.reshape(c, group, -1)[:, 0]
+                    )
+                    xi32 = np.ascontiguousarray(
+                        xi32.reshape(c, group, -1)[:, 0]
+                    )
+                    xbool = np.ascontiguousarray(
+                        xbool.reshape(c, group, -1)[:, 0]
+                    )
+                    self.dispatch_counts["compact_batches"] += 1
         else:
             group = 1
             kinds = jnp.zeros(1, dtype=jnp.int32)
@@ -1512,6 +1593,7 @@ class ExactSolver:
             jnp.asarray(xi32),
             jnp.asarray(xbool),
             kinds,
+            jnp.asarray(vcnt_host),
             jnp.asarray(nom_used),
             key,
             bspec=tuple(bspec),
@@ -1519,6 +1601,7 @@ class ExactSolver:
             grouped=grouped,
             group=group,
             pack_result=not session,
+            compact=compact,
             **kw,
         )
         if session:

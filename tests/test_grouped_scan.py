@@ -267,3 +267,123 @@ def test_random_mode_distribution_divergence_bounded():
         tvu = 0.5 * np.abs(m - uniform).sum()
         assert tvu < 0.1, f"{name} marginal vs uniform TV {tvu:.3f}"
     assert abs(ml_scan - ml_grouped) <= 1.0, (ml_scan, ml_grouped)
+
+
+# -- compact wire mode (one representative row per chunk) --------------------
+
+
+def _solve_full(nodes, pods, group, *, compact, spread=False, tie="first",
+                seed=0):
+    """Full tensorizer pipeline solve with the compact_wire knob exposed,
+    returning (assignments, solver) so tests can assert which wire path
+    actually ran."""
+    from kubernetes_tpu.tensorize.interpod import build_interpod_tensors
+    from kubernetes_tpu.tensorize.spread import build_spread_tensors
+
+    vocab = ResourceVocab.build(pods, nodes)
+    nbatch = build_node_batch(nodes, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - len(nodes))
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded)
+    ports = build_port_tensors(pods, pbatch, slot_nodes, {}, nbatch.padded)
+    spr = ipa = None
+    if spread:
+        spr = build_spread_tensors(
+            pods, static.reps, pbatch, slot_nodes, {}, nbatch.padded,
+            static.c_pad,
+        )
+        ipa = build_interpod_tensors(
+            pods, static.reps, pbatch, slot_nodes, {}, nbatch.padded,
+            static.c_pad,
+        )
+    solver = ExactSolver(
+        ExactSolverConfig(
+            tie_break=tie, seed=seed, group_size=group, compact_wire=compact
+        )
+    )
+    return solver.solve(nbatch, pbatch, static, ports, spr, ipa), solver
+
+
+def test_compact_wire_bit_identical_uniform():
+    """Uniform replica runs: the compact upload (one row per chunk + vcnt)
+    must engage and produce bit-identical assignments to the full [P, *]
+    upload, including the tail chunk whose validity is a partial prefix."""
+    rng = np.random.default_rng(13)
+    nodes = mk_nodes(12, rng, taint_every=4)
+    pods = mk_replica_run("web", 42, 250, 512)  # 42 % 8 != 0: partial tail
+    a_full, s_full = _solve_full(nodes, pods, 8, compact=False)
+    a_comp, s_comp = _solve_full(nodes, pods, 8, compact=True)
+    np.testing.assert_array_equal(a_full, a_comp)
+    assert s_comp.dispatch_counts.get("compact_batches", 0) == 1
+    assert s_full.dispatch_counts.get("compact_batches", 0) == 0
+
+
+def test_compact_wire_random_mode_same_seed():
+    """Random tie-break: same seed, same chunk kinds => the compact path
+    consumes identical PRNG draws, so results stay bit-identical."""
+    rng = np.random.default_rng(17)
+    nodes = mk_nodes(10, rng)
+    pods = mk_replica_run("app", 32, 300, 256)
+    a_full, _ = _solve_full(nodes, pods, 8, compact=False, tie="random", seed=5)
+    a_comp, s = _solve_full(nodes, pods, 8, compact=True, tie="random", seed=5)
+    np.testing.assert_array_equal(a_full, a_comp)
+    assert s.dispatch_counts.get("compact_batches", 0) == 1
+
+
+def test_compact_wire_slow_chunk_broadcast_replay():
+    """Uniform pods whose shape defeats the quota fast paths (hard zone
+    spread + a preferred node affinity => nonzero preference rows => kind
+    0) must replay the broadcast representative through the full per-pod
+    pipeline bit-identically to the full upload AND to the ungrouped
+    scan."""
+    rng = np.random.default_rng(19)
+    nodes = []
+    for i in range(9):
+        nodes.append(
+            MakeNode()
+            .name(f"zn-{i}")
+            .capacity({"cpu": "8", "memory": "32Gi", "pods": "30"})
+            .label("topology.kubernetes.io/zone", f"z{i % 3}")
+            .label("disk", "ssd" if i % 2 == 0 else "hdd")
+            .obj()
+        )
+    pods = []
+    # 26 % 8 != 0: the tail kind-0 chunk has vc < group, exercising the
+    # compact slow branch's reconstructed pod_valid = iota < vc masking
+    # (padding rows of a compact slow chunk carry live representative data)
+    for i in range(26):
+        pods.append(
+            MakePod()
+            .name(f"sp-{i:02}")
+            .req({"cpu": "500m", "memory": "1Gi"})
+            .label("app", "sp")
+            .spread_constraint(
+                1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                {"app": "sp"},
+            )
+            .preferred_node_affinity(5, "disk", ["ssd"])
+            .obj()
+        )
+    a_scan, _ = _solve_full(nodes, pods, 0, compact=False, spread=True)
+    a_full, s_full = _solve_full(nodes, pods, 8, compact=False, spread=True)
+    a_comp, s_comp = _solve_full(nodes, pods, 8, compact=True, spread=True)
+    np.testing.assert_array_equal(a_scan, a_full)
+    np.testing.assert_array_equal(a_full, a_comp)
+    assert s_comp.dispatch_counts.get("kind0", 0) > 0  # slow chunks ran
+    assert s_comp.dispatch_counts.get("compact_batches", 0) == 1
+
+
+def test_compact_wire_falls_back_on_mixed_rows():
+    """A chunk with two different pod shapes is not row-uniform: compact
+    must NOT engage, and results must still match the ungrouped scan."""
+    rng = np.random.default_rng(23)
+    nodes = mk_nodes(8, rng)
+    pods = mk_replica_run("a", 12, 200, 256) + mk_replica_run(
+        "b", 12, 400, 512
+    )
+    order = rng.permutation(len(pods))
+    pods = [pods[i] for i in order]
+    a_scan, _ = _solve_full(nodes, pods, 0, compact=False)
+    a_grp, s = _solve_full(nodes, pods, 8, compact=True)
+    np.testing.assert_array_equal(a_scan, a_grp)
+    assert s.dispatch_counts.get("compact_batches", 0) == 0
