@@ -518,6 +518,14 @@ class Pod:
     host_network: bool = False
     # PVC names referenced by spec.volumes[].persistentVolumeClaim.claimName
     pvc_names: tuple[str, ...] = ()
+    # ResourceClaim names referenced by spec.resourceClaims[].
+    # resourceClaimName (DRA). Entries that carry only a
+    # resourceClaimTemplateName (the claim is generated by a controller we
+    # don't run) are kept in claim_template_names — the DRA path reports
+    # such pods unschedulable with a clear reason, and to_dict preserves
+    # the references. [BOUNDARY] per SURVEY §3.2 dynamicresources row.
+    resource_claim_names: tuple[str, ...] = ()
+    claim_template_names: tuple[str, ...] = ()
 
     # status
     phase: str = "Pending"
@@ -538,6 +546,12 @@ class Pod:
     @property
     def effective_priority(self) -> int:
         return self.priority if self.priority is not None else 0
+
+    @property
+    def claim_templates_unresolved(self) -> bool:
+        """True when the pod references a ResourceClaim template whose
+        generated claim we cannot resolve (DRA reports it unschedulable)."""
+        return bool(self.claim_template_names)
 
     def resource_request(self) -> dict[str, int]:
         """computePodResourceRequest: sum(containers) elementwise-max'd with
@@ -634,6 +648,17 @@ class Pod:
                 for v in spec.get("volumes") or ()
                 if v.get("persistentVolumeClaim", {}).get("claimName")
             ),
+            resource_claim_names=tuple(
+                rc["resourceClaimName"]
+                for rc in spec.get("resourceClaims") or ()
+                if rc.get("resourceClaimName")
+            ),
+            claim_template_names=tuple(
+                rc["resourceClaimTemplateName"]
+                for rc in spec.get("resourceClaims") or ()
+                if rc.get("resourceClaimTemplateName")
+                and not rc.get("resourceClaimName")
+            ),
             phase=status.get("phase") or "Pending",
             nominated_node_name=status.get("nominatedNodeName") or "",
             resource_version=int(meta.get("resourceVersion") or 0),
@@ -679,6 +704,14 @@ class Pod:
                     "persistentVolumeClaim": {"claimName": c},
                 }
                 for i, c in enumerate(self.pvc_names)
+            ]
+        if self.resource_claim_names or self.claim_template_names:
+            spec["resourceClaims"] = [
+                {"name": f"claim{i}", "resourceClaimName": c}
+                for i, c in enumerate(self.resource_claim_names)
+            ] + [
+                {"name": f"claimtpl{i}", "resourceClaimTemplateName": t}
+                for i, t in enumerate(self.claim_template_names)
             ]
         status: dict[str, Any] = {"phase": self.phase}
         if self.nominated_node_name:

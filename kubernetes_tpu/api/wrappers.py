@@ -284,6 +284,13 @@ class MakePod:
         self._pod.pvc_names = self._pod.pvc_names + (claim_name,)
         return self
 
+    def resource_claim(self, claim_name: str) -> "MakePod":
+        """spec.resourceClaims[].resourceClaimName reference (DRA)."""
+        self._pod.resource_claim_names = self._pod.resource_claim_names + (
+            claim_name,
+        )
+        return self
+
     def nominated_node_name(self, n: str) -> "MakePod":
         self._pod.nominated_node_name = n
         return self

@@ -155,7 +155,10 @@ def main(argv: list[str] | None = None) -> int:
     )
     p_serve.add_argument(
         "--state",
-        help="initial cluster state file (JSON/YAML: nodes, pods, services, pdbs)",
+        help=(
+            "initial cluster state file (JSON/YAML: nodes, pods, services, "
+            "pdbs, resourceSlices, deviceClasses, resourceClaims)"
+        ),
     )
     p_serve.add_argument(
         "--grpc-port",

@@ -33,6 +33,7 @@ from .solver.exact import ExactSolver, ExactSolverConfig
 from .solver.preemption import PreemptionEvaluator
 from .state.cache import SchedulerCache
 from .state.cluster import ApiError, ClusterState, Event
+from .state.claim_allocator import ClaimAllocationError
 from .state.volume_binder import VolumeBindingError
 from .state.queue import PriorityQueue, QueuedPodInfo
 from .state.snapshot import Snapshot
@@ -165,6 +166,14 @@ class Scheduler:
         from .state.volume_binder import VolumeBinder
 
         self.volume_binder = VolumeBinder(cluster)
+        # dynamicresources plugin (behind the DynamicResourceAllocation
+        # gate): the claim allocator is this framework's Reserve/PreBind
+        # half; the filter half folds DraContext masks into the static
+        # tables per batch
+        from .state.claim_allocator import ClaimAllocator
+
+        self.claim_allocator = ClaimAllocator(cluster)
+        self._dra = self.feature_gates.enabled("DynamicResourceAllocation")
         # profile map: schedulerName -> solver (profile/profile.go#NewMap)
         from .api.objects import DEFAULT_SCHEDULER_NAME
 
@@ -203,6 +212,18 @@ class Scheduler:
     def _on_event(self, ev: Event) -> None:
         if ev.kind == "Event":
             return  # the scheduler's own recorder output
+        if ev.kind in ("ResourceSlice", "DeviceClass", "ResourceClaim"):
+            # DRA inventory/claim changes can unblock claim-bearing pods
+            # (eventhandlers.go registers the dynamicresources plugin's
+            # cluster events [U]); the hint stays conservative (move all)
+            # EXCEPT for this scheduler's own binding-side claim writes
+            # (reservedFor/allocation appends for a pod that just bound
+            # TAKE devices — they cannot unblock a parked pod, and waking
+            # the whole unschedulable map per bind defeats backoff).
+            # Unreserve rollbacks FREE devices and are not suppressed.
+            if self._dra and not self.claim_allocator.writing:
+                self.queue.move_all_to_active_or_backoff(ev.kind + ev.type)
+            return
         if ev.kind == "Pod":
             pod = ev.obj
             # nominator-map maintenance: an unbound pod with a nomination is
@@ -487,6 +508,9 @@ class Scheduler:
         pending_before = len(pending)
         unsched_before = len(res.unschedulable)
         failures_before = len(res.bind_failures)
+        # per-pod overrides for the generic "0/N nodes" failure message
+        # (e.g. DRA unresolvable-claim reasons)
+        unsched_reason: dict[str, str] = {}
         with self.cluster.lock:
             # phase 2a: snapshot + tensorize against a consistent view
             batch = self.snapshot.update(self.cache)
@@ -618,6 +642,25 @@ class Scheduler:
                         return None
                     return default_selector_key(p, services)
 
+            dra_active = self._dra and any(
+                p.resource_claim_names or p.claim_templates_unresolved
+                for p in pods
+            )
+            if dra_active:
+                # pods with different claim sets must not share a class
+                # rep: the DRA mask is per-claim-set
+                base_dra = class_key_extra
+
+                def class_key_extra(p, _base=base_dra):
+                    parts = (
+                        p.namespace,
+                        tuple(sorted(p.resource_claim_names)),
+                        p.claim_templates_unresolved,
+                    )
+                    if _base is not None:
+                        return (parts, _base(p))
+                    return parts
+
             if self.config.out_of_tree_plugins or self.extender_clients:
                 # custom plugins and extenders read pod fields the in-tree
                 # class key doesn't cover (labels/annotations on spread-free
@@ -645,6 +688,41 @@ class Scheduler:
                 added_affinity=solver.config.added_affinity,
                 class_key_extra=class_key_extra,
             )
+            if dra_active:
+                # dynamicresources Filter: fold per-class claim
+                # feasibility into the static mask (allocated claims pin
+                # to their node). The allocator's cached context is reused
+                # — same dra_generation-keyed build, plus the in-flight
+                # assumption overlay, so devices taken by pods still
+                # binding are already masked out.
+                from .ops.oracle.dra import ClaimError
+
+                tdra = time.perf_counter()
+                dra_ctx = self.claim_allocator.context()
+                unresolvable: dict[int, str] = {}
+                for ci, rep in enumerate(static.reps):
+                    if not (
+                        rep.resource_claim_names
+                        or rep.claim_templates_unresolved
+                    ):
+                        continue
+                    try:
+                        m = dra_ctx.feasible_mask(rep, slot_nodes)
+                    except ClaimError as e:
+                        # UnschedulableAndUnresolvable: mask the class and
+                        # surface the REASON on the pods' failure events
+                        m = False
+                        unresolvable[ci] = str(e)
+                    static.mask[ci] &= m
+                if unresolvable:
+                    class_of = np.asarray(static.class_of)
+                    for i, p in enumerate(pods):
+                        why = unresolvable.get(int(class_of[i]))
+                        if why is not None:
+                            unsched_reason[p.key] = why
+                metrics.plugin_execution_duration_seconds.labels(
+                    "DynamicResources", "PreFilter", "Success"
+                ).observe(time.perf_counter() - tdra)
             placed_by_slot: dict[int, list[Pod]] = {}
             if need_ports or need_spread or need_interpod:
                 for slot, name in enumerate(self.snapshot.names):
@@ -837,8 +915,12 @@ class Scheduler:
                     n_nodes = sum(1 for n in slot_nodes if n is not None)
                     self._event(
                         pod, "FailedScheduling",
-                        f"0/{n_nodes} nodes are available: the batched "
-                        "filter pipeline rejected every candidate",
+                        unsched_reason.get(
+                            pod.key,
+                            f"0/{n_nodes} nodes are available: the "
+                            "batched filter pipeline rejected every "
+                            "candidate",
+                        ),
                         type_="Warning",
                     )
                     continue
@@ -867,6 +949,18 @@ class Scheduler:
                                 f"node {node_name} vanished before volume binding"
                             )
                         self.volume_binder.assume_pod_volumes(pod, ninfo.node)
+                    if self._dra and (
+                        pod.resource_claim_names
+                        or pod.claim_templates_unresolved
+                    ):
+                        # dynamicresources Reserve: assume concrete devices
+                        # on the chosen node (the mask said they exist; a
+                        # same-batch racer may have taken them — fail =>
+                        # unreserve + requeue, like the reference's
+                        # in-flight claim conflicts)
+                        self.claim_allocator.assume_pod_claims(
+                            pod, node_name
+                        )
                     for p in self.registry.reserve:
                         st = p.reserve(state, pod, node_name)
                         if not st.is_success:
@@ -875,7 +969,9 @@ class Scheduler:
                                 + "; ".join(st.reasons)
                             )
                     bind_dt += time.perf_counter() - tb
-                except (VolumeBindingError, _Rejected) as e:
+                except (
+                    VolumeBindingError, ClaimAllocationError, _Rejected,
+                ) as e:
                     self._unreserve_all(state, pod, node_name)
                     res.bind_failures.append((pod.key, str(e)))
                     self._requeue(info, cycle)
@@ -1010,6 +1106,7 @@ class Scheduler:
         for p in reversed(self.registry.reserve):
             p.unreserve(state, pod, node_name)
         self.volume_binder.unreserve(pod.key)
+        self.claim_allocator.unreserve(pod.key)
         try:
             self.cache.forget_pod(pod.key)
         except Exception:
@@ -1046,6 +1143,8 @@ class Scheduler:
                     )
             if pod.pvc_names:
                 self.volume_binder.bind_pod_volumes(pod)
+            if self._dra and pod.resource_claim_names:
+                self.claim_allocator.bind_pod_claims(pod)
             binder = next(
                 (
                     cl
@@ -1081,6 +1180,7 @@ class Scheduler:
         with self.cluster.lock:
             self.cache.finish_binding(pod.key)
             self.volume_binder.finish(pod.key)
+            self.claim_allocator.finish(pod.key)
             self._event(
                 pod, "Scheduled",
                 f"Successfully assigned {pod.key} to {node_name}",

@@ -429,7 +429,8 @@ class MicroBatcher:
 
 def _load_state_file(cluster: ClusterState, path: str) -> None:
     """Initial-state ingest: JSON/YAML with {"nodes": [...], "pods": [...],
-    "services": [...], "pdbs": [...]} of wire-shape dicts."""
+    "services": [...], "pdbs": [...], "resourceSlices": [...],
+    "deviceClasses": [...], "resourceClaims": [...]} of wire-shape dicts."""
     import json
 
     with open(path) as f:
@@ -454,6 +455,19 @@ def _load_state_file(cluster: ClusterState, path: str) -> None:
 
         for dd in doc["pdbs"]:
             cluster.create_pdb(PodDisruptionBudget.from_dict(dd))
+    if (
+        doc.get("resourceSlices")
+        or doc.get("deviceClasses")
+        or doc.get("resourceClaims")
+    ):
+        from ..api.dra import DeviceClass, ResourceClaim, ResourceSlice
+
+        for sd in doc.get("resourceSlices") or []:
+            cluster.create_resource_slice(ResourceSlice.from_dict(sd))
+        for cd in doc.get("deviceClasses") or []:
+            cluster.create_device_class(DeviceClass.from_dict(cd))
+        for cd in doc.get("resourceClaims") or []:
+            cluster.create_resource_claim(ResourceClaim.from_dict(cd))
 
 
 def make_app(core: ExtenderCore, scheduler=None, batch_window: float = 0.002):

@@ -126,6 +126,14 @@ class ClusterState:
         self._pvs: dict[str, PersistentVolume] = {}
         self._pvcs: dict[str, PersistentVolumeClaim] = {}
         self._services: dict[str, object] = {}
+        # DRA (resource.k8s.io subset, api/dra.py): keyed by name (slices,
+        # classes are cluster-scoped) / ns-name (claims). dra_generation
+        # bumps on every DRA-object write so the allocator's base-context
+        # cache invalidates exactly when the inventory/claims change.
+        self._resource_slices: dict[str, object] = {}
+        self._device_classes: dict[str, object] = {}
+        self._resource_claims: dict[str, object] = {}
+        self.dra_generation = 0
         self._events: dict[str, EventRecord] = {}
         self._events_by_agg: dict[tuple, EventRecord] = {}
         self._event_seq = 0
@@ -201,6 +209,24 @@ class ClusterState:
             raise ApiError("NotFound", key)
         self._next_rv()
         self._emit("DELETED", "Pod", pod)
+        # DRA deallocating-controller stand-in ([BOUNDARY]): a deleted pod
+        # leaves every claim's reservedFor; a claim nobody reserves loses
+        # its allocation, freeing the devices (the resourceclaim
+        # controller's deallocation, collapsed into the state service)
+        if pod.resource_claim_names:
+            for cname in pod.resource_claim_names:
+                c = self._resource_claims.get(f"{namespace}/{cname}")
+                if c is None or key not in c.reserved_for:
+                    continue
+                c.reserved_for = tuple(
+                    k for k in c.reserved_for if k != key
+                )
+                if not c.reserved_for:
+                    c.allocated_node = ""
+                    c.results = ()
+                c.resource_version = self._next_rv()
+                self.dra_generation += 1
+                self._emit("MODIFIED", "ResourceClaim", c)
 
     def list_pods(self) -> list[Pod]:
         return list(self._pods.values())
@@ -324,6 +350,85 @@ class ClusterState:
         pvc.resource_version = self._next_rv()
         self._pvcs[pvc.key] = pvc
         return pvc
+
+    # -- DRA: ResourceSlices / DeviceClasses / ResourceClaims --
+
+    def create_resource_slice(self, s) -> object:
+        if s.name in self._resource_slices:
+            raise ApiError("AlreadyExists", s.name)
+        s.resource_version = self._next_rv()
+        self.dra_generation += 1
+        self._resource_slices[s.name] = s
+        self._emit("ADDED", "ResourceSlice", s)
+        return s
+
+    def delete_resource_slice(self, name: str) -> None:
+        s = self._resource_slices.pop(name, None)
+        if s is None:
+            raise ApiError("NotFound", name)
+        self._next_rv()
+        self.dra_generation += 1
+        self._emit("DELETED", "ResourceSlice", s)
+
+    def list_resource_slices(self) -> list:
+        return list(self._resource_slices.values())
+
+    def create_device_class(self, dc) -> object:
+        if dc.name in self._device_classes:
+            raise ApiError("AlreadyExists", dc.name)
+        dc.resource_version = self._next_rv()
+        self.dra_generation += 1
+        self._device_classes[dc.name] = dc
+        self._emit("ADDED", "DeviceClass", dc)
+        return dc
+
+    def delete_device_class(self, name: str) -> None:
+        dc = self._device_classes.pop(name, None)
+        if dc is None:
+            raise ApiError("NotFound", name)
+        self._next_rv()
+        self.dra_generation += 1
+        self._emit("DELETED", "DeviceClass", dc)
+
+    def list_device_classes(self) -> list:
+        return list(self._device_classes.values())
+
+    def create_resource_claim(self, c) -> object:
+        if c.key in self._resource_claims:
+            raise ApiError("AlreadyExists", c.key)
+        c.resource_version = self._next_rv()
+        self.dra_generation += 1
+        self._resource_claims[c.key] = c
+        self._emit("ADDED", "ResourceClaim", c)
+        return c
+
+    def get_resource_claim(self, namespace: str, name: str) -> object:
+        key = f"{namespace}/{name}"
+        try:
+            return self._resource_claims[key]
+        except KeyError:
+            raise ApiError("NotFound", key) from None
+
+    def update_resource_claim(self, c) -> object:
+        if c.key not in self._resource_claims:
+            raise ApiError("NotFound", c.key)
+        c.resource_version = self._next_rv()
+        self.dra_generation += 1
+        self._resource_claims[c.key] = c
+        self._emit("MODIFIED", "ResourceClaim", c)
+        return c
+
+    def delete_resource_claim(self, namespace: str, name: str) -> None:
+        key = f"{namespace}/{name}"
+        c = self._resource_claims.pop(key, None)
+        if c is None:
+            raise ApiError("NotFound", key)
+        self._next_rv()
+        self.dra_generation += 1
+        self._emit("DELETED", "ResourceClaim", c)
+
+    def list_resource_claims(self) -> list:
+        return list(self._resource_claims.values())
 
     # -- bulk helpers for benchmarks --
 

@@ -13,8 +13,12 @@ Gates wired to real behavior in this framework:
 - PodSchedulingReadiness (default on, upstream GA): when off,
   .spec.schedulingGates are ignored and gated pods enqueue normally
   (pre-1.26 behavior).
-- DynamicResourceAllocation (default off): accepted for flag parity;
-  enabling it warns — DRA is documented out of scope (SURVEY §3.2).
+- DynamicResourceAllocation (default off, matching the upstream beta
+  gate): when on, pods referencing ResourceClaims are filtered to nodes
+  whose ResourceSlices satisfy the claims, devices are allocated at
+  Reserve, and allocation + reservedFor are written at PreBind
+  (api/dra.py, ops/oracle/dra.py, state/claim_allocator.py — scope and
+  divergences documented there).
 """
 
 from __future__ import annotations
@@ -63,10 +67,4 @@ class FeatureGates:
                     f"feature gate {name}: invalid value {val!r}"
                 )
             fg.overrides[name] = lv == "true"
-        if fg.overrides.get("DynamicResourceAllocation"):
-            fg.warnings.append(
-                "DynamicResourceAllocation accepted but not implemented "
-                "(documented out of scope, SURVEY §3.2); DRA claims are "
-                "ignored"
-            )
         return fg

@@ -1,0 +1,590 @@
+"""Dynamic Resource Allocation (resource.k8s.io subset) — the
+dynamicresources plugin behind the DynamicResourceAllocation gate:
+wire shapes, claim-feasibility filtering, Reserve-time device allocation,
+PreBind status writes, rollback, sharing, and release on pod delete.
+Scope/divergences documented in kubernetes_tpu/api/dra.py.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.dra import (
+    Device,
+    DeviceClass,
+    DeviceRequest,
+    ResourceClaim,
+    ResourceSlice,
+)
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.state.cluster import ClusterState
+from kubernetes_tpu.utils.featuregate import FeatureGates
+
+
+def mk_cluster(n_nodes=4, gpus_per_node=2):
+    cs = ClusterState()
+    for i in range(n_nodes):
+        cs.create_node(
+            MakeNode()
+            .name(f"n{i}")
+            .capacity({"cpu": "8", "memory": "32Gi", "pods": "20"})
+            .obj()
+        )
+        cs.create_resource_slice(
+            ResourceSlice(
+                name=f"slice-n{i}",
+                node_name=f"n{i}",
+                driver="gpu.example.com",
+                devices=tuple(
+                    Device(name=f"gpu-{j}", attributes={"model": "a100"})
+                    for j in range(gpus_per_node)
+                ),
+            )
+        )
+    cs.create_device_class(DeviceClass(name="gpu", driver="gpu.example.com"))
+    return cs
+
+
+def mk_sched(cs, batch=64):
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    return Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=batch,
+            feature_gates=FeatureGates.parse("DynamicResourceAllocation=true"),
+        ),
+        clock=FakeClock(),
+    )
+
+
+def drain(sched, rounds=6):
+    """Drain until quiescent, stepping the fake clock over backoffs so a
+    Reserve-failed pod's retry lands in a later batch."""
+    scheduled, unschedulable = 0, 0
+    idle = 0
+    for _ in range(rounds * 4):
+        r = sched.schedule_batch()
+        scheduled += len(r.scheduled)
+        unschedulable += len(r.unschedulable)
+        if r.scheduled or r.bind_failures:
+            idle = 0
+            continue
+        if hasattr(sched.clock, "advance"):
+            sched.clock.advance(11.0)  # past podMaxBackoffSeconds
+        idle += 1
+        if idle >= 2:
+            break
+    return scheduled, unschedulable
+
+
+def test_wire_round_trip():
+    claim = ResourceClaim.from_dict(
+        {
+            "metadata": {"name": "c1", "namespace": "ns1"},
+            "spec": {
+                "devices": {
+                    "requests": [
+                        {
+                            "name": "req0",
+                            "deviceClassName": "gpu",
+                            "allocationMode": "ExactCount",
+                            "count": 2,
+                        }
+                    ]
+                }
+            },
+        }
+    )
+    assert claim.requests[0].count == 2
+    claim.allocated_node = "n1"
+    rt = ResourceClaim.from_dict(claim.to_dict())
+    assert rt.allocated_node == "n1" and rt.requests == claim.requests
+
+    sl = ResourceSlice.from_dict(
+        {
+            "metadata": {"name": "s"},
+            "spec": {
+                "nodeName": "n0",
+                "driver": "d",
+                "devices": [
+                    {
+                        "name": "dev0",
+                        "basic": {
+                            "attributes": {"model": {"string": "a100"}}
+                        },
+                    }
+                ],
+            },
+        }
+    )
+    assert sl.devices[0].attributes == {"model": "a100"}
+    assert ResourceSlice.from_dict(sl.to_dict()) == sl
+
+    # CEL selectors: the two structural shapes parse; anything else makes
+    # the class match nothing (conservative), not silently everything
+    dc = DeviceClass.from_dict(
+        {
+            "metadata": {"name": "g"},
+            "spec": {
+                "selectors": [
+                    {"cel": {"expression": 'device.driver == "d"'}},
+                    {
+                        "cel": {
+                            "expression": 'device.attributes["model"] == "a100"'
+                        }
+                    },
+                ]
+            },
+        }
+    )
+    assert dc.driver == "d" and dc.match_attributes == {"model": "a100"}
+    opaque = DeviceClass.from_dict(
+        {
+            "metadata": {"name": "o"},
+            "spec": {
+                "selectors": [
+                    {"cel": {"expression": "device.capacity['x'].value > 5"}}
+                ]
+            },
+        }
+    )
+    assert not opaque.matches("d", Device(name="x"))
+
+    # pod claim refs parse; template-only refs are flagged unresolved
+    pod = Pod.from_dict(
+        {
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [{"name": "c"}],
+                "resourceClaims": [
+                    {"name": "r0", "resourceClaimName": "c1"},
+                    {"name": "r1", "resourceClaimTemplateName": "tpl"},
+                ],
+            },
+        }
+    )
+    assert pod.resource_claim_names == ("c1",)
+    assert pod.claim_templates_unresolved
+
+
+def test_unsupported_allocation_mode_rejected():
+    with pytest.raises(ValueError):
+        DeviceRequest.from_dict(
+            {"name": "r", "deviceClassName": "gpu", "allocationMode": "All"}
+        )
+
+
+def test_allocation_on_bind():
+    cs = mk_cluster(n_nodes=3, gpus_per_node=2)
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="train",
+            requests=(DeviceRequest(name="g", device_class_name="gpu", count=2),),
+        )
+    )
+    sched = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("p0").req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("train").obj()
+    )
+    scheduled, _ = drain(sched)
+    assert scheduled == 1
+    claim = cs.get_resource_claim("default", "train")
+    pod = cs.get_pod("default", "p0")
+    assert claim.allocated_node == pod.node_name
+    assert len(claim.results) == 2
+    assert len({r.device for r in claim.results}) == 2
+    assert claim.reserved_for == ("default/p0",)
+
+
+def test_exhaustion_then_release_on_delete():
+    """Each node has 2 GPUs; claims ask for 2 => one claim-bearing pod per
+    node. The overflow pod parks; deleting a holder frees its devices and
+    the ResourceClaim MODIFIED event wakes the parked pod."""
+    cs = mk_cluster(n_nodes=2, gpus_per_node=2)
+    for i in range(3):
+        cs.create_resource_claim(
+            ResourceClaim(
+                name=f"c{i}",
+                requests=(
+                    DeviceRequest(name="g", device_class_name="gpu", count=2),
+                ),
+            )
+        )
+    sched = mk_sched(cs)
+    for i in range(3):
+        cs.create_pod(
+            MakePod().name(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+            .resource_claim(f"c{i}").obj()
+        )
+    scheduled, unsched = drain(sched)
+    # two bind; the third's Reserve fails (devices taken in-flight) and it
+    # PARKS awaiting a claim/slice event — our own reservedFor writes must
+    # NOT wake it (review-caught backoff defeat), so it stays parked
+    assert scheduled == 2
+    bound = {
+        p.name: p.node_name for p in cs.list_pods() if p.node_name
+    }
+    assert len(bound) == 2
+    # the 5-minute leftover flush is the reference's safety net: the pod
+    # retries and is now properly unschedulable (mask exhausted)
+    sched.clock.advance(301.0)
+    r = sched.schedule_batch()
+    assert len(r.unschedulable) == 1
+    victim = next(iter(bound))
+    cs.delete_pod("default", victim)
+    # the deallocating-controller stand-in cleared the claim
+    freed_claim = cs.get_resource_claim("default", f"c{victim[1:]}")
+    assert not freed_claim.allocated and not freed_claim.reserved_for
+    scheduled2, _ = drain(sched)
+    assert scheduled2 == 1
+    assert sum(1 for p in cs.list_pods() if p.node_name) == 2
+
+
+def test_two_claim_pods_race_distinct_devices():
+    """Two pods with separate 1-GPU claims on a 2-GPU single node must get
+    DISTINCT devices even when they bind in the same batch (the in-flight
+    assumption accounting)."""
+    cs = mk_cluster(n_nodes=1, gpus_per_node=2)
+    for i in range(2):
+        cs.create_resource_claim(
+            ResourceClaim(
+                name=f"c{i}",
+                requests=(
+                    DeviceRequest(name="g", device_class_name="gpu", count=1),
+                ),
+            )
+        )
+    sched = mk_sched(cs)
+    for i in range(2):
+        cs.create_pod(
+            MakePod().name(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+            .resource_claim(f"c{i}").obj()
+        )
+    scheduled, _ = drain(sched)
+    assert scheduled == 2
+    devs = [
+        r.device
+        for i in range(2)
+        for r in cs.get_resource_claim("default", f"c{i}").results
+    ]
+    assert sorted(devs) == ["gpu-0", "gpu-1"]
+
+
+def test_shared_claim_pins_second_pod_to_allocation_node():
+    cs = mk_cluster(n_nodes=3, gpus_per_node=2)
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="shared",
+            requests=(DeviceRequest(name="g", device_class_name="gpu", count=1),),
+        )
+    )
+    sched = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("p0").req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("shared").obj()
+    )
+    scheduled, _ = drain(sched)
+    assert scheduled == 1
+    node0 = cs.get_pod("default", "p0").node_name
+    cs.create_pod(
+        MakePod().name("p1").req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("shared").obj()
+    )
+    scheduled, _ = drain(sched)
+    assert scheduled == 1
+    assert cs.get_pod("default", "p1").node_name == node0
+    claim = cs.get_resource_claim("default", "shared")
+    assert set(claim.reserved_for) == {"default/p0", "default/p1"}
+    assert len(claim.results) == 1  # allocated once, shared
+
+
+def test_missing_claim_and_template_unschedulable():
+    cs = mk_cluster(n_nodes=2)
+    sched = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("orphan").req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("nope").obj()
+    )
+    tpl = MakePod().name("tpl").req({"cpu": "1", "memory": "1Gi"}).obj()
+    tpl.claim_template_names = ("tpl",)
+    cs.create_pod(tpl)
+    scheduled, unsched = drain(sched)
+    assert scheduled == 0 and unsched == 2
+
+
+def test_bind_failure_rolls_back_allocation():
+    cs = mk_cluster(n_nodes=1, gpus_per_node=1)
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="c0",
+            requests=(DeviceRequest(name="g", device_class_name="gpu", count=1),),
+        )
+    )
+    sched = mk_sched(cs)
+    from kubernetes_tpu.state.cluster import ApiError
+
+    fails = {"n": 0}
+
+    def fault(pod, node_name):
+        if fails["n"] == 0:
+            fails["n"] += 1
+            raise ApiError("Conflict", "injected bind fault")
+
+    cs.bind_fault = fault
+    cs.create_pod(
+        MakePod().name("p0").req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("c0").obj()
+    )
+    r = sched.schedule_batch()
+    assert r.bind_failures
+    claim = cs.get_resource_claim("default", "c0")
+    assert not claim.allocated and not claim.reserved_for  # rolled back
+    # retry succeeds and re-allocates
+    scheduled, _ = drain(sched)
+    assert scheduled == 1
+    assert cs.get_resource_claim("default", "c0").allocated
+
+
+def test_gate_off_ignores_claims():
+    """Without the gate, claim references don't constrain scheduling and
+    no allocation is written (the pre-round-4 behavior)."""
+    cs = mk_cluster(n_nodes=1, gpus_per_node=0)
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="c0",
+            requests=(DeviceRequest(name="g", device_class_name="gpu", count=1),),
+        )
+    )
+    sched = Scheduler(cs, SchedulerConfig(batch_size=16))
+    cs.create_pod(
+        MakePod().name("p0").req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("c0").obj()
+    )
+    scheduled, _ = drain(sched)
+    assert scheduled == 1
+    assert not cs.get_resource_claim("default", "c0").allocated
+
+
+def test_device_class_attribute_matching():
+    """Two drivers publish devices on one node; a class selecting on an
+    attribute must only count matching devices."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n0").capacity(
+            {"cpu": "8", "memory": "32Gi", "pods": "20"}
+        ).obj()
+    )
+    cs.create_resource_slice(
+        ResourceSlice(
+            name="s-a",
+            node_name="n0",
+            driver="a.dev",
+            devices=(Device("d0", {"model": "a100"}), Device("d1", {"model": "v100"})),
+        )
+    )
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="wants-a100",
+            requests=(
+                DeviceRequest(name="g", device_class_name="a100", count=2),
+            ),
+        )
+    )
+    cs.create_device_class(
+        DeviceClass(name="a100", match_attributes={"model": "a100"})
+    )
+    sched = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("p0").req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("wants-a100").obj()
+    )
+    scheduled, unsched = drain(sched)
+    assert scheduled == 0 and unsched == 1  # only one a100 exists
+
+
+def test_pool_scoped_device_identity():
+    """Same device name in two pools of one driver on one node must count
+    as two devices (identity is (driver, pool, name))."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n0").capacity(
+            {"cpu": "8", "memory": "32Gi", "pods": "20"}
+        ).obj()
+    )
+    for pool in ("p1", "p2"):
+        cs.create_resource_slice(
+            ResourceSlice(
+                name=f"s-{pool}", node_name="n0", driver="d", pool=pool,
+                devices=(Device(name="gpu-0"),),
+            )
+        )
+    cs.create_device_class(DeviceClass(name="gpu", driver="d"))
+    for i in range(2):
+        cs.create_resource_claim(
+            ResourceClaim(
+                name=f"c{i}",
+                requests=(
+                    DeviceRequest(name="g", device_class_name="gpu", count=1),
+                ),
+            )
+        )
+    sched = mk_sched(cs)
+    for i in range(2):
+        cs.create_pod(
+            MakePod().name(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+            .resource_claim(f"c{i}").obj()
+        )
+    scheduled, _ = drain(sched)
+    assert scheduled == 2
+    pools = {
+        cs.get_resource_claim("default", f"c{i}").results[0].pool
+        for i in range(2)
+    }
+    assert pools == {"p1", "p2"}
+
+
+def test_sharer_survives_allocator_rollback():
+    """Pod A allocates a claim, pod B reserves it in the same batch, A's
+    bind fails AFTER B bound: the claim must stay allocated for B and its
+    devices must stay accounted (review-caught rollback hole)."""
+    from kubernetes_tpu.state.claim_allocator import ClaimAllocator
+
+    cs = mk_cluster(n_nodes=1, gpus_per_node=2)
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="shared",
+            requests=(DeviceRequest(name="g", device_class_name="gpu", count=1),),
+        )
+    )
+    alloc = ClaimAllocator(cs)
+    pod_a = MakePod().name("a").resource_claim("shared").obj()
+    pod_b = MakePod().name("b").resource_claim("shared").obj()
+    assert alloc.assume_pod_claims(pod_a, "n0")
+    assert alloc.assume_pod_claims(pod_b, "n0")  # sharer, pinned to n0
+    alloc.bind_pod_claims(pod_b)  # B commits first
+    alloc.finish(pod_b.key)
+    alloc.unreserve(pod_a.key)  # A rolls back
+    claim = cs.get_resource_claim("default", "shared")
+    assert claim.allocated_node == "n0" and len(claim.results) == 1
+    assert claim.reserved_for == ("default/b",)
+    # the allocated device is still accounted: a fresh 2-device claim on
+    # the 2-GPU node must not fit
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="greedy",
+            requests=(DeviceRequest(name="g", device_class_name="gpu", count=2),),
+        )
+    )
+    pod_c = MakePod().name("c").resource_claim("greedy").obj()
+    from kubernetes_tpu.state.claim_allocator import ClaimAllocationError
+
+    with pytest.raises(ClaimAllocationError):
+        alloc.assume_pod_claims(pod_c, "n0")
+
+
+def test_cel_conjunction_conflict_matches_nothing():
+    dc = DeviceClass.from_dict(
+        {
+            "metadata": {"name": "x"},
+            "spec": {
+                "selectors": [
+                    {"cel": {"expression": 'device.attributes["m"] == "a"'}},
+                    {"cel": {"expression": 'device.attributes["m"] == "b"'}},
+                ]
+            },
+        }
+    )
+    assert not dc.matches("d", Device(name="g", attributes={"m": "a"}))
+    assert not dc.matches("d", Device(name="g", attributes={"m": "b"}))
+
+
+def test_flat_bool_attribute_normalizes():
+    dv = Device.from_dict({"name": "g", "attributes": {"coherent": True}})
+    assert dv.attributes["coherent"] == "true"
+    dc = DeviceClass(name="c", match_attributes={"coherent": "true"})
+    assert dc.matches("d", dv)
+
+
+def test_pod_template_refs_round_trip():
+    pod = Pod.from_dict(
+        {
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [{"name": "c"}],
+                "resourceClaims": [
+                    {"name": "r1", "resourceClaimTemplateName": "tpl"}
+                ],
+            },
+        }
+    )
+    assert pod.claim_templates_unresolved
+    rt = Pod.from_dict(pod.to_dict())
+    assert rt.claim_template_names == ("tpl",)
+    assert rt.claim_templates_unresolved
+
+
+def test_unresolvable_claim_reason_in_events():
+    """A dangling claim reference must surface ITS reason on the
+    FailedScheduling event, not the generic 0/N-nodes message."""
+    cs = mk_cluster(n_nodes=2)
+    sched = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("orphan").req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("nope").obj()
+    )
+    drain(sched)
+    notes = [
+        e.note
+        for e in cs.list_events(regarding_name="orphan")
+        if e.reason == "FailedScheduling"
+    ]
+    assert any("resourceclaim default/nope not found" in n for n in notes), notes
+
+
+def test_preexisting_allocation_survives_rollback():
+    """A claim allocated by an external controller (no reservedFor) must
+    NOT lose its allocation when a pod that merely joined it rolls back."""
+    from kubernetes_tpu.state.claim_allocator import ClaimAllocator
+
+    cs = mk_cluster(n_nodes=2, gpus_per_node=2)
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="ext",
+            requests=(DeviceRequest(name="g", device_class_name="gpu", count=1),),
+            allocated_node="n1",
+            results=(
+                __import__("kubernetes_tpu.api.dra", fromlist=["DeviceResult"])
+                .DeviceResult(request="g", driver="gpu.example.com", device="gpu-0"),
+            ),
+        )
+    )
+    alloc = ClaimAllocator(cs)
+    pod = MakePod().name("joiner").resource_claim("ext").obj()
+    assert alloc.assume_pod_claims(pod, "n1")
+    alloc.bind_pod_claims(pod)  # reservedFor=(joiner,)
+    alloc.unreserve(pod.key)  # bind failed
+    claim = cs.get_resource_claim("default", "ext")
+    assert claim.allocated_node == "n1" and claim.results  # preserved
+    assert claim.reserved_for == ()
+
+
+def test_duplicate_claim_reference_counts_once():
+    """A pod listing the same claim twice uses one allocation, not two."""
+    cs = mk_cluster(n_nodes=1, gpus_per_node=1)
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="c0",
+            requests=(DeviceRequest(name="g", device_class_name="gpu", count=1),),
+        )
+    )
+    sched = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("p0").req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("c0").resource_claim("c0").obj()
+    )
+    scheduled, _ = drain(sched)
+    assert scheduled == 1
+    assert len(cs.get_resource_claim("default", "c0").results) == 1

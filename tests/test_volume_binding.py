@@ -122,7 +122,10 @@ def test_feature_gate_parsing():
         FeatureGates.parse("NoSuchGate=true")
     with pytest.raises(ValueError):
         FeatureGates.parse("SchedulerQueueingHints=maybe")
-    assert FeatureGates.parse("DynamicResourceAllocation=true").warnings
+    # DRA is implemented (round 4): enabling the gate is no longer a
+    # warned-but-ignored flag
+    fg = FeatureGates.parse("DynamicResourceAllocation=true")
+    assert fg.enabled("DynamicResourceAllocation") and not fg.warnings
 
 
 def test_pod_scheduling_readiness_gate_off_ignores_gates():
