@@ -160,9 +160,12 @@ class DeviceClass:
                 kind, key, val = parsed
                 if kind == "driver":
                     if driver and driver != val:
-                        # contradictory conjunction: matches nothing
+                        # contradictory conjunction: matches nothing —
+                        # keep the original driver so the opaque state
+                        # round-trips through to_dict/from_dict
                         opaque = cel
-                    driver = val
+                    else:
+                        driver = val
                 elif key in match and match[key] != val:
                     # two selectors pinning one attribute to different
                     # values is an unsatisfiable AND, not last-wins
@@ -234,10 +237,16 @@ class DeviceRequest:
             raise ValueError(
                 f"deviceRequest {d.get('name')!r}: adminAccess is out of scope"
             )
+        count = int(d.get("count") or 1)
+        if count < 1:
+            raise ValueError(
+                f"deviceRequest {d.get('name')!r}: count must be >= 1, "
+                f"got {count}"
+            )
         return DeviceRequest(
             name=d.get("name") or "",
             device_class_name=d.get("deviceClassName") or "",
-            count=int(d.get("count") or 1),
+            count=count,
         )
 
     def to_dict(self) -> dict:

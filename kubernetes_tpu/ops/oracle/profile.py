@@ -133,11 +133,26 @@ class FullOracle:
         spread_state=_UNSET,
         interpod_state=_UNSET,
     ) -> bool:
-        """All Filter plugins, any order (they're independent predicates).
-        ``spread_state``/``interpod_state`` are the per-pod PreFilter
-        precomputations (spread: None = pod has no hard constraints);
-        omitting them rebuilds per call — fine for single-node probes, hot
-        paths prebuild via feasible_and_ties."""
+        """All Filter plugins (delegates to filter_reason so the plugin
+        sequence exists exactly once). ``spread_state``/``interpod_state``
+        are the per-pod PreFilter precomputations (spread: None = pod has
+        no hard constraints); omitting them rebuilds per call — fine for
+        single-node probes, hot paths prebuild via feasible_and_ties."""
+        return (
+            self.filter_reason(pod, on, spread_state, interpod_state)
+            is None
+        )
+
+    def filter_reason(
+        self,
+        pod: Pod,
+        on: OracleNode,
+        spread_state=_UNSET,
+        interpod_state=_UNSET,
+    ) -> str | None:
+        """First failing Filter plugin's reference-shaped diagnosis for
+        this node (None = feasible) — the per-node Status message
+        RunFilterPlugins would record. Same plugin order as filter_one."""
         if spread_state is FullOracle._UNSET:
             spread_state = osp.build_filter_state(pod, self._all_nodes_with_pods())
         if interpod_state is FullOracle._UNSET:
@@ -148,35 +163,76 @@ class FullOracle:
         from ...tensorize.plugins import VOLUME_PLUGINS
 
         dis = self.disabled
-        return (
-            ("NodeName" in dis or opl.node_name_filter(pod, on.node))
-            and (
-                "NodeUnschedulable" in dis
-                or opl.node_unschedulable_filter(pod, on.node)
-            )
-            and (
-                "TaintToleration" in dis
-                or opl.taint_toleration_filter(pod, on.node)
-            )
-            and (
-                "NodeAffinity" in dis
-                or opl.node_affinity_filter(pod, on.node)
-            )
-            and ("NodePorts" in dis or opl.node_ports_filter(pod, on.used_ports))
-            and ("NodeResourcesFit" in dis or not fit_filter(pod, on.res))
-            and (
-                "PodTopologySpread" in dis
-                or spread_state is None
-                or spread_state.check(on.node)
-            )
-            and ("InterPodAffinity" in dis or interpod_state.check(on.node))
-            and (
-                self.volume_ctx is None
-                or not pod.pvc_names
-                or bool(VOLUME_PLUGINS & dis)
-                or ovol.volume_filter(pod, on.node, self.volume_ctx)
-            )
+        if "NodeName" not in dis and not opl.node_name_filter(pod, on.node):
+            return "node(s) didn't match the requested node name"
+        if "NodeUnschedulable" not in dis and not opl.node_unschedulable_filter(
+            pod, on.node
+        ):
+            return "node(s) were unschedulable"
+        if "TaintToleration" not in dis and not opl.taint_toleration_filter(
+            pod, on.node
+        ):
+            return "node(s) had untolerated taint(s)"
+        if "NodeAffinity" not in dis and not opl.node_affinity_filter(
+            pod, on.node
+        ):
+            return "node(s) didn't match Pod's node affinity/selector"
+        if "NodePorts" not in dis and not opl.node_ports_filter(
+            pod, on.used_ports
+        ):
+            return "node(s) didn't have free ports for the requested pod ports"
+        if "NodeResourcesFit" not in dis:
+            failures = fit_filter(pod, on.res)
+            if failures:
+                r = failures[0]
+                return "Too many pods" if r == "pods" else f"Insufficient {r}"
+        if (
+            "PodTopologySpread" not in dis
+            and spread_state is not None
+            and not spread_state.check(on.node)
+        ):
+            return "node(s) didn't match pod topology spread constraints"
+        if "InterPodAffinity" not in dis and not interpod_state.check(on.node):
+            return "node(s) didn't match pod affinity/anti-affinity rules"
+        if (
+            self.volume_ctx is not None
+            and pod.pvc_names
+            and not (VOLUME_PLUGINS & dis)
+            and not ovol.volume_filter(pod, on.node, self.volume_ctx)
+        ):
+            return "node(s) had volume node affinity/limit conflict"
+        return None
+
+    def fit_error(self, pod: Pod, extra=None) -> str:
+        """The aggregated unschedulable message the reference's FitError
+        renders (schedule_one.go#FitError.Error [U]): '0/N nodes are
+        available: {count} {reason}, ...' with reasons sorted.
+
+        ``extra(on) -> str | None`` contributes reasons from filters the
+        scalar replay doesn't model (DRA claim feasibility, folded
+        out-of-tree plugins); it is consulted for nodes every scalar
+        filter accepts."""
+        from collections import Counter
+
+        spread_state = osp.build_filter_state(
+            pod, self._all_nodes_with_pods()
         )
+        interpod_state = oip.build_interpod_state(
+            pod, self._all_nodes_with_pods()
+        )
+        reasons: Counter = Counter()
+        for on in self.nodes:
+            why = self.filter_reason(pod, on, spread_state, interpod_state)
+            if why is None and extra is not None:
+                why = extra(on)
+            if why is not None:
+                reasons[why] += 1
+        if not reasons:
+            return f"0/{len(self.nodes)} nodes are available"
+        detail = ", ".join(
+            f"{cnt} {why}" for why, cnt in sorted(reasons.items())
+        )
+        return f"0/{len(self.nodes)} nodes are available: {detail}."
 
     def score_totals(self, pod: Pod, feasible: list[int]) -> dict[int, int]:
         """Weighted, per-plugin-normalized totals over the feasible set

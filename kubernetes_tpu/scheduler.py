@@ -511,6 +511,9 @@ class Scheduler:
         # per-pod overrides for the generic "0/N nodes" failure message
         # (e.g. DRA unresolvable-claim reasons)
         unsched_reason: dict[str, str] = {}
+        # pre-DRA-fold mask rows per class: preemption candidacy for
+        # device-exhausted nodes (empty when DRA is off)
+        dra_prefold: dict[int, np.ndarray] = {}
         with self.cluster.lock:
             # phase 2a: snapshot + tensorize against a consistent view
             batch = self.snapshot.update(self.cache)
@@ -713,6 +716,13 @@ class Scheduler:
                         # surface the REASON on the pods' failure events
                         m = False
                         unresolvable[ci] = str(e)
+                    else:
+                        # device exhaustion is Unschedulable, NOT
+                        # Unresolvable: preemption may free devices, so
+                        # candidate selection widens back to the pre-DRA
+                        # mask (with a victims-release recheck —
+                        # _dra_preempt_ok)
+                        dra_prefold[ci] = static.mask[ci].copy()
                     static.mask[ci] &= m
                 if unresolvable:
                     class_of = np.asarray(static.class_of)
@@ -868,6 +878,86 @@ class Scheduler:
             postfilter_reasons: dict | None = None
             preempt_dt = 0.0
             bind_dt = 0.0
+            # FitError diagnosis (schedule_one.go#FitError [U]): per-node
+            # reasons don't exist inside the fused device pipeline, so the
+            # failure path replays the scalar oracle's filters to build the
+            # reference-shaped "0/N nodes are available: k Insufficient
+            # cpu, ..." message. Lazy (failures only) and memoized on
+            # (class, requests) — pods sharing constraint class AND
+            # request vector share the diagnosis.
+            fit_oracle = None
+            fiterr_memo: dict[tuple, str] = {}
+            class_of_host = np.asarray(static.class_of)
+
+            def fit_error_for(pod: Pod, idx: int) -> str:
+                nonlocal fit_oracle
+                key = (
+                    int(class_of_host[idx]),
+                    tuple(sorted(pod.resource_request().items())),
+                    pod.host_ports(),  # ports are per-pod, not class-level
+                )
+                msg = fiterr_memo.get(key)
+                if msg is not None:
+                    return msg
+                if fit_oracle is None:
+                    from .ops.oracle.profile import (
+                        FullOracle,
+                        make_oracle_nodes,
+                    )
+
+                    live = [n for n in slot_nodes if n is not None]
+                    by_name = {
+                        info2.node.name: list(info2.pods.values())
+                        for info2 in self.cache.nodes.values()
+                        if info2.node is not None and info2.pods
+                    }
+                    fit_oracle = FullOracle(
+                        make_oracle_nodes(live, by_name),
+                        volume_ctx=volume_ctx,
+                        services=services,
+                        spread_defaulting=solver.config.spread_defaulting,
+                        disabled=frozenset(solver.config.disabled_filters),
+                    )
+                n_nodes = sum(1 for n in slot_nodes if n is not None)
+                generic = (
+                    f"0/{n_nodes} nodes are available: the batched "
+                    "filter pipeline rejected every candidate"
+                )
+                extra = None
+                if dra_active and pod.resource_claim_names:
+                    # the scalar replay has no DRA filter: contribute the
+                    # claim-feasibility verdicts for nodes it accepts
+                    try:
+                        dm = self.claim_allocator.context().feasible_mask(
+                            pod, slot_nodes
+                        )
+                        ok_by_name = {
+                            n.name: bool(dm[i])
+                            for i, n in enumerate(slot_nodes)
+                            if n is not None
+                        }
+
+                        def extra(on):
+                            if ok_by_name.get(on.node.name, True):
+                                return None
+                            return (
+                                "node(s) cannot allocate the pod's "
+                                "resourceclaim devices"
+                            )
+                    except Exception:
+                        extra = None
+                try:
+                    msg = fit_oracle.fit_error(pod, extra=extra)
+                except Exception:
+                    msg = generic
+                if msg.endswith("nodes are available"):
+                    # every scalar filter accepted some node: the rejection
+                    # came from a folded filter the replay can't attribute
+                    # (out-of-tree plugin / extender verdict) — stay honest
+                    # instead of implying the cluster is full
+                    msg = generic
+                fiterr_memo[key] = msg
+                return msg
             for idx, (info, a) in enumerate(zip(infos, assignments)):
                 pod = info.pod
                 cycle = base_cycle + cycle_offsets[idx] + 1
@@ -891,6 +981,7 @@ class Scheduler:
                         nominated_node = self._try_preempt(
                             pod, static, idx, res, preempt_placed, slot_nodes,
                             preempt_pdbs, cluster_has_affinity, solver,
+                            dra_prefold=dra_prefold,
                         )
                         preempt_dt += time.perf_counter() - tpf
                     if nominated_node is None and self.registry.post_filter:
@@ -912,15 +1003,10 @@ class Scheduler:
                         preempt_dt += time.perf_counter() - tpf
                     res.unschedulable.append(pod.key)
                     self._requeue(info, cycle)
-                    n_nodes = sum(1 for n in slot_nodes if n is not None)
                     self._event(
                         pod, "FailedScheduling",
-                        unsched_reason.get(
-                            pod.key,
-                            f"0/{n_nodes} nodes are available: the "
-                            "batched filter pipeline rejected every "
-                            "candidate",
-                        ),
+                        unsched_reason.get(pod.key)
+                        or fit_error_for(pod, idx),
                         type_="Warning",
                     )
                     continue
@@ -1296,6 +1382,7 @@ class Scheduler:
         pdbs: list,
         cluster_has_affinity: bool,
         solver: ExactSolver,
+        dra_prefold: dict | None = None,
     ) -> str | None:
         if pod.preemption_policy == "Never":
             return None
@@ -1310,6 +1397,13 @@ class Scheduler:
 
         batch = self.snapshot.batch
         static_row = static.mask[static.class_of[idx]]
+        # DRA device exhaustion is preemptible (upstream dynamicresources
+        # Filter returns Unschedulable, not Unresolvable): widen candidate
+        # selection to the pre-DRA mask; a chosen node that the DRA fold
+        # had excluded must pass the victims-release recheck below
+        widen_row = None
+        if dra_prefold and pod.resource_claim_names:
+            widen_row = dra_prefold.get(int(static.class_of[idx]))
         # the pod's failure can involve beyond-fit filters when it carries
         # ports/spread constraints or pod (anti-)affinity is in play — then
         # the dry-run must re-run the full pipeline per candidate/re-add
@@ -1326,11 +1420,44 @@ class Scheduler:
             or cluster_has_affinity
         )
         result = self.preemptor.evaluate(
-            pod, batch, self.snapshot.names, placed_by_slot, static_row,
+            pod, batch, self.snapshot.names, placed_by_slot,
+            widen_row if widen_row is not None else static_row,
             pdbs,
             slot_nodes=slot_nodes, beyond_fit=beyond_fit,
             disabled=frozenset(solver.config.disabled_filters),
         )
+        if widen_row is not None:
+            # DRA path: the resource-driven dry-run doesn't model devices,
+            # so its victim set (possibly empty) may not free any. Validate
+            # it; when it doesn't hold up, select device-holding victims
+            # directly (lowest priority first, PDB-respecting).
+            ok = False
+            if result is not None:
+                try:
+                    slot = self.snapshot.slot_of(result.node_name)
+                except KeyError:
+                    return None
+                ok = bool(static_row[slot]) or (
+                    bool(result.victims)
+                    and self._dra_preempt_ok(
+                        pod, result.node_name, result.victims
+                    )
+                )
+            if not ok:
+                # first retry the UNWIDENED mask: a resource-only
+                # preemption on a DRA-feasible node needs no device math
+                result = self.preemptor.evaluate(
+                    pod, batch, self.snapshot.names, placed_by_slot,
+                    static_row, pdbs,
+                    slot_nodes=slot_nodes, beyond_fit=beyond_fit,
+                    disabled=frozenset(solver.config.disabled_filters),
+                )
+                if result is None:
+                    result = self._dra_victim_preempt(
+                        pod, prio, placed_by_slot, widen_row, pdbs,
+                        beyond_fit=beyond_fit, slot_nodes=slot_nodes,
+                        disabled=frozenset(solver.config.disabled_filters),
+                    )
         if result is None:
             return None
         # prepareCandidate: API-delete victims; clear lower-priority
@@ -1381,6 +1508,146 @@ class Scheduler:
             (pod.key, result.node_name, [v.key for v in result.victims])
         )
         return result.node_name
+
+    def _dra_victim_preempt(
+        self,
+        pod: Pod,
+        prio: int,
+        placed_by_slot: dict[int, list[Pod]],
+        widen_row: np.ndarray,
+        pdbs: list,
+        beyond_fit: bool = False,
+        slot_nodes: list | None = None,
+        disabled: frozenset = frozenset(),
+    ):
+        """Device-driven victim selection for claim-bearing preemptors:
+        per candidate node, evict the least-important claim-holding pods
+        (PDB-respecting, never PDB-violating) until the pod's claims would
+        allocate, and verify the pod still passes the filters with the
+        victims gone (resources always; the full scalar pipeline when the
+        pod/cluster carries beyond-fit constraints). Chooses the candidate
+        needing the fewest victims (tie: node name) — the leading keys of
+        pickOneNodeForPreemption."""
+        from .ops.oracle.noderesources import fit_filter
+        from .ops.oracle.preemption import classify_pdb_violations
+        from .ops.oracle.profile import FullOracle, make_oracle_nodes
+        from .solver.preemption import PreemptionResult
+
+        ctx = self.claim_allocator.context()
+        best: PreemptionResult | None = None
+        for slot, resident in placed_by_slot.items():
+            if slot >= len(widen_row) or not widen_row[slot]:
+                continue
+            node_name = self.snapshot.names[slot]
+            info = self.cache.nodes.get(node_name)
+            if info is None or info.node is None:
+                continue
+            lower = [q for q in resident if q.effective_priority < prio]
+            _viol, safe = classify_pdb_violations(lower, pdbs)
+            # claim-holding pods only, least important first
+            holders = [
+                q
+                for q in sorted(
+                    safe,
+                    key=lambda q: (q.effective_priority, -q.start_time),
+                )
+                if any(
+                    (c := ctx.claims.get(f"{q.namespace}/{n}")) is not None
+                    and c.allocated_node == node_name
+                    for n in q.resource_claim_names
+                )
+            ]
+            victims: list[Pod] = []
+            for q in holders:
+                victims.append(q)
+                if self._dra_preempt_ok(pod, node_name, victims):
+                    break
+            else:
+                continue  # exhausted holders without freeing enough
+            victim_keys = {v.key for v in victims}
+            remaining = [q for q in resident if q.key not in victim_keys]
+            if beyond_fit:
+                # ports/spread/interpod/volume filters need the whole
+                # cluster's occupancy (minus the victims) — a resource-only
+                # check could evict victims on a node the pod still can't
+                # land on (review-caught)
+                live = [
+                    (s2, n2)
+                    for s2, n2 in enumerate(slot_nodes or [])
+                    if n2 is not None
+                ]
+                by_name = {
+                    n2.name: (
+                        remaining
+                        if n2.name == node_name
+                        else placed_by_slot.get(s2, [])
+                    )
+                    for s2, n2 in live
+                }
+                oracle = FullOracle(
+                    make_oracle_nodes([n2 for _, n2 in live], by_name),
+                    disabled=disabled,
+                )
+                target = next(
+                    on for on in oracle.nodes if on.node.name == node_name
+                )
+                if not oracle.filter_one(pod, target):
+                    continue
+            else:
+                on = make_oracle_nodes(
+                    [info.node], {node_name: remaining}
+                )[0]
+                if fit_filter(pod, on.res):
+                    continue
+            if best is None or (len(victims), node_name) < (
+                len(best.victims), best.node_name
+            ):
+                best = PreemptionResult(
+                    node_name=node_name, victims=victims, num_violating=0
+                )
+        return best
+
+    def _dra_preempt_ok(self, pod: Pod, node_name: str, victims) -> bool:
+        """Would evicting ``victims`` free enough claim devices on
+        ``node_name`` for ``pod``'s claims? Simulates the deallocating
+        controller's release (claims reserved exclusively by victims lose
+        their allocation) on a copy of the claim context, then re-runs the
+        greedy pick."""
+        from .ops.oracle.dra import ClaimError
+
+        ctx = self.claim_allocator.context()
+        victim_keys = {v.key for v in victims}
+        freed = set(ctx.taken.get(node_name, ()))
+        claims = dict(ctx.claims)
+        changed = False
+        for key, c in list(claims.items()):
+            if (
+                c.allocated
+                and c.allocated_node == node_name
+                and c.reserved_for
+                and all(k in victim_keys for k in c.reserved_for)
+            ):
+                for r in c.results:
+                    freed.discard((r.driver, r.pool, r.device))
+                from .api.dra import ResourceClaim
+
+                claims[key] = ResourceClaim(
+                    name=c.name,
+                    namespace=c.namespace,
+                    requests=c.requests,
+                )
+                changed = True
+        if not changed:
+            return False
+        ctx.claims = claims
+        ctx.taken = dict(ctx.taken)
+        ctx.taken[node_name] = freed
+        try:
+            pod_claims = ctx.pod_claims(pod)
+        except ClaimError:
+            return False
+        pod_claims = [ctx.claims[c.key] for c in pod_claims]
+        return ctx.pick(node_name, pod_claims) is not None
 
     def run_until_settled(self, max_batches: int = 10_000) -> list[BatchResult]:
         """Drain the active queue (benchmark / test driver)."""

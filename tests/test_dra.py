@@ -588,3 +588,135 @@ def test_duplicate_claim_reference_counts_once():
     scheduled, _ = drain(sched)
     assert scheduled == 1
     assert len(cs.get_resource_claim("default", "c0").results) == 1
+
+
+def test_preemption_frees_claim_devices():
+    """Upstream's dynamicresources Filter failure is Unschedulable (not
+    Unresolvable): a high-priority claim pod must be able to preempt a
+    lower-priority pod whose claim holds the only device."""
+    cs = mk_cluster(n_nodes=1, gpus_per_node=1)
+    for i, name in enumerate(("low", "high")):
+        cs.create_resource_claim(
+            ResourceClaim(
+                name=f"c-{name}",
+                requests=(
+                    DeviceRequest(name="g", device_class_name="gpu", count=1),
+                ),
+            )
+        )
+    sched = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("low").priority(1).req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("c-low").obj()
+    )
+    s, _ = drain(sched)
+    assert s == 1
+    cs.create_pod(
+        MakePod().name("high").priority(100).req({"cpu": "1", "memory": "1Gi"})
+        .resource_claim("c-high").obj()
+    )
+    s2, _ = drain(sched)
+    # low was evicted (its claim released on delete), high bound
+    assert cs.get_pod("default", "high").node_name == "n0"
+    assert cs.get_resource_claim("default", "c-high").allocated_node == "n0"
+    low_claim = cs.get_resource_claim("default", "c-low")
+    assert not low_claim.allocated and not low_claim.reserved_for
+
+
+def test_preemption_shared_claim_evicts_all_or_none():
+    """A device freed only by evicting EVERY reserver: when all sharers
+    are lower priority, both are evicted; when one sharer outranks the
+    preemptor, the device is not freeable and nothing is evicted."""
+    def build(b_priority):
+        cs = mk_cluster(n_nodes=1, gpus_per_node=1)
+        cs.create_resource_claim(
+            ResourceClaim(
+                name="shared",
+                requests=(
+                    DeviceRequest(name="g", device_class_name="gpu", count=1),
+                ),
+            )
+        )
+        cs.create_resource_claim(
+            ResourceClaim(
+                name="wants",
+                requests=(
+                    DeviceRequest(name="g", device_class_name="gpu", count=1),
+                ),
+            )
+        )
+        sched = mk_sched(cs)
+        for n, pr in (("a", 1), ("b", b_priority)):
+            cs.create_pod(
+                MakePod().name(n).priority(pr)
+                .req({"cpu": "1", "memory": "1Gi"})
+                .resource_claim("shared").obj()
+            )
+        s, _ = drain(sched)
+        assert s == 2
+        cs.create_pod(
+            MakePod().name("high").priority(100)
+            .req({"cpu": "1", "memory": "1Gi"}).resource_claim("wants").obj()
+        )
+        drain(sched)
+        return cs
+
+    # both sharers lower priority: the victim set extends to both and the
+    # preemptor binds
+    cs = build(b_priority=1)
+    assert cs.get_pod("default", "high").node_name == "n0"
+    assert not cs.get_resource_claim("default", "shared").reserved_for
+
+    # one sharer outranks the preemptor: evicting the other alone frees
+    # nothing, so nobody is evicted
+    cs = build(b_priority=200)
+    assert cs.get_pod("default", "high").node_name == ""
+    assert {p.name for p in cs.list_pods() if p.node_name} == {"a", "b"}
+
+
+def test_negative_count_rejected():
+    with pytest.raises(ValueError):
+        DeviceRequest.from_dict(
+            {"name": "r", "deviceClassName": "gpu", "count": -1}
+        )
+
+
+def test_contradictory_driver_selector_round_trips():
+    d = {
+        "metadata": {"name": "x"},
+        "spec": {
+            "driver": "a",
+            "selectors": [{"cel": {"expression": 'device.driver == "b"'}}],
+        },
+    }
+    dc = DeviceClass.from_dict(d)
+    assert dc.opaque_selector and dc.driver == "a"
+    rt = DeviceClass.from_dict(dc.to_dict())
+    assert rt.opaque_selector  # still matches nothing after a round trip
+    assert not rt.matches("b", Device(name="g"))
+
+
+def test_dra_widen_does_not_block_resource_preemption():
+    """A claim pod failing on CPU (devices fine) must still preempt via
+    the ordinary resource dry-run on a DRA-feasible node."""
+    cs = mk_cluster(n_nodes=1, gpus_per_node=2)
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="c0",
+            requests=(DeviceRequest(name="g", device_class_name="gpu", count=1),),
+        )
+    )
+    sched = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("filler").priority(1)
+        .req({"cpu": "7", "memory": "1Gi"}).obj()
+    )
+    s, _ = drain(sched)
+    assert s == 1
+    cs.create_pod(
+        MakePod().name("high").priority(100)
+        .req({"cpu": "4", "memory": "1Gi"}).resource_claim("c0").obj()
+    )
+    drain(sched)
+    assert cs.get_pod("default", "high").node_name == "n0"
+    assert "filler" not in {p.name for p in cs.list_pods()}  # evicted

@@ -212,3 +212,42 @@ class TestEventsRecorder:
             ev.type == "ADDED" and ev.obj.reason == "Scheduled"
             for ev in seen
         )
+
+
+def test_fit_error_reference_shaped_message():
+    """An unschedulable pod's FailedScheduling event carries the
+    reference's aggregated FitError diagnosis (schedule_one.go#FitError):
+    per-reason node counts, not a generic rejection."""
+    from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.state.cluster import ClusterState
+
+    cs = ClusterState()
+    # two nodes too small for the pod, one tainted
+    for i in range(2):
+        cs.create_node(
+            MakeNode().name(f"small-{i}").capacity(
+                {"cpu": "1", "memory": "1Gi", "pods": "10"}
+            ).obj()
+        )
+    cs.create_node(
+        MakeNode().name("tainted").capacity(
+            {"cpu": "32", "memory": "64Gi", "pods": "10"}
+        ).taint("dedicated", "gpu", "NoSchedule").obj()
+    )
+    sched = Scheduler(cs, SchedulerConfig(batch_size=8))
+    cs.create_pod(
+        MakePod().name("big").req({"cpu": "8", "memory": "2Gi"}).obj()
+    )
+    r = sched.schedule_batch()
+    assert r.unschedulable == ["default/big"]
+    notes = [
+        e.note
+        for e in cs.list_events(regarding_name="big")
+        if e.reason == "FailedScheduling"
+    ]
+    assert notes, "no FailedScheduling event"
+    note = notes[-1]
+    assert note.startswith("0/3 nodes are available:"), note
+    assert "2 Insufficient cpu" in note, note
+    assert "1 node(s) had untolerated taint(s)" in note, note
