@@ -238,6 +238,13 @@ def ladder1_basic() -> dict:
     wall, res = best
     thr = res.throughput_summary()
     return {
+        "note": (
+            "500 pods solve as ONE batch, so wall time is bounded below "
+            "by a single dispatch+read round trip on the tunnel (~0.2 s "
+            "at the canary's RTT) plus host pop/tensorize/bind — this "
+            "row measures per-batch latency floor, not sustained "
+            "throughput (ladders #2-#4 measure that)"
+        ),
         "pods": 500,
         "nodes": 500,
         "pods_per_sec": round(res.measured_pods / res.measure_seconds, 1)

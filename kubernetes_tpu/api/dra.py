@@ -237,7 +237,8 @@ class DeviceRequest:
             raise ValueError(
                 f"deviceRequest {d.get('name')!r}: adminAccess is out of scope"
             )
-        count = int(d.get("count") or 1)
+        raw = d.get("count")
+        count = 1 if raw is None else int(raw)
         if count < 1:
             raise ValueError(
                 f"deviceRequest {d.get('name')!r}: count must be >= 1, "

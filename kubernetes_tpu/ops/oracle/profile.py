@@ -149,10 +149,12 @@ class FullOracle:
         on: OracleNode,
         spread_state=_UNSET,
         interpod_state=_UNSET,
-    ) -> str | None:
+    ) -> tuple[str, ...] | None:
         """First failing Filter plugin's reference-shaped diagnosis for
-        this node (None = feasible) — the per-node Status message
-        RunFilterPlugins would record. Same plugin order as filter_one."""
+        this node (None = feasible) — the per-node Status reasons
+        RunFilterPlugins would record. Usually one string; NodeResourcesFit
+        reports every insufficient resource (its Status carries all of
+        them upstream, and FitError counts each)."""
         if spread_state is FullOracle._UNSET:
             spread_state = osp.build_filter_state(pod, self._all_nodes_with_pods())
         if interpod_state is FullOracle._UNSET:
@@ -164,43 +166,45 @@ class FullOracle:
 
         dis = self.disabled
         if "NodeName" not in dis and not opl.node_name_filter(pod, on.node):
-            return "node(s) didn't match the requested node name"
+            return ("node(s) didn't match the requested node name",)
         if "NodeUnschedulable" not in dis and not opl.node_unschedulable_filter(
             pod, on.node
         ):
-            return "node(s) were unschedulable"
+            return ("node(s) were unschedulable",)
         if "TaintToleration" not in dis and not opl.taint_toleration_filter(
             pod, on.node
         ):
-            return "node(s) had untolerated taint(s)"
+            return ("node(s) had untolerated taint(s)",)
         if "NodeAffinity" not in dis and not opl.node_affinity_filter(
             pod, on.node
         ):
-            return "node(s) didn't match Pod's node affinity/selector"
+            return ("node(s) didn't match Pod's node affinity/selector",)
         if "NodePorts" not in dis and not opl.node_ports_filter(
             pod, on.used_ports
         ):
-            return "node(s) didn't have free ports for the requested pod ports"
+            return ("node(s) didn't have free ports for the requested pod ports",)
         if "NodeResourcesFit" not in dis:
             failures = fit_filter(pod, on.res)
             if failures:
-                r = failures[0]
-                return "Too many pods" if r == "pods" else f"Insufficient {r}"
+                return tuple(
+                    "Too many pods" if r == "pods" else f"Insufficient {r}"
+                    for r in failures
+                )
         if (
             "PodTopologySpread" not in dis
             and spread_state is not None
             and not spread_state.check(on.node)
         ):
-            return "node(s) didn't match pod topology spread constraints"
+            return ("node(s) didn't match pod topology spread constraints",)
         if "InterPodAffinity" not in dis and not interpod_state.check(on.node):
-            return "node(s) didn't match pod affinity/anti-affinity rules"
+            return ("node(s) didn't match pod affinity/anti-affinity rules",)
         if (
             self.volume_ctx is not None
             and pod.pvc_names
             and not (VOLUME_PLUGINS & dis)
             and not ovol.volume_filter(pod, on.node, self.volume_ctx)
         ):
-            return "node(s) had volume node affinity/limit conflict"
+            return ("node(s) had volume node affinity/limit conflict",)
         return None
 
     def fit_error(self, pod: Pod, extra=None) -> str:
@@ -224,9 +228,11 @@ class FullOracle:
         for on in self.nodes:
             why = self.filter_reason(pod, on, spread_state, interpod_state)
             if why is None and extra is not None:
-                why = extra(on)
+                e = extra(on)
+                why = (e,) if e is not None else None
             if why is not None:
-                reasons[why] += 1
+                for w in why:
+                    reasons[w] += 1
         if not reasons:
             return f"0/{len(self.nodes)} nodes are available"
         detail = ", ".join(

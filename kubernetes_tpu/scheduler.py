@@ -691,48 +691,6 @@ class Scheduler:
                 added_affinity=solver.config.added_affinity,
                 class_key_extra=class_key_extra,
             )
-            if dra_active:
-                # dynamicresources Filter: fold per-class claim
-                # feasibility into the static mask (allocated claims pin
-                # to their node). The allocator's cached context is reused
-                # — same dra_generation-keyed build, plus the in-flight
-                # assumption overlay, so devices taken by pods still
-                # binding are already masked out.
-                from .ops.oracle.dra import ClaimError
-
-                tdra = time.perf_counter()
-                dra_ctx = self.claim_allocator.context()
-                unresolvable: dict[int, str] = {}
-                for ci, rep in enumerate(static.reps):
-                    if not (
-                        rep.resource_claim_names
-                        or rep.claim_templates_unresolved
-                    ):
-                        continue
-                    try:
-                        m = dra_ctx.feasible_mask(rep, slot_nodes)
-                    except ClaimError as e:
-                        # UnschedulableAndUnresolvable: mask the class and
-                        # surface the REASON on the pods' failure events
-                        m = False
-                        unresolvable[ci] = str(e)
-                    else:
-                        # device exhaustion is Unschedulable, NOT
-                        # Unresolvable: preemption may free devices, so
-                        # candidate selection widens back to the pre-DRA
-                        # mask (with a victims-release recheck —
-                        # _dra_preempt_ok)
-                        dra_prefold[ci] = static.mask[ci].copy()
-                    static.mask[ci] &= m
-                if unresolvable:
-                    class_of = np.asarray(static.class_of)
-                    for i, p in enumerate(pods):
-                        why = unresolvable.get(int(class_of[i]))
-                        if why is not None:
-                            unsched_reason[p.key] = why
-                metrics.plugin_execution_duration_seconds.labels(
-                    "DynamicResources", "PreFilter", "Success"
-                ).observe(time.perf_counter() - tdra)
             placed_by_slot: dict[int, list[Pod]] = {}
             if need_ports or need_spread or need_interpod:
                 for slot, name in enumerate(self.snapshot.names):
@@ -846,6 +804,51 @@ class Scheduler:
             )
             if extra.any():
                 static.extra_score = extra
+        if dra_active:
+            # dynamicresources Filter: fold per-class claim feasibility
+            # into the static mask (allocated claims pin to their node).
+            # Runs AFTER the out-of-tree/extender folds so the preemption
+            # widen mask below already carries their rejections (widening
+            # must never resurrect a node an extender vetoed), and keeps
+            # their mask-keyed memo stable. The allocator's cached
+            # context is reused — dra_generation-keyed build plus the
+            # in-flight assumption overlay, so devices taken by pods
+            # still binding are already masked out.
+            from .ops.oracle.dra import ClaimError
+
+            tdra = time.perf_counter()
+            dra_ctx = self.claim_allocator.context()
+            unresolvable: dict[int, str] = {}
+            for ci, rep in enumerate(static.reps):
+                if not (
+                    rep.resource_claim_names
+                    or rep.claim_templates_unresolved
+                ):
+                    continue
+                try:
+                    m = dra_ctx.feasible_mask(rep, slot_nodes)
+                except ClaimError as e:
+                    # UnschedulableAndUnresolvable: mask the class and
+                    # surface the REASON on the pods' failure events
+                    m = False
+                    unresolvable[ci] = str(e)
+                else:
+                    # device exhaustion is Unschedulable, NOT
+                    # Unresolvable: preemption may free devices, so
+                    # candidate selection widens back to the pre-DRA
+                    # mask (with a victims-release recheck —
+                    # _dra_preempt_ok)
+                    dra_prefold[ci] = static.mask[ci].copy()
+                static.mask[ci] &= m
+            if unresolvable:
+                class_of = np.asarray(static.class_of)
+                for i, p in enumerate(pods):
+                    why = unresolvable.get(int(class_of[i]))
+                    if why is not None:
+                        unsched_reason[p.key] = why
+            metrics.plugin_execution_duration_seconds.labels(
+                "DynamicResources", "PreFilter", "Success"
+            ).observe(time.perf_counter() - tdra)
         t1 = time.perf_counter()
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
@@ -1444,14 +1447,18 @@ class Scheduler:
                     )
                 )
             if not ok:
-                # first retry the UNWIDENED mask: a resource-only
-                # preemption on a DRA-feasible node needs no device math
-                result = self.preemptor.evaluate(
-                    pod, batch, self.snapshot.names, placed_by_slot,
-                    static_row, pdbs,
-                    slot_nodes=slot_nodes, beyond_fit=beyond_fit,
-                    disabled=frozenset(solver.config.disabled_filters),
-                )
+                # retry the UNWIDENED mask (a resource-only preemption on
+                # a DRA-feasible node needs no device math) — but only
+                # when the widened run FOUND something its recheck
+                # rejected: static_row is a subset of widen_row, so a
+                # widened None is already a subset None
+                if result is not None:
+                    result = self.preemptor.evaluate(
+                        pod, batch, self.snapshot.names, placed_by_slot,
+                        static_row, pdbs,
+                        slot_nodes=slot_nodes, beyond_fit=beyond_fit,
+                        disabled=frozenset(solver.config.disabled_filters),
+                    )
                 if result is None:
                     result = self._dra_victim_preempt(
                         pod, prio, placed_by_slot, widen_row, pdbs,
@@ -1643,10 +1650,11 @@ class Scheduler:
         ctx.taken = dict(ctx.taken)
         ctx.taken[node_name] = freed
         try:
+            # resolves through the mutated ctx.claims, so released claims
+            # are already the unallocated copies
             pod_claims = ctx.pod_claims(pod)
         except ClaimError:
             return False
-        pod_claims = [ctx.claims[c.key] for c in pod_claims]
         return ctx.pick(node_name, pod_claims) is not None
 
     def run_until_settled(self, max_batches: int = 10_000) -> list[BatchResult]:

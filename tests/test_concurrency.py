@@ -1,0 +1,190 @@
+"""Cross-thread hardening for the serve-mode surfaces (SURVEY §6.2): the
+decoupled binding cycle's three-phase locking vs concurrent ingest, and
+the delete-during-bind window. The reference's analog is `go test -race`
+over the binding-goroutine overlap; here the invariants are asserted
+directly on the shared state after real thread interleavings."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.state.cluster import ApiError, ClusterState
+
+
+def test_concurrent_ingest_during_scheduling():
+    """Writer threads create pods and delete bound pods while the
+    scheduler drains; afterwards the cache, cluster, and queue must agree
+    and every surviving pod must be bound exactly once to a live node."""
+    cs = ClusterState()
+    for i in range(8):
+        cs.create_node(
+            MakeNode().name(f"n{i}").capacity(
+                {"cpu": "16", "memory": "64Gi", "pods": "50"}
+            ).obj()
+        )
+    sched = Scheduler(cs, SchedulerConfig(batch_size=64))
+    stop = threading.Event()
+    created = []
+    errors = []
+
+    def creator(tag):
+        try:
+            for i in range(120):
+                p = MakePod().name(f"{tag}-{i:03}").req(
+                    {"cpu": "100m", "memory": "64Mi"}
+                ).obj()
+                cs.create_pod(p)
+                created.append(p.key)
+                if i % 10 == 9:
+                    time.sleep(0.001)
+        except Exception as e:  # pragma: no cover
+            errors.append(repr(e))
+
+    def deleter():
+        try:
+            while not stop.is_set():
+                bound = [p for p in cs.list_pods() if p.node_name]
+                if bound:
+                    victim = bound[0]
+                    try:
+                        cs.delete_pod(victim.namespace, victim.name)
+                    except ApiError:
+                        pass
+                time.sleep(0.002)
+        except Exception as e:  # pragma: no cover
+            errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=creator, args=(f"w{k}",)) for k in range(2)
+    ] + [threading.Thread(target=deleter)]
+    for t in threads:
+        t.start()
+    # drain while the writers run
+    deadline = time.time() + 60
+    while any(t.is_alive() for t in threads[:2]) and time.time() < deadline:
+        sched.schedule_batch()
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    # settle the survivors
+    for _ in range(200):
+        r = sched.schedule_batch()
+        if not (r.scheduled or r.unschedulable or r.bind_failures):
+            break
+    assert not errors, errors
+
+    with cs.lock:
+        pods = cs.list_pods()
+        node_names = {n.name for n in cs.list_nodes()}
+        # every bound pod points at a live node
+        for p in pods:
+            if p.node_name:
+                assert p.node_name in node_names
+        # cache agrees with cluster: per-node bound sets match
+        cache_keys = {
+            key
+            for info in sched.cache.nodes.values()
+            for key in info.pods
+        }
+        cluster_keys = {p.key for p in pods if p.node_name}
+        assert cache_keys == cluster_keys
+        # conservation: cache used cpu == sum of bound requests per node
+        for info in sched.cache.nodes.values():
+            want = sum(
+                q.resource_request().get("cpu", 0)
+                for q in info.pods.values()
+            )
+            assert info.used.get("cpu", 0) == want
+
+
+def test_delete_during_bind_window():
+    """A pod deleted while its bind is in flight (the unlocked window of
+    the decoupled binding cycle) must not be requeued or resurrected, and
+    the assume must be rolled back."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n0").capacity(
+            {"cpu": "8", "memory": "32Gi", "pods": "10"}
+        ).obj()
+    )
+    sched = Scheduler(cs, SchedulerConfig(batch_size=8))
+
+    def fault(pod, node_name):
+        # simulate the pod being deleted by another client exactly at the
+        # binding subresource call
+        cs.delete_pod(pod.namespace, pod.name)
+        raise ApiError("NotFound", pod.key)
+
+    cs.bind_fault = fault
+    cs.create_pod(
+        MakePod().name("ghost").req({"cpu": "1", "memory": "1Gi"}).obj()
+    )
+    r = sched.schedule_batch()
+    assert r.scheduled == []
+    cs.bind_fault = None
+    # no resurrection: further batches find nothing to do
+    for _ in range(3):
+        r = sched.schedule_batch()
+        assert not (r.scheduled or r.unschedulable or r.bind_failures)
+    assert all(p.name != "ghost" for p in cs.list_pods())
+    # the assume was rolled back: a full-size pod fits
+    cs.create_pod(
+        MakePod().name("full").req({"cpu": "8", "memory": "1Gi"}).obj()
+    )
+    r = sched.schedule_batch()
+    assert [k for k, _ in r.scheduled] == ["default/full"]
+
+
+def test_ingest_not_blocked_by_slow_wire_bind():
+    """The three-phase lock: a bind stalled ON THE WIRE (extender bind
+    delegate) must not hold the cluster lock — an ingest write completes
+    WHILE the bind is still in flight."""
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("n0").capacity(
+            {"cpu": "8", "memory": "32Gi", "pods": "10"}
+        ).obj()
+    )
+    sched = Scheduler(cs, SchedulerConfig(batch_size=8))
+    entered = threading.Event()
+    release = threading.Event()
+
+    class StallingBinder:
+        """Bind-verb-only extender client whose wire call parks until
+        told — exercises the real extender-delegate path of
+        _commit_binding, which runs without the cluster lock."""
+
+        from types import SimpleNamespace
+
+        is_binder = True
+        cfg = SimpleNamespace(filter_verb="", prioritize_verb="", bind_verb="b")
+
+        def is_interested(self, pod):
+            return True
+
+        def bind(self, pod, node_name):
+            entered.set()
+            assert release.wait(timeout=30), "never released"
+            cs.bind(pod.namespace, pod.name, node_name)
+
+    sched.extender_clients = [StallingBinder()]
+    cs.create_pod(
+        MakePod().name("slow").req({"cpu": "1", "memory": "1Gi"}).obj()
+    )
+    t = threading.Thread(target=sched.schedule_batch)
+    t.start()
+    assert entered.wait(timeout=30)
+    # the wire bind is mid-flight RIGHT NOW: ingest must succeed before
+    # it completes, proving the lock is not held across the wire call
+    cs.create_pod(
+        MakePod().name("ingested").req({"cpu": "1", "memory": "1Gi"}).obj()
+    )
+    assert any(p.name == "ingested" for p in cs.list_pods())
+    release.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert cs.get_pod("default", "slow").node_name == "n0"

@@ -674,11 +674,12 @@ def test_preemption_shared_claim_evicts_all_or_none():
     assert {p.name for p in cs.list_pods() if p.node_name} == {"a", "b"}
 
 
-def test_negative_count_rejected():
-    with pytest.raises(ValueError):
-        DeviceRequest.from_dict(
-            {"name": "r", "deviceClassName": "gpu", "count": -1}
-        )
+def test_nonpositive_count_rejected():
+    for bad in (-1, 0):
+        with pytest.raises(ValueError):
+            DeviceRequest.from_dict(
+                {"name": "r", "deviceClassName": "gpu", "count": bad}
+            )
 
 
 def test_contradictory_driver_selector_round_trips():
