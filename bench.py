@@ -552,6 +552,14 @@ def _north_star_exact() -> dict:
         exact_s = min(exact_s, time.perf_counter() - t0)
     placed = int((a >= 0).sum())
     assert placed == NS_PODS, f"exact north star placed {placed}/{NS_PODS}"
+    # validity gates at full scale (a number only counts if the bindings
+    # are right): every pick lands on a live node, and no node exceeds
+    # cpu / memory / pod-count capacity under the actual request vectors
+    # (weighted bincounts, so the gates survive heterogeneous workloads)
+    assert int(a.min()) >= 0 and int(a.max()) < NS_NODES
+    assert int(np.bincount(a, minlength=NS_NODES).max()) <= 110
+    assert np.bincount(a, weights=cpu.astype(np.float64)).max() <= 16_000
+    assert np.bincount(a, weights=mem.astype(np.float64)).max() <= 64 << 30
     return {
         "exact_parity_solve_s": round(exact_s, 2),
         "exact_parity_pods_per_sec": round(placed / exact_s, 1),

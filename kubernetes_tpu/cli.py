@@ -81,6 +81,45 @@ def cmd_serve(args) -> int:
     cluster = ClusterState()
     sched_cfg = config_types.scheduler_config(cfg)
     sched_cfg.feature_gates = _feature_gates(args)
+    if args.leader_elect:
+        # client-go leaderelection.RunOrDie semantics over the state
+        # service's Lease store: block serving until the lease is held;
+        # renew in the background; exit the process on loss (the
+        # reference's OnStoppedLeading is fatal). NOTE: exclusion spans
+        # electors sharing THIS ClusterState (embedded schedulers); a
+        # second standalone process has its own store and self-elects —
+        # the --leader-elect help documents this scope honestly.
+        import os
+        import socket
+        import threading
+
+        from .utils.leaderelection import LeaderElector
+
+        elector = LeaderElector(
+            cluster, identity=f"{socket.gethostname()}_{os.getpid()}"
+        )
+        acquired = threading.Event()
+
+        def lost():
+            print(
+                "error: leader lease lost; exiting", file=sys.stderr
+            )
+            os._exit(1)
+
+        t = threading.Thread(
+            target=elector.run,
+            args=(threading.Event(),),
+            kwargs=dict(
+                on_started_leading=acquired.set, on_stopped_leading=lost
+            ),
+            daemon=True,
+        )
+        t.start()
+        acquired.wait()
+        print(
+            f"leader election: acquired lease as {elector.identity}",
+            file=sys.stderr,
+        )
     run_server(
         cluster,
         host=args.host,
@@ -138,7 +177,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--leader-elect",
         action="store_true",
-        help="accepted for config parity; single-process build ignores it",
+        help="Lease-based active/passive leader election over the state "
+        "service (client-go tools/leaderelection semantics): serve blocks "
+        "until the lease is acquired and exits if it is lost. Mutual "
+        "exclusion spans schedulers SHARING one state service; this "
+        "binary embeds its own store, so a standalone process self-elects "
+        "(the reference's lease lives in the shared apiserver)",
     )
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -177,11 +221,6 @@ def main(argv: list[str] | None = None) -> int:
     p_cfg.set_defaults(fn=cmd_config)
 
     args = parser.parse_args(argv)
-    if args.leader_elect:
-        print(
-            "warning: --leader-elect ignored (single-process build)",
-            file=sys.stderr,
-        )
     if args.trace_dir:
         import atexit
 

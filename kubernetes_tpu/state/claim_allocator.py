@@ -110,16 +110,21 @@ class ClaimAllocator:
         self._ov_dirty = False
 
     def context(self) -> DraContext:
-        gen = getattr(self.cluster, "dra_generation", -1)
-        if self._ctx_cache is None or self._ctx_cache[0] != gen:
-            self._ctx_cache = (
-                gen,
-                DraContext.build(
-                    self.cluster.list_resource_slices(),
-                    self.cluster.list_device_classes(),
-                    self.cluster.list_resource_claims(),
-                ),
-            )
+        # snapshot generation + the three lists atomically: callers run
+        # outside the cluster lock (the fold section, the binding cycle),
+        # and individually-locked list calls could tear against a
+        # concurrent slice/claim write
+        with self.cluster.lock:
+            gen = getattr(self.cluster, "dra_generation", -1)
+            if self._ctx_cache is None or self._ctx_cache[0] != gen:
+                self._ctx_cache = (
+                    gen,
+                    DraContext.build(
+                        self.cluster.list_resource_slices(),
+                        self.cluster.list_device_classes(),
+                        self.cluster.list_resource_claims(),
+                    ),
+                )
         base = self._ctx_cache[1]
         if self._ov_dirty:
             self._rebuild_overlay()

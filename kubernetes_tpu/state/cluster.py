@@ -134,6 +134,8 @@ class ClusterState:
         self._device_classes: dict[str, object] = {}
         self._resource_claims: dict[str, object] = {}
         self.dra_generation = 0
+        # coordination.k8s.io Leases (leader election)
+        self._leases: dict[str, object] = {}
         self._events: dict[str, EventRecord] = {}
         self._events_by_agg: dict[tuple, EventRecord] = {}
         self._event_seq = 0
@@ -429,6 +431,45 @@ class ClusterState:
 
     def list_resource_claims(self) -> list:
         return list(self._resource_claims.values())
+
+    # -- Leases (coordination.k8s.io/v1 subset; leader election) --
+
+    def create_lease(self, lease) -> object:
+        import dataclasses
+
+        if lease.key in self._leases:
+            raise ApiError("AlreadyExists", lease.key)
+        lease.resource_version = self._next_rv()
+        self._leases[lease.key] = dataclasses.replace(lease)
+        return lease
+
+    def get_lease(self, namespace: str, name: str) -> object:
+        """Returns a SNAPSHOT copy: electors mutate their read before the
+        compare-and-swap update, and handing out the live object would
+        let a losing challenger corrupt the store (the rv check must be
+        the only write path)."""
+        import dataclasses
+
+        key = f"{namespace}/{name}"
+        try:
+            return dataclasses.replace(self._leases[key])
+        except KeyError:
+            raise ApiError("NotFound", key) from None
+
+    def update_lease(self, lease, expect_rv: int | None = None) -> object:
+        import dataclasses
+
+        cur = self._leases.get(lease.key)
+        if cur is None:
+            raise ApiError("NotFound", lease.key)
+        if expect_rv is not None and cur.resource_version != expect_rv:
+            raise ApiError(
+                "Conflict",
+                f"{lease.key} rv {cur.resource_version} != {expect_rv}",
+            )
+        lease.resource_version = self._next_rv()
+        self._leases[lease.key] = dataclasses.replace(lease)
+        return lease
 
     # -- bulk helpers for benchmarks --
 

@@ -1,0 +1,145 @@
+"""Lease-based leader election (client-go tools/leaderelection analog):
+acquire, renew, challenge, expiry takeover, and optimistic-concurrency
+races over the state service's Lease store."""
+
+import threading
+
+from kubernetes_tpu.state.cluster import ApiError, ClusterState
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.leaderelection import Lease, LeaderElector
+
+
+def mk(cs, ident, clock):
+    return LeaderElector(
+        cs,
+        identity=ident,
+        lease_duration=15.0,
+        renew_deadline=10.0,
+        retry_period=2.0,
+        clock=clock,
+    )
+
+
+def test_acquire_renew_and_challenge():
+    cs = ClusterState()
+    clock = FakeClock()
+    a = mk(cs, "a", clock)
+    b = mk(cs, "b", clock)
+    assert a.try_acquire_or_renew() and a.is_leader
+    lease = cs.get_lease("kube-system", "kubernetes-tpu-scheduler")
+    assert lease.holder_identity == "a"
+    # a fresh lease blocks the challenger
+    assert not b.try_acquire_or_renew() and not b.is_leader
+    # the holder renews: renewTime advances
+    clock.advance(5.0)
+    t0 = lease.renew_time
+    assert a.try_acquire_or_renew()
+    assert cs.get_lease("kube-system", "kubernetes-tpu-scheduler").renew_time > t0
+    # still blocked (renewal reset the expiry window)
+    clock.advance(12.0)
+    assert not b.try_acquire_or_renew()
+
+
+def test_takeover_after_expiry():
+    cs = ClusterState()
+    clock = FakeClock()
+    a = mk(cs, "a", clock)
+    b = mk(cs, "b", clock)
+    assert a.try_acquire_or_renew()
+    # a crashes (stops renewing); past leaseDuration the challenger wins
+    clock.advance(15.1)
+    assert b.try_acquire_or_renew() and b.is_leader
+    lease = cs.get_lease("kube-system", "kubernetes-tpu-scheduler")
+    assert lease.holder_identity == "b"
+    assert lease.acquire_time == clock.now()
+    # the old leader's next renew attempt loses
+    assert not a.try_acquire_or_renew() and not a.is_leader
+
+
+def test_update_race_loses_cleanly():
+    """A stale-rv update (someone else re-acquired between the read and
+    the write) must report not-leader, never raise."""
+    cs = ClusterState()
+    clock = FakeClock()
+    a = mk(cs, "a", clock)
+    assert a.try_acquire_or_renew()
+    # sneak a competing acquisition in with a bumped rv
+    lease = cs.get_lease("kube-system", "kubernetes-tpu-scheduler")
+    clock.advance(16.0)
+    lease.holder_identity = "c"
+    lease.renew_time = clock.now()
+    cs.update_lease(lease)
+    assert not a.try_acquire_or_renew()
+    assert cs.get_lease("kube-system", "kubernetes-tpu-scheduler").holder_identity == "c"
+
+
+def test_creation_race():
+    """Two electors racing the initial create: exactly one wins."""
+    cs = ClusterState()
+    clock = FakeClock()
+    a = mk(cs, "a", clock)
+    # simulate the race by pre-creating the lease between a's NotFound
+    # read and its create: create directly, then call a
+    cs.create_lease(
+        Lease(
+            name="kubernetes-tpu-scheduler",
+            holder_identity="z",
+            lease_duration_seconds=15.0,
+            renew_time=clock.now(),
+        )
+    )
+    assert not a.try_acquire_or_renew()
+
+
+def test_run_loop_active_passive_handover():
+    """Elector A leads; when its renewals stop, elector B's run() loop
+    takes over and fires on_started_leading."""
+    cs = ClusterState()
+    clock = FakeClock()
+    a = mk(cs, "a", clock)
+    assert a.try_acquire_or_renew()
+
+    b = mk(cs, "b", clock)
+    b.retry_period = 0.01  # fast wall-clock loop; expiry is FakeClock time
+    started = threading.Event()
+    stop = threading.Event()
+    t = threading.Thread(
+        target=b.run, args=(stop,), kwargs=dict(on_started_leading=started.set)
+    )
+    t.start()
+    assert not started.wait(timeout=0.3)  # a's lease is fresh
+    clock.advance(20.0)  # a expires
+    assert started.wait(timeout=10)
+    assert b.is_leader
+    stop.set()
+    t.join(timeout=10)
+    assert cs.get_lease("kube-system", "kubernetes-tpu-scheduler").holder_identity == "b"
+
+
+def test_losing_challenger_cannot_corrupt_store():
+    """get_lease returns snapshots: a challenger that mutates its read
+    and loses the rv CAS must leave the store showing the real winner
+    (review-caught split-brain window)."""
+    cs = ClusterState()
+    clock = FakeClock()
+    a = mk(cs, "a", clock)
+    assert a.try_acquire_or_renew()
+    clock.advance(16.0)  # expired: both challengers see it takeable
+    b = mk(cs, "b", clock)
+    c = mk(cs, "c", clock)
+    # b reads+wins first; c's stale-rv update must fail AND the store
+    # must still show b
+    stale = cs.get_lease("kube-system", "kubernetes-tpu-scheduler")
+    assert b.try_acquire_or_renew() and b.is_leader
+    stale.holder_identity = "c"
+    stale.renew_time = clock.now()
+    try:
+        cs.update_lease(stale, expect_rv=stale.resource_version)
+    except ApiError:
+        pass
+    lease = cs.get_lease("kube-system", "kubernetes-tpu-scheduler")
+    assert lease.holder_identity == "b"
+    # b keeps renewing successfully (no split brain)
+    clock.advance(5.0)
+    assert b.try_acquire_or_renew()
+    assert not c.try_acquire_or_renew()
