@@ -701,6 +701,7 @@ class Scheduler:
                 ports = _timed(
                     "NodePorts", build_port_tensors,
                     pods, pbatch, slot_nodes, placed_by_slot, batch.padded,
+                    nominated=nom_pairs,
                 )
             else:
                 ports = trivial_port_tensors(pbatch, batch.padded)
@@ -730,7 +731,8 @@ class Scheduler:
             from .tensorize.schema import build_nominated_tensors
 
             nominated = build_nominated_tensors(
-                nom_pairs, batch.vocab, batch.padded
+                nom_pairs, batch.vocab, batch.padded,
+                ports=ports if need_ports else None,
             )
             nominated_slot = None
             if not nominated.empty:

@@ -32,6 +32,7 @@ deployment) so `cli.py serve` is an operable component:
 - POST   /api/pods            Pod dict or {"items": [...]}
 - DELETE /api/pods/{ns}/{name}
 - GET    /api/state           {"nodes": N, "pods": P, "unscheduled": U}
+- GET    /api/leases          {"items": [coordination.k8s.io Lease, ...]}
 In ``--mode scheduler`` a full Scheduler drains the queue in the
 background: ingested pods get bound by device solves without any external
 kube-scheduler (the cmd/kube-scheduler#Run analog).
@@ -568,6 +569,12 @@ def make_app(core: ExtenderCore, scheduler=None, batch_window: float = 0.002):
             }
         )
 
+    async def get_leases(request):
+        # coordination.k8s.io wire shapes: who leads (leader election)
+        return web.json_response(
+            {"items": [le.to_dict() for le in core.cluster.list_leases()]}
+        )
+
     app = web.Application()
     app.router.add_post("/filter", filter_)
     app.router.add_post("/prioritize", prioritize)
@@ -581,6 +588,7 @@ def make_app(core: ExtenderCore, scheduler=None, batch_window: float = 0.002):
     app.router.add_post("/api/pods", post_pods)
     app.router.add_delete("/api/pods/{ns}/{name}", delete_pod)
     app.router.add_get("/api/state", get_state)
+    app.router.add_get("/api/leases", get_leases)
 
     if scheduler is not None:
 

@@ -199,6 +199,7 @@ def _mask_and_score(
     ipa_ident: bool = False,
     ipa_score: bool = True,
     use_nominated: bool = False,
+    use_nominated_ports: bool = False,
     use_extra_score: bool = False,
 ):
     """One pod's full filter+score pipeline over all nodes against node
@@ -224,6 +225,7 @@ def _mask_and_score(
     mask = tables["static_mask"][cls] & tables["node_valid"]
     used = st["used"]
     pod_count = st["pod_count"]
+    port_used = st["port_used"]
     if use_nominated:
         # addNominatedPods: nominated pods with priority >= this pod's
         # count as placed for the monotone filters; the pod's own
@@ -242,6 +244,14 @@ def _mask_and_score(
         extra_c = extra_c.at[ss].add(-is_nom.astype(extra_c.dtype))
         used = used + extra_u
         pod_count = pod_count + extra_c
+        if use_nominated_ports:
+            # NodePorts is as monotone as resources: nominated hostPorts
+            # occupy their reserved node for lower-priority pods too
+            extra_p = tables["nom_ports"][lvl] - st["nom_corr_ports"][lvl]
+            extra_p = extra_p.at[:, ss].add(
+                -x["pod_takes"] * is_nom.astype(extra_p.dtype)
+            )
+            port_used = port_used + extra_p
     if "NodeResourcesFit" not in disabled:
         mask = mask & nr.fit_mask(
             x["req"], x["req_mask"], alloc, used,
@@ -249,7 +259,7 @@ def _mask_and_score(
         )
     if "NodePorts" not in disabled:
         mask = mask & ~pl.ports_conflict_mask(
-            x["pod_conflict"], st["port_used"]
+            x["pod_conflict"], port_used
         )
     if use_spread and "PodTopologySpread" not in disabled:
         mask = mask & ~sp.hard_violations(spr, st["spr_cnt"], cls, d_pad)
@@ -368,6 +378,13 @@ def _make_step(
             new_st["nom_corr_cnt"] = st["nom_corr_cnt"].at[:, ssn].add(
                 lev_mask.astype(jnp.int32)
             )
+            if pipe_kw.get("use_nominated_ports"):
+                new_st["nom_corr_ports"] = st["nom_corr_ports"].at[
+                    :, :, ssn
+                ].add(
+                    lev_mask[:, None].astype(jnp.int32)
+                    * x["pod_takes"][None, :]
+                )
         st = new_st
         assignment = jnp.where(found, pick, -1).astype(jnp.int32)
         return (st, k), assignment
@@ -1036,6 +1053,7 @@ def _run_packed(
     kinds,  # [P // group] int32 chunk kinds (grouped) or [1] dummy
     vcnt,  # [C] int32 per-chunk valid counts (compact mode) or [1] dummy
     nom_used,  # [L+1, K, N] int64 cumulative nominated load ([1,1,1] unused)
+    nom_ports,  # [L+1, B, N] int32 nominated hostPort occupancy ([1,1,1] unused)
     key,
     *,
     bspec,  # tuple of (name, start, width)
@@ -1058,6 +1076,9 @@ def _run_packed(
         state0["nom_corr_cnt"] = jnp.zeros(
             (nom_used.shape[0], nom_used.shape[2]), dtype=jnp.int32
         )
+        if kw.get("use_nominated_ports"):
+            tables["nom_ports"] = nom_ports
+            state0["nom_corr_ports"] = jnp.zeros_like(nom_ports)
     srcs = {"i64": xi64, "i32": xi32, "bool": xbool}
     xs = {}
     for name, src, s, w, squeeze in xspec:
@@ -1117,6 +1138,7 @@ _RUN_PACKED_STATICS = (
     "ipa_ident",
     "ipa_score",
     "use_nominated",
+    "use_nominated_ports",
     "use_extra_score",
     "pack_result",
     "compact",
@@ -1431,6 +1453,14 @@ class ExactSolver:
         nom_used = (
             nominated.used if use_nominated else np.zeros((1, 1, 1), np.int64)
         )
+        use_nominated_ports = (
+            use_nominated and nominated.port_takes is not None
+        )
+        nom_ports = (
+            nominated.port_takes
+            if use_nominated_ports
+            else np.zeros((1, 1, 1), np.int32)
+        )
 
         # per-pod inputs, one upload per dtype class
         pod_valid = (pods.valid & pods.feasible_static)[:, None]
@@ -1523,6 +1553,7 @@ class ExactSolver:
             ipa_ident=interpod.ident,
             ipa_score=interpod.has_score,
             use_nominated=use_nominated,
+            use_nominated_ports=use_nominated_ports,
             use_extra_score=static.extra_score is not None,
         )
         group = cfg.group_size
@@ -1595,6 +1626,7 @@ class ExactSolver:
             kinds,
             jnp.asarray(vcnt_host),
             jnp.asarray(nom_used),
+            jnp.asarray(nom_ports),
             key,
             bspec=tuple(bspec),
             xspec=xspec,

@@ -471,6 +471,11 @@ class ClusterState:
         self._leases[lease.key] = dataclasses.replace(lease)
         return lease
 
+    def list_leases(self) -> list:
+        import dataclasses
+
+        return [dataclasses.replace(le) for le in self._leases.values()]
+
     # -- bulk helpers for benchmarks --
 
     def create_nodes(self, nodes: Iterable[Node]) -> None:

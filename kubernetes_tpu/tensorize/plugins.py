@@ -273,7 +273,11 @@ def build_port_tensors(
     slot_nodes: Sequence[Node | None],
     placed_by_slot: Mapping[int, Sequence[Pod]],
     padded_n: int,
+    nominated: Sequence[tuple[Pod, int]] = (),
 ) -> PortTensors:
+    """``nominated`` (pod, slot) pairs contribute their hostPorts to the
+    vocab so build_nominated_tensors can encode their occupancy rows in
+    this batch's port space (NominatedTensors.port_takes)."""
     vocab_index: dict[tuple[str, str, int], int] = {}
     vocab: list[tuple[str, str, int]] = []
 
@@ -297,6 +301,9 @@ def build_port_tensors(
         for p in placed:
             for t in p.host_ports():
                 lst.append(intern(t))
+    for p, _slot in nominated:
+        for t in p.host_ports():
+            intern(t)
 
     v_pad = bucket_pow2(max(len(vocab), 1), floor=PORT_PAD)
     used = np.zeros((v_pad, padded_n), dtype=np.int32)

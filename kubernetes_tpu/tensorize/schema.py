@@ -251,20 +251,22 @@ class NominatedTensors:
     protocol collapses to one run for them; the non-monotone plugins
     (affinity symmetry from nominated pods) are documented out of scope.
 
-    Scope note on NodePorts (ADVICE r3): port conflicts are as monotone
-    as resources, but a nominated pod's hostPorts would have to be
-    re-encoded into each BATCH's port vocabulary (PortTensors builds the
-    conflict rows from the batch's own pods + placed pods), coupling this
-    batch-independent structure to every batch's vocab. Until that
-    plumbing exists, a conflicting pod can still find a preemptor's
-    reserved node port-feasible during the nomination window; the window
-    closes when the nominated pod binds. Resources/count — the filters
-    preemption actually frees — are covered.
+    NodePorts is covered too (ADVICE r3: port conflicts are as monotone
+    as resources): when the caller passes the batch's PortTensors, the
+    nominated pods' hostPorts are interned into that batch's port
+    vocabulary (build_port_tensors takes ``nominated`` for exactly this)
+    and ``port_takes`` carries their cumulative occupancy rows — a
+    conflicting pod can no longer find a preemptor's reserved node
+    port-feasible during the nomination window. The remaining out-of-scope
+    piece is the non-monotone affinity symmetry from nominated pods.
     """
 
     levels: np.ndarray  # [L] int32 distinct nominated priorities, desc
     used: np.ndarray  # [L+1, K, Np] int64 cumulative nominated requests
     count: np.ndarray  # [L+1, Np] int32 cumulative nominated pod counts
+    # [L+1, B, Np] int32 cumulative nominated hostPort occupancy in the
+    # batch's port vocab (None: no port tensors supplied / no ports)
+    port_takes: np.ndarray | None = None
 
     @property
     def empty(self) -> bool:
@@ -283,9 +285,13 @@ def build_nominated_tensors(
     nominated: Sequence[tuple[Pod, int]],  # (pod, node slot)
     vocab: "ResourceVocab",
     n_pad: int,
+    ports=None,  # PortTensors whose vocab includes the nominated ports
 ) -> NominatedTensors:
     """``nominated``: unbound pods carrying status.nominatedNodeName,
-    with their nominated node's snapshot slot."""
+    with their nominated node's snapshot slot. With ``ports`` (the
+    batch's PortTensors, built with the same ``nominated`` so its vocab
+    interns their hostPorts), the cumulative port-occupancy rows are
+    built too."""
     if not nominated:
         return NominatedTensors(
             levels=np.zeros(0, dtype=np.int32),
@@ -304,6 +310,13 @@ def build_nominated_tensors(
         rows *= 2
     used = np.zeros((rows, k, n_pad), dtype=np.int64)
     count = np.zeros((rows, n_pad), dtype=np.int32)
+    port_takes = None
+    port_index = None
+    if ports is not None and any(p.host_ports() for p, _ in nominated):
+        port_index = {t: i for i, t in enumerate(ports.vocab)}
+        port_takes = np.zeros(
+            (rows, ports.used.shape[0], n_pad), dtype=np.int32
+        )
     # each pod's load lands in every cumulative row that includes its
     # priority (its own level row and every lower-priority row below it)
     for pod, slot in nominated:
@@ -311,7 +324,14 @@ def build_nominated_tensors(
         r = vocab.vectorize(pod.resource_request())
         used[row:, :, slot] += r[None, :]
         count[row:, slot] += 1
-    return NominatedTensors(levels=levels, used=used, count=count)
+        if port_takes is not None:
+            for t in pod.host_ports():
+                v = port_index.get(t)
+                if v is not None:  # vocab built with `nominated` has all
+                    port_takes[row:, v, slot] += 1
+    return NominatedTensors(
+        levels=levels, used=used, count=count, port_takes=port_takes
+    )
 
 
 def build_pod_batch(

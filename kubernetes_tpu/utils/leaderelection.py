@@ -13,7 +13,6 @@ leadership lost (the reference's OnStoppedLeading → process exit).
 from __future__ import annotations
 
 import threading
-import time as _time
 from dataclasses import dataclass, field
 
 from ..state.cluster import ApiError, ClusterState
@@ -135,13 +134,17 @@ class LeaderElector:
             return
         if on_started_leading is not None:
             on_started_leading()
-        last_renew = _time.monotonic()
+        # one timebase for the whole protocol: the injected clock stamps
+        # lease renewals AND measures the renew deadline, so holder
+        # self-demotion and challenger takeover can't drift apart (and
+        # the loss path is drivable with a fake clock)
+        last_renew = self.clock.now()
         while not stop.is_set():
             if stop.wait(self.retry_period):
                 return
             if self.try_acquire_or_renew():
-                last_renew = _time.monotonic()
-            elif _time.monotonic() - last_renew > self.renew_deadline:
+                last_renew = self.clock.now()
+            elif self.clock.now() - last_renew > self.renew_deadline:
                 self.is_leader = False
                 if on_stopped_leading is not None:
                     on_stopped_leading()

@@ -143,3 +143,41 @@ def test_losing_challenger_cannot_corrupt_store():
     clock.advance(5.0)
     assert b.try_acquire_or_renew()
     assert not c.try_acquire_or_renew()
+
+
+def test_run_loop_reports_loss_after_renew_deadline():
+    """The one remaining protocol branch: a holder whose renewals keep
+    failing (lease stolen with a fresh renew_time) fires
+    on_stopped_leading once the injected clock passes renew_deadline."""
+    cs = ClusterState()
+    clock = FakeClock()
+    a = mk(cs, "a", clock)
+    a.retry_period = 0.01  # fast wall loop; deadline measured on FakeClock
+    lost = threading.Event()
+    stop = threading.Event()
+    t = threading.Thread(
+        target=a.run, args=(stop,), kwargs=dict(on_stopped_leading=lost.set)
+    )
+    t.start()
+    # wait for leadership
+    for _ in range(500):
+        if a.is_leader:
+            break
+        threading.Event().wait(0.01)
+    assert a.is_leader
+    # steal the lease with a perpetually-fresh foreign holder
+    def keep_fresh():
+        while not lost.is_set() and not stop.is_set():
+            le = cs.get_lease("kube-system", "kubernetes-tpu-scheduler")
+            le.holder_identity = "z"
+            le.renew_time = clock.now()
+            cs.update_lease(le)
+            clock.advance(3.0)  # march time toward a's renew_deadline
+            threading.Event().wait(0.01)
+    th = threading.Thread(target=keep_fresh)
+    th.start()
+    assert lost.wait(timeout=30), "loss path never fired"
+    assert not a.is_leader
+    stop.set()
+    t.join(timeout=10)
+    th.join(timeout=10)

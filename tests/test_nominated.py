@@ -156,3 +156,58 @@ def test_nominated_node_tried_first():
     cs.create_pod(pod)
     r = sched.schedule_batch()
     assert dict(r.scheduled).get("default/p") == "busy"
+
+
+def test_nominated_host_port_reserved():
+    """ADVICE r3: port conflicts are as monotone as resources — a
+    lower-priority pod wanting the nominated preemptor's hostPort must
+    not find the reserved node port-feasible during the nomination
+    window, even though cpu/memory would fit it."""
+    clock = FakeClock()
+    cs = ClusterState()
+    cs.create_node(
+        MakeNode().name("only").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": "10"}
+        ).obj()
+    )
+    sched = Scheduler(
+        cs,
+        SchedulerConfig(solver=ExactSolverConfig(tie_break="first")),
+        clock=clock,
+    )
+    # victim holds the port; the preemptor (with the same hostPort) evicts
+    victim = (
+        MakePod().name("victim").priority(0)
+        .req({"cpu": "1"}).host_port(8080).obj()
+    )
+    cs.create_pod(victim)
+    cs.bind("default", "victim", "only")
+    cs.create_pod(
+        MakePod().name("preemptor").priority(10)
+        .req({"cpu": "1"}).host_port(8080).obj()
+    )
+    r1 = sched.schedule_batch()
+    assert r1.preemptions and r1.preemptions[0][1] == "only"
+
+    # plenty of cpu remains, but the PORT is reserved by the nomination:
+    # a lower-priority pod wanting 8080 must fail...
+    cs.create_pod(
+        MakePod().name("port-thief").priority(1)
+        .req({"cpu": "1"}).host_port(8080).obj()
+    )
+    # ...while one without the port binds fine in the same batch
+    cs.create_pod(
+        MakePod().name("portless").priority(1).req({"cpu": "1"}).obj()
+    )
+    r2 = sched.schedule_batch()
+    assert "default/port-thief" in r2.unschedulable
+    assert dict(r2.scheduled).get("default/portless") == "only"
+
+    # backoff expires; the preemptor lands and takes its port
+    clock.advance(15.0)
+    r3 = sched.schedule_batch()
+    assert dict(r3.scheduled).get("default/preemptor") == "only"
+    # the thief keeps failing: the port is now genuinely taken
+    clock.advance(15.0)
+    r4 = sched.schedule_batch()
+    assert "default/port-thief" in r4.unschedulable or not r4.scheduled
