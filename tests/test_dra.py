@@ -721,3 +721,74 @@ def test_dra_widen_does_not_block_resource_preemption():
     drain(sched)
     assert cs.get_pod("default", "high").node_name == "n0"
     assert "filler" not in {p.name for p in cs.list_pods()}  # evicted
+
+
+def test_fuzz_invariants_under_churn():
+    """Random create/schedule/delete churn with the gate on: at every
+    quiescent point, no device is owned by two claims, every allocation
+    sits on a live node with its devices actually published there, and
+    reservedFor only names live pods."""
+    rng = np.random.default_rng(42)
+    for trial in range(4):
+        n_nodes = int(rng.integers(2, 5))
+        gpn = int(rng.integers(1, 4))
+        cs = mk_cluster(n_nodes=n_nodes, gpus_per_node=gpn)
+        sched = mk_sched(cs)
+        live_pods: list[str] = []
+        for step in range(30):
+            op = rng.random()
+            if op < 0.55:
+                i = trial * 1000 + step
+                cs.create_resource_claim(
+                    ResourceClaim(
+                        name=f"c{i}",
+                        requests=(
+                            DeviceRequest(
+                                name="g",
+                                device_class_name="gpu",
+                                count=int(rng.integers(1, gpn + 1)),
+                            ),
+                        ),
+                    )
+                )
+                cs.create_pod(
+                    MakePod().name(f"p{i}")
+                    .priority(int(rng.integers(0, 5)))
+                    .req({"cpu": "1", "memory": "1Gi"})
+                    .resource_claim(f"c{i}").obj()
+                )
+                live_pods.append(f"p{i}")
+            elif live_pods:
+                # victims are popped exactly once with unique names, so
+                # delete must succeed — any exception IS the bug class
+                # this fuzz exists to catch
+                victim = live_pods.pop(int(rng.integers(0, len(live_pods))))
+                cs.delete_pod("default", victim)
+            drain(sched, rounds=2)
+
+            # -- invariants --
+            claims = cs.list_resource_claims()
+            node_devices = {}
+            for s in cs.list_resource_slices():
+                node_devices.setdefault(s.node_name, set()).update(
+                    (s.driver, s.pool, d.name) for d in s.devices
+                )
+            owned: dict[tuple, str] = {}
+            pod_keys = {p.key for p in cs.list_pods()}
+            node_names = {n.name for n in cs.list_nodes()}
+            for c in claims:
+                for r in c.results:
+                    did = (c.allocated_node, r.driver, r.pool, r.device)
+                    assert did not in owned, (
+                        f"device {did} owned by {owned[did]} and {c.key}"
+                    )
+                    owned[did] = c.key
+                if c.allocated:
+                    assert c.allocated_node in node_names
+                    published = node_devices.get(c.allocated_node, set())
+                    for r in c.results:
+                        assert (r.driver, r.pool, r.device) in published
+                for k in c.reserved_for:
+                    assert k in pod_keys, (
+                        f"{c.key} reserves deleted pod {k}"
+                    )
