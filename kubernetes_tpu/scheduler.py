@@ -893,17 +893,33 @@ class Scheduler:
             fit_oracle = None
             fiterr_memo: dict[tuple, str] = {}
             class_of_host = np.asarray(static.class_of)
+            fe_nodes = sum(1 for n in slot_nodes if n is not None)
+            fe_generic = (
+                f"0/{fe_nodes} nodes are available: the batched "
+                "filter pipeline rejected every candidate"
+            )
 
             def fit_error_for(pod: Pod, idx: int) -> str:
                 nonlocal fit_oracle
+                # claims are already folded into the class identity when
+                # DRA is active (class_key_extra), so they're in the key
+                # only as belt-and-braces
                 key = (
                     int(class_of_host[idx]),
                     tuple(sorted(pod.resource_request().items())),
                     pod.host_ports(),  # ports are per-pod, not class-level
+                    pod.resource_claim_names,
                 )
                 msg = fiterr_memo.get(key)
                 if msg is not None:
                     return msg
+                # the oracle replay is O(nodes x plugins) scalar Python on
+                # a 1-vCPU host: bound the diagnosis work per batch so a
+                # pathological batch of many distinct failing shapes can't
+                # stall the scheduling loop (later shapes get the generic
+                # message; their retry in a later batch gets a fresh budget)
+                if len(fiterr_memo) >= 16:
+                    return fe_generic
                 if fit_oracle is None:
                     from .ops.oracle.profile import (
                         FullOracle,
@@ -923,11 +939,6 @@ class Scheduler:
                         spread_defaulting=solver.config.spread_defaulting,
                         disabled=frozenset(solver.config.disabled_filters),
                     )
-                n_nodes = sum(1 for n in slot_nodes if n is not None)
-                generic = (
-                    f"0/{n_nodes} nodes are available: the batched "
-                    "filter pipeline rejected every candidate"
-                )
                 extra = None
                 if dra_active and pod.resource_claim_names:
                     # the scalar replay has no DRA filter: contribute the
@@ -954,13 +965,13 @@ class Scheduler:
                 try:
                     msg = fit_oracle.fit_error(pod, extra=extra)
                 except Exception:
-                    msg = generic
+                    msg = fe_generic
                 if msg.endswith("nodes are available"):
                     # every scalar filter accepted some node: the rejection
                     # came from a folded filter the replay can't attribute
                     # (out-of-tree plugin / extender verdict) — stay honest
                     # instead of implying the cluster is full
-                    msg = generic
+                    msg = fe_generic
                 fiterr_memo[key] = msg
                 return msg
             for idx, (info, a) in enumerate(zip(infos, assignments)):
