@@ -902,13 +902,16 @@ class Scheduler:
             def fit_error_for(pod: Pod, idx: int) -> str:
                 nonlocal fit_oracle
                 # claims are already folded into the class identity when
-                # DRA is active (class_key_extra), so they're in the key
-                # only as belt-and-braces
+                # DRA is active (class_key_extra); with DRA off they can't
+                # influence the diagnosis, so keying them then would only
+                # fragment the 16-entry replay budget
                 key = (
                     int(class_of_host[idx]),
                     tuple(sorted(pod.resource_request().items())),
                     pod.host_ports(),  # ports are per-pod, not class-level
-                    pod.resource_claim_names,
+                    tuple(sorted(pod.resource_claim_names))
+                    if dra_active
+                    else (),
                 )
                 msg = fiterr_memo.get(key)
                 if msg is not None:

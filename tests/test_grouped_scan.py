@@ -387,3 +387,45 @@ def test_compact_wire_falls_back_on_mixed_rows():
     a_grp, s = _solve_full(nodes, pods, 8, compact=True)
     np.testing.assert_array_equal(a_scan, a_grp)
     assert s.dispatch_counts.get("compact_batches", 0) == 0
+
+
+# -- hypothesis property: compact wire ≡ full upload ------------------------
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+
+@settings(max_examples=12, deadline=None)
+@given(
+    seed=st.integers(0, 10_000),
+    n_nodes=st.integers(3, 14),
+    runs=st.lists(
+        st.tuples(
+            st.integers(1, 18),  # replicas
+            st.integers(1, 8),  # cpu units of 100m
+            st.integers(1, 6),  # memory units of 256Mi
+            st.booleans(),  # tolerate the taint
+        ),
+        min_size=1,
+        max_size=3,
+    ),
+    group=st.sampled_from([4, 8]),
+    tie=st.sampled_from(["first", "random"]),
+)
+def test_compact_wire_equivalence_property(seed, n_nodes, runs, group, tie):
+    """For ANY workload of uniform replica runs (the compact-eligible
+    family), the compact upload must be bit-identical to the full [P, *]
+    upload under both tie-break modes — including partial tail chunks and
+    mixed-run batches that fall back to the full path."""
+    rng = np.random.default_rng(seed)
+    nodes = mk_nodes(n_nodes, rng, taint_every=3)
+    pods = []
+    for ri, (cnt, cpu_u, mem_u, tol) in enumerate(runs):
+        pods += mk_replica_run(
+            f"r{ri}", cnt, cpu_u * 100, mem_u * 256, tolerate=tol
+        )
+    a_full, _ = _solve_full(nodes, pods, group, compact=False, tie=tie,
+                            seed=seed)
+    a_comp, _ = _solve_full(nodes, pods, group, compact=True, tie=tie,
+                            seed=seed)
+    np.testing.assert_array_equal(a_full, a_comp)
