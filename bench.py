@@ -560,12 +560,31 @@ def _north_star_exact() -> dict:
     assert int(np.bincount(a, minlength=NS_NODES).max()) <= 110
     assert np.bincount(a, weights=cpu.astype(np.float64)).max() <= 16_000
     assert np.bincount(a, weights=mem.astype(np.float64)).max() <= 64 << 30
+    # SEQUENTIAL-PARITY replay (the oracle-replay gate at full scale):
+    # with identical pods on identical nodes, LeastAllocated AND
+    # BalancedAllocation are strictly decreasing in a node's pod count,
+    # so the reference tie set at every step is exactly the
+    # minimum-count nodes — each of the 51,200 placements must land on
+    # a node at the then-minimum count, in emitted order
+    # every placement consumes one min-count slot, so the running minimum
+    # is simply k // NS_NODES — no carried bookkeeping to desynchronize
+    counts = np.zeros(NS_NODES, dtype=np.int64)
+    for k, node in enumerate(a):
+        assert counts[node] == k // NS_NODES, (
+            f"step {k}: node at count {counts[node]}, tie set at "
+            f"{k // NS_NODES} — outside the reference tie set"
+        )
+        counts[node] += 1
     return {
         "exact_parity_solve_s": round(exact_s, 2),
         "exact_parity_pods_per_sec": round(placed / exact_s, 1),
         "exact_parity_vs_1s_target": round(NS_TARGET_S / exact_s, 2),
         "exact_parity_dispatch": "; ".join(
             f"{k}={v}" for k, v in sorted(solver.dispatch_counts.items())
+        ),
+        "exact_parity_replay": (
+            f"all {NS_PODS} placements verified inside the sequential "
+            "reference tie set (min-count replay) + capacity gates"
         ),
     }
 
