@@ -117,6 +117,13 @@ tensorize_seconds = Histogram(
     buckets=_BUCKETS,
     registry=REGISTRY,
 )
+solves_discarded_total = Counter(
+    "scheduler_tpu_solves_discarded_total",
+    "Deferred device solves discarded by the pipelined loop's conflict "
+    "fence (a capacity/mask-affecting event landed between dispatch and "
+    "apply); the batch's pods retry immediately without backoff.",
+    registry=REGISTRY,
+)
 extender_batch_size = Histogram(
     "scheduler_tpu_extender_batch_size",
     "Webhook requests coalesced per device evaluation (micro-batching).",

@@ -29,7 +29,12 @@ from .api.objects import Pod
 from .framework.interface import CycleState, StatusCode
 from .framework.runtime import WaitingPod
 from .server.extender_client import ExtenderError
-from .solver.exact import ExactSolver, ExactSolverConfig
+from .solver.exact import (
+    DeferredAssignments,
+    ExactSolver,
+    ExactSolverConfig,
+    SessionDrainRequired,
+)
 from .solver.preemption import PreemptionEvaluator
 from .state.cache import SchedulerCache
 from .state.cluster import ApiError, ClusterState, Event
@@ -109,6 +114,59 @@ class BatchResult:
     latencies: list[float] = field(default_factory=list)
 
 
+@dataclass
+class _PreparedGroup:
+    """Everything one profile sub-batch needs between tensorization and
+    result application, so the two phases can run on opposite sides of a
+    deferred device read (run_pipelined). For the synchronous path the
+    phases run back to back and this is pure plumbing."""
+
+    profile: str
+    infos: list
+    pods: list
+    cycle_offsets: list
+    base_cycle: int
+    t0: float  # cycle start (per-pod latency base)
+    gs: float  # tensorize start (attempt-duration base)
+    batch: object
+    pbatch: object
+    static: object
+    ports: object
+    spread: object
+    interpod: object
+    nominated: object
+    nominated_slot: object
+    slot_nodes: list
+    names: list  # snapshot slot->name mapping AT PREP TIME (fence-stable)
+    volume_ctx: object
+    services: list
+    dra_active: bool
+    fence: int = 0  # _conflict_seq INSIDE the tensorize lock (the snapshot
+    # consistency point — capturing it any later would mask events landing
+    # between lock release and dispatch; review-caught)
+    tensorize_seconds: float = 0.0  # host prep cost (set at dispatch)
+    unsched_reason: dict = field(default_factory=dict)
+    dra_prefold: dict = field(default_factory=dict)
+
+
+@dataclass
+class _InFlightSolve:
+    """A dispatched solve whose assignments may not have been read yet.
+    Its conflict fence is ``prep.fence`` — captured inside the tensorize
+    lock, NOT at dispatch (re-reading _conflict_seq any later would mask
+    events landing between lock release and dispatch)."""
+
+    prep: _PreparedGroup
+    handle: object  # np.ndarray (sync) | DeferredAssignments (pipelined)
+    dispatch_seconds: float
+    read_seconds: float = 0.0  # blocking device-read wait (set at apply)
+
+    def assignments(self) -> np.ndarray:
+        if isinstance(self.handle, DeferredAssignments):
+            return self.handle.get()
+        return self.handle
+
+
 class Scheduler:
     def __init__(
         self,
@@ -162,6 +220,16 @@ class Scheduler:
         # neither queued nor waiting — without this map queue.update would
         # re-add it and double-schedule (review-caught)
         self._in_flight: dict[str, QueuedPodInfo] = {}
+        # fence for the double-buffered loop (run_pipelined): bumped by any
+        # watch event that could invalidate a dispatched-but-unapplied
+        # solve (node capacity/mask changes, external pod placements). A
+        # deferred solve whose fence no longer matches is discarded.
+        self._conflict_seq = 0
+        # set when a deferred solve was discarded: the device session's
+        # carried state counted the discarded placements and must be
+        # re-uploaded from host truth before the next dispatch (done at
+        # _dispatch_group once no other solve is in flight)
+        self._session_stale = False
         self.snapshot = Snapshot()
         from .state.volume_binder import VolumeBinder
 
@@ -234,15 +302,43 @@ class Scheduler:
                 self.nominated_pods.pop(pod.key, None)
             if ev.type == "ADDED":
                 if pod.node_name:
+                    # an externally placed pod consumes capacity a deferred
+                    # solve did not see
+                    self._conflict_seq += 1
                     self.cache.add_pod(pod)
                 elif pod.scheduler_name in self.solvers:
                     self.queue.add(pod)
             elif ev.type == "MODIFIED":
                 if pod.node_name:
-                    # covers our own bind confirmations (assumed -> confirmed)
-                    self.cache.update_pod(pod) if not self.cache.is_assumed(
-                        pod.key
-                    ) else self.cache.add_pod(pod)
+                    if not self.cache.is_assumed(pod.key):
+                        # external bind/update of an assigned pod (our own
+                        # bind confirmations arrive while still assumed).
+                        # Fence-bump only when the update changes what a
+                        # deferred solve consumed — placement or resource
+                        # footprint; status heartbeats and label/condition
+                        # flaps on running pods must not discard solves
+                        # (review-caught pipeline-degeneration hazard)
+                        old = None
+                        old_node = self.cache.pod_node(pod.key)
+                        if old_node is not None:
+                            ninfo = self.cache.nodes.get(old_node)
+                            if ninfo is not None:
+                                old = ninfo.pods.get(pod.key)
+                        if (
+                            old is None
+                            or old.node_name != pod.node_name
+                            or old.resource_request()
+                            != pod.resource_request()
+                        ):
+                            self._conflict_seq += 1
+                        self.cache.update_pod(pod)
+                        # a pod this scheduler still had queued was bound
+                        # by someone else: drop it (upstream's filtering
+                        # handler pair fires the unassigned handler's
+                        # OnDelete when a pod becomes assigned)
+                        self.queue.delete(pod.key)
+                    else:
+                        self.cache.add_pod(pod)
                 elif pod.key in self._in_flight:
                     # popped and mid-cycle (the unlocked solve window):
                     # refresh the in-flight copy; re-adding to the queue
@@ -280,6 +376,9 @@ class Scheduler:
                         self._unreserve_all(state, wp.pod, wp.node_name)
         else:  # Node
             if ev.type == "ADDED":
+                # node add/remove remaps snapshot slots: any in-flight
+                # deferred solve's assignment indices go stale
+                self._conflict_seq += 1
                 self.cache.add_node(ev.obj)
                 self.queue.move_all_to_active_or_backoff(
                     "NodeAdd", worth=self._fit_hint(ev.obj.name)
@@ -292,6 +391,9 @@ class Scheduler:
                 # #nodeSchedulingPropertiesChange): only wake parked pods for
                 # node changes that could make one schedulable
                 if old_node is None or _node_change_could_help(old_node, ev.obj):
+                    # the same changes invalidate a deferred solve's masks
+                    # and capacity math (pure heartbeats do not)
+                    self._conflict_seq += 1
                     # label/taint/unschedulable changes can unblock pods
                     # regardless of resources; a pure allocatable change
                     # only helps pods that now FIT this node
@@ -307,6 +409,7 @@ class Scheduler:
                         else None,
                     )
             else:
+                self._conflict_seq += 1
                 self.cache.remove_node(ev.obj.name)
 
     def _fit_hint(self, node_name: str, old=None):
@@ -413,6 +516,20 @@ class Scheduler:
             infos = self.queue.pop_batch(self.config.batch_size)
             for i in infos:
                 self._in_flight[i.key] = i
+        return self._run_popped(infos, t0, res, pending)
+
+    def _run_popped(
+        self,
+        infos: list[QueuedPodInfo],
+        t0: float,
+        res: BatchResult | None = None,
+        pending: list | None = None,
+    ) -> BatchResult:
+        """The synchronous cycle body for an already-popped batch (the
+        pipelined driver pops before it knows whether a batch can overlap
+        a deferred solve; non-overlappable batches route here)."""
+        res = BatchResult() if res is None else res
+        pending = [] if pending is None else pending
         try:
             if infos:
                 self._run_groups(infos, res, pending, t0)
@@ -426,41 +543,60 @@ class Scheduler:
             # popped pods that were neither approved, parked, nor already
             # requeued go back to the queue with backoff, and approved
             # binds still commit (the finally below).
-            handled = (
-                {e[2].key for e in pending}
-                | set(res.unschedulable)
-                | {k for k, _ in res.bind_failures}
-                | set(self._waiting)
-            )
-            with self.cluster.lock:
-                base = self.queue.scheduling_cycle
-                for info in infos:
-                    if info.key not in handled:
-                        self._requeue(info, base)
+            self._requeue_unhandled(infos, pending, res)
             raise
         finally:
-            first_err = None
-            for entry in pending:
-                tb = time.perf_counter()
-                try:
-                    ok = self._commit_binding(entry, res)
-                except Exception as e:  # a buggy PreBind/PostBind plugin
-                    # must not strand the REST of the approved batch:
-                    # roll this pod back, keep committing, re-raise last
-                    ok = False
-                    first_err = first_err or e
-                    state, info, pod, node_name, cycle, _ts = entry
-                    with self.cluster.lock:
-                        self._unreserve_all(state, pod, node_name)
-                        res.bind_failures.append((pod.key, repr(e)))
-                        self._requeue(info, cycle)
-                metrics.framework_extension_point_duration_seconds.labels(
-                    "Bind", "Success" if ok else "Error", "all"
-                ).observe(time.perf_counter() - tb)
-            self._in_flight.clear()
-            if first_err is not None:
-                raise first_err
+            self._commit_all(infos, pending, res)
         return res
+
+    def _requeue_unhandled(
+        self, infos: list[QueuedPodInfo], pending: list, res: BatchResult
+    ) -> None:
+        """Backoff-requeue every popped pod a mid-cycle exception left
+        neither approved, parked, nor already requeued (shared by the
+        sync and pipelined failure paths)."""
+        handled = (
+            {e[2].key for e in pending}
+            | set(res.unschedulable)
+            | {k for k, _ in res.bind_failures}
+            | set(self._waiting)
+        )
+        with self.cluster.lock:
+            base = self.queue.scheduling_cycle
+            for info in infos:
+                if info.key not in handled:
+                    self._requeue(info, base)
+
+    def _commit_all(
+        self, infos: list[QueuedPodInfo], pending: list, res: BatchResult
+    ) -> None:
+        """The binding-cycle pass for a batch's approved pods, plus
+        in-flight bookkeeping teardown for exactly this batch (the
+        pipelined loop keeps other batches' in-flight entries live)."""
+        first_err = None
+        for entry in pending:
+            tb = time.perf_counter()
+            try:
+                ok = self._commit_binding(entry, res)
+            except Exception as e:  # a buggy PreBind/PostBind plugin
+                # must not strand the REST of the approved batch:
+                # roll this pod back, keep committing, re-raise last
+                ok = False
+                first_err = first_err or e
+                state, info, pod, node_name, cycle, _ts = entry
+                with self.cluster.lock:
+                    self._unreserve_all(state, pod, node_name)
+                    res.bind_failures.append((pod.key, repr(e)))
+                    self._requeue(info, cycle)
+            metrics.framework_extension_point_duration_seconds.labels(
+                "Bind", "Success" if ok else "Error", "all"
+            ).observe(time.perf_counter() - tb)
+        for info in infos:
+            self._in_flight.pop(info.key, None)
+        for entry in pending:
+            self._in_flight.pop(entry[1].key, None)
+        if first_err is not None:
+            raise first_err
 
     def _run_groups(
         self, infos: list, res: BatchResult, pending: list, t0: float
@@ -503,17 +639,29 @@ class Scheduler:
         t0: float,
         pending: list,
     ) -> None:
+        """One profile sub-batch, synchronously: tensorize -> fold ->
+        dispatch (blocking read) -> apply. run_pipelined drives the same
+        four phases with a deferred read between dispatch and apply so
+        the next batch's host work overlaps this one's tunnel RTT."""
+        prep = self._tensorize_group(
+            profile, infos, cycle_offsets, base_cycle, t0
+        )
+        self._fold_group(prep)
+        flight = self._dispatch_group(prep, defer=False)
+        self._apply_group(flight, res, pending)
+
+    def _tensorize_group(
+        self,
+        profile: str,
+        infos: list[QueuedPodInfo],
+        cycle_offsets: list[int],
+        base_cycle: int,
+        t0: float,
+    ) -> _PreparedGroup:
+        """Phase 2a (locked): snapshot + tensorize against a consistent
+        view of cache + cluster."""
         solver = self.solvers[profile]
         gs = time.perf_counter()
-        pending_before = len(pending)
-        unsched_before = len(res.unschedulable)
-        failures_before = len(res.bind_failures)
-        # per-pod overrides for the generic "0/N nodes" failure message
-        # (e.g. DRA unresolvable-claim reasons)
-        unsched_reason: dict[str, str] = {}
-        # pre-DRA-fold mask rows per class: preemption candidacy for
-        # device-exhausted nodes (empty when DRA is off)
-        dra_prefold: dict[int, np.ndarray] = {}
         with self.cluster.lock:
             # phase 2a: snapshot + tensorize against a consistent view
             batch = self.snapshot.update(self.cache)
@@ -743,10 +891,28 @@ class Scheduler:
                 for i, p in enumerate(pods):
                     nominated_slot[i] = slot_by_key.get(p.key, -1)
 
-        # Out-of-tree plugin + extender folding runs OUTSIDE the
-        # cluster lock (arbitrary user code / HTTP round trips must
-        # not block ingest); it only touches the host-side static
-        # tables and immutable Node snapshots gathered above.
+            return _PreparedGroup(
+                profile=profile, infos=infos, pods=pods,
+                cycle_offsets=cycle_offsets, base_cycle=base_cycle,
+                t0=t0, gs=gs, batch=batch, pbatch=pbatch, static=static,
+                ports=ports, spread=spread, interpod=interpod,
+                nominated=nominated, nominated_slot=nominated_slot,
+                slot_nodes=slot_nodes, names=list(self.snapshot.names),
+                volume_ctx=volume_ctx, services=services,
+                dra_active=dra_active, fence=self._conflict_seq,
+            )
+
+    def _fold_group(self, prep: _PreparedGroup) -> None:
+        """Out-of-tree plugin + extender + DRA folding, OUTSIDE the
+        cluster lock (arbitrary user code / HTTP round trips must not
+        block ingest); it only touches the host-side static tables and
+        immutable Node snapshots gathered at tensorize time."""
+        static = prep.static
+        slot_nodes = prep.slot_nodes
+        pods = prep.pods
+        dra_active = prep.dra_active
+        dra_prefold = prep.dra_prefold
+        unsched_reason = prep.unsched_reason
         if self.config.out_of_tree_plugins:
             # out-of-tree Scheduling Framework plugins: class-vectorized
             # folding into the static mask / extra-score tables. A
@@ -851,30 +1017,88 @@ class Scheduler:
             metrics.plugin_execution_duration_seconds.labels(
                 "DynamicResources", "PreFilter", "Success"
             ).observe(time.perf_counter() - tdra)
+    def _dispatch_group(
+        self, prep: _PreparedGroup, defer: bool, allow_heal: bool = True
+    ) -> _InFlightSolve:
+        """Upload + launch the device solve. ``defer=False`` blocks on
+        the assignment read (the synchronous path); ``defer=True``
+        returns immediately with an async device→host copy in flight so
+        the read overlaps later host work (run_pipelined).
+        ``allow_heal=False`` defers dirty-column healing while an
+        earlier solve is still unapplied (see _DeviceSession.sync)."""
+        solver = self.solvers[prep.profile]
+        if self._session_stale and allow_heal:
+            # a discarded solve polluted the device carry; with no other
+            # solve in flight (allow_heal implies the pipeline drained),
+            # re-upload from host truth before dispatching
+            solver.reset_session()
+            self._session_stale = False
         t1 = time.perf_counter()
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
-        assignments = solver.solve(
-            batch, pbatch, static, ports, spread, interpod,
+        handle = solver.solve(
+            prep.batch, prep.pbatch, prep.static, prep.ports, prep.spread,
+            prep.interpod,
             col_versions=self.snapshot.col_versions,
-            nominated=nominated if not nominated.empty else None,
-            nominated_slot=nominated_slot,
+            nominated=prep.nominated if not prep.nominated.empty else None,
+            nominated_slot=prep.nominated_slot,
+            defer_read=defer,
+            allow_heal=allow_heal,
         )
-        solve_dt = time.perf_counter() - t1
-        res.solve_seconds += solve_dt
-        metrics.tensorize_seconds.observe(max(t1 - gs, 0.0))
-        # extension-point durations with the reference's metric name: the
-        # fused device program IS RunFilterPlugins+RunScorePlugins, so its
-        # wall time reports under Filter (documented mapping, SURVEY §6.5);
-        # host tensorization maps to PreFilter
+        dispatch_dt = time.perf_counter() - t1
+        prep.tensorize_seconds = max(t1 - prep.gs, 0.0)
+        metrics.tensorize_seconds.observe(prep.tensorize_seconds)
+        # extension-point durations with the reference's metric names:
+        # host tensorization maps to PreFilter (documented, SURVEY §6.5)
         metrics.framework_extension_point_duration_seconds.labels(
-            "PreFilter", "Success", profile
-        ).observe(max(t1 - gs, 0.0))
+            "PreFilter", "Success", prep.profile
+        ).observe(prep.tensorize_seconds)
+        return _InFlightSolve(
+            prep=prep, handle=handle, dispatch_seconds=dispatch_dt,
+        )
+
+    def _apply_group(
+        self,
+        flight: _InFlightSolve,
+        res: BatchResult,
+        pending: list,
+        fence: int | None = None,
+    ) -> bool:
+        """Phase 2b (locked): read the assignments and apply them —
+        assume / Reserve / Permit / PostFilter — atomically with the
+        watch-event consumers. With ``fence`` set (pipelined path), the
+        fence is RE-CHECKED inside the lock — a conflicting event can
+        land during the unlocked device read — and a stale solve applies
+        nothing and returns False (the caller discards). The synchronous
+        path passes no fence: its solve-window staleness is the same one
+        the reference's binding goroutines accept."""
+        prep = flight.prep
+        profile = prep.profile
+        solver = self.solvers[profile]
+        infos, pods = prep.infos, prep.pods
+        static, slot_nodes = prep.static, prep.slot_nodes
+        volume_ctx, services = prep.volume_ctx, prep.services
+        dra_active, dra_prefold = prep.dra_active, prep.dra_prefold
+        unsched_reason = prep.unsched_reason
+        base_cycle, cycle_offsets = prep.base_cycle, prep.cycle_offsets
+        t0, gs = prep.t0, prep.gs
+        pending_before = len(pending)
+        unsched_before = len(res.unschedulable)
+        failures_before = len(res.bind_failures)
+        tr = time.perf_counter()
+        assignments = flight.assignments()
+        flight.read_seconds = time.perf_counter() - tr
+        solve_dt = flight.dispatch_seconds + flight.read_seconds
+        res.solve_seconds += solve_dt
+        # the fused device program IS RunFilterPlugins+RunScorePlugins, so
+        # its dispatch+read wall time reports under Filter (SURVEY §6.5)
         metrics.framework_extension_point_duration_seconds.labels(
             "Filter", "Success", profile
         ).observe(solve_dt)
 
         with self.cluster.lock:
+            if fence is not None and fence != self._conflict_seq:
+                return False  # went stale during the device read
             # phase 2b: apply assignments — assume / Reserve / Permit /
             # PostFilter — atomically with the watch-event consumers
             preempt_placed: dict[int, list[Pod]] | None = None
@@ -1029,7 +1253,7 @@ class Scheduler:
                         type_="Warning",
                     )
                     continue
-                node_name = self.snapshot.name_of(int(a))
+                node_name = prep.names[int(a)]
                 try:
                     self.cache.assume_pod(pod, node_name)
                 except Exception as e:  # cache inconsistency: requeue
@@ -1146,6 +1370,7 @@ class Scheduler:
             )
         if n_fail:
             metrics.schedule_attempts_total.labels("error", profile).inc(n_fail)
+        return True
 
     def _fold_signature(self, static, slot_nodes) -> bytes:
         """Memo key for the out-of-tree fold: plugin identities, the
@@ -1681,6 +1906,253 @@ class Scheduler:
             if not (r.scheduled or r.unschedulable or r.bind_failures):
                 break
             out.append(r)
+        return out
+
+    # -- double-buffered loop (VERDICT r4 #1) --
+
+    def _plain_batch(self, pods: list[Pod]) -> bool:
+        """True when tensorizing this batch reads NO host state that a
+        previous batch's apply could change — exactly then it may be
+        prepared and dispatched before the previous solve's results land
+        (the device session carries the fit/balanced node state forward
+        on its own). Ports/spread/interpod occupancy, volume and DRA
+        context, and nominated-pod load are all rebuilt from the cache
+        each batch, so any of them forces the synchronous path."""
+        if self.nominated_pods or self._waiting:
+            return False
+        for p in pods:
+            if p.host_ports() or p.topology_spread_constraints or p.pvc_names:
+                return False
+            if p.affinity is not None and (
+                p.affinity.pod_affinity is not None
+                or p.affinity.pod_anti_affinity is not None
+            ):
+                return False
+            if self._dra and (
+                p.resource_claim_names or p.claim_templates_unresolved
+            ):
+                return False
+        if any(
+            info.pods_with_affinity
+            for info in self.cache.nodes.values()
+            if info.node is not None
+        ):
+            return False
+        if self.solver.config.spread_defaulting == "System":
+            services = self.cluster.list_services()
+            if services:
+                from .ops.oracle.spread import default_selector
+
+                if any(
+                    not p.topology_spread_constraints
+                    and default_selector(p, services) is not None
+                    for p in pods
+                ):
+                    return False
+        return True
+
+    def _discard_flight(self, flight: _InFlightSolve) -> None:
+        """Drop a stale (or salvaged) deferred solve. The pods retry at
+        the head of the active queue with no backoff (the failure is the
+        solve's, not theirs) — EXCEPT pods that were externally bound or
+        deleted mid-flight (often the very event that tripped the fence):
+        requeueing those would create ghost entries that churn forever
+        (review-caught). The device session's carried state counted the
+        discarded placements, so it is marked stale and re-uploads from
+        host truth once the pipeline has drained (a later solve may still
+        be chained on it)."""
+        self._session_stale = True
+        metrics.solves_discarded_total.inc()
+        with self.cluster.lock:
+            for info in flight.prep.infos:
+                self._in_flight.pop(info.key, None)
+                try:
+                    cur = self.cluster.get_pod(
+                        info.pod.namespace, info.pod.name
+                    )
+                except ApiError:
+                    continue  # deleted while the solve was in flight
+                if cur.node_name:
+                    continue  # bound externally while in flight
+                info.pod = cur
+                self.queue.requeue_popped(info)
+
+    def _apply_flight(self, flight: _InFlightSolve) -> BatchResult:
+        """Apply (or discard) a deferred solve and commit its bindings."""
+        res = BatchResult()
+        pending: list = []
+        prep = flight.prep
+        infos = prep.infos
+        if prep.fence == self._conflict_seq:  # cheap unlocked pre-check
+            applied = False
+            ta = time.perf_counter()
+            try:
+                # the fence is re-checked INSIDE _apply_group's locked
+                # region: a conflicting event can land during the device
+                # read (review-caught check-to-lock window)
+                applied = self._apply_group(
+                    flight, res, pending, fence=prep.fence
+                )
+                if applied:
+                    # host cost = this batch's own tensorize + apply
+                    # phases; wall-since-pop would charge the overlapped
+                    # batches' work and the hidden RTT to this batch
+                    # (review-caught)
+                    res.host_seconds = prep.tensorize_seconds + (
+                        time.perf_counter() - ta - flight.read_seconds
+                    )
+                    self._record_metrics(res, len(infos))
+            except Exception:
+                self._requeue_unhandled(infos, pending, res)
+                self._commit_all(infos, pending, res)
+                raise
+            if applied:
+                self._commit_all(infos, pending, res)
+                return res
+        self._discard_flight(flight)
+        return res
+
+    def run_pipelined(self, max_batches: int = 10_000) -> list[BatchResult]:
+        """Drain the queue with up to TWO solves in flight: batch k+1 is
+        tensorized and dispatched while batch k's assignments are still
+        crossing the device→host tunnel, so steady-state throughput pays
+        host work, not round trips (VERDICT r4 #1; the reference's
+        scheduleOne overlaps binding the same way —
+        schedule_one.go#scheduleOne's bind goroutine [U] — extended here
+        to the device boundary).
+
+        Safety: only _plain_batch batches overlap (their tensorization
+        reads nothing a previous apply writes; the device session carries
+        node fit state forward itself, so batch k+1's solve already sees
+        batch k's placements). Every dispatched solve is fenced on
+        _conflict_seq; a conflicting watch event between dispatch and
+        apply discards the solve, resets the device session, and requeues
+        the pods for an immediate retry. Batches that are not plain (or
+        arrive while pods wait at Permit) drain the pipeline and run the
+        synchronous cycle. Multi-profile, extender, and out-of-tree
+        plugin configurations fall back to run_until_settled entirely."""
+        can_pipeline = (
+            len(self.solvers) == 1
+            and not self.config.out_of_tree_plugins
+            and not self.extender_clients
+        )
+        if not can_pipeline:
+            return self.run_until_settled(max_batches)
+        profile = next(iter(self.solvers))
+        out: list[BatchResult] = []
+        flight: _InFlightSolve | None = None
+        nxt: _InFlightSolve | None = None
+
+        def apply_flight() -> None:
+            nonlocal flight
+            f, flight = flight, None
+            r = self._apply_flight(f)
+            if r.scheduled or r.unschedulable or r.bind_failures:
+                out.append(r)
+
+        batches = 0
+        try:
+            while batches < max_batches:
+                if self._waiting:
+                    if flight is not None:
+                        apply_flight()
+                    r = self.schedule_batch()
+                    batches += 1
+                    if not (
+                        r.scheduled or r.unschedulable or r.bind_failures
+                    ):
+                        break
+                    out.append(r)
+                    continue
+                t0 = time.perf_counter()
+                with self.cluster.lock:
+                    self.queue.flush_unschedulable_leftover()
+                    infos = self.queue.pop_batch(self.config.batch_size)
+                    base_cycle = self.queue.scheduling_cycle - len(infos)
+                    for i in infos:
+                        self._in_flight[i.key] = i
+                    plain = bool(infos) and self._plain_batch(
+                        [i.pod for i in infos]
+                    )
+                if not infos:
+                    if flight is not None:
+                        apply_flight()
+                        continue  # discards/failures may requeue work
+                    break
+                batches += 1
+                # ``owned``: popped but not yet handed to a cycle or a
+                # flight — an exception below must requeue exactly these
+                # (handing off clears it; review-caught leak)
+                owned: list | None = infos
+                try:
+                    if not plain:
+                        # this batch's tensorization must see every prior
+                        # assume: drain the pipeline, then run the
+                        # synchronous cycle body
+                        if flight is not None:
+                            apply_flight()
+                        owned = None
+                        r = self._run_popped(infos, t0)
+                        if (
+                            r.scheduled
+                            or r.unschedulable
+                            or r.bind_failures
+                        ):
+                            out.append(r)
+                        continue
+                    if self._session_stale and flight is not None:
+                        # last apply discarded a solve: drain the survivor
+                        # so the stale device carry re-uploads at dispatch
+                        apply_flight()
+                    prep = self._tensorize_group(
+                        profile, infos, list(range(len(infos))),
+                        base_cycle, t0,
+                    )
+                    if (
+                        flight is not None
+                        and prep.fence != flight.prep.fence
+                    ):
+                        # an event landed since the in-flight solve's
+                        # snapshot. The deferred heal (allow_heal=False)
+                        # is only conservative for USAGE columns — node
+                        # TABLES (allocatable/valid) can shrink, and a
+                        # solve against stale tables would carry THIS
+                        # prep's fresh fence and apply a capacity
+                        # violation (review-caught). Drain first: the
+                        # stale flight discards itself, and this dispatch
+                        # heals with current tables.
+                        apply_flight()
+                    try:
+                        nxt = self._dispatch_group(
+                            prep, defer=True, allow_heal=flight is None
+                        )
+                    except SessionDrainRequired:
+                        # node/vocab shape change with a solve still in
+                        # flight: apply it, then dispatch with healing
+                        apply_flight()
+                        nxt = self._dispatch_group(
+                            prep, defer=True, allow_heal=True
+                        )
+                    owned = None  # the batch now lives in nxt
+                except Exception:
+                    if owned is not None:
+                        with self.cluster.lock:
+                            base = self.queue.scheduling_cycle
+                            for info in owned:
+                                self._requeue(info, base)
+                    raise
+                if flight is not None:
+                    apply_flight()
+                flight, nxt = nxt, None
+            if flight is not None:
+                apply_flight()
+        finally:
+            # exception escape hatch: a dispatched-but-unapplied solve
+            # must not strand its pods in _in_flight nor leave the device
+            # session silently ahead of host truth (review-caught)
+            for f in (flight, nxt):
+                if f is not None:
+                    self._discard_flight(f)
         return out
 
     @property

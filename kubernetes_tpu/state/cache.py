@@ -160,6 +160,12 @@ class SchedulerCache:
         else:
             self._add_to_node(pod)
 
+    def pod_node(self, pod_key: str) -> str | None:
+        """Node the cache currently holds this assigned pod on (None if
+        unknown) — lets event handlers compare the cached object against
+        an incoming update without reaching into node internals."""
+        return self._pod_node.get(pod_key)
+
     def update_pod(self, pod: Pod) -> None:
         old_node = self._pod_node.get(pod.key)
         if old_node is None:

@@ -256,6 +256,21 @@ class PriorityQueue:
 
     # -- failure / retry paths --
 
+    def requeue_popped(self, info: QueuedPodInfo) -> None:
+        """Return a popped pod to the active queue as if the pop had not
+        happened: the attempt is uncharged and the original queue
+        timestamp keeps its PrioritySort/FIFO position. Used when a
+        dispatched device solve is DISCARDED by the pipelined loop's
+        fence (Scheduler.run_pipelined) — the failure is the solve's, not
+        the pod's, so no backoff applies. The PreEnqueue gate still runs
+        (_activate), matching every other path into the active heap."""
+        info.attempts = max(info.attempts - 1, 0)
+        self._info[info.key] = info
+        self._activate(info)
+        metrics.queue_incoming_pods_total.labels(
+            self._where[info.key], "SolveDiscarded"
+        ).inc()
+
     def add_unschedulable(self, info: QueuedPodInfo, pod_scheduling_cycle: int) -> None:
         """#AddUnschedulableIfNotPresent."""
         now = self._clock.now()
