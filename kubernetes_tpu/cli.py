@@ -142,6 +142,7 @@ def cmd_perf(args) -> int:
     sched_cfg.feature_gates = _feature_gates(args)
     runner = PerfRunner(sched_cfg)
     results = runner.run_file(args.workload, workload_filter=args.workload_name)
+    failed = 0
     for r in results:
         print(
             json.dumps(
@@ -151,11 +152,26 @@ def cmd_perf(args) -> int:
                     "scheduled": r.scheduled,
                     "unschedulable": r.unschedulable,
                     "throughput": r.throughput_summary(),
+                    "podLatency": r.latency_summary(),
                     "deviceSolveSeconds": round(r.solve_seconds, 3),
+                    **(
+                        {"threshold": r.threshold, "passed": r.passed}
+                        if r.threshold
+                        else {}
+                    ),
                 }
             )
         )
-    return 0
+        if not r.passed:
+            failed += 1
+            print(
+                f"FAIL: {r.test_case}/{r.workload}: avg "
+                f"{r.measured_pods / max(r.measure_seconds, 1e-9):.0f} "
+                f"pods/s below threshold {r.threshold:.0f}",
+                file=sys.stderr,
+            )
+    # scheduler_perf.go's threshold assert: a perf regression fails the run
+    return 1 if failed else 0
 
 
 def main(argv: list[str] | None = None) -> int:

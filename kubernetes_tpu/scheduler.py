@@ -112,6 +112,12 @@ class BatchResult:
     host_seconds: float = 0.0
     # per-pod schedule latency (pop -> bind committed), for the p99 metric
     latencies: list[float] = field(default_factory=list)
+    # per-pod end-to-end latency (first queue entry -> bind committed, on
+    # the scheduler clock) — the open-loop sustained benchmark's p99
+    e2e_latencies: list[float] = field(default_factory=list)
+    # perf_counter when this batch's bindings finished committing; lets
+    # throughput collectors sample pods/s across overlapped batches
+    completed_at: float = 0.0
 
 
 @dataclass
@@ -547,6 +553,7 @@ class Scheduler:
             raise
         finally:
             self._commit_all(infos, pending, res)
+            res.completed_at = time.perf_counter()
         return res
 
     def _requeue_unhandled(
@@ -1520,12 +1527,12 @@ class Scheduler:
         res.latencies.append(time.perf_counter() - t_start)
         # pod-level SLIs: attempts-to-success histogram and e2e latency
         # from first queue entry, labeled by attempt count
+        e2e = max(self.clock.now() - info.initial_attempt_timestamp, 0.0)
+        res.e2e_latencies.append(e2e)
         metrics.pod_scheduling_attempts.observe(info.attempts)
         metrics.pod_scheduling_sli_duration_seconds.labels(
             str(min(info.attempts, 16))
-        ).observe(
-            max(self.clock.now() - info.initial_attempt_timestamp, 0.0)
-        )
+        ).observe(e2e)
         for p in self.registry.post_bind:
             p.post_bind(state, pod, node_name)
         self._in_flight.pop(pod.key, None)
@@ -2008,8 +2015,10 @@ class Scheduler:
                 raise
             if applied:
                 self._commit_all(infos, pending, res)
+                res.completed_at = time.perf_counter()
                 return res
         self._discard_flight(flight)
+        res.completed_at = time.perf_counter()
         return res
 
     def run_pipelined(self, max_batches: int = 10_000) -> list[BatchResult]:

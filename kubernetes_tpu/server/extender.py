@@ -604,19 +604,28 @@ def make_app(core: ExtenderCore, scheduler=None, batch_window: float = 0.002):
                     progressed = False
                     if scheduler.pending:
                         try:
-                            res = await loop.run_in_executor(
-                                None, scheduler.schedule_batch
+                            # bounded double-buffered burst: overlaps each
+                            # batch's device read with the next batch's
+                            # tensorize/dispatch (Scheduler.run_pipelined),
+                            # then returns to the event loop so ingest
+                            # keeps flowing
+                            results = await loop.run_in_executor(
+                                None,
+                                lambda: scheduler.run_pipelined(
+                                    max_batches=64
+                                ),
                             )
                         except Exception:
-                            # a failed batch must not kill the drain loop —
+                            # a failed burst must not kill the drain loop —
                             # log and retry (pods stay queued)
-                            log.exception("schedule_batch failed")
+                            log.exception("pipelined drain burst failed")
                             await asyncio.sleep(1.0)
                             continue
-                        progressed = bool(
-                            res.scheduled
-                            or res.unschedulable
-                            or res.bind_failures
+                        progressed = any(
+                            r.scheduled
+                            or r.unschedulable
+                            or r.bind_failures
+                            for r in results
                         )
                     if not progressed:
                         # pending may count backoff/unschedulable pods the
