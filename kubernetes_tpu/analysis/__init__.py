@@ -1,0 +1,117 @@
+"""kubernetes_tpu.analysis — tracer-safety & lock-discipline analyzer.
+
+A self-contained AST static analyzer (stdlib only) for the two bug
+classes the batched scheduler cannot afford: accidental host<->device
+syncs on the solve hot path (TPU001/TPU002/TPU003) and undisciplined
+access to the shared mutable state the pipelined loop threads through
+watch ingest (LOCK001), plus metric-name drift (MET001).
+
+Usage::
+
+    python -m kubernetes_tpu.analysis [--json] [paths...]
+    findings = analysis.run_paths(["kubernetes_tpu/"])
+
+Annotations and rule semantics: analysis/README.md. The in-process
+pytest gate is tests/test_static_analysis.py.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .core import (
+    AnalysisContext,
+    Finding,
+    Pass,
+    SourceModule,
+    apply_suppressions,
+    suppression_findings,
+)
+from .passes import ALL_PASSES
+from .registry import default_context
+
+__all__ = [
+    "ALL_PASSES",
+    "AnalysisContext",
+    "Finding",
+    "Pass",
+    "SourceModule",
+    "analyze_module",
+    "default_context",
+    "run_paths",
+]
+
+
+def analyze_module(
+    module: SourceModule,
+    ctx: AnalysisContext | None = None,
+    passes=None,
+) -> list[Finding]:
+    """Run the pass set over one parsed module, apply suppressions, and
+    enforce the reason requirement (KTPU000)."""
+    ctx = ctx or default_context()
+    findings: list[Finding] = []
+    for cls in passes or ALL_PASSES:
+        findings.extend(cls().run(module, ctx))
+    apply_suppressions(module, findings)
+    findings.extend(suppression_findings(module))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def analyze_source(
+    source: str,
+    filename: str = "snippet.py",
+    ctx: AnalysisContext | None = None,
+    passes=None,
+) -> list[Finding]:
+    """Fixture-test entry point: analyze an in-memory snippet."""
+    return analyze_module(
+        SourceModule.parse(filename, source=source), ctx=ctx, passes=passes
+    )
+
+
+def collect_files(paths) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.is_file() and p.suffix == ".py":
+            files.append(p)
+        else:
+            # a typo'd path silently scanning nothing would leave a CI
+            # gate permanently green (review-caught) — fail loudly
+            raise FileNotFoundError(
+                f"{p}: not a directory or .py file — nothing to analyze"
+            )
+    return files
+
+
+def run_paths(
+    paths=None,
+    ctx: AnalysisContext | None = None,
+    passes=None,
+) -> list[Finding]:
+    """Analyze files/directories (default: the kubernetes_tpu package
+    this module ships in). Returns ALL findings; callers filter on
+    ``suppressed`` for gating."""
+    if not paths:
+        paths = [Path(__file__).resolve().parents[1]]
+    ctx = ctx or default_context()
+    findings: list[Finding] = []
+    for f in collect_files(paths):
+        try:
+            module = SourceModule.parse(f)
+        except SyntaxError as e:
+            findings.append(
+                Finding(
+                    rule="KTPU001",
+                    path=str(f),
+                    line=e.lineno or 0,
+                    message=f"syntax error: {e.msg}",
+                )
+            )
+            continue
+        findings.extend(analyze_module(module, ctx=ctx, passes=passes))
+    return findings

@@ -1,0 +1,58 @@
+"""CLI: ``python -m kubernetes_tpu.analysis [--json] [paths...]``.
+
+Exit status 0 when every finding is suppressed (with a reason), 1
+otherwise — scripts/lint.py and the tier-1 gate both key on this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import ALL_PASSES, run_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.analysis",
+        description="Tracer-safety & lock-discipline static analyzer.",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories (default: the kubernetes_tpu package)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings (including suppressed) as a JSON array",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed findings in text mode",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        findings = run_paths(args.paths or None)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.as_json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        shown = findings if args.show_suppressed else active
+        for f in shown:
+            print(f.render())
+        rules = ", ".join(c.rule for c in ALL_PASSES)
+        print(
+            f"{len(active)} finding(s), {len(suppressed)} suppressed "
+            f"(passes: {rules})"
+        )
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""The analyzer's rule set. Each module holds one pass; ALL_PASSES is
+the shipped order (cheap scoping passes first, cross-file MET001 last).
+"""
+
+from __future__ import annotations
+
+from .hostsync import HostSyncPass
+from .tracedbranch import TracedBranchPass
+from .dtypes import DtypeDisciplinePass
+from .locks import LockDisciplinePass
+from .metricnames import MetricNamePass
+
+ALL_PASSES = (
+    HostSyncPass,
+    TracedBranchPass,
+    DtypeDisciplinePass,
+    LockDisciplinePass,
+    MetricNamePass,
+)
+
+__all__ = [
+    "ALL_PASSES",
+    "HostSyncPass",
+    "TracedBranchPass",
+    "DtypeDisciplinePass",
+    "LockDisciplinePass",
+    "MetricNamePass",
+]

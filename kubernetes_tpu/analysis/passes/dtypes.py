@@ -1,0 +1,69 @@
+"""TPU003 — dtype discipline in solver/ops tensor constructors.
+
+``jnp.array([True])`` / ``jnp.zeros(n)`` / ``jnp.full(n, 0.5)`` without
+an explicit dtype take jax's weak-type defaults: the array's dtype then
+depends on x64 mode and on the literal's Python type, which silently
+forks the jit cache (same shapes, different dtypes -> recompile) and
+upcasts int64 node tables through float64 intermediates. Under ``ops/``
+and ``solver/`` every constructor names its dtype; a float literal
+without one is called out specifically (the classic weak-float leak).
+
+Positional dtypes count (``jnp.zeros(n, jnp.int32)``), as does
+``dtype=``; ``jnp.zeros_like``/``astype`` are inherently typed and out
+of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Pass
+
+# constructor -> index of the positional dtype slot
+_CONSTRUCTORS = {"array": 1, "zeros": 1, "ones": 1, "full": 2}
+
+
+def _has_float_literal(expr: ast.expr) -> bool:
+    return any(
+        isinstance(n, ast.Constant) and isinstance(n.value, float)
+        for n in ast.walk(expr)
+    )
+
+
+class DtypeDisciplinePass(Pass):
+    rule = "TPU003"
+    title = "missing explicit dtype"
+
+    def run(self, module, ctx):
+        if not any(module.rel.startswith(p) for p in ctx.dtype_paths):
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "jnp"
+                and f.attr in _CONSTRUCTORS
+            ):
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            if len(node.args) > _CONSTRUCTORS[f.attr]:
+                continue  # positional dtype
+            detail = (
+                "a bare float literal rides the weak-type default"
+                if any(_has_float_literal(a) for a in node.args)
+                else "dtype falls to the weak-type default"
+            )
+            findings.append(
+                Finding(
+                    self.rule, module.path, node.lineno,
+                    f"jnp.{f.attr}(...) without explicit dtype ({detail})",
+                    hint="pass dtype= (e.g. jnp.int64/jnp.bool_) so the "
+                    "jit cache keys stay stable across x64 modes",
+                )
+            )
+        return findings

@@ -1,0 +1,68 @@
+"""TPU002 — Python control flow on traced/device values.
+
+Inside jit-traced code, a Python ``if``/``while`` whose condition is a
+``jnp`` expression either raises a ConcretizationTypeError at trace
+time or — worse, via implicit ``bool()`` on platforms that allow it —
+silently syncs and bakes the branch for the traced shape. In *hot*
+(host-side) scope the same shape is an implicit device->host sync on
+every call — the exact per-batch round trip the pipelined loop exists
+to hide. Branching on *static* Python arguments is fine and common
+(the solver's ``static_argnames`` dispatch), so this pass only flags
+conditions that syntactically contain a ``jnp.``-rooted expression;
+name-typed data flow is out of scope (documented precision bound,
+analysis/README.md).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import own_nodes, scoped_graph
+from ..core import Finding, Pass
+
+_TRACED_BASES = {"jnp", "lax"}
+
+
+def _jnp_rooted(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in _TRACED_BASES
+        ):
+            return True
+    return False
+
+
+class TracedBranchPass(Pass):
+    rule = "TPU002"
+    title = "Python branch on traced value"
+
+    def run(self, module, ctx):
+        graph, traced, hot = scoped_graph(module, ctx)
+        findings: list[Finding] = []
+        for qual in sorted(traced | hot):
+            info = graph.functions.get(qual)
+            if info is None:
+                continue
+            in_traced = qual in traced
+            for node in own_nodes(info.node):
+                if isinstance(node, (ast.If, ast.While)) and _jnp_rooted(
+                    node.test
+                ):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    what = (
+                        f"Python '{kind}' on a jnp expression in "
+                        f"jit-traced function '{qual}'"
+                        if in_traced
+                        else f"Python '{kind}' on a jnp expression in "
+                        f"hot-path function '{qual}' syncs per call"
+                    )
+                    findings.append(
+                        Finding(
+                            self.rule, module.path, node.lineno, what,
+                            hint="use jnp.where / lax.cond / lax.while_loop"
+                            " (or hoist the decision to a static arg)",
+                        )
+                    )
+        return findings

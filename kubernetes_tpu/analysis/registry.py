@@ -1,0 +1,50 @@
+"""Shipped analyzer configuration: the audited whitelists and path
+scopes for the kubernetes_tpu package.
+
+SANCTIONED_SYNC_POINTS is the contract at the heart of the pipelined
+solver (BENCH_r05: ~104 ms per host<->device sync post-first-read): the
+hot path may read device values through EXACTLY these two points —
+
+- ``DeferredAssignments.get`` (solver/exact.py): the deferred
+  assignment download whose async D2H copy was started at dispatch, so
+  the blocking read lands after the tunnel RTT has been overlapped.
+- ``_InFlightSolve.assignments`` (scheduler.py): the scheduler-side
+  wrapper the apply path calls once per batch.
+
+Adding a third entry is a design decision, not a lint tweak: it must
+come with the same overlap analysis those two carry.
+"""
+
+from __future__ import annotations
+
+from .core import AnalysisContext
+
+SANCTIONED_SYNC_POINTS = frozenset(
+    {
+        ("kubernetes_tpu/solver/exact.py", "DeferredAssignments.get"),
+        ("kubernetes_tpu/scheduler.py", "_InFlightSolve.assignments"),
+    }
+)
+
+# TPU003 dtype discipline applies where tensors feed the solve pipeline
+# (a weakly-typed float literal silently re-specializes the jit cache).
+DTYPE_PATHS = (
+    "kubernetes_tpu/ops/",
+    "kubernetes_tpu/solver/",
+)
+
+# MET001 scans these for metric usage against metrics/__init__.py.
+METRIC_SCAN_PATHS = (
+    "kubernetes_tpu/scheduler.py",
+    "kubernetes_tpu/server/",
+    "kubernetes_tpu/solver/",
+)
+
+
+def default_context() -> AnalysisContext:
+    return AnalysisContext(
+        sanctioned_sync=SANCTIONED_SYNC_POINTS,
+        dtype_paths=DTYPE_PATHS,
+        metric_scan_paths=METRIC_SCAN_PATHS,
+        metric_attrs=None,  # resolved lazily from kubernetes_tpu/metrics
+    )

@@ -124,6 +124,13 @@ solves_discarded_total = Counter(
     "apply); the batch's pods retry immediately without backoff.",
     registry=REGISTRY,
 )
+pipeline_fallback_total = Counter(
+    "scheduler_pipeline_fallback_total",
+    "Times the pipelined loop fell back to a synchronous (fence-free) "
+    "cycle after consecutive fence discards — the livelock backstop "
+    "under sustained capacity/mask-affecting event churn.",
+    registry=REGISTRY,
+)
 extender_batch_size = Histogram(
     "scheduler_tpu_extender_batch_size",
     "Webhook requests coalesced per device evaluation (micro-batching).",

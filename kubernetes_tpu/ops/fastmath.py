@@ -24,6 +24,7 @@ import jax.numpy as jnp
 _STEPS = 4
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def floor_div_exact(num, den):
     """floor(num / den) for num >= 0, den >= 1 (int32/int64 arrays or
     scalars; shapes broadcast). Exact for quotients below 2^23.

@@ -19,6 +19,7 @@ MAX_NODE_SCORE = 100
 INF = jnp.int32(2**30)
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def domain_counts(dom, cnt, d_pad: int, ident: bool = False):
     """dom, cnt: [T, N] -> (per-node domain totals [T, N], has_key [T, N]).
 
@@ -45,6 +46,7 @@ def domain_counts(dom, cnt, d_pad: int, ident: bool = False):
     return node_counts, hk
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def filter_and_score(
     ipa, in_cnt, ex_cnt, cls, x, d_pad: int, node_valid,
     ident: bool = False, score: bool = True,
@@ -114,6 +116,7 @@ def filter_and_score(
     return allowed, raw
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def normalize(raw, mask):
     """scoring.go#NormalizeScore: 100*(s-min)/(max-min) over the feasible
     set; all-equal -> 0."""

@@ -31,6 +31,7 @@ from .fastmath import floor_div_exact
 MAX_NODE_SCORE = 100
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def fit_mask(
     req: jax.Array,  # [K] int
     req_mask: jax.Array,  # [K] bool — resources the pod requests (>0)
@@ -55,6 +56,7 @@ def scoring_requested(
     return nonzero_used + nonzero_req[:, None]
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def least_allocated_score(
     requested: jax.Array,  # [R, N] int — per scoring resource
     alloc: jax.Array,  # [R, N] int
@@ -80,6 +82,7 @@ def least_allocated_score(
     )
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def most_allocated_score(
     requested: jax.Array, alloc: jax.Array, weights: jax.Array,
     div=floor_div_exact,
@@ -96,6 +99,7 @@ def most_allocated_score(
     )
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def rtc_score(
     requested: jax.Array,  # [R, N] int
     alloc: jax.Array,  # [R, N] int
@@ -136,6 +140,7 @@ def rtc_score(
     )
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def balanced_allocation_score(
     requested: jax.Array,  # [R, N] int — scoring resources (default cpu, mem)
     alloc: jax.Array,  # [R, N] int

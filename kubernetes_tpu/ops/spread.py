@@ -42,6 +42,7 @@ def _domain_aggregate(dom_row, elig_row, cnt_row, d_pad: int):
     return node_cnt, n_dom, min_match, hk
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def hard_violations(spr, cnt, cls, d_pad: int):
     """[N] bool — any hard spread constraint of class ``cls`` violated.
 
@@ -66,6 +67,7 @@ def hard_violations(spr, cnt, cls, d_pad: int):
     return viol
 
 
+# traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def soft_scores(spr, cnt, cls, mask, d_pad: int, fdtype=jnp.float32):
     """[N] int32 — normalized 0-100 PodTopologySpread score over the
     feasible set ``mask`` (scoring.go#Score + #NormalizeScore).

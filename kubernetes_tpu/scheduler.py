@@ -167,6 +167,8 @@ class _InFlightSolve:
     dispatch_seconds: float
     read_seconds: float = 0.0  # blocking device-read wait (set at apply)
 
+    # sanctioned deferred-read point (analysis/registry.py) — the ONE
+    # place the apply path may block on the device: ktpu: hot
     def assignments(self) -> np.ndarray:
         if isinstance(self.handle, DeferredAssignments):
             return self.handle.get()
@@ -174,6 +176,11 @@ class _InFlightSolve:
 
 
 class Scheduler:
+    # consecutive fence discards before run_pipelined falls back to one
+    # synchronous (fence-free) cycle — the pipelined loop's livelock
+    # backstop under sustained capacity/mask event churn (ADVICE r5 #2)
+    _PIPELINE_FALLBACK_AFTER = 3
+
     def __init__(
         self,
         cluster: ClusterState,
@@ -225,17 +232,23 @@ class Scheduler:
         # window means a MODIFIED watch event can arrive for a pod that is
         # neither queued nor waiting — without this map queue.update would
         # re-add it and double-schedule (review-caught)
-        self._in_flight: dict[str, QueuedPodInfo] = {}
+        self._in_flight: dict[str, QueuedPodInfo] = {}  # ktpu: guarded-by(cluster.lock)
         # fence for the double-buffered loop (run_pipelined): bumped by any
         # watch event that could invalidate a dispatched-but-unapplied
         # solve (node capacity/mask changes, external pod placements). A
         # deferred solve whose fence no longer matches is discarded.
-        self._conflict_seq = 0
+        self._conflict_seq = 0  # ktpu: guarded-by(cluster.lock)
         # set when a deferred solve was discarded: the device session's
         # carried state counted the discarded placements and must be
         # re-uploaded from host truth before the next dispatch (done at
         # _dispatch_group once no other solve is in flight)
-        self._session_stale = False
+        self._session_stale = False  # ktpu: guarded-by(cluster.lock)
+        # consecutive fence discards with no successful apply (driver
+        # thread only — never touched by watch ingest): once it reaches
+        # _PIPELINE_FALLBACK_AFTER, run_pipelined falls back to one
+        # synchronous cycle so sustained event churn cannot livelock the
+        # pipelined loop (ADVICE r5 #2)
+        self._discard_streak = 0
         self.snapshot = Snapshot()
         from .state.volume_binder import VolumeBinder
 
@@ -283,6 +296,9 @@ class Scheduler:
 
     # -- eventhandlers.go#addAllEventHandlers routing --
 
+    # ClusterState fires watch callbacks under its lock (every public
+    # mutator takes it before _emit), so this handler always holds it:
+    # ktpu: holds(cluster.lock)
     def _on_event(self, ev: Event) -> None:
         if ev.kind == "Event":
             return  # the scheduler's own recorder output
@@ -498,6 +514,8 @@ class Scheduler:
                 return self._schedule_cycle()
         return self._schedule_cycle()
 
+    # every caller requeues inside its locked region (watch events must
+    # not interleave with the bookkeeping): ktpu: holds(cluster.lock)
     def _requeue(self, info: QueuedPodInfo, cycle: int) -> None:
         """AddUnschedulableIfNotPresent + in-flight bookkeeping: once a
         pod re-enters the queue, watch events must route to queue.update
@@ -598,10 +616,14 @@ class Scheduler:
             metrics.framework_extension_point_duration_seconds.labels(
                 "Bind", "Success" if ok else "Error", "all"
             ).observe(time.perf_counter() - tb)
-        for info in infos:
-            self._in_flight.pop(info.key, None)
-        for entry in pending:
-            self._in_flight.pop(entry[1].key, None)
+        # LOCK001 (pre-analyzer gap): these pops ran unlocked, racing the
+        # watch handler's in-flight refresh (_on_event could KeyError-skip
+        # or resurrect an entry mid-pop on the ingest thread)
+        with self.cluster.lock:
+            for info in infos:
+                self._in_flight.pop(info.key, None)
+            for entry in pending:
+                self._in_flight.pop(entry[1].key, None)
         if first_err is not None:
             raise first_err
 
@@ -1034,12 +1056,17 @@ class Scheduler:
         ``allow_heal=False`` defers dirty-column healing while an
         earlier solve is still unapplied (see _DeviceSession.sync)."""
         solver = self.solvers[prep.profile]
-        if self._session_stale and allow_heal:
+        with self.cluster.lock:
+            heal_stale = self._session_stale and allow_heal
+            if heal_stale:
+                self._session_stale = False
+        if heal_stale:
             # a discarded solve polluted the device carry; with no other
             # solve in flight (allow_heal implies the pipeline drained),
-            # re-upload from host truth before dispatching
+            # re-upload from host truth before dispatching. The flag is
+            # cleared under the lock, the device reset runs outside it
+            # (only the drain thread resets sessions)
             solver.reset_session()
-            self._session_stale = False
         t1 = time.perf_counter()
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
@@ -1123,6 +1150,7 @@ class Scheduler:
             # request vector share the diagnosis.
             fit_oracle = None
             fiterr_memo: dict[tuple, str] = {}
+            # ktpu: ignore[TPU001]: static.class_of is a host-resident numpy table from tensorize — no device transfer happens here
             class_of_host = np.asarray(static.class_of)
             fe_nodes = sum(1 for n in slot_nodes if n is not None)
             fe_generic = (
@@ -1535,9 +1563,11 @@ class Scheduler:
         ).observe(e2e)
         for p in self.registry.post_bind:
             p.post_bind(state, pod, node_name)
-        self._in_flight.pop(pod.key, None)
+        with self.cluster.lock:
+            self._in_flight.pop(pod.key, None)
         return True
 
+    # called only from _schedule_cycle's locked region: ktpu: holds(cluster.lock)
     def _process_waiting(self, res: BatchResult, pending: list) -> None:
         """Settle WaitingPods (the batched WaitOnPermit): rejected or
         timed-out pods unreserve and requeue; fully-allowed pods complete
@@ -1968,9 +1998,10 @@ class Scheduler:
         discarded placements, so it is marked stale and re-uploads from
         host truth once the pipeline has drained (a later solve may still
         be chained on it)."""
-        self._session_stale = True
         metrics.solves_discarded_total.inc()
+        self._discard_streak += 1
         with self.cluster.lock:
+            self._session_stale = True
             for info in flight.prep.infos:
                 self._in_flight.pop(info.key, None)
                 try:
@@ -1984,13 +2015,16 @@ class Scheduler:
                 info.pod = cur
                 self.queue.requeue_popped(info)
 
+    # per-batch apply path: device reads only through the sanctioned
+    # _InFlightSolve.assignments boundary: ktpu: hot
     def _apply_flight(self, flight: _InFlightSolve) -> BatchResult:
         """Apply (or discard) a deferred solve and commit its bindings."""
         res = BatchResult()
         pending: list = []
         prep = flight.prep
         infos = prep.infos
-        if prep.fence == self._conflict_seq:  # cheap unlocked pre-check
+        # ktpu: ignore[LOCK001]: deliberately unlocked pre-check — a torn read can only misroute to the locked re-check inside _apply_group or to a discard, both safe
+        if prep.fence == self._conflict_seq:
             applied = False
             ta = time.perf_counter()
             try:
@@ -2010,10 +2044,19 @@ class Scheduler:
                     )
                     self._record_metrics(res, len(infos))
             except Exception:
+                # the fence matched, so _apply_group may have read the
+                # device assignments before dying: the session's carried
+                # state counts this batch's placements, but the requeued
+                # pods never bound. Mark the carry stale so the next
+                # dispatch re-uploads from host truth instead of counting
+                # phantom placements against future solves (ADVICE r5 #3)
+                with self.cluster.lock:
+                    self._session_stale = True
                 self._requeue_unhandled(infos, pending, res)
                 self._commit_all(infos, pending, res)
                 raise
             if applied:
+                self._discard_streak = 0  # forward progress: reset backstop
                 self._commit_all(infos, pending, res)
                 res.completed_at = time.perf_counter()
                 return res
@@ -2039,7 +2082,13 @@ class Scheduler:
         the pods for an immediate retry. Batches that are not plain (or
         arrive while pods wait at Permit) drain the pipeline and run the
         synchronous cycle. Multi-profile, extender, and out-of-tree
-        plugin configurations fall back to run_until_settled entirely."""
+        plugin configurations fall back to run_until_settled entirely.
+
+        Livelock backstop (ADVICE r5 #2): _PIPELINE_FALLBACK_AFTER
+        consecutive fence discards force one synchronous (fence-free)
+        cycle — counted by scheduler_pipeline_fallback_total — so
+        sustained capacity/mask event churn degrades to the synchronous
+        path's throughput instead of zero forward progress."""
         can_pipeline = (
             len(self.solvers) == 1
             and not self.config.out_of_tree_plugins
@@ -2089,6 +2138,21 @@ class Scheduler:
                         continue  # discards/failures may requeue work
                     break
                 batches += 1
+                fallback = (
+                    self._discard_streak >= self._PIPELINE_FALLBACK_AFTER
+                )
+                if fallback and plain:
+                    # livelock backstop (ADVICE r5 #2): N consecutive
+                    # fence discards mean conflicting events are landing
+                    # faster than one per dispatch→apply window, and the
+                    # fenced pipeline can requeue forever with zero
+                    # forward progress. One synchronous cycle applies
+                    # WITHOUT a fence (accepting the same solve-window
+                    # staleness the reference's binding goroutines do),
+                    # guaranteeing at least one batch lands per N
+                    # discards under sustained churn.
+                    metrics.pipeline_fallback_total.inc()
+                    plain = False
                 # ``owned``: popped but not yet handed to a cycle or a
                 # flight — an exception below must requeue exactly these
                 # (handing off clears it; review-caught leak)
@@ -2102,6 +2166,9 @@ class Scheduler:
                             apply_flight()
                         owned = None
                         r = self._run_popped(infos, t0)
+                        # the synchronous cycle applied (no fence): the
+                        # backstop counter restarts from real progress
+                        self._discard_streak = 0
                         if (
                             r.scheduled
                             or r.unschedulable
@@ -2109,7 +2176,9 @@ class Scheduler:
                         ):
                             out.append(r)
                         continue
-                    if self._session_stale and flight is not None:
+                    with self.cluster.lock:
+                        stale = self._session_stale
+                    if stale and flight is not None:
                         # last apply discarded a solve: drain the survivor
                         # so the stale device carry re-uploads at dispatch
                         apply_flight()

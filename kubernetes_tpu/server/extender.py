@@ -118,6 +118,7 @@ class ExtenderCore:
         pods_by_node = self._pods_by_node()
         return FullOracle(make_oracle_nodes(nodes, pods_by_node))
 
+    # per-webhook-batch device evaluation path: ktpu: hot
     def _score_rows(
         self, pods: Sequence[Pod], nodes: list[Node]
     ) -> np.ndarray:

@@ -163,7 +163,9 @@ def _fit_scorer(scoring_strategy, rtc_shape):
     kernels' default float-estimate exact division wins
     (ops/fastmath.py)."""
     if scoring_strategy == "RequestedToCapacityRatio" and rtc_shape:
+        # ktpu: ignore[TPU001]: rtc_shape is a static argname, coerced once at trace time on Python ints
         sx = jnp.asarray([int(p[0]) for p in rtc_shape], dtype=jnp.int64)
+        # ktpu: ignore[TPU001]: rtc_shape is a static argname, coerced once at trace time on Python ints
         sy = jnp.asarray([int(p[1]) for p in rtc_shape], dtype=jnp.int64)
         return lambda requested, alloc, w: nr.rtc_score(
             requested, alloc, w, sx, sy
@@ -1217,6 +1219,8 @@ class DeferredAssignments:
         except Exception:
             pass  # platform without async D2H: get() falls back to a sync read
 
+    # sanctioned deferred-read point (analysis/registry.py) — the async
+    # D2H copy started in __init__ makes this read post-overlap: ktpu: hot
     def get(self) -> np.ndarray:
         return np.asarray(self._dev)[: self._num_pods]
 

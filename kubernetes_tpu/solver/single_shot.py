@@ -160,7 +160,7 @@ def _single_shot(
         order_rc = jnp.argsort(key)
         rc_sorted = rc_of[order_rc]
         seg_start_rc = jnp.concatenate(
-            [jnp.array([True]), rc_sorted[1:] != rc_sorted[:-1]]
+            [jnp.array([True], dtype=jnp.bool_), rc_sorted[1:] != rc_sorted[:-1]]
         )
         seg_id_rc = _cumsum0(seg_start_rc.astype(jnp.int32)) - 1
         rank_sorted = (
@@ -192,7 +192,7 @@ def _single_shot(
         )  # [P, K]
 
         seg_start = jnp.concatenate(
-            [jnp.array([True]), t_sorted[1:] != t_sorted[:-1]]
+            [jnp.array([True], dtype=jnp.bool_), t_sorted[1:] != t_sorted[:-1]]
         )
         seg_id = _cumsum0(seg_start.astype(jnp.int32)) - 1
         prefix = _segmented_prefix(req_sorted, seg_start, seg_id, p)

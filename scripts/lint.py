@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Repo lint gate: run the tracer-safety & lock-discipline analyzer.
+
+Thin wrapper over ``python -m kubernetes_tpu.analysis`` so CI and
+pre-commit hooks have one entry point; exits non-zero on any
+unsuppressed finding. Extra arguments pass through (e.g. ``--json``,
+or specific paths to scan).
+
+    python scripts/lint.py
+    python scripts/lint.py --json kubernetes_tpu/scheduler.py
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from kubernetes_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -5,8 +5,7 @@ scaled by 100)."""
 
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings
-from hypothesis import strategies as st
+from _hypothesis_compat import given, settings, st
 
 from kubernetes_tpu.ops.fastmath import floor_div_exact
 

@@ -122,8 +122,7 @@ def _oracle_validate(nodes, pods, assignments, nbatch):
     assert not errors, "\n".join(errors[:5])
 
 
-from hypothesis import given, settings
-from hypothesis import strategies as st
+from _hypothesis_compat import given, settings, st
 
 
 @settings(max_examples=8, deadline=None)

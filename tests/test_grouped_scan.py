@@ -391,8 +391,7 @@ def test_compact_wire_falls_back_on_mixed_rows():
 
 # -- hypothesis property: compact wire ≡ full upload ------------------------
 
-from hypothesis import given, settings
-from hypothesis import strategies as st
+from _hypothesis_compat import given, settings, st
 
 
 @settings(max_examples=12, deadline=None)

@@ -8,7 +8,7 @@ adversarial pod/node populations through both implementations.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+from _hypothesis_compat import given, settings, st
 
 import jax.numpy as jnp
 

@@ -11,8 +11,7 @@ validated via the oracle replay.
 """
 
 import numpy as np
-from hypothesis import given, settings
-from hypothesis import strategies as st
+from _hypothesis_compat import given, settings, st
 
 from kubernetes_tpu.api.wrappers import MakeNode, MakePod
 from kubernetes_tpu.ops.oracle.profile import FullOracle, make_oracle_nodes

@@ -30,7 +30,7 @@ ZONE = "topology.kubernetes.io/zone"
 HOST = "kubernetes.io/hostname"
 
 
-def build(n_nodes, cpu="8", batch=64, group=16, n_pods=0, pod_cpu="500m"):
+def build(n_nodes, cpu="8", batch=64, group=16, n_pods=0, pod_cpu="500m", clock=None):
     cs = ClusterState()
     for i in range(n_nodes):
         cs.create_node(
@@ -46,6 +46,7 @@ def build(n_nodes, cpu="8", batch=64, group=16, n_pods=0, pod_cpu="500m"):
             batch_size=batch,
             solver=ExactSolverConfig(tie_break="first", group_size=group),
         ),
+        clock=clock,
     )
     for i in range(n_pods):
         cs.create_pod(
@@ -373,6 +374,80 @@ def test_discard_skips_externally_bound_and_deleted_pods():
     s.run_until_settled()
     placed = {p.name: p.node_name for p in cs.list_pods() if p.node_name}
     assert set(placed) == {"p0000", "p0002", "p0003"}
+
+
+def test_discard_storm_backstop_makes_progress():
+    """Livelock backstop (ADVICE r5 #2): a capacity-bumping watch event
+    landing in EVERY dispatch→apply window discards every fenced solve;
+    after _PIPELINE_FALLBACK_AFTER consecutive discards the loop must
+    fall back to one synchronous (fence-free) cycle and land the batch
+    anyway."""
+    cs, s = build(2, batch=4, n_pods=12)
+    fallbacks_before = metrics.pipeline_fallback_total._value.get()
+    cpu = [16]
+    real_dispatch = s._dispatch_group
+
+    def churny_dispatch(prep, defer, allow_heal=True):
+        flight = real_dispatch(prep, defer, allow_heal)
+        # a node-capacity grow event lands while the solve is in flight:
+        # _node_change_could_help -> fence bump -> the apply discards
+        cpu[0] += 1
+        node = cs.get_node("n000")
+        grown = (
+            MakeNode()
+            .name("n000")
+            .capacity({"cpu": str(cpu[0]), "memory": "32Gi", "pods": "110"})
+            .label(HOST, "n000")
+            .obj()
+        )
+        grown.resource_version = node.resource_version
+        cs.update_node(grown)
+        return flight
+
+    s._dispatch_group = churny_dispatch
+    results = s.run_pipelined(max_batches=200)
+    assert sum(len(r.scheduled) for r in results) == 12
+    assert all(p.node_name for p in cs.list_pods())
+    assert metrics.pipeline_fallback_total._value.get() > fallbacks_before
+    # the storm really was a storm: fenced solves did get discarded
+    assert s._discard_streak == 0 or len(s.queue) == 0
+
+
+def test_apply_exception_marks_session_stale_and_heals():
+    """ADVICE r5 #3: an exception on the apply path (after the fence
+    matched) must mark the device session stale — its carried state
+    counted this batch's placements, but the pods were requeued. The
+    next dispatch re-uploads from host truth and schedules them all."""
+    import pytest
+
+    from kubernetes_tpu.solver.exact import DeferredAssignments
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    cs, s = build(2, n_pods=6, clock=clock)
+    flight = _manual_flight(s, 6)
+
+    class Boom(DeferredAssignments):
+        def __init__(self):  # no device handle; the read itself dies
+            pass
+
+        def get(self):
+            raise RuntimeError("device read failed")
+
+    flight.handle = Boom()
+    with pytest.raises(RuntimeError, match="device read failed"):
+        s._apply_flight(flight)
+    assert s._session_stale  # carry no longer trusted
+    assert len(s.queue) == 6  # every pod requeued, none stranded
+    assert not s._in_flight  # bookkeeping torn down
+    # the exception path parks the pods unschedulable (the failure was
+    # charged to their attempt); no watch event arrives to wake them, so
+    # step past the 5-min leftover flush — then the drain heals: the
+    # stale session re-uploads from host truth and everything fits
+    clock.advance(301.0)
+    s.run_until_settled()
+    assert all(p.node_name for p in cs.list_pods())
+    assert not s._session_stale
 
 
 def test_requeue_popped_uncharges_attempt():

@@ -100,6 +100,9 @@ class TestCanonical:
 
 class TestHypothesis:
     def test_milli_value_ceiling_property(self):
+        import pytest
+
+        pytest.importorskip("hypothesis")
         from hypothesis import given, strategies as st
 
         @given(st.integers(min_value=0, max_value=10**12))
@@ -112,6 +115,9 @@ class TestHypothesis:
         check()
 
     def test_value_vs_int_strings(self):
+        import pytest
+
+        pytest.importorskip("hypothesis")
         from hypothesis import given, strategies as st
 
         @given(st.integers(min_value=0, max_value=2**62))

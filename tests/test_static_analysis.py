@@ -1,0 +1,492 @@
+"""Gate + fixture tests for kubernetes_tpu.analysis.
+
+The gate runs the analyzer in-process over the whole package and fails
+on ANY unsuppressed finding — the tier-1 equivalent of scripts/lint.py.
+The fixture tests prove each rule actually fires on a known-bad snippet
+(a rule that never fires gates nothing), including LOCK001 catching the
+pre-fix ``_apply_flight`` exception-path pattern it was built for.
+"""
+
+import textwrap
+
+from kubernetes_tpu import analysis
+from kubernetes_tpu.analysis import AnalysisContext, analyze_source
+from kubernetes_tpu.analysis.passes import (
+    DtypeDisciplinePass,
+    HostSyncPass,
+    LockDisciplinePass,
+    MetricNamePass,
+    TracedBranchPass,
+)
+
+
+def findings_for(source, passes, ctx=None, filename="snippet.py"):
+    return analyze_source(
+        textwrap.dedent(source), filename=filename, ctx=ctx, passes=passes
+    )
+
+
+def active(findings, rule=None):
+    return [
+        f
+        for f in findings
+        if not f.suppressed and (rule is None or f.rule == rule)
+    ]
+
+
+# -- the gate ---------------------------------------------------------------
+
+
+def test_package_has_zero_unsuppressed_findings():
+    """python -m kubernetes_tpu.analysis kubernetes_tpu/ must exit 0."""
+    findings = analysis.run_paths()
+    bad = active(findings)
+    assert not bad, "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in bad
+    )
+
+
+def test_every_suppression_carries_a_reason():
+    findings = analysis.run_paths()
+    assert not [f for f in findings if f.rule == "KTPU000"]
+    for f in findings:
+        if f.suppressed:
+            assert f.suppress_reason.strip()
+
+
+# -- TPU001 host-sync-in-hot-path ------------------------------------------
+
+_JIT_SYNC = """
+    import jax
+    import numpy as np
+
+    def leaf(x):
+        return np.asarray(x).sum()
+
+    @jax.jit
+    def solve(x):
+        return leaf(x) + 1
+"""
+
+
+def test_tpu001_fires_on_np_asarray_reachable_from_jit():
+    fs = findings_for(_JIT_SYNC, [HostSyncPass])
+    assert active(fs, "TPU001"), "np.asarray reachable from jax.jit missed"
+    assert any("leaf" in f.message for f in fs)
+
+
+def test_tpu001_fires_on_coercion_and_block_until_ready():
+    fs = findings_for(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x.block_until_ready()
+            return int(y)
+        """,
+        [HostSyncPass],
+    )
+    msgs = [f.message for f in active(fs, "TPU001")]
+    assert any("block_until_ready" in m for m in msgs)
+    assert any("int() coercion" in m for m in msgs)
+
+
+def test_tpu001_fires_in_registered_hot_function():
+    fs = findings_for(
+        """
+        # the apply path: ktpu: hot
+        def apply(batch):
+            return batch.assignments.tolist()
+        """,
+        [HostSyncPass],
+    )
+    assert active(fs, "TPU001")
+
+
+def test_tpu001_hot_scope_skips_plain_host_coercions():
+    """int()/float() on host values is legitimate outside traced code."""
+    fs = findings_for(
+        """
+        # ktpu: hot
+        def apply(batch):
+            return int(batch.count) + float(batch.score)
+        """,
+        [HostSyncPass],
+    )
+    assert not active(fs, "TPU001")
+
+
+def test_tpu001_whitelist_exempts_sanctioned_read_point():
+    src = """
+        import numpy as np
+
+        class DeferredAssignments:
+            # ktpu: hot
+            def get(self):
+                return np.asarray(self._dev)
+    """
+    hit = findings_for(src, [HostSyncPass], filename="exact.py")
+    assert active(hit, "TPU001"), "unwhitelisted read must be flagged"
+    ctx = AnalysisContext(
+        sanctioned_sync=frozenset({("exact.py", "DeferredAssignments.get")})
+    )
+    ok = findings_for(src, [HostSyncPass], ctx=ctx, filename="exact.py")
+    assert not active(ok, "TPU001")
+
+
+def test_tpu001_jit_assignment_form_is_a_root():
+    """g = jax.jit(f) roots f even without a decorator."""
+    fs = findings_for(
+        """
+        import jax
+        import numpy as np
+
+        def _scan(x):
+            return np.asarray(x)
+
+        _scan_jit = jax.jit(_scan)
+        """,
+        [HostSyncPass],
+    )
+    assert active(fs, "TPU001")
+
+
+def test_tpu001_bare_name_resolves_to_module_function_not_sibling_method():
+    """A bare name inside a method is the module-level function (a
+    sibling method needs `self.`); scope must follow the right callee."""
+    fs = findings_for(
+        """
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.asarray(x)
+
+        class S:
+            def helper(self, x):
+                return x  # clean sibling that must NOT shadow the call
+
+            @jax.jit
+            def solve(self, x):
+                return helper(x)
+        """,
+        [HostSyncPass],
+    )
+    hits = active(fs, "TPU001")
+    assert hits and all("'helper'" in f.message for f in hits)
+
+
+def test_tpu001_sees_functions_defined_in_except_handlers():
+    fs = findings_for(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def solve(x):
+            try:
+                return x
+            except Exception:
+                def rescue(v):
+                    return np.asarray(v)
+
+                return rescue(x)
+        """,
+        [HostSyncPass],
+    )
+    assert active(fs, "TPU001"), "def inside except handler escaped scope"
+
+
+def test_cli_errors_on_nonexistent_path(tmp_path):
+    """A typo'd path must not leave the gate silently green."""
+    import pytest
+
+    from kubernetes_tpu.analysis import run_paths
+    from kubernetes_tpu.analysis.__main__ import main
+
+    with pytest.raises(FileNotFoundError):
+        run_paths([str(tmp_path / "no_such_dir")])
+    assert main([str(tmp_path / "no_such_dir")]) == 2
+
+
+def test_tpu001_suppression_with_reason_is_honored():
+    fs = findings_for(
+        """
+        import jax
+
+        @jax.jit
+        def f(shape):
+            # ktpu: ignore[TPU001]: shape is a static argname
+            return int(shape[0])
+        """,
+        [HostSyncPass],
+    )
+    assert not active(fs, "TPU001")
+    assert any(f.suppressed for f in fs)
+
+
+def test_reasonless_suppression_is_its_own_finding():
+    fs = findings_for(
+        """
+        import jax
+
+        @jax.jit
+        def f(shape):
+            # ktpu: ignore[TPU001]
+            return int(shape[0])
+        """,
+        [HostSyncPass],
+    )
+    assert active(fs, "KTPU000"), "reasonless ignore must be rejected"
+
+
+# -- TPU002 traced-branch ---------------------------------------------------
+
+
+def test_tpu002_fires_on_python_if_over_jnp():
+    fs = findings_for(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if jnp.any(x > 0):
+                return x
+            while jnp.sum(x) < 3:
+                x = x + 1
+            return -x
+        """,
+        [TracedBranchPass],
+    )
+    assert len(active(fs, "TPU002")) == 2
+
+
+def test_tpu002_fires_in_hot_scope_as_implicit_sync():
+    """if jnp.any(...) in HOST hot-path code syncs on every call."""
+    fs = findings_for(
+        """
+        import jax.numpy as jnp
+
+        # ktpu: hot
+        def apply(rows):
+            if jnp.any(rows < 0):
+                return None
+            return rows
+        """,
+        [TracedBranchPass],
+    )
+    hits = active(fs, "TPU002")
+    assert len(hits) == 1
+    assert "syncs per call" in hits[0].message
+
+
+def test_tpu002_allows_static_python_branches():
+    fs = findings_for(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if mode == "fast":
+                return x * 2
+            return x
+        """,
+        [TracedBranchPass],
+    )
+    assert not active(fs, "TPU002")
+
+
+# -- TPU003 dtype discipline ------------------------------------------------
+
+_DTYPE_CTX = AnalysisContext(dtype_paths=("",))
+
+
+def test_tpu003_fires_on_missing_dtype_and_float_literal():
+    fs = findings_for(
+        """
+        import jax.numpy as jnp
+
+        def build(n):
+            a = jnp.zeros(n)
+            b = jnp.full(n, 0.5)
+            c = jnp.array([True])
+            return a, b, c
+        """,
+        [DtypeDisciplinePass],
+        ctx=_DTYPE_CTX,
+    )
+    hits = active(fs, "TPU003")
+    assert len(hits) == 3
+    assert any("float literal" in f.message for f in hits)
+
+
+def test_tpu003_accepts_keyword_and_positional_dtype():
+    fs = findings_for(
+        """
+        import jax.numpy as jnp
+
+        def build(n, x):
+            a = jnp.zeros(n, jnp.int32)
+            b = jnp.full(n, 0, jnp.int64)
+            c = jnp.array([1], dtype=jnp.int32)
+            d = jnp.zeros_like(x)
+            return a, b, c, d
+        """,
+        [DtypeDisciplinePass],
+        ctx=_DTYPE_CTX,
+    )
+    assert not active(fs, "TPU003")
+
+
+def test_tpu003_scoped_to_configured_paths():
+    fs = findings_for(
+        "import jax.numpy as jnp\nx = jnp.zeros(3)\n",
+        [DtypeDisciplinePass],
+        ctx=AnalysisContext(dtype_paths=("kubernetes_tpu/ops/",)),
+        filename="elsewhere.py",
+    )
+    assert not active(fs, "TPU003")
+
+
+# -- LOCK001 lock discipline ------------------------------------------------
+
+# Distilled from the PRE-FIX _apply_flight/_commit_all exception path:
+# guarded in-flight bookkeeping and the session-stale flag touched on the
+# failure path without the lock the happy path holds (ADVICE r5 #3).
+_PREFIX_APPLY_FLIGHT = """
+    class Scheduler:
+        def __init__(self, cluster):
+            self.cluster = cluster
+            self._in_flight = {}  # ktpu: guarded-by(cluster.lock)
+            self._session_stale = False  # ktpu: guarded-by(cluster.lock)
+
+        def _apply_flight(self, flight):
+            try:
+                with self.cluster.lock:
+                    self._in_flight.update(flight.infos)
+            except Exception:
+                # exception path: bookkeeping torn down WITHOUT the lock
+                for info in flight.infos:
+                    self._in_flight.pop(info.key, None)
+                self._session_stale = True
+                raise
+"""
+
+
+def test_lock001_catches_prefix_apply_flight_exception_path():
+    fs = findings_for(_PREFIX_APPLY_FLIGHT, [LockDisciplinePass])
+    hits = active(fs, "LOCK001")
+    assert len(hits) == 2
+    assert any("_in_flight" in f.message for f in hits)
+    assert any("_session_stale" in f.message for f in hits)
+    # the happy path (inside the with) is NOT flagged: both hits sit in
+    # the except handler, after the locked update
+    locked_line = next(
+        i + 1
+        for i, l in enumerate(_PREFIX_APPLY_FLIGHT.splitlines())
+        if "update" in l
+    )
+    assert all(f.line > locked_line for f in hits)
+
+
+def test_lock001_accepts_with_lock_and_holds_annotation():
+    fs = findings_for(
+        """
+        class Scheduler:
+            def __init__(self):
+                self._seq = 0  # ktpu: guarded-by(_lock)
+
+            def bump(self):
+                with self._lock:
+                    self._seq += 1
+
+            # watch callbacks fire under the lock: ktpu: holds(_lock)
+            def on_event(self, ev):
+                self._seq += 1
+        """,
+        [LockDisciplinePass],
+    )
+    assert not active(fs, "LOCK001")
+
+
+def test_lock001_unannotated_attrs_are_free():
+    fs = findings_for(
+        """
+        class Scheduler:
+            def __init__(self):
+                self.counter = 0
+
+            def bump(self):
+                self.counter += 1
+        """,
+        [LockDisciplinePass],
+    )
+    assert not active(fs, "LOCK001")
+
+
+def test_lock001_flags_real_scheduler_gap_when_annotations_stand():
+    """The shipped Scheduler class passes ONLY because the exception
+    paths now lock; stripping one lock re-fires the rule (guards the
+    guard)."""
+    fs = findings_for(
+        """
+        class Scheduler:
+            def __init__(self):
+                self._in_flight = {}  # ktpu: guarded-by(cluster.lock)
+
+            def _commit_all(self, infos):
+                for info in infos:
+                    self._in_flight.pop(info.key, None)
+        """,
+        [LockDisciplinePass],
+    )
+    assert active(fs, "LOCK001")
+
+
+# -- MET001 metric names ----------------------------------------------------
+
+_MET_CTX = AnalysisContext(
+    metric_scan_paths=("",),
+    metric_attrs={
+        "solve_latency_seconds": "scheduler_tpu_solve_latency_seconds",
+        "render": None,
+    },
+)
+
+
+def test_met001_fires_on_unknown_attr_and_series_string():
+    fs = findings_for(
+        """
+        from . import metrics
+
+        def record():
+            metrics.solve_latency_seconds.observe(1.0)
+            metrics.solve_latency_sconds.observe(1.0)  # typo
+            return "scheduler_tpu_solve_latency_secnds"  # typo
+        """,
+        [MetricNamePass],
+        ctx=_MET_CTX,
+    )
+    hits = active(fs, "MET001")
+    assert len(hits) == 2
+    assert any("solve_latency_sconds" in f.message for f in hits)
+    assert any("secnds" in f.message for f in hits)
+
+
+def test_met001_shipped_registry_resolves_real_usage():
+    """The real metrics module must expose every series the scheduler
+    records — including the new pipeline fallback counter."""
+    from kubernetes_tpu.analysis.passes.metricnames import (
+        load_metric_registry,
+    )
+
+    attrs = load_metric_registry()
+    assert attrs["pipeline_fallback_total"] == (
+        "scheduler_pipeline_fallback_total"
+    )
+    assert attrs["solves_discarded_total"] == (
+        "scheduler_tpu_solves_discarded_total"
+    )
