@@ -38,6 +38,7 @@ METRIC_SCAN_PATHS = (
     "kubernetes_tpu/scheduler.py",
     "kubernetes_tpu/server/",
     "kubernetes_tpu/solver/",
+    "kubernetes_tpu/sim/",
 )
 
 

@@ -131,6 +131,37 @@ pipeline_fallback_total = Counter(
     "under sustained capacity/mask-affecting event churn.",
     registry=REGISTRY,
 )
+# -- cluster simulator (kubernetes_tpu/sim) --
+
+sim_events_total = Counter(
+    "scheduler_sim_events_total",
+    "Cluster-churn events the simulator applied, by operation "
+    "(create_pod|delete_pod|create_node|delete_node|flap_label|"
+    "alloc_grow|alloc_shrink|external_bind).",
+    ["op"],
+    registry=REGISTRY,
+)
+sim_faults_injected_total = Counter(
+    "scheduler_sim_faults_injected_total",
+    "Faults the simulator injected at real boundaries, by fault kind "
+    "(bind_conflict|watch_delay|watch_duplicate|extender_timeout|"
+    "extender_5xx|permit_stall).",
+    ["fault"],
+    registry=REGISTRY,
+)
+sim_invariant_violations_total = Counter(
+    "scheduler_sim_invariant_violations_total",
+    "Invariant violations the simulator's checkers flagged, by "
+    "invariant (double_bind|capacity|lost_pod|progress|monotonic).",
+    ["invariant"],
+    registry=REGISTRY,
+)
+sim_cycles_total = Counter(
+    "scheduler_sim_cycles_total",
+    "Simulator churn cycles driven to completion.",
+    registry=REGISTRY,
+)
+
 extender_batch_size = Histogram(
     "scheduler_tpu_extender_batch_size",
     "Webhook requests coalesced per device evaluation (micro-batching).",

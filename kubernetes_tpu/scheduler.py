@@ -19,7 +19,6 @@ from the state service on restart.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -249,6 +248,13 @@ class Scheduler:
         # synchronous cycle so sustained event churn cannot livelock the
         # pipelined loop (ADVICE r5 #2)
         self._discard_streak = 0
+        # sim/fault-injection seam (kubernetes_tpu/sim): called with the
+        # in-flight solve right after every dispatch, while NO lock is
+        # held — the one real boundary where a concurrent actor's watch
+        # events can land between a solve's dispatch and its apply. The
+        # simulator delivers delayed watch events here to exercise the
+        # conflict fence and the livelock backstop deterministically.
+        self._post_dispatch_hook = None
         self.snapshot = Snapshot()
         from .state.volume_binder import VolumeBinder
 
@@ -526,7 +532,7 @@ class Scheduler:
     def _schedule_cycle(self) -> BatchResult:
         pending: list[tuple] = []
         res = BatchResult()
-        t0 = time.perf_counter()
+        t0 = self.clock.perf()
         with self.cluster.lock:
             # WaitOnPermit analog: settle WaitingPods whose verdict or
             # deadline arrived since the last cycle, before popping new
@@ -558,7 +564,7 @@ class Scheduler:
             if infos:
                 self._run_groups(infos, res, pending, t0)
                 res.host_seconds = (
-                    time.perf_counter() - t0 - res.solve_seconds
+                    self.clock.perf() - t0 - res.solve_seconds
                 )
                 self._record_metrics(res, len(infos))
         except Exception:
@@ -571,7 +577,7 @@ class Scheduler:
             raise
         finally:
             self._commit_all(infos, pending, res)
-            res.completed_at = time.perf_counter()
+            res.completed_at = self.clock.perf()
         return res
 
     def _requeue_unhandled(
@@ -600,7 +606,7 @@ class Scheduler:
         pipelined loop keeps other batches' in-flight entries live)."""
         first_err = None
         for entry in pending:
-            tb = time.perf_counter()
+            tb = self.clock.perf()
             try:
                 ok = self._commit_binding(entry, res)
             except Exception as e:  # a buggy PreBind/PostBind plugin
@@ -615,7 +621,7 @@ class Scheduler:
                     self._requeue(info, cycle)
             metrics.framework_extension_point_duration_seconds.labels(
                 "Bind", "Success" if ok else "Error", "all"
-            ).observe(time.perf_counter() - tb)
+            ).observe(self.clock.perf() - tb)
         # LOCK001 (pre-analyzer gap): these pops ran unlocked, racing the
         # watch handler's in-flight refresh (_on_event could KeyError-skip
         # or resurrect an entry mid-pop on the ingest thread)
@@ -690,7 +696,7 @@ class Scheduler:
         """Phase 2a (locked): snapshot + tensorize against a consistent
         view of cache + cluster."""
         solver = self.solvers[profile]
-        gs = time.perf_counter()
+        gs = self.clock.perf()
         with self.cluster.lock:
             # phase 2a: snapshot + tensorize against a consistent view
             batch = self.snapshot.update(self.cache)
@@ -782,11 +788,11 @@ class Scheduler:
             # program per-plugin attribution doesn't exist, but the host-side
             # per-plugin-family tensorizers are real measured work
             def _timed(plugin: str, fn, *a, **kw):
-                tp = time.perf_counter()
+                tp = self.clock.perf()
                 out = fn(*a, **kw)
                 metrics.plugin_execution_duration_seconds.labels(
                     plugin, "PreFilter", "Success"
-                ).observe(time.perf_counter() - tp)
+                ).observe(self.clock.perf() - tp)
                 return out
 
             pbatch = _timed(
@@ -1013,7 +1019,7 @@ class Scheduler:
             # still binding are already masked out.
             from .ops.oracle.dra import ClaimError
 
-            tdra = time.perf_counter()
+            tdra = self.clock.perf()
             dra_ctx = self.claim_allocator.context()
             unresolvable: dict[int, str] = {}
             for ci, rep in enumerate(static.reps):
@@ -1045,7 +1051,7 @@ class Scheduler:
                         unsched_reason[p.key] = why
             metrics.plugin_execution_duration_seconds.labels(
                 "DynamicResources", "PreFilter", "Success"
-            ).observe(time.perf_counter() - tdra)
+            ).observe(self.clock.perf() - tdra)
     def _dispatch_group(
         self, prep: _PreparedGroup, defer: bool, allow_heal: bool = True
     ) -> _InFlightSolve:
@@ -1067,7 +1073,7 @@ class Scheduler:
             # cleared under the lock, the device reset runs outside it
             # (only the drain thread resets sessions)
             solver.reset_session()
-        t1 = time.perf_counter()
+        t1 = self.clock.perf()
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
         handle = solver.solve(
@@ -1079,7 +1085,7 @@ class Scheduler:
             defer_read=defer,
             allow_heal=allow_heal,
         )
-        dispatch_dt = time.perf_counter() - t1
+        dispatch_dt = self.clock.perf() - t1
         prep.tensorize_seconds = max(t1 - prep.gs, 0.0)
         metrics.tensorize_seconds.observe(prep.tensorize_seconds)
         # extension-point durations with the reference's metric names:
@@ -1087,9 +1093,13 @@ class Scheduler:
         metrics.framework_extension_point_duration_seconds.labels(
             "PreFilter", "Success", prep.profile
         ).observe(prep.tensorize_seconds)
-        return _InFlightSolve(
+        flight = _InFlightSolve(
             prep=prep, handle=handle, dispatch_seconds=dispatch_dt,
         )
+        hook = self._post_dispatch_hook
+        if hook is not None:
+            hook(flight)
+        return flight
 
     def _apply_group(
         self,
@@ -1119,9 +1129,9 @@ class Scheduler:
         pending_before = len(pending)
         unsched_before = len(res.unschedulable)
         failures_before = len(res.bind_failures)
-        tr = time.perf_counter()
+        tr = self.clock.perf()
         assignments = flight.assignments()
-        flight.read_seconds = time.perf_counter() - tr
+        flight.read_seconds = self.clock.perf() - tr
         solve_dt = flight.dispatch_seconds + flight.read_seconds
         res.solve_seconds += solve_dt
         # the fused device program IS RunFilterPlugins+RunScorePlugins, so
@@ -1255,13 +1265,13 @@ class Scheduler:
                                 for i2 in self.cache.nodes.values()
                                 if i2.node is not None
                             )
-                        tpf = time.perf_counter()
+                        tpf = self.clock.perf()
                         nominated_node = self._try_preempt(
                             pod, static, idx, res, preempt_placed, slot_nodes,
                             preempt_pdbs, cluster_has_affinity, solver,
                             dra_prefold=dra_prefold,
                         )
-                        preempt_dt += time.perf_counter() - tpf
+                        preempt_dt += self.clock.perf() - tpf
                     if nominated_node is None and self.registry.post_filter:
                         if postfilter_reasons is None:
                             # NodeToStatusMap analog, shared across this
@@ -1274,11 +1284,11 @@ class Scheduler:
                                 for n in slot_nodes
                                 if n is not None
                             }
-                        tpf = time.perf_counter()
+                        tpf = self.clock.perf()
                         # fresh copy per pod: upstream's NodeToStatusMap is
                         # per-pod scratch a plugin may legitimately mutate
                         self._run_post_filter(pod, dict(postfilter_reasons))
-                        preempt_dt += time.perf_counter() - tpf
+                        preempt_dt += self.clock.perf() - tpf
                     res.unschedulable.append(pod.key)
                     self._requeue(info, cycle)
                     self._event(
@@ -1305,7 +1315,7 @@ class Scheduler:
                 # (reverse order), forgets the assume, and requeues
                 state = CycleState()
                 try:
-                    tb = time.perf_counter()
+                    tb = self.clock.perf()
                     if pod.pvc_names:
                         ninfo = self.cache.nodes.get(node_name)
                         if ninfo is None or ninfo.node is None:
@@ -1332,7 +1342,7 @@ class Scheduler:
                                 f"Reserve plugin {p.name()} rejected: "
                                 + "; ".join(st.reasons)
                             )
-                    bind_dt += time.perf_counter() - tb
+                    bind_dt += self.clock.perf() - tb
                 except (
                     VolumeBindingError, ClaimAllocationError, _Rejected,
                 ) as e:
@@ -1387,7 +1397,7 @@ class Scheduler:
             ).observe(bind_dt)
 
         # per-profile attempt metrics (this group's own wall time)
-        attempt_avg = (time.perf_counter() - gs) / max(len(infos), 1)
+        attempt_avg = (self.clock.perf() - gs) / max(len(infos), 1)
         # "scheduled" attempts = this group's approved bindings (upstream
         # observes at scheduling-cycle end; a later bind failure records
         # separately under the error paths, like the binding goroutine)
@@ -1552,7 +1562,7 @@ class Scheduler:
                 action="Binding",
             )
             res.scheduled.append((pod.key, node_name))
-        res.latencies.append(time.perf_counter() - t_start)
+        res.latencies.append(self.clock.perf() - t_start)
         # pod-level SLIs: attempts-to-success histogram and e2e latency
         # from first queue entry, labeled by attempt count
         e2e = max(self.clock.now() - info.initial_attempt_timestamp, 0.0)
@@ -2026,7 +2036,7 @@ class Scheduler:
         # ktpu: ignore[LOCK001]: deliberately unlocked pre-check — a torn read can only misroute to the locked re-check inside _apply_group or to a discard, both safe
         if prep.fence == self._conflict_seq:
             applied = False
-            ta = time.perf_counter()
+            ta = self.clock.perf()
             try:
                 # the fence is re-checked INSIDE _apply_group's locked
                 # region: a conflicting event can land during the device
@@ -2040,7 +2050,7 @@ class Scheduler:
                     # batches' work and the hidden RTT to this batch
                     # (review-caught)
                     res.host_seconds = prep.tensorize_seconds + (
-                        time.perf_counter() - ta - flight.read_seconds
+                        self.clock.perf() - ta - flight.read_seconds
                     )
                     self._record_metrics(res, len(infos))
             except Exception:
@@ -2058,10 +2068,10 @@ class Scheduler:
             if applied:
                 self._discard_streak = 0  # forward progress: reset backstop
                 self._commit_all(infos, pending, res)
-                res.completed_at = time.perf_counter()
+                res.completed_at = self.clock.perf()
                 return res
         self._discard_flight(flight)
-        res.completed_at = time.perf_counter()
+        res.completed_at = self.clock.perf()
         return res
 
     def run_pipelined(self, max_batches: int = 10_000) -> list[BatchResult]:
@@ -2122,7 +2132,7 @@ class Scheduler:
                         break
                     out.append(r)
                     continue
-                t0 = time.perf_counter()
+                t0 = self.clock.perf()
                 with self.cluster.lock:
                     self.queue.flush_unschedulable_leftover()
                     infos = self.queue.pop_batch(self.config.batch_size)

@@ -45,11 +45,24 @@ class ExtenderError(Exception):
 
 
 class HTTPExtenderClient:
-    """One configured extender (extender.go#HTTPExtender)."""
+    """One configured extender (extender.go#HTTPExtender).
 
-    def __init__(self, cfg: Extender, timeout: float = 5.0) -> None:
+    ``transport`` is the injectable wire seam: a callable
+    ``(verb, payload) -> parsed JSON`` that replaces the real HTTP POST.
+    Production leaves it None (urllib against ``url_prefix``); the
+    cluster simulator injects a fault transport here so extender
+    latency/timeout/5xx scenarios exercise the REAL client paths —
+    ignorable-skip, non-ignorable batch abort, malformed-result
+    rejection — without a socket. A transport signals failure by raising
+    ``OSError`` (connection/timeout analog) or ``ValueError`` (bad
+    body); both map to ExtenderError exactly like the HTTP path."""
+
+    def __init__(
+        self, cfg: Extender, timeout: float = 5.0, transport=None
+    ) -> None:
         self.cfg = cfg
         self.timeout = timeout
+        self.transport = transport
 
     @property
     def name(self) -> str:
@@ -76,6 +89,13 @@ class HTTPExtenderClient:
     # -- verbs --
 
     def _post(self, verb: str, payload: dict) -> dict | list:
+        if self.transport is not None:
+            try:
+                return self.transport(verb, payload)
+            except (OSError, ValueError) as e:
+                raise ExtenderError(
+                    f"extender {self.name}/{verb}: {e}"
+                ) from e
         req = urllib.request.Request(
             f"{self.cfg.url_prefix.rstrip('/')}/{verb}",
             json.dumps(payload).encode(),

@@ -1,0 +1,157 @@
+"""CLI: seeded simulator runs and trace replay.
+
+    # fresh run (deterministic: same seed+profile => identical trace)
+    python -m kubernetes_tpu.sim --seed 0 --profile churn_heavy
+    python -m kubernetes_tpu.sim --seed 7 --cycles 20 --profile bind_storms \\
+        --trace /tmp/storm.jsonl
+
+    # reproduce a recorded run bit-for-bit
+    python -m kubernetes_tpu.sim --replay /tmp/storm.jsonl
+
+    # determinism self-check: run twice, compare trace digests
+    python -m kubernetes_tpu.sim --seed 0 --profile node_flaps --selfcheck
+
+Exit status: 0 clean; 1 invariant violations / failed settle / replay
+divergence; 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _configure_jax() -> None:
+    """Force CPU + 64-bit resource arithmetic BEFORE the solver imports
+    jax (tests get this from tests/conftest.py; the CLI must do it
+    itself — on this toolchain only jax.config.update is honored)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+
+def _print_result(res) -> None:
+    s = res.summary
+    print(
+        f"profile={res.profile} seed={res.seed} cycles={res.cycles} "
+        f"pipelined={s['pipelined']}"
+    )
+    print(
+        f"  events={s['events']} bound={s['bound']} unbound={s['unbound']} "
+        f"settled={s['settled']}"
+    )
+    print(
+        f"  faults: bind={s['bind_faults']} "
+        f"watch_delivered={s['watch_delivered']} "
+        f"dup={s['watch_duplicated']} extender_aborts={s['extender_aborts']} "
+        f"permit_stalls={s['permit_stalls']}"
+    )
+    print(
+        f"  pipeline: discards={s['discards']:.0f} "
+        f"fallbacks={s['pipeline_fallbacks']:.0f} "
+        f"preemptions={s['preemptions']:.0f}"
+    )
+    print(f"  trace_digest={res.trace.digest()}")
+    if res.replay_divergence:
+        print(f"  REPLAY DIVERGED: {res.replay_divergence}")
+    elif res.violations:
+        print(f"  {len(res.violations)} INVARIANT VIOLATION(S):")
+        for v in res.violations[:20]:
+            print(f"    [{v.invariant}] cycle {v.cycle}: {v.detail}")
+    else:
+        print("  invariants: OK")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.sim",
+        description="Deterministic cluster simulator + fault injection.",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--cycles", type=int, default=10)
+    parser.add_argument(
+        "--profile", default="churn_heavy",
+        help="scenario profile (see sim/README.md); --list-profiles",
+    )
+    parser.add_argument(
+        "--sync", action="store_true",
+        help="drive run_until_settled instead of the profile's default",
+    )
+    parser.add_argument(
+        "--trace", metavar="PATH", help="write the replayable trace here"
+    )
+    parser.add_argument(
+        "--replay", metavar="PATH",
+        help="re-execute a recorded trace instead of a fresh run",
+    )
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="run twice and verify the traces are byte-identical",
+    )
+    parser.add_argument("--list-profiles", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_profiles:
+        from .profiles import PROFILES
+
+        for name in sorted(PROFILES):
+            p = PROFILES[name]
+            print(f"{name}: pipelined={p.pipelined} nodes={p.nodes}")
+        return 0
+
+    _configure_jax()
+    from .harness import replay_trace, run_sim
+    from .trace import TraceError
+
+    if args.replay:
+        try:
+            res = replay_trace(args.replay)
+        except TraceError as e:
+            print(f"replay failed: {e}", file=sys.stderr)
+            return 1
+        _print_result(res)
+        return 0 if res.ok else 1
+
+    pipelined = False if args.sync else None
+    try:
+        res = run_sim(
+            args.profile, seed=args.seed, cycles=args.cycles,
+            pipelined=pipelined,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    _print_result(res)
+    if args.trace:
+        res.trace.dump(args.trace)
+        print(f"  trace written: {args.trace}")
+    if args.selfcheck:
+        res2 = run_sim(
+            args.profile, seed=args.seed, cycles=args.cycles,
+            pipelined=pipelined,
+        )
+        if res.trace.lines != res2.trace.lines:
+            for i, (a, b) in enumerate(
+                zip(res.trace.lines, res2.trace.lines)
+            ):
+                if a != b:
+                    print(
+                        f"NON-DETERMINISTIC at trace line {i + 1}:\n"
+                        f"  run1: {a}\n  run2: {b}",
+                        file=sys.stderr,
+                    )
+                    break
+            else:
+                print(
+                    "NON-DETERMINISTIC: trace lengths differ "
+                    f"({len(res.trace.lines)} vs {len(res2.trace.lines)})",
+                    file=sys.stderr,
+                )
+            return 1
+        print("  selfcheck: two runs produced byte-identical traces")
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

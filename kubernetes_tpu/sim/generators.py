@@ -1,0 +1,308 @@
+"""Churn generators: seeded event streams from scenario profiles.
+
+``ChurnGenerator.generate(cycle)`` derives the cycle's events from the
+profile's rates and ONE ``random.Random`` dedicated to generation (the
+fault injectors draw from a separate stream, so mid-run fault decisions
+never shift what churn a cycle produces). Events are plain dicts —
+exactly what lands in the trace — and ``apply`` executes them against
+the live ``ClusterState``. Replay skips ``generate`` entirely and feeds
+recorded event dicts straight to ``apply``.
+
+All choices over live cluster state go through sorted snapshots, never
+raw set/dict iteration, so a run is independent of PYTHONHASHSEED.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .. import metrics
+from ..api.objects import Node, Pod
+from ..state.cluster import ApiError, ClusterState
+from .profiles import Profile
+
+
+def make_node(
+    name: str, cpu: str, mem: str, labels: dict[str, str] | None = None
+) -> Node:
+    from ..api.wrappers import MakeNode
+
+    b = (
+        MakeNode()
+        .name(name)
+        .capacity({"cpu": cpu, "memory": mem, "pods": "110"})
+        .label("kubernetes.io/hostname", name)
+    )
+    for k, v in (labels or {}).items():
+        b = b.label(k, v)
+    return b.obj()
+
+
+def make_pod(name: str, cpu: str, priority: int = 0) -> Pod:
+    from ..api.wrappers import MakePod
+
+    b = MakePod().name(name).req({"cpu": cpu, "memory": "1Gi"})
+    if priority:
+        b = b.priority(priority)
+    return b.obj()
+
+
+def _count(rng: random.Random, rate: float) -> int:
+    """Expected-count rate -> integer count: the whole part always
+    happens, the fractional part happens with its probability."""
+    whole = int(rate)
+    return whole + (1 if rng.random() < (rate - whole) else 0)
+
+
+class ChurnGenerator:
+    def __init__(
+        self, profile: Profile, rng: random.Random, cluster: ClusterState
+    ) -> None:
+        self.profile = profile
+        self.rng = rng
+        self.cluster = cluster
+        self._pod_seq = 0
+        self._node_seq = 0
+        self._flap_seq = 0
+
+    # -- seeding (before the scheduler exists; not part of the trace —
+    # replay re-derives it from the header's profile) --
+
+    def seed_nodes(self) -> list[Node]:
+        out = []
+        for _ in range(self.profile.nodes):
+            out.append(
+                make_node(
+                    self._next_node_name(),
+                    self.profile.node_cpu,
+                    self.profile.node_mem,
+                )
+            )
+        return out
+
+    def _next_node_name(self) -> str:
+        name = f"n{self._node_seq:03}"
+        self._node_seq += 1
+        return name
+
+    def _next_pod_name(self) -> str:
+        name = f"p{self._pod_seq:05}"
+        self._pod_seq += 1
+        return name
+
+    # -- per-cycle event stream --
+
+    def generate(self, cycle: int) -> list[dict]:
+        """The cycle's churn, in a fixed category order. Each event dict
+        is self-contained (wire-shape payloads) so the trace replays
+        without this generator."""
+        p, rng = self.profile, self.rng
+        events: list[dict] = []
+
+        # pod arrivals
+        for _ in range(rng.randint(*p.arrivals)):
+            pod = make_pod(
+                self._next_pod_name(),
+                rng.choice(p.pod_cpu_choices),
+                rng.choice(p.pod_priorities),
+            )
+            events.append({"op": "create_pod", "pod": pod.to_dict()})
+
+        # pod deletes (any pod — pending or bound; bound deletes free
+        # capacity, pending deletes exercise mid-flight removal)
+        candidates = sorted(q.key for q in self.cluster.list_pods())
+        for _ in range(_count(rng, p.delete_pod_rate)):
+            if not candidates:
+                break
+            key = candidates.pop(rng.randrange(len(candidates)))
+            ns, name = key.split("/", 1)
+            events.append({"op": "delete_pod", "ns": ns, "name": name})
+
+        # node adds
+        for _ in range(_count(rng, p.node_add_rate)):
+            node = make_node(
+                self._next_node_name(), p.node_cpu, p.node_mem
+            )
+            events.append({"op": "create_node", "node": node.to_dict()})
+
+        # node deletes (keep at least one node alive)
+        names = sorted(n.name for n in self.cluster.list_nodes())
+        for _ in range(_count(rng, p.node_delete_rate)):
+            if len(names) <= 1:
+                break
+            name = names.pop(rng.randrange(len(names)))
+            events.append({"op": "delete_node", "name": name})
+
+        # label flaps (conflict-fence food: _node_change_could_help)
+        for _ in range(_count(rng, p.label_flap_rate)):
+            if not names:
+                break
+            name = rng.choice(names)
+            self._flap_seq += 1
+            events.append(
+                {
+                    "op": "flap_label",
+                    "name": name,
+                    "key": "sim.kubernetes.io/flap",
+                    "value": f"f{self._flap_seq}",
+                }
+            )
+
+        # allocatable grow/shrink (cpu only). A shrink never goes below
+        # the node's CURRENT bound usage: shrinking under load is
+        # legitimate cluster behavior (kubelet eviction territory, not a
+        # scheduler bug), so allowing it would make the capacity
+        # invariant unsound — the same reasoning that forbids combining
+        # shrinks with delayed watch delivery (profiles.py). The floor
+        # keeps "used > allocatable" attributable to a bad BIND only.
+        used_cpu: dict[str, int] = {}
+        for q in self.cluster.list_pods():
+            if q.node_name:
+                used_cpu[q.node_name] = used_cpu.get(
+                    q.node_name, 0
+                ) + q.resource_request().get("cpu", 0)
+        staged_alloc: dict[str, int] = {}  # staged cpu deltas this cycle
+        alloc_of = {
+            n.name: n.allocatable.get("cpu", 0)
+            for n in self.cluster.list_nodes()
+        }
+        for op, rate in (
+            ("grow", p.alloc_grow_rate),
+            ("shrink", p.alloc_shrink_rate),
+        ):
+            for _ in range(_count(rng, rate)):
+                if not names:
+                    break
+                name = rng.choice(names)
+                cur = alloc_of.get(name, 0) + staged_alloc.get(name, 0)
+                if op == "shrink":
+                    floor = max(used_cpu.get(name, 0), 1000)
+                    if cur - 1000 < floor:
+                        continue  # would undercut committed usage
+                    staged_alloc[name] = staged_alloc.get(name, 0) - 1000
+                else:
+                    staged_alloc[name] = staged_alloc.get(name, 0) + 1000
+                events.append({"op": f"alloc_{op}", "name": name})
+
+        # external competing binds: another actor places a pending pod
+        # (ground-truth fit-checked at generation time against THIS
+        # cycle's staged allocatable deltas — shrinks apply before binds;
+        # the scheduler may be racing for the same slot — that's the
+        # point)
+        for _ in range(_count(rng, p.external_bind_rate)):
+            ev = self._external_bind_event(events, staged_alloc)
+            if ev is None:
+                break
+            events.append(ev)
+        return events
+
+    def _external_bind_event(
+        self, staged: list[dict], staged_alloc: dict[str, int]
+    ) -> dict | None:
+        staged_deletes = {
+            f"{e['ns']}/{e['name']}"
+            for e in staged
+            if e["op"] in ("delete_pod", "external_bind")
+        }
+        staged_node_deletes = {
+            e["name"] for e in staged if e["op"] == "delete_node"
+        }
+        pods = sorted(
+            (q for q in self.cluster.list_pods()), key=lambda q: q.key
+        )
+        pending = [
+            q
+            for q in pods
+            if not q.node_name and q.key not in staged_deletes
+        ]
+        if not pending:
+            return None
+        pod = self.rng.choice(pending)
+        used: dict[str, dict[str, int]] = {}
+        for q in pods:
+            if q.node_name:
+                u = used.setdefault(q.node_name, {})
+                for r, v in q.resource_request().items():
+                    u[r] = u.get(r, 0) + v
+        # earlier external binds staged this cycle consume capacity too
+        for e in staged:
+            if e["op"] != "external_bind":
+                continue
+            q = next(
+                (
+                    x
+                    for x in pods
+                    if x.namespace == e["ns"] and x.name == e["name"]
+                ),
+                None,
+            )
+            if q is not None:
+                u = used.setdefault(e["node"], {})
+                for r, v in q.resource_request().items():
+                    u[r] = u.get(r, 0) + v
+        fits = []
+        for node in sorted(self.cluster.list_nodes(), key=lambda n: n.name):
+            if node.name in staged_node_deletes or node.unschedulable:
+                continue
+            u = used.get(node.name, {})
+            if all(
+                u.get(r, 0) + v
+                <= node.allocatable.get(r, 0)
+                + (staged_alloc.get(node.name, 0) if r == "cpu" else 0)
+                for r, v in pod.resource_request().items()
+                if v > 0 and r != "pods"
+            ):
+                fits.append(node.name)
+        if not fits:
+            return None
+        return {
+            "op": "external_bind",
+            "ns": pod.namespace,
+            "name": pod.name,
+            "node": self.rng.choice(fits),
+        }
+
+
+def apply_event(cluster: ClusterState, ev: dict) -> None:
+    """Execute one churn event against the state service. Tolerates
+    NotFound/AlreadyExists/Conflict — under replay the cluster can have
+    drifted only if the scheduler diverged, and the decision journal
+    catches that with a better message than a KeyError here."""
+    op = ev["op"]
+    metrics.sim_events_total.labels(op).inc()
+    try:
+        if op == "create_pod":
+            cluster.create_pod(Pod.from_dict(ev["pod"]))
+        elif op == "delete_pod":
+            cluster.delete_pod(ev["ns"], ev["name"])
+        elif op == "create_node":
+            cluster.create_node(Node.from_dict(ev["node"]))
+        elif op == "delete_node":
+            cluster.delete_node(ev["name"])
+        elif op == "flap_label":
+            node = cluster.get_node(ev["name"])
+            import dataclasses
+
+            labels = dict(node.labels)
+            labels[ev["key"]] = ev["value"]
+            cluster.update_node(dataclasses.replace(node, labels=labels))
+        elif op in ("alloc_grow", "alloc_shrink"):
+            node = cluster.get_node(ev["name"])
+            import dataclasses
+
+            alloc = dict(node.allocatable)
+            cpu = alloc.get("cpu", 0)
+            # canonical cpu ints are millicores
+            delta = 1000 if op == "alloc_grow" else -1000
+            alloc["cpu"] = max(cpu + delta, 1000)
+            cluster.update_node(
+                dataclasses.replace(node, allocatable=alloc)
+            )
+        elif op == "external_bind":
+            cluster.bind(ev["ns"], ev["name"], ev["node"])
+        else:
+            raise ValueError(f"unknown sim event op {op!r}")
+    except ApiError:
+        # target vanished between generation and apply (or replay drift
+        # that the decision journal will diagnose) — churn, not a bug
+        pass

@@ -1,0 +1,474 @@
+"""SimHarness: drive the REAL Scheduler through the REAL ClusterState
+under generated churn and injected faults, on virtual time, checking
+invariants after every drive — the regression harness the pipelined
+loop's concurrency story is validated against.
+
+One harness = one deterministic run:
+
+    seed + profile  ──►  churn events (gen RNG)  ─┐
+                    ──►  fault decisions (fault RNG, journaled)  ─┤
+                                                                  ▼
+    FakeClock ── ClusterState ── DelayedWatchBus ── Scheduler.run_pipelined
+                     ▲                                    │ post-dispatch hook
+                     └── BindTransitionTracker (ground truth watch)
+
+Everything that could vary between runs is pinned: a single-threaded
+event loop, ``FakeClock`` virtual time threaded through scheduler /
+queue / cache / cluster, ``tie_break="first"`` solves, sorted iteration
+in generators/checkers, and RNG streams seeded from strings (immune to
+PYTHONHASHSEED). Two runs with the same seed+profile produce
+byte-identical traces; ``replay`` re-executes a recorded trace's events
+and fault decisions literally and diffs the final bindings against its
+footer.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from .. import metrics
+from ..config.types import Extender
+from ..scheduler import Scheduler, SchedulerConfig
+from ..server.extender_client import ExtenderError
+from ..solver.exact import ExactSolverConfig
+from ..state.cluster import ClusterState
+from ..utils.clock import FakeClock
+from .faults import (
+    BindFaultInjector,
+    DecisionJournal,
+    DelayedWatchBus,
+    FlakyExtenderTransport,
+    StallingPermitPlugin,
+)
+from .generators import ChurnGenerator, apply_event
+from .invariants import (
+    BindTransitionTracker,
+    MonotonicCounters,
+    Violation,
+    _record,
+    check_capacity,
+    check_lost_pods,
+)
+from .profiles import Profile, get_profile
+from .trace import TraceReader, TraceWriter
+
+
+@dataclass
+class SimResult:
+    profile: str
+    seed: int
+    cycles: int
+    bindings: dict[str, str]  # pod key -> node (final, bound pods only)
+    unbound: list[str]  # pod keys still pending at the end
+    violations: list[Violation]
+    settled: bool
+    summary: dict
+    trace: TraceWriter
+    replay_divergence: str | None = None  # replay mode only
+
+    @property
+    def ok(self) -> bool:
+        return (
+            not self.violations
+            and self.settled
+            and self.replay_divergence is None
+        )
+
+
+# counters whose within-run deltas go into the footer summary (reading
+# absolutes would leak cross-run registry state into the trace)
+_DELTA_COUNTERS = {
+    "discards": metrics.solves_discarded_total,
+    "pipeline_fallbacks": metrics.pipeline_fallback_total,
+    "preemptions": metrics.preemption_attempts_total,
+}
+
+
+def _counter_value(c) -> float:
+    return c._value.get()  # prometheus_client internal, test-style read
+
+
+class SimHarness:
+    def __init__(
+        self,
+        profile: Profile | str,
+        seed: int = 0,
+        cycles: int = 10,
+        *,
+        pipelined: bool | None = None,
+        replay: TraceReader | None = None,
+        max_settle_rounds: int = 12,
+    ) -> None:
+        self.profile = (
+            get_profile(profile) if isinstance(profile, str) else profile
+        )
+        self.profile.validate()
+        self.seed = seed
+        self.cycles = cycles
+        self.pipelined = (
+            self.profile.pipelined if pipelined is None else pipelined
+        )
+        self.max_settle_rounds = max_settle_rounds
+        self._reader = replay
+
+        self.trace = TraceWriter()
+        self.trace.header(
+            seed=seed,
+            profile=self.profile.name,
+            cycles=cycles,
+            pipelined=self.pipelined,
+        )
+        self.journal = DecisionJournal(
+            None if replay is not None else self.trace,
+            replay.decisions if replay is not None else None,
+        )
+        # two independent RNG streams (string-seeded: hash-seed immune):
+        # churn generation consumes gen, injectors consume fault — so
+        # mid-run fault draws never shift what churn a cycle produces
+        self._gen_rng = random.Random(f"{seed}/gen")
+        self._fault_rng = random.Random(f"{seed}/fault")
+
+        self.clock = FakeClock()
+        self.cluster = ClusterState(clock=self.clock)
+        self.generator = ChurnGenerator(
+            self.profile, self._gen_rng, self.cluster
+        )
+        for node in self.generator.seed_nodes():
+            self.cluster.create_node(node)
+
+        plugins: tuple = ()
+        self.permit_plugin: StallingPermitPlugin | None = None
+        if self.profile.permit:
+            self.permit_plugin = StallingPermitPlugin(
+                self.journal,
+                self._fault_rng,
+                self.profile.permit_stall_rate,
+                self.profile.permit_timeout,
+            )
+            plugins = (self.permit_plugin,)
+        extenders: tuple = ()
+        if self.profile.extender:
+            extenders = (
+                Extender(
+                    url_prefix="http://sim-extender",
+                    filter_verb="filter",
+                    prioritize_verb="prioritize",
+                    node_cache_capable=True,
+                ),
+            )
+        self.scheduler = Scheduler(
+            self.cluster,
+            SchedulerConfig(
+                batch_size=self.profile.batch_size,
+                solver=ExactSolverConfig(
+                    tie_break="first", group_size=self.profile.group_size
+                ),
+                extenders=extenders,
+                out_of_tree_plugins=plugins,
+            ),
+            clock=self.clock,
+        )
+        self.ext_transport: FlakyExtenderTransport | None = None
+        if self.profile.extender:
+            self.ext_transport = FlakyExtenderTransport(
+                self.journal, self._fault_rng, self.profile.extender_fault_rate
+            )
+            for cl in self.scheduler.extender_clients:
+                cl.transport = self.ext_transport
+
+        # interpose the delayed bus between cluster and scheduler; the
+        # ground-truth tracker subscribes directly (no delay)
+        self.cluster.unsubscribe(self.scheduler._on_event)
+        self.bus = DelayedWatchBus(
+            self.cluster,
+            self.scheduler._on_event,
+            self.journal,
+            self._fault_rng,
+            delaying=self.profile.watch_delay,
+            dup_rate=self.profile.watch_dup_rate,
+        )
+        self.cluster.subscribe(self.bus.ingest)
+        self.scheduler._post_dispatch_hook = self._on_dispatch
+
+        self.bind_injector = BindFaultInjector(
+            self.journal, self._fault_rng, self.profile.bind_fault_rate
+        )
+        self.cluster.bind_fault = self.bind_injector
+
+        self.tracker = BindTransitionTracker(self.cluster)
+        self.monotonic = MonotonicCounters()
+        self.violations: list[Violation] = []
+        self._events_applied = 0
+        self._extender_aborts = 0
+        self._counters0 = {
+            k: _counter_value(c) for k, c in _DELTA_COUNTERS.items()
+        }
+
+    # -- fault delivery inside the dispatch→apply window --
+
+    def _on_dispatch(self, flight) -> None:
+        """Post-dispatch hook: while a solve is in flight (the one real
+        window where another actor's events race a deferred solve),
+        deliver some delayed watch events — this is what makes fence
+        discards, session re-uploads, and the livelock backstop
+        reachable from a single-threaded simulation."""
+        if not self.bus.delaying or not self.bus.pending:
+            return
+        pending = len(self.bus.pending)
+
+        def draw():
+            if self._fault_rng.random() < 0.2:
+                return 0
+            return min(pending, 1 + self._fault_rng.randrange(2))
+
+        self.bus.pump(self.journal.decide("midpump", draw))
+
+    # -- drive + invariants --
+
+    def _drive(self, cycle: int) -> None:
+        if self.pipelined:
+            try:
+                results = self.scheduler.run_pipelined(max_batches=200)
+            except ExtenderError:
+                # only reachable when a caller forces pipelined=True with
+                # an extender profile (run_pipelined then falls back to
+                # the sync loop internally); completed batches' results
+                # are lost with the raise — acceptable for that corner
+                self._extender_aborts += 1
+                return
+            for r in results:
+                self.tracker.record_results(r.scheduled)
+            return
+        # sync mode drives batch-by-batch (observationally identical to
+        # run_until_settled) so an injected non-ignorable extender abort
+        # ends the DRIVE without discarding earlier batches' results —
+        # losing them would silently weaken the double-bind tracker
+        # (review-caught). The scheduler's unhandled-requeue path owns
+        # the aborted batch's pods; the lost-pod invariant verifies it.
+        for _ in range(200):
+            try:
+                r = self.scheduler.schedule_batch()
+            except ExtenderError:
+                self._extender_aborts += 1
+                return  # retry next cycle / settle round
+            if not (r.scheduled or r.unschedulable or r.bind_failures):
+                return
+            self.tracker.record_results(r.scheduled)
+
+    def _check(self, cycle: int) -> None:
+        self.tracker.drain(cycle, self.violations)
+        check_capacity(self.cluster, cycle, self.violations)
+        check_lost_pods(
+            self.cluster,
+            self.scheduler,
+            cycle,
+            self.violations,
+            undelivered=self.bus.pending_pod_adds,
+        )
+        self.monotonic.observe(cycle, self.violations)
+
+    def _settled(self) -> bool:
+        if self.scheduler._waiting or self.scheduler._in_flight:
+            return False
+        live = set(self.scheduler.queue.entries().values())
+        return not (live & {"active", "backoff"})
+
+    # -- the run --
+
+    def run(self) -> SimResult:
+        replaying = self._reader is not None
+        for cycle in range(self.cycles):
+            metrics.sim_cycles_total.inc()
+            if replaying:
+                events = [
+                    {k: v for k, v in rec.items() if k not in ("k", "c")}
+                    for rec in self._reader.events_by_cycle.get(cycle, [])
+                ]
+            else:
+                events = self.generator.generate(cycle)
+            self.bind_injector.suspended = True
+            try:
+                for ev in events:
+                    if not replaying:
+                        self.trace.event(cycle, **ev)
+                    apply_event(self.cluster, ev)
+                    self._events_applied += 1
+            finally:
+                self.bind_injector.suspended = False
+            self.clock.advance(1.0)
+            if self.bus.delaying and self.bus.pending:
+                pending = len(self.bus.pending)
+                self.bus.pump(
+                    self.journal.decide(
+                        "prepump",
+                        lambda: self._fault_rng.randint(0, pending),
+                    )
+                )
+            self._drive(cycle)
+            self._permit_verdicts()
+            self._check(cycle)
+
+        settled = self._quiesce()
+        if not settled:
+            _record(
+                self.violations, "progress", self.cycles + self.max_settle_rounds,
+                "scheduler failed to quiesce after churn stopped "
+                f"({self.max_settle_rounds} settle rounds): "
+                f"queue={self.scheduler.queue.pending_counts()} "
+                f"waiting={len(self.scheduler._waiting)}",
+            )
+        return self._finish(settled)
+
+    def _permit_verdicts(self) -> None:
+        """Allow or abandon (→ virtual-clock timeout) parked WaitingPods,
+        one journaled decision each."""
+        if self.permit_plugin is None:
+            return
+        waiting = self.scheduler.waiting_pods()
+        for key in sorted(waiting):
+            wp = waiting[key]
+            allow = self.journal.decide(
+                "permit_verdict",
+                lambda: int(self._fault_rng.random() < 0.5),
+            )
+            if allow:
+                wp.allow(self.permit_plugin.name())
+            # else: left to expire; the settle loop's clock advances
+            # cross the deadline and the next cycle rejects + requeues
+
+    def _quiesce(self) -> bool:
+        """Churn has stopped: stop injecting, deliver every held event,
+        and drain on an advancing virtual clock — through the backoff
+        horizon and (once) the 5-minute unschedulable leftover flush —
+        until the scheduler goes quiet."""
+        self.bind_injector.settling = True
+        if self.ext_transport is not None:
+            self.ext_transport.settling = True
+        if self.permit_plugin is not None:
+            self.permit_plugin.settling = True
+        self.bus.pump_all()
+        # 11s rounds clear max backoff (10s) and permit timeouts; the
+        # 301s round forces the unschedulable-leftover flush. The flush
+        # round is MANDATORY before declaring quiescence: pods parked
+        # unschedulable by injected faults (extender outages, bind
+        # conflicts) see no waking cluster event once churn stops — the
+        # 5-minute flush is the only path back, and skipping it would
+        # misread "parked by a fault" as "settled" (sim-caught).
+        advances = [11.0, 11.0, 301.0] + [11.0] * max(
+            self.max_settle_rounds - 3, 0
+        )
+        flush_round = 2
+        for i, adv in enumerate(advances):
+            cycle = self.cycles + i
+            self.clock.advance(adv)
+            self._drive(cycle)
+            self._permit_verdicts()
+            self._check(cycle)
+            if i >= flush_round and self._settled():
+                return True
+        return False
+
+    def _finish(self, settled: bool) -> SimResult:
+        bindings = {
+            p.key: p.node_name
+            for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
+            if p.node_name
+        }
+        unbound = sorted(
+            p.key for p in self.cluster.list_pods() if not p.node_name
+        )
+        deltas = {
+            k: _counter_value(c) - self._counters0[k]
+            for k, c in _DELTA_COUNTERS.items()
+        }
+        summary = {
+            "pipelined": self.pipelined,
+            "events": self._events_applied,
+            "bound": len(bindings),
+            "unbound": len(unbound),
+            "settled": settled,
+            "violations": len(self.violations),
+            "bind_faults": self.bind_injector.injected,
+            "watch_delivered": self.bus.delivered,
+            "watch_duplicated": self.bus.duplicated,
+            "extender_aborts": self._extender_aborts,
+            "permit_stalls": (
+                self.permit_plugin.stalls if self.permit_plugin else 0
+            ),
+            **deltas,
+        }
+        self.trace.footer(
+            bindings=bindings,
+            unbound=unbound,
+            violations=[v.as_dict() for v in self.violations],
+            summary=summary,
+        )
+        divergence = None
+        if self._reader is not None:
+            divergence = self._diff_replay(bindings)
+        return SimResult(
+            profile=self.profile.name,
+            seed=self.seed,
+            cycles=self.cycles,
+            bindings=bindings,
+            unbound=unbound,
+            violations=self.violations,
+            settled=settled,
+            summary=summary,
+            trace=self.trace,
+            replay_divergence=divergence,
+        )
+
+    def _diff_replay(self, bindings: dict[str, str]) -> str | None:
+        footer = self._reader.footer
+        if footer is None:
+            return "trace has no footer (recorded run died mid-write)"
+        if self.journal.leftover():
+            return (
+                f"{self.journal.leftover()} recorded decisions were never "
+                "consumed (the replayed run took a shorter path)"
+            )
+        recorded = footer.get("bindings") or {}
+        if recorded != bindings:
+            gone = sorted(set(recorded) - set(bindings))
+            new = sorted(set(bindings) - set(recorded))
+            moved = sorted(
+                k
+                for k in set(recorded) & set(bindings)
+                if recorded[k] != bindings[k]
+            )
+            return (
+                "final bindings diverged from the recorded footer: "
+                f"missing={gone[:5]} extra={new[:5]} moved={moved[:5]} "
+                f"(recorded {len(recorded)} vs replayed {len(bindings)})"
+            )
+        return None
+
+
+def run_sim(
+    profile: str,
+    seed: int = 0,
+    cycles: int = 10,
+    *,
+    pipelined: bool | None = None,
+) -> SimResult:
+    """One fresh seeded run (library entry; the CLI and tests use this)."""
+    return SimHarness(
+        profile, seed=seed, cycles=cycles, pipelined=pipelined
+    ).run()
+
+
+def replay_trace(path) -> SimResult:
+    """Re-execute a recorded trace: events and fault decisions replay
+    literally; the result's ``replay_divergence`` reports any drift
+    from the recorded footer."""
+    reader = TraceReader.load(path)
+    h = reader.header
+    return SimHarness(
+        h["profile"],
+        seed=int(h["seed"]),
+        cycles=int(h["cycles"]),
+        pipelined=bool(h["pipelined"]),
+        replay=reader,
+    ).run()

@@ -1,0 +1,193 @@
+"""Invariant checkers the simulator runs after every scheduler drive.
+
+Each checker is a small, separately-testable unit (mirrors the
+known-bad-fixture-per-rule pattern of tests/test_static_analysis.py —
+tests/test_sim_invariants.py feeds each one a crafted violation):
+
+- ``BindTransitionTracker``  — no double-bind: watches the state
+  service directly (ground truth, no injected delay) and flags any pod
+  whose nodeName moves A→B, plus any pod the scheduler reports
+  scheduled twice without an intervening delete;
+- ``check_capacity``         — per-node allocatable is never exceeded
+  by the bound-pod request sum (and pod count never exceeds the node's
+  pods allocatable);
+- ``check_lost_pods``        — every unbound pod this scheduler owns is
+  accounted for: scheduling queue (active/backoff/unschedulable/gated),
+  in-flight map, WaitingPods map, or still-undelivered watch ADDs.
+  Anything else fell out of the bookkeeping and would never schedule;
+- ``MonotonicCounters``      — sampled Counter series never decrease;
+- eventual progress is checked by the harness's settle loop (bounded
+  rounds of drain + virtual-clock advance), emitting a ``progress``
+  violation when the loop fails to quiesce — the livelock detector the
+  PR-1 pipeline backstop exists to satisfy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from .. import metrics
+from ..state.cluster import ClusterState, Event
+
+
+@dataclass(frozen=True)
+class Violation:
+    invariant: str  # double_bind | capacity | lost_pod | progress | monotonic
+    cycle: int
+    detail: str
+
+    def as_dict(self) -> dict:
+        return {
+            "invariant": self.invariant,
+            "cycle": self.cycle,
+            "detail": self.detail,
+        }
+
+
+def _record(violations: list[Violation], inv: str, cycle: int, detail: str):
+    metrics.sim_invariant_violations_total.labels(inv).inc()
+    violations.append(Violation(inv, cycle, detail))
+
+
+class BindTransitionTracker:
+    """Subscribes straight to the state service (never through the
+    delayed bus) and accumulates double-bind violations as they
+    happen. ``drain`` collects them tagged with the current cycle."""
+
+    def __init__(self, cluster: ClusterState) -> None:
+        self._node_of: dict[str, str] = {
+            p.key: p.node_name for p in cluster.list_pods() if p.node_name
+        }
+        self._pending: list[str] = []
+        self._sched_bound: set[str] = set()
+        cluster.subscribe(self._on_event)
+
+    def _on_event(self, ev: Event) -> None:
+        if ev.kind != "Pod":
+            return
+        pod = ev.obj
+        if ev.type == "DELETED":
+            self._node_of.pop(pod.key, None)
+            self._sched_bound.discard(pod.key)
+            return
+        if not pod.node_name:
+            return
+        prev = self._node_of.get(pod.key)
+        if prev is not None and prev != pod.node_name:
+            self._pending.append(
+                f"pod {pod.key} rebound {prev} -> {pod.node_name}"
+            )
+        self._node_of[pod.key] = pod.node_name
+
+    def record_results(self, scheduled: Iterable[tuple[str, str]]) -> None:
+        """Feed one drive's BatchResult.scheduled entries: a pod bound
+        twice by the scheduler (no delete in between) is a double-bind
+        even if the state service masked it."""
+        for key, node in scheduled:
+            if key in self._sched_bound:
+                self._pending.append(
+                    f"scheduler bound pod {key} twice (latest to {node})"
+                )
+            self._sched_bound.add(key)
+
+    def drain(self, cycle: int, violations: list[Violation]) -> None:
+        for detail in self._pending:
+            _record(violations, "double_bind", cycle, detail)
+        self._pending.clear()
+
+
+def check_capacity(
+    cluster: ClusterState, cycle: int, violations: list[Violation]
+) -> None:
+    nodes = {n.name: n for n in cluster.list_nodes()}
+    used: dict[str, dict[str, int]] = {}
+    count: dict[str, int] = {}
+    for pod in cluster.list_pods():
+        if not pod.node_name or pod.node_name not in nodes:
+            continue  # node deleted after the bind: capacity is moot
+        u = used.setdefault(pod.node_name, {})
+        for r, v in pod.resource_request().items():
+            u[r] = u.get(r, 0) + v
+        count[pod.node_name] = count.get(pod.node_name, 0) + 1
+    for name in sorted(used):
+        node = nodes[name]
+        for r in sorted(used[name]):
+            v = used[name][r]
+            if r == "pods" or v <= 0:
+                continue
+            if v > node.allocatable.get(r, 0):
+                _record(
+                    violations, "capacity", cycle,
+                    f"node {name}: {r} used {v} > allocatable "
+                    f"{node.allocatable.get(r, 0)}",
+                )
+        if count.get(name, 0) > node.allowed_pod_number:
+            _record(
+                violations, "capacity", cycle,
+                f"node {name}: {count[name]} pods > allowed "
+                f"{node.allowed_pod_number}",
+            )
+
+
+def check_lost_pods(
+    cluster: ClusterState,
+    scheduler,
+    cycle: int,
+    violations: list[Violation],
+    undelivered: Callable[[], set[str]] = lambda: set(),
+) -> None:
+    tracked = set(scheduler.queue.entries())
+    tracked |= set(scheduler._in_flight)
+    tracked |= set(scheduler._waiting)
+    tracked |= undelivered()
+    for pod in cluster.list_pods():
+        if pod.node_name or pod.scheduler_name not in scheduler.solvers:
+            continue
+        if pod.key not in tracked:
+            _record(
+                violations, "lost_pod", cycle,
+                f"pod {pod.key} is unbound but tracked by neither the "
+                "queue, the in-flight map, the WaitingPods map, nor an "
+                "undelivered watch event",
+            )
+
+
+class MonotonicCounters:
+    """Counter series must never decrease between checks. ``sample``
+    is injectable so known-bad tests can feed a regressing series; the
+    default reads the live metrics registry."""
+
+    WATCHED = (
+        "scheduler_schedule_attempts_total",
+        "scheduler_queue_incoming_pods_total",
+        "scheduler_tpu_solves_discarded_total",
+        "scheduler_pipeline_fallback_total",
+        "scheduler_preemption_attempts_total",
+    )
+
+    def __init__(self, sample: Callable[[], dict[str, float]] | None = None):
+        self._sample = sample or self._sample_registry
+        self._last: dict[str, float] = {}
+
+    @staticmethod
+    def _sample_registry() -> dict[str, float]:
+        out: dict[str, float] = {}
+        for family in metrics.REGISTRY.collect():
+            for s in family.samples:
+                if not s.name.endswith("_total"):
+                    continue
+                if s.name in MonotonicCounters.WATCHED:
+                    out[s.name] = out.get(s.name, 0.0) + s.value
+        return out
+
+    def observe(self, cycle: int, violations: list[Violation]) -> None:
+        cur = self._sample()
+        for name in sorted(self._last):
+            if cur.get(name, 0.0) < self._last[name]:
+                _record(
+                    violations, "monotonic", cycle,
+                    f"counter {name} went backwards: "
+                    f"{self._last[name]} -> {cur.get(name, 0.0)}",
+                )
+        self._last = cur

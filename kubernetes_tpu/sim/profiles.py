@@ -1,0 +1,159 @@
+"""Scenario profiles: named churn/fault mixes the simulator runs.
+
+A profile is a declarative recipe — per-cycle event rates for the
+generators plus fault-injection knobs — from which a seeded run derives
+everything else. Rates are expected counts or probabilities consumed in
+a fixed order by ``generators.ChurnGenerator``, so a profile + seed is
+a complete description of a run.
+
+Soundness constraint (enforced in ``validate``): a profile that delays
+watch delivery must NOT also shrink node allocatable or perform
+external competing binds. Under delayed delivery the scheduler's view
+legitimately lags the cluster, and binding against a view that predates
+a capacity *reduction* can transiently overcommit — exactly the
+staleness the reference scheduler also accepts (kubelet admission is
+the real-world backstop). The capacity invariant would flag it as a
+scheduler bug when it is not one, so those knobs are mutually
+exclusive per profile. Capacity-*increasing* churn (node adds, label
+flaps, allocatable grows, pod deletes) is always safe to delay.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Profile:
+    name: str
+    # -- cluster shape / scheduler config --
+    pipelined: bool = True
+    nodes: int = 6
+    node_cpu: str = "8"
+    node_mem: str = "32Gi"
+    batch_size: int = 16
+    group_size: int = 8
+    # -- pod arrival process (uniform count per cycle, inclusive) --
+    arrivals: tuple[int, int] = (2, 6)
+    pod_cpu_choices: tuple[str, ...] = ("500m", "1", "2")
+    pod_priorities: tuple[int, ...] = (0,)
+    # -- churn rates (events per cycle; fractional = probability) --
+    delete_pod_rate: float = 0.0
+    node_add_rate: float = 0.0
+    node_delete_rate: float = 0.0
+    label_flap_rate: float = 0.0
+    alloc_grow_rate: float = 0.0
+    alloc_shrink_rate: float = 0.0
+    external_bind_rate: float = 0.0
+    # -- fault injection --
+    bind_fault_rate: float = 0.0  # P(injected ApiError per scheduler bind)
+    watch_delay: bool = False  # hold watch events for later delivery
+    watch_dup_rate: float = 0.0  # P(an event is delivered twice)
+    extender: bool = False  # configure a (faultable) HTTP extender
+    extender_fault_rate: float = 0.0  # P(timeout/5xx per extender call)
+    permit: bool = False  # register the stalling Permit plugin
+    permit_stall_rate: float = 0.0  # P(first attempt of a pod WAITs)
+    permit_timeout: float = 5.0
+
+    def validate(self) -> None:
+        if self.watch_delay and (
+            self.alloc_shrink_rate > 0 or self.external_bind_rate > 0
+        ):
+            raise ValueError(
+                f"profile {self.name}: watch_delay cannot be combined with "
+                "alloc_shrink_rate/external_bind_rate (delayed delivery of "
+                "capacity reductions makes transient overcommit legitimate, "
+                "so the capacity invariant would be unsound — see module "
+                "docstring)"
+            )
+
+
+PROFILES: dict[str, Profile] = {
+    p.name: p
+    for p in (
+        # the flagship: everything that can churn does, delivery is
+        # delayed and duplicated (at-least-once), binds fail randomly —
+        # the scenario class every advisor-found concurrency bug
+        # (fence livelock, stale sessions, unlocked in-flight maps)
+        # lived in. No shrinks/external binds (see module docstring).
+        Profile(
+            name="churn_heavy",
+            arrivals=(2, 6),
+            delete_pod_rate=0.8,
+            node_add_rate=0.3,
+            node_delete_rate=0.25,
+            label_flap_rate=2.5,
+            alloc_grow_rate=0.4,
+            bind_fault_rate=0.15,
+            watch_delay=True,
+            watch_dup_rate=0.2,
+        ),
+        # competing actors: an external binder races the scheduler for
+        # the same pods/capacity while injected bind conflicts exercise
+        # the forget/requeue protocol. Prompt delivery.
+        Profile(
+            name="bind_storms",
+            arrivals=(3, 8),
+            external_bind_rate=1.5,
+            bind_fault_rate=0.35,
+            alloc_shrink_rate=0.2,
+            delete_pod_rate=0.3,
+        ),
+        # topology churn: nodes come, go, shrink, grow, flap labels;
+        # snapshot slot remaps and SessionDrainRequired paths dominate.
+        Profile(
+            name="node_flaps",
+            arrivals=(1, 4),
+            node_add_rate=1.0,
+            node_delete_rate=0.8,
+            label_flap_rate=1.5,
+            alloc_grow_rate=0.5,
+            alloc_shrink_rate=0.5,
+        ),
+        # priority inversion pressure: low-priority filler keeps nodes
+        # full, high-priority arrivals must preempt their way in.
+        Profile(
+            name="preemption_pressure",
+            nodes=4,
+            arrivals=(3, 6),
+            pod_cpu_choices=("2", "4"),
+            pod_priorities=(0, 0, 0, 1000),
+            delete_pod_rate=0.2,
+        ),
+        # the extender boundary under latency/timeout/5xx: ignorable=False
+        # so a failed call aborts the batch (the reference's error status),
+        # exercising the mid-cycle-outage requeue path every few cycles.
+        Profile(
+            name="extender_flaky",
+            pipelined=False,  # extenders force the synchronous loop anyway
+            arrivals=(2, 5),
+            extender=True,
+            extender_fault_rate=0.3,
+            bind_fault_rate=0.1,
+        ),
+        # Permit-point stalls: pods park in the WaitingPods map and are
+        # later allowed or timed out on the virtual clock.
+        Profile(
+            name="permit_stalls",
+            pipelined=False,  # out-of-tree plugins force the sync loop
+            arrivals=(2, 5),
+            permit=True,
+            permit_stall_rate=0.5,
+            permit_timeout=5.0,
+            delete_pod_rate=0.2,
+        ),
+    )
+}
+
+for _p in PROFILES.values():
+    _p.validate()
+del _p
+
+
+def get_profile(name: str) -> Profile:
+    try:
+        return PROFILES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown profile {name!r}; available: {', '.join(sorted(PROFILES))}"
+        ) from None

@@ -117,8 +117,15 @@ class ClusterState:
     the same lock across a whole schedule_batch, which makes its
     pop -> solve -> bind cycle atomic with respect to ingest."""
 
-    def __init__(self) -> None:
+    def __init__(self, clock=None) -> None:
+        from ..utils.clock import Clock
+
         self.lock = threading.RLock()
+        # event timestamps (TTL sweeps, first/lastTimestamp) come off an
+        # injectable clock so the sim's virtual timeline covers the state
+        # service too; callers that pass explicit timestamps (the
+        # scheduler's recorder) are unaffected
+        self.clock = clock or Clock()
         self._rv = 0
         self._pods: dict[str, Pod] = {}  # key = ns/name
         self._nodes: dict[str, Node] = {}
@@ -151,6 +158,16 @@ class ClusterState:
 
     def subscribe(self, w: Watcher) -> None:
         self._watchers.append(w)
+
+    def unsubscribe(self, w: Watcher) -> None:
+        """Remove a watcher (bound methods compare equal by func +
+        instance, so ``unsubscribe(obj.handler)`` works). The sim's
+        fault harness uses this to interpose a delayed/duplicating
+        delivery bus between the state service and the scheduler."""
+        try:
+            self._watchers.remove(w)
+        except ValueError:
+            raise ApiError("NotFound", "watcher not subscribed") from None
 
     def _emit(self, etype: EventType, kind: str, obj: Pod | Node) -> None:
         ev = Event(etype, kind, obj, self._rv)
@@ -502,9 +519,7 @@ class ClusterState:
         record (EventAggregator's dedup key, minus source — one scheduler
         here); new tuples create a record. Emits on the watch bus with
         kind="Event" either way."""
-        import time as _time
-
-        ts = _time.time() if timestamp is None else timestamp
+        ts = self.clock.now() if timestamp is None else timestamp
         # reference apiserver gives Events a TTL (1h default) instead of
         # durable storage. Pruning must not trust insertion order: a
         # count-bumped old record keeps a FRESH last_timestamp at the

@@ -133,6 +133,14 @@ class PriorityQueue:
             ] += 1
         return out
 
+    def entries(self) -> dict[str, str]:
+        """Pod key -> structure it currently lives in (``active`` |
+        ``backoff`` | ``unsched`` | ``gated``). Read-only snapshot for
+        observers (the sim's lost-pod invariant checker accounts every
+        unbound pod against this map plus the scheduler's in-flight and
+        waiting sets) — never a mutation surface."""
+        return dict(self._where)
+
     def _push_active(self, info: QueuedPodInfo) -> None:
         if self._less is not None:
             key0 = _SortKey(info, self._less)

@@ -148,14 +148,27 @@ class Snapshot:
                 self._free.append(i)
                 self._bump_col(i)
 
-        # additions + dirty rewrites
-        next_slot = max(self._slot_of.values(), default=-1) + 1
+        # additions + dirty rewrites. Fresh slots must dodge EVERY taken
+        # slot, not just count up from the pre-add maximum: a removal can
+        # free a HIGH slot in this same update, and once _free hands it
+        # out, a max+1 counter sitting below it would walk back up and
+        # assign the same slot twice — two nodes sharing one column, the
+        # second _write_column silently erasing the first node's usage
+        # (device tables then understate and the solver overcommits;
+        # caught by the sim harness's capacity invariant under node-churn
+        # profiles).
+        taken = set(self._slot_of.values())
+        next_slot = 0
         for name, info in live.items():
             i = self._slot_of.get(name)
             if i is None:
-                i = self._free.pop() if self._free else next_slot
-                if i == next_slot:
-                    next_slot += 1
+                if self._free:
+                    i = self._free.pop()
+                else:
+                    while next_slot in taken:
+                        next_slot += 1
+                    i = next_slot
+                taken.add(i)
                 self._slot_of[name] = i
                 self.names[i] = name
                 self._write_column(i, info, vocab)

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# CI gate: the three correctness/perf layers in order of cost —
+#   1. static analysis (scripts/lint.py — TPU001..MET001, instant)
+#   2. tier-1 tests   (ROADMAP.md invocation, minus the soak marker)
+#   3. sim smokes     (one fixed-seed run per scenario profile, plus a
+#      determinism self-check on the flagship churn profile)
+#
+# Usage: scripts/ci.sh            # everything
+#        SKIP_TESTS=1 scripts/ci.sh   # lint + sim only (fast local loop)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint: static analyzer =="
+python scripts/lint.py
+
+if [ -z "${SKIP_TESTS:-}" ]; then
+    echo "== tier-1 tests =="
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly
+fi
+
+echo "== sim smokes (fixed seed, every profile) =="
+for profile in churn_heavy bind_storms node_flaps preemption_pressure \
+               extender_flaky permit_stalls; do
+    echo "-- $profile --"
+    python -m kubernetes_tpu.sim --seed 0 --cycles 6 --profile "$profile"
+done
+
+echo "== sim determinism self-check =="
+python -m kubernetes_tpu.sim --seed 0 --cycles 6 --profile churn_heavy \
+    --selfcheck
+
+echo "CI gate: OK"

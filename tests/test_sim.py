@@ -1,0 +1,87 @@
+"""Tier-1 sim scenarios: short fixed-seed runs of the deterministic
+cluster simulator (kubernetes_tpu/sim) against the REAL scheduler.
+
+Three pins:
+1. determinism — two fresh runs of the same seed+profile produce
+   byte-identical traces and identical final bindings;
+2. the ISSUE-2 acceptance scenario — bind-failure + watch-delay
+   injection against run_pipelined completes with zero invariant
+   violations while the livelock backstop (PR-1's
+   scheduler_pipeline_fallback_total) engages at least once;
+3. replay — a recorded trace re-executes to identical final bindings.
+
+Long multi-profile soaks live in test_sim_soak.py (@slow).
+"""
+
+import pytest
+
+from kubernetes_tpu import metrics
+from kubernetes_tpu.sim import SimHarness, replay_trace, run_sim
+
+CYCLES = 6  # small: tier-1 budget; soak covers depth
+
+
+def test_churn_heavy_deterministic():
+    a = run_sim("churn_heavy", seed=0, cycles=CYCLES)
+    b = run_sim("churn_heavy", seed=0, cycles=CYCLES)
+    assert a.trace.lines == b.trace.lines
+    assert a.trace.digest() == b.trace.digest()
+    assert a.bindings == b.bindings
+    assert a.violations == b.violations == []
+    assert a.settled and b.settled
+
+
+def test_churn_heavy_pipelined_fence_and_backstop():
+    """The acceptance scenario: churn_heavy injects bind failures and
+    delayed/duplicated watch delivery against run_pipelined. The run
+    must finish with zero invariant violations, and the sustained
+    fence-discard churn must have engaged the pipeline's livelock
+    backstop at least once (proving the sim reaches the dispatch→apply
+    window, not just the idle gaps between cycles)."""
+    res = run_sim("churn_heavy", seed=0, cycles=CYCLES)
+    assert res.summary["pipelined"] is True
+    assert res.violations == []
+    assert res.settled
+    assert res.summary["bind_faults"] > 0  # faults actually fired
+    assert res.summary["watch_delivered"] > 0
+    assert res.summary["discards"] >= 1  # fence actually discarded solves
+    assert res.summary["pipeline_fallbacks"] >= 1  # backstop engaged
+
+
+def test_bind_storms_external_actors():
+    """External competing binds + injected bind conflicts: the
+    assume/forget protocol and ghost-entry handling under a racing
+    actor, with every invariant holding."""
+    res = run_sim("bind_storms", seed=1, cycles=CYCLES)
+    assert res.violations == []
+    assert res.settled
+    assert res.summary["bind_faults"] > 0
+
+
+def test_trace_replays_to_identical_bindings(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    rec = run_sim("churn_heavy", seed=5, cycles=CYCLES)
+    rec.trace.dump(path)
+    rep = replay_trace(path)
+    assert rep.replay_divergence is None
+    assert rep.bindings == rec.bindings
+    assert [v.as_dict() for v in rep.violations] == [
+        v.as_dict() for v in rec.violations
+    ]
+
+
+def test_sim_metrics_registered():
+    """MET001 satellite: every scheduler_sim_* series the sim records
+    is registered in the dedicated registry (a typo would only blow up
+    on the first faulted run otherwise)."""
+    run_sim("node_flaps", seed=2, cycles=3)
+    names = {
+        family.name for family in metrics.REGISTRY.collect()
+    }
+    for expected in (
+        "scheduler_sim_events",
+        "scheduler_sim_faults_injected",
+        "scheduler_sim_invariant_violations",
+        "scheduler_sim_cycles",
+    ):
+        assert expected in names, expected

@@ -1,0 +1,215 @@
+"""Known-bad fixtures for the sim's invariant checkers — the
+fixture-per-rule pattern of tests/test_static_analysis.py: a checker
+that never fires gates nothing, so each one is fed a crafted violation
+it MUST flag (and a clean state it must not)."""
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.sim.invariants import (
+    BindTransitionTracker,
+    MonotonicCounters,
+    check_capacity,
+    check_lost_pods,
+)
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def _cluster(n_nodes=2, cpu="4"):
+    cs = ClusterState(clock=FakeClock())
+    for i in range(n_nodes):
+        cs.create_node(
+            MakeNode()
+            .name(f"n{i}")
+            .capacity({"cpu": cpu, "memory": "8Gi", "pods": "10"})
+            .obj()
+        )
+    return cs
+
+
+def _pod(name, cpu="1"):
+    return MakePod().name(name).req({"cpu": cpu, "memory": "1Gi"}).obj()
+
+
+# -- double_bind ------------------------------------------------------------
+
+
+def test_double_bind_flags_node_transition():
+    cs = _cluster()
+    tracker = BindTransitionTracker(cs)
+    cs.create_pod(_pod("a"))
+    cs.bind("default", "a", "n0")
+    violations = []
+    tracker.drain(0, violations)
+    assert violations == []  # a first bind is fine
+    # the state service's binding subresource refuses rebinds, so forge
+    # the A->B transition the way a buggy writer would: update_pod
+    pod = cs.get_pod("default", "a")
+    pod.node_name = "n1"
+    cs.update_pod(pod)
+    tracker.drain(1, violations)
+    assert len(violations) == 1
+    assert violations[0].invariant == "double_bind"
+    assert "rebound n0 -> n1" in violations[0].detail
+
+
+def test_double_bind_flags_duplicate_scheduler_result():
+    cs = _cluster()
+    tracker = BindTransitionTracker(cs)
+    tracker.record_results([("default/a", "n0")])
+    tracker.record_results([("default/a", "n1")])
+    violations = []
+    tracker.drain(0, violations)
+    assert [v.invariant for v in violations] == ["double_bind"]
+
+
+def test_double_bind_allows_delete_then_recreate():
+    cs = _cluster()
+    tracker = BindTransitionTracker(cs)
+    cs.create_pod(_pod("a"))
+    cs.bind("default", "a", "n0")
+    cs.delete_pod("default", "a")
+    cs.create_pod(_pod("a"))
+    cs.bind("default", "a", "n1")
+    violations = []
+    tracker.drain(0, violations)
+    assert violations == []
+
+
+# -- capacity ---------------------------------------------------------------
+
+
+def test_capacity_flags_overflow():
+    cs = _cluster(n_nodes=1, cpu="2")
+    # the binding subresource doesn't check capacity (neither does the
+    # apiserver) — overflowing it is exactly the scheduler bug class
+    # this checker exists to catch
+    for i in range(3):
+        cs.create_pod(_pod(f"p{i}", cpu="1"))
+        cs.bind("default", f"p{i}", "n0")
+    violations = []
+    check_capacity(cs, 0, violations)
+    assert [v.invariant for v in violations] == ["capacity"]
+    assert "cpu used 3000 > allocatable 2000" in violations[0].detail
+
+
+def test_capacity_clean_at_exact_fit():
+    cs = _cluster(n_nodes=1, cpu="2")
+    for i in range(2):
+        cs.create_pod(_pod(f"p{i}", cpu="1"))
+        cs.bind("default", f"p{i}", "n0")
+    violations = []
+    check_capacity(cs, 0, violations)
+    assert violations == []
+
+
+def test_capacity_flags_pod_count_overflow():
+    cs = _cluster(n_nodes=1, cpu="64")
+    # pods allocatable is 10; bind 11 near-free pods
+    for i in range(11):
+        cs.create_pod(_pod(f"p{i}", cpu="100m"))
+        cs.bind("default", f"p{i}", "n0")
+    violations = []
+    check_capacity(cs, 0, violations)
+    assert any("pods > allowed" in v.detail for v in violations)
+
+
+# -- lost_pod ---------------------------------------------------------------
+
+
+def _sched(cs):
+    return Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=8,
+            solver=ExactSolverConfig(tie_break="first", group_size=4),
+        ),
+        clock=FakeClock(),
+    )
+
+
+def test_lost_pod_flags_dropped_bookkeeping():
+    cs = _cluster()
+    s = _sched(cs)
+    cs.create_pod(_pod("a"))
+    violations = []
+    check_lost_pods(cs, s, 0, violations)
+    assert violations == []  # queued: accounted for
+    # simulate the bug class: the pod falls out of every structure
+    s.queue.delete("default/a")
+    check_lost_pods(cs, s, 1, violations)
+    assert [v.invariant for v in violations] == ["lost_pod"]
+    assert "default/a" in violations[0].detail
+
+
+def test_lost_pod_accepts_undelivered_watch_add():
+    cs = _cluster()
+    s = _sched(cs)
+    cs.unsubscribe(s._on_event)  # the delayed-bus interposition shape
+    cs.create_pod(_pod("a"))  # scheduler never saw the ADDED event
+    violations = []
+    check_lost_pods(
+        cs, s, 0, violations, undelivered=lambda: {"default/a"}
+    )
+    assert violations == []
+    check_lost_pods(cs, s, 1, violations)  # no undelivered claim -> lost
+    assert [v.invariant for v in violations] == ["lost_pod"]
+
+
+def test_lost_pod_ignores_foreign_scheduler_pods():
+    cs = _cluster()
+    s = _sched(cs)
+    pod = MakePod().name("x").scheduler_name("other").req({"cpu": "1"}).obj()
+    cs.create_pod(pod)
+    violations = []
+    check_lost_pods(cs, s, 0, violations)
+    assert violations == []
+
+
+# -- monotonic --------------------------------------------------------------
+
+
+def test_monotonic_flags_regressing_counter():
+    series = {"scheduler_schedule_attempts_total": 5.0}
+    mono = MonotonicCounters(sample=lambda: dict(series))
+    violations = []
+    mono.observe(0, violations)
+    assert violations == []
+    series["scheduler_schedule_attempts_total"] = 3.0  # regression
+    mono.observe(1, violations)
+    assert [v.invariant for v in violations] == ["monotonic"]
+    assert "went backwards" in violations[0].detail
+
+
+def test_monotonic_clean_on_growth():
+    series = {"scheduler_schedule_attempts_total": 5.0}
+    mono = MonotonicCounters(sample=lambda: dict(series))
+    violations = []
+    mono.observe(0, violations)
+    series["scheduler_schedule_attempts_total"] = 9.0
+    mono.observe(1, violations)
+    assert violations == []
+
+
+# -- progress (the settle loop's violation) ---------------------------------
+
+
+def test_progress_violation_on_unsettled_harness():
+    """A harness whose scheduler never drains must emit a progress
+    violation instead of looping forever — pin it with a queue-stuffed
+    settle check rather than a real livelock (the real one is what the
+    pipelined backstop prevents, test_pipelined covers it)."""
+    from kubernetes_tpu.sim.harness import SimHarness
+
+    h = SimHarness("node_flaps", seed=0, cycles=0, max_settle_rounds=3)
+    # park a pod the scheduler will never see an event for, then gut the
+    # drive so nothing ever drains it
+    cs = h.cluster
+    cs.create_pod(_pod("stuck"))
+    h.bus.pump_all()
+    h.scheduler.run_until_settled = lambda max_batches=0: []
+    h.scheduler.run_pipelined = lambda max_batches=0: []
+    res = h.run()
+    assert not res.settled
+    assert any(v.invariant == "progress" for v in res.violations)

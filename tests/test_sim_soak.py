@@ -1,0 +1,44 @@
+"""Long sim soaks — every profile, multiple seeds, deeper cycle counts.
+
+Marked ``slow``: tier-1 deselects these (-m 'not slow'); run them
+explicitly before touching the scheduling loop's concurrency story:
+
+    JAX_PLATFORMS=cpu python -m pytest tests/test_sim_soak.py -m slow
+"""
+
+import pytest
+
+from kubernetes_tpu.sim import PROFILES, run_sim
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.mark.parametrize("profile", sorted(PROFILES))
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_soak_profile(profile, seed):
+    res = run_sim(profile, seed=seed, cycles=25)
+    assert res.violations == [], [v.as_dict() for v in res.violations]
+    assert res.settled
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13, 14])
+def test_soak_churn_heavy_deep_deterministic(seed):
+    a = run_sim("churn_heavy", seed=seed, cycles=40)
+    b = run_sim("churn_heavy", seed=seed, cycles=40)
+    assert a.trace.digest() == b.trace.digest()
+    assert a.bindings == b.bindings
+    assert a.violations == [] and a.settled
+
+
+def test_soak_sync_vs_pipelined_agree_on_quiet_cluster():
+    """With no faults or churn racing mid-flight (node_flaps is prompt
+    delivery), the pipelined and synchronous drivers must settle every
+    pod — cross-driver sanity over a long run."""
+    a = run_sim("node_flaps", seed=9, cycles=30, pipelined=True)
+    b = run_sim("node_flaps", seed=9, cycles=30, pipelined=False)
+    assert a.violations == [] and b.violations == []
+    assert a.settled and b.settled
+    # identical churn stream (same seed) => identical pod population
+    assert set(a.bindings) | set(a.unbound) == set(b.bindings) | set(
+        b.unbound
+    )

@@ -183,6 +183,37 @@ class TestSnapshot:
         assert snap.slot_of("n9") == slot  # reused
         assert b.valid[slot]
 
+    def test_high_freed_slot_with_multiple_adds_no_collision(self):
+        """Regression (sim-caught overcommit): removing a HIGH slot and
+        adding more nodes than _free holds in ONE update used to
+        double-assign the freed slot — max+1 fresh-slot counting walked
+        back up into a slot _free had already handed out, two nodes
+        shared a column, and the second write erased the first node's
+        usage (the solver then overcommitted against understated
+        tables)."""
+        c = SchedulerCache(FakeClock())
+        for i in range(9):
+            c.add_node(node(f"n{i}"))
+        snap = Snapshot()
+        snap.update(c)
+        # free a LOW slot, then a HIGH slot, then add three nodes in one
+        # update: free=[low, high] pops high first, and the fresh-slot
+        # path must not re-issue it
+        c.remove_node("n7")
+        snap.update(c)
+        c.remove_node("n8")
+        for i in range(9, 12):
+            c.add_node(node(f"n{i}"))
+        b = snap.update(c)
+        slots = [snap.slot_of(f"n{i}") for i in (0, 1, 2, 3, 4, 5, 6, 9, 10, 11)]
+        assert len(set(slots)) == len(slots), slots
+        # every column carries ITS node's tables (no silent overwrite)
+        for i in (9, 10, 11):
+            s = snap.slot_of(f"n{i}")
+            assert b.valid[s]
+            assert b.allocatable[0, s] == 4000
+            assert b.used[0, s] == 0
+
     def test_capacity_growth_preserves_slots(self):
         c = SchedulerCache(FakeClock())
         for i in range(100):
