@@ -18,7 +18,15 @@ Package map (SURVEY.md §8):
 - ``server``    — scheduler-extender webhook (aiohttp) + bulk gRPC path
 - ``config``    — KubeSchedulerConfiguration mirror
 - ``metrics``   — Prometheus metrics with upstream names
+- ``obs``       — scheduling trace layer: spans, per-pod decision
+  journal, flight recorder, explain CLI
 - ``parallel``  — device-mesh sharding of the pods×nodes solve
 """
+
+import logging as _logging
+
+# library practice: no output unless an application configures handlers
+# (cli.py serve installs the structured formatter via utils/logging.py)
+_logging.getLogger(__name__).addHandler(_logging.NullHandler())
 
 __version__ = "0.1.0"

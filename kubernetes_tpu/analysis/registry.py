@@ -39,6 +39,7 @@ METRIC_SCAN_PATHS = (
     "kubernetes_tpu/server/",
     "kubernetes_tpu/solver/",
     "kubernetes_tpu/sim/",
+    "kubernetes_tpu/obs/",
 )
 
 

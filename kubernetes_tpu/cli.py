@@ -74,13 +74,29 @@ def cmd_config(args) -> int:
 def cmd_serve(args) -> int:
     from .server.extender import run_server
     from .state.cluster import ClusterState
+    from .utils import logging as structured_logging
 
+    # component-base logs analog (--logging-format): one JSON object per
+    # line carrying the scheduler's span/batch ids, or klog-ish text
+    structured_logging.setup(args.log_format)
     cfg = _load_config(args.config)
     for w in cfg.warnings:
         print(f"warning: {w}", file=sys.stderr)
     cluster = ClusterState()
     sched_cfg = config_types.scheduler_config(cfg)
     sched_cfg.feature_gates = _feature_gates(args)
+    if args.obs or args.obs_journal or args.obs_dump:
+        from .obs import ObsConfig
+
+        sched_cfg.obs = ObsConfig(
+            spans=True,
+            journal=True,
+            journal_path=args.obs_journal,
+            dump_path=args.obs_dump,
+            # a serving process runs indefinitely: bound the in-memory
+            # journal and rely on --obs-journal streaming for history
+            journal_capacity=65536,
+        )
     if args.leader_elect:
         # client-go leaderelection.RunOrDie semantics over the state
         # service's Lease store: block serving until the lease is held;
@@ -225,6 +241,33 @@ def main(argv: list[str] | None = None) -> int:
         type=int,
         default=0,
         help="also serve the bulk tensor gRPC path on this port (0 = off)",
+    )
+    p_serve.add_argument(
+        "--log-format",
+        choices=("text", "json"),
+        default="text",
+        help="structured logging format (component-base --logging-format "
+        "analog); json emits one object per line carrying span/batch ids",
+    )
+    p_serve.add_argument(
+        "--obs",
+        action="store_true",
+        help="enable the scheduling trace layer (kubernetes_tpu/obs): "
+        "spans + per-pod decision journal in a bounded flight recorder, "
+        "served at /debug/flightrecorder and /debug/spans",
+    )
+    p_serve.add_argument(
+        "--obs-journal",
+        metavar="PATH",
+        help="also stream per-pod decision-journal JSONL here (implies "
+        "--obs); explain pods later with `python -m kubernetes_tpu.obs "
+        "explain <pod> --trace PATH`",
+    )
+    p_serve.add_argument(
+        "--obs-dump",
+        metavar="PATH",
+        help="flight-recorder dump target for crash and on-demand dumps "
+        "(implies --obs)",
     )
     p_serve.set_defaults(fn=cmd_serve)
 

@@ -131,6 +131,32 @@ pipeline_fallback_total = Counter(
     "under sustained capacity/mask-affecting event churn.",
     registry=REGISTRY,
 )
+# -- scheduling trace layer (kubernetes_tpu/obs) --
+
+trace_spans_total = Counter(
+    "scheduler_tpu_trace_spans_total",
+    "Spans finished by the scheduling trace layer, by span name "
+    "(schedule_batch|snapshot|tensorize|fold|dispatch|fence|apply|"
+    "bind|enqueue|discard|extender_batch).",
+    ["name"],
+    registry=REGISTRY,
+)
+journal_records_total = Counter(
+    "scheduler_tpu_trace_journal_records_total",
+    "Per-pod decision-journal records written, by outcome "
+    "(bound|unschedulable|bind_failure|permit_wait|permit_rejected|"
+    "permit_timeout|discarded).",
+    ["outcome"],
+    registry=REGISTRY,
+)
+flight_recorder_dumps_total = Counter(
+    "scheduler_tpu_flight_recorder_dumps_total",
+    "Flight-recorder ring dumps, by trigger "
+    "(crash|invariant|manual).",
+    ["trigger"],
+    registry=REGISTRY,
+)
+
 # -- cluster simulator (kubernetes_tpu/sim) --
 
 sim_events_total = Counter(
@@ -152,7 +178,8 @@ sim_faults_injected_total = Counter(
 sim_invariant_violations_total = Counter(
     "scheduler_sim_invariant_violations_total",
     "Invariant violations the simulator's checkers flagged, by "
-    "invariant (double_bind|capacity|lost_pod|progress|monotonic).",
+    "invariant (double_bind|capacity|lost_pod|progress|monotonic|"
+    "journal).",
     ["invariant"],
     registry=REGISTRY,
 )

@@ -1,0 +1,122 @@
+"""kubernetes_tpu.obs — the end-to-end scheduling trace layer.
+
+Three cooperating pieces, all zero-dep and virtual-time-clean:
+
+- **spans** (``span.py``): OTel-shaped host-side spans threaded through
+  both scheduler loops (enqueue → snapshot → tensorize → fold/extender
+  → dispatch → fence → apply → bind) and the extender server's
+  micro-batcher; exported as JSONL and into the flight recorder.
+- **per-pod decision journal** (``journal.py``): one record per pod per
+  solved batch — outcome plus per-plugin filter attribution pulled from
+  the host-materialized solve tensors, so "why is pod X pending" has a
+  concrete answer ("NodeResourcesFit rejected 14/16 nodes, ...").
+- **flight recorder** (``recorder.py``): bounded ring of recent spans +
+  decisions, dumped on crash, on sim invariant violation, and on demand
+  via ``GET /debug/flightrecorder`` / ``/debug/spans``.
+
+``python -m kubernetes_tpu.obs explain <pod> [--trace FILE | --url U]``
+reconstructs a pod's history from any of those sources (``explain.py``).
+
+Everything is OFF by default: ``build_obs(None, clock)`` returns a
+disabled tracer and no journal/recorder, and the scheduler's hot path
+then pays one attribute check per would-be span — no allocation, no
+host↔device syncs (TPU001 stays clean; verified by the analyzer gate).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.clock import Clock
+from .explain import Explanation, explain_pod, parse_stream
+from .journal import (
+    OUTCOMES,
+    TERMINAL_OUTCOMES,
+    PodDecisionJournal,
+    attribute_failure,
+    summarize_plugins,
+    validate_line,
+    validate_lines,
+)
+from .recorder import FlightRecorder, canonical
+from .span import Span, Tracer
+
+__all__ = [
+    "ObsConfig",
+    "build_obs",
+    "Tracer",
+    "Span",
+    "PodDecisionJournal",
+    "FlightRecorder",
+    "Explanation",
+    "explain_pod",
+    "parse_stream",
+    "attribute_failure",
+    "summarize_plugins",
+    "validate_line",
+    "validate_lines",
+    "canonical",
+    "OUTCOMES",
+    "TERMINAL_OUTCOMES",
+]
+
+
+@dataclass
+class ObsConfig:
+    """Observability knobs carried on SchedulerConfig.obs (None = all
+    off, the production default)."""
+
+    spans: bool = False  # emit spans from the scheduler loops
+    journal: bool = False  # per-pod decision journal
+    span_capacity: int = 4096  # flight-recorder ring sizes
+    decision_capacity: int = 8192
+    # in-memory journal line retention: None = unbounded (the sim needs
+    # the full history); serve passes a bound and streams to
+    # journal_path for durability
+    journal_capacity: int | None = None
+    # streaming JSONL sinks (append-mode files); None = in-memory only
+    spans_path: str | None = None
+    journal_path: str | None = None
+    # crash / invariant-violation dump target for the flight recorder
+    dump_path: str | None = None
+
+
+class _FileSink:
+    """Append-mode JSONL line writer (flushed per line: a crash must
+    not lose the records explaining it)."""
+
+    def __init__(self, path: str) -> None:
+        self._f = open(path, "a")
+
+    def __call__(self, rec: dict) -> None:
+        self._f.write(canonical(rec) + "\n")
+        self._f.flush()
+
+
+def build_obs(
+    cfg: ObsConfig | None, clock: Clock | None = None
+) -> tuple[Tracer, PodDecisionJournal | None, FlightRecorder | None]:
+    """(tracer, journal, flight recorder) for one Scheduler. With cfg
+    None or everything disabled: a disabled Tracer and two Nones."""
+    if cfg is None or not (cfg.spans or cfg.journal):
+        return Tracer(clock=clock, enabled=False), None, None
+    recorder = FlightRecorder(
+        span_capacity=cfg.span_capacity,
+        decision_capacity=cfg.decision_capacity,
+        dump_path=cfg.dump_path,
+    )
+    tracer = Tracer(
+        clock=clock,
+        enabled=cfg.spans,
+        recorder=recorder,
+        sink=_FileSink(cfg.spans_path) if cfg.spans_path else None,
+    )
+    journal = None
+    if cfg.journal:
+        journal = PodDecisionJournal(
+            clock=clock,
+            recorder=recorder,
+            sink=_FileSink(cfg.journal_path) if cfg.journal_path else None,
+            capacity=cfg.journal_capacity,
+        )
+    return tracer, journal, recorder

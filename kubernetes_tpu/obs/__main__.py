@@ -1,0 +1,99 @@
+"""CLI: explain a pod's scheduling history / validate a trace file.
+
+    # from a recorded journal or flight-recorder dump
+    python -m kubernetes_tpu.obs explain default/pod-3 --trace journal.jsonl
+    python -m kubernetes_tpu.obs explain <pod-uid> --trace dump.jsonl
+
+    # from a live scheduler's flight recorder (serve --mode scheduler)
+    python -m kubernetes_tpu.obs explain pod-3 --url http://127.0.0.1:10259
+
+    # schema-check a journal / dump (the CI obs smoke)
+    python -m kubernetes_tpu.obs validate journal.jsonl
+
+Exit status: 0 found/valid; 1 pod not found or schema errors; 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def _load_lines(args) -> list[str]:
+    if args.trace:
+        return Path(args.trace).read_text().splitlines()
+    if args.url:
+        import json
+        import urllib.request
+
+        from .recorder import canonical
+
+        url = args.url.rstrip("/") + "/debug/flightrecorder"
+        with urllib.request.urlopen(url, timeout=10.0) as r:
+            doc = json.loads(r.read().decode())
+        return [canonical(rec) for rec in doc.get("decisions") or []] + [
+            canonical(sp) for sp in doc.get("spans") or []
+        ]
+    raise SystemExit("error: one of --trace or --url is required")
+
+
+def cmd_explain(args) -> int:
+    from .explain import explain_pod, parse_stream
+
+    decisions, spans = parse_stream(_load_lines(args))
+    out = explain_pod(decisions, args.pod, spans=spans)
+    print(out.render())
+    return 0 if out.found else 1
+
+
+def cmd_validate(args) -> int:
+    from .journal import validate_lines
+
+    lines = Path(args.trace).read_text().splitlines()
+    errors = validate_lines(lines)
+    for err in errors:
+        print(f"{args.trace}: {err}", file=sys.stderr)
+    n = sum(1 for ln in lines if ln.strip())
+    if errors:
+        print(f"{args.trace}: {len(errors)} schema error(s) in {n} record(s)")
+        return 1
+    print(f"{args.trace}: {n} record(s), schema OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.obs",
+        description="Scheduling-trace tools: explain pods, validate traces.",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_explain = sub.add_parser(
+        "explain", help="reconstruct one pod's scheduling history"
+    )
+    p_explain.add_argument(
+        "pod", help="pod uid, ns/name key, or bare pod name"
+    )
+    p_explain.add_argument(
+        "--trace", metavar="FILE",
+        help="journal / flight-recorder JSONL to read",
+    )
+    p_explain.add_argument(
+        "--url", metavar="URL",
+        help="base URL of a live scheduler (reads /debug/flightrecorder)",
+    )
+    p_explain.set_defaults(fn=cmd_explain)
+
+    p_val = sub.add_parser(
+        "validate", help="schema-check a journal / flight-recorder JSONL"
+    )
+    p_val.add_argument("trace", metavar="FILE")
+    p_val.set_defaults(fn=cmd_validate)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""Reconstruct one pod's scheduling history from a decision journal (or
+a flight-recorder dump): the `kubectl describe pod` events story, but
+sourced from the scheduler's own trace layer and including per-plugin
+rejection attribution.
+
+Input is any JSONL stream mixing ``{"k": "dec"}`` decision records and
+``{"k": "span"}`` spans (a journal file, a flight-recorder dump, or the
+``/debug/flightrecorder`` JSON body re-flattened by the CLI). Pods
+match by exact uid, exact ``ns/name`` key, or bare pod name.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from .journal import TERMINAL_OUTCOMES, summarize_plugins
+
+
+@dataclass
+class Explanation:
+    ref: str
+    records: list[dict] = field(default_factory=list)  # journal order
+    spans: list[dict] = field(default_factory=list)  # terminal batch's spans
+
+    @property
+    def found(self) -> bool:
+        return bool(self.records)
+
+    @property
+    def terminal(self) -> dict | None:
+        """The pod's last terminal-outcome record (None = still open:
+        every record is a permit_wait/discarded intermediate)."""
+        for rec in reversed(self.records):
+            if rec.get("outcome") in TERMINAL_OUTCOMES:
+                return rec
+        return None
+
+    def render(self) -> str:
+        if not self.records:
+            return f"pod {self.ref!r}: no journal records found"
+        first = self.records[0]
+        uid = first.get("uid") or "?"
+        lines = [f"pod {first['pod']} (uid {uid}): {len(self.records)} record(s)"]
+        term = self.terminal
+        if term is None:
+            last = self.records[-1]
+            lines.append(
+                f"  state: OPEN — last record is {last['outcome']!r} at "
+                f"step {last['step']} (no terminal outcome yet)"
+            )
+        elif term["outcome"] == "bound":
+            lines.append(
+                f"  terminal outcome: bound to {term.get('node', '?')} "
+                f"(step {term['step']}, t={term['t']})"
+            )
+        else:
+            lines.append(
+                f"  terminal outcome: {term['outcome']} "
+                f"(step {term['step']}, t={term['t']})"
+            )
+            if term.get("plugins"):
+                lines.append(f"    plugins: {summarize_plugins(term['plugins'])}")
+            if term.get("reason"):
+                lines.append(f"    reason: {term['reason']}")
+        lines.append("  history:")
+        for rec in self.records:
+            bits = [
+                f"step {rec['step']}",
+                f"cycle {rec['cycle']}",
+                f"t={rec['t']}",
+                rec["outcome"],
+            ]
+            if rec.get("node"):
+                bits.append(f"-> {rec['node']}")
+            if rec.get("nominated"):
+                bits.append(f"nominated={rec['nominated']}")
+            if rec.get("attempts"):
+                bits.append(f"attempt {rec['attempts']}")
+            line = "    " + " ".join(bits)
+            if rec.get("plugins"):
+                line += f"  [{summarize_plugins(rec['plugins'])}]"
+            if rec.get("reason"):
+                line += f"  ({rec['reason']})"
+            lines.append(line)
+        if self.spans:
+            lines.append("  spans of the terminal batch:")
+            for sp in self.spans:
+                indent = "      " if sp.get("parent") else "    "
+                lines.append(
+                    f"{indent}{sp['name']}: {sp['dur'] * 1e3:.3f} ms"
+                    + (f" {sp['attrs']}" if sp.get("attrs") else "")
+                )
+        return "\n".join(lines)
+
+
+def parse_stream(lines) -> tuple[list[dict], list[dict]]:
+    """(decisions, spans) from a JSONL iterable; unknown/broken lines
+    are skipped (a flight-recorder dump may be truncated mid-crash)."""
+    decisions: list[dict] = []
+    spans: list[dict] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        kind = rec.get("k") if isinstance(rec, dict) else None
+        if kind == "dec":
+            decisions.append(rec)
+        elif kind == "span":
+            spans.append(rec)
+    return decisions, spans
+
+
+def _matches(rec: dict, ref: str) -> bool:
+    if rec.get("uid") == ref or rec.get("pod") == ref:
+        return True
+    pod = rec.get("pod") or ""
+    return "/" in pod and pod.split("/", 1)[1] == ref
+
+
+def explain_pod(
+    decisions: list[dict], ref: str, spans: list[dict] | None = None
+) -> Explanation:
+    records = [r for r in decisions if _matches(r, ref)]
+    out = Explanation(ref=ref, records=records)
+    term = out.terminal
+    if term is not None and spans:
+        out.spans = [s for s in spans if s.get("trace") == term["step"]]
+    return out

@@ -1,0 +1,286 @@
+"""Per-pod decision journal: one JSONL record per pod per solved batch,
+so "why is pod X still pending" is answerable from a file instead of a
+re-run under the profiler.
+
+Each record carries the pod's **outcome** for that batch and, for
+unschedulable pods, **per-plugin filter attribution** computed from the
+already-materialized host-side solve tensors (``_PreparedGroup``'s
+numpy tables: pod requests, node capacities, the static class mask, the
+port occupancy vocab). No device read happens here — the assignments
+were already downloaded through the one sanctioned deferred-read point
+(``analysis/registry.py``), and everything else lives on the host, so
+journaling is TPU001-clean by construction.
+
+Attribution granularity follows what the tensors materialize:
+
+- ``NodeResourcesFit``   — request vs (allocatable - used) + pod count,
+  from the NodeBatch/PodBatch tensors;
+- ``NodeAffinity``       — the fused static-family mask row (NodeName,
+  NodeUnschedulable, TaintToleration, NodeAffinity, volume plugins,
+  plus any folded out-of-tree/extender/DRA verdicts), reported under
+  the family's dominant member like the scheduler's per-plugin timing
+  metric does;
+- ``NodePorts``          — the pod's conflict vocab vs per-node port
+  occupancy;
+- residual rejections (nodes every host-side mask accepts but the
+  solve still rejected) are attributed to the in-scan constraint the
+  pod actually carries — ``PodTopologySpread`` / ``InterPodAffinity``
+  — or to ``BatchCarriedUsage`` (capacity consumed by earlier pods of
+  the same batch, which only exists device-side).
+
+Determinism contract (shared with ``sim/trace.py``): records are
+canonical JSON with sorted keys, timestamps come off the injectable
+``Clock``, and attribution is pure numpy over deterministic inputs —
+two same-seed simulator runs produce **byte-identical** journals.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .. import metrics
+from ..utils.clock import Clock
+from .recorder import canonical
+
+SCHEMA_VERSION = 1
+
+OUTCOMES = frozenset(
+    {
+        "bound",
+        "unschedulable",
+        "bind_failure",
+        "permit_wait",
+        "permit_rejected",
+        "permit_timeout",
+        "discarded",
+    }
+)
+# a pod whose LAST journal record is one of these has a settled fate for
+# the run; permit_wait and discarded always lead to another attempt
+TERMINAL_OUTCOMES = frozenset(
+    {"bound", "unschedulable", "bind_failure", "permit_rejected", "permit_timeout"}
+)
+
+_REQUIRED_KEYS = ("k", "v", "step", "cycle", "pod", "outcome", "t")
+
+
+def attribute_failure(prep, idx: int) -> dict[str, list[int]]:
+    """Per-plugin ``{name: [rejected, of]}`` for pod ``idx`` of a
+    prepared group, from the group's host tensors. ``of`` is the live
+    node count; families that rejected nothing are omitted."""
+    slot_nodes = prep.slot_nodes
+    valid = [j for j, n in enumerate(slot_nodes) if n is not None]
+    total = len(valid)
+    out: dict[str, list[int]] = {}
+    if not total:
+        return out
+    vs = np.asarray(valid, dtype=np.int64)
+    batch, pbatch, static = prep.batch, prep.pbatch, prep.static
+
+    req = pbatch.req[idx]  # [K]
+    free = batch.allocatable[:, vs] - batch.used[:, vs]
+    fit_ok = (req[:, None] <= free).all(axis=0) & (
+        batch.pod_count[vs] + 1 <= batch.max_pods[vs]
+    )
+    if not bool(pbatch.feasible_static[idx]):
+        # requests a resource no node advertises: every node fails Fit
+        fit_ok[:] = False
+    n = int((~fit_ok).sum())
+    if n:
+        out["NodeResourcesFit"] = [n, total]
+
+    static_ok = static.mask[int(static.class_of[idx])][vs]
+    n = int((~static_ok).sum())
+    if n:
+        out["NodeAffinity"] = [n, total]
+
+    ports_ok = np.ones(total, dtype=bool)
+    ports = prep.ports
+    if ports is not None and ports.num_ports:
+        conflict_rows = np.nonzero(ports.pod_conflict[idx])[0]
+        if conflict_rows.size:
+            ports_ok = ~(ports.used[np.ix_(conflict_rows, vs)] > 0).any(axis=0)
+            n = int((~ports_ok).sum())
+            if n:
+                out["NodePorts"] = [n, total]
+
+    residual = int((fit_ok & static_ok & ports_ok).sum())
+    if residual:
+        pod = prep.pods[idx]
+        if pod.topology_spread_constraints:
+            label = "PodTopologySpread"
+        elif pod.affinity is not None and (
+            pod.affinity.pod_affinity is not None
+            or pod.affinity.pod_anti_affinity is not None
+        ):
+            label = "InterPodAffinity"
+        else:
+            label = "BatchCarriedUsage"
+        out[label] = [residual, total]
+    return out
+
+
+def summarize_plugins(plugins: dict[str, list[int]]) -> str:
+    """Human line for a plugins dict: 'NodeResourcesFit rejected 14/16
+    nodes, PodTopologySpread 2/16' (the ISSUE's explain shape)."""
+    if not plugins:
+        return ""
+    parts = []
+    for name in sorted(plugins):
+        rej, of = plugins[name]
+        parts.append(f"{name} rejected {rej}/{of} nodes")
+    return ", ".join(parts)
+
+
+class PodDecisionJournal:
+    """Collects decision records in memory (``lines``), fans them out to
+    the flight recorder and an optional line sink (streaming JSONL
+    file). One instance per Scheduler; all writes happen on scheduler
+    threads that already serialize per batch."""
+
+    def __init__(
+        self,
+        clock: Clock | None = None,
+        recorder=None,
+        sink=None,
+        capacity: int | None = None,
+    ):
+        self.clock = clock or Clock()
+        self.recorder = recorder
+        self.sink = sink
+        # capacity=None keeps every line (the sim's byte-identity and
+        # completeness contracts need the full history); a long-running
+        # serve process passes a bound and relies on the streaming sink
+        # for durability, so memory stays O(capacity)
+        if capacity is None:
+            self.lines: list[str] = []
+        else:
+            from collections import deque
+
+            self.lines = deque(maxlen=capacity)
+
+    def record(
+        self,
+        step: int,
+        cycle: int,
+        pod,
+        outcome: str,
+        *,
+        node: str = "",
+        reason: str = "",
+        plugins: dict | None = None,
+        profile: str = "",
+        attempts: int = 0,
+        nominated: str = "",
+    ) -> dict:
+        rec: dict = {
+            "k": "dec",
+            "v": SCHEMA_VERSION,
+            "step": step,
+            "cycle": cycle,
+            "pod": pod.key,
+            "uid": pod.uid or "",
+            "outcome": outcome,
+            "t": self.clock.now(),
+        }
+        if node:
+            rec["node"] = node
+        if reason:
+            rec["reason"] = reason
+        if plugins:
+            rec["plugins"] = plugins
+        if profile:
+            rec["profile"] = profile
+        if attempts:
+            rec["attempts"] = attempts
+        if nominated:
+            rec["nominated"] = nominated
+        self.lines.append(canonical(rec))
+        metrics.journal_records_total.labels(outcome).inc()
+        if self.recorder is not None:
+            self.recorder.record_decision(rec)
+        if self.sink is not None:
+            self.sink(rec)
+        return rec
+
+    def unschedulable(
+        self, step: int, cycle: int, pod, prep, idx: int, *,
+        reason: str = "", nominated: str = "", attempts: int = 0,
+    ) -> dict:
+        """The failure-path record: outcome + per-plugin attribution
+        from the group's materialized tensors."""
+        return self.record(
+            step, cycle, pod, "unschedulable",
+            reason=reason,
+            plugins=attribute_failure(prep, idx),
+            profile=prep.profile,
+            nominated=nominated,
+            attempts=attempts,
+        )
+
+    def dump(self, path) -> None:
+        from pathlib import Path
+
+        Path(path).write_text("\n".join(self.lines) + "\n")
+
+    def last_outcomes(self) -> dict[str, dict]:
+        """pod key -> its most recent record (the sim's completeness
+        invariant reads this)."""
+        out: dict[str, dict] = {}
+        for line in self.lines:
+            rec = json.loads(line)
+            out[rec["pod"]] = rec
+        return out
+
+
+def validate_line(line: str) -> str | None:
+    """Schema check for one journal/flight-recorder JSONL line. Returns
+    an error string, or None when valid. Span lines (``k == "span"``)
+    are accepted and shallow-checked; unknown kinds are errors."""
+    try:
+        rec = json.loads(line)
+    except ValueError as e:
+        return f"not JSON: {e}"
+    if not isinstance(rec, dict):
+        return "not a JSON object"
+    kind = rec.get("k")
+    if kind == "span":
+        for key in ("name", "span", "trace", "start", "end", "dur"):
+            if key not in rec:
+                return f"span record missing {key!r}"
+        return None
+    if kind != "dec":
+        return f"unknown record kind {kind!r}"
+    for key in _REQUIRED_KEYS:
+        if key not in rec:
+            return f"decision record missing {key!r}"
+    if rec["v"] != SCHEMA_VERSION:
+        return f"unsupported schema version {rec['v']!r}"
+    if rec["outcome"] not in OUTCOMES:
+        return f"unknown outcome {rec['outcome']!r}"
+    plugins = rec.get("plugins")
+    if plugins is not None:
+        if not isinstance(plugins, dict):
+            return "plugins is not an object"
+        for name, pair in plugins.items():
+            if (
+                not isinstance(pair, list)
+                or len(pair) != 2
+                or not all(isinstance(x, int) for x in pair)
+            ):
+                return f"plugins[{name!r}] is not [rejected, of]"
+    return None
+
+
+def validate_lines(lines) -> list[str]:
+    """All schema errors across an iterable of lines (empty = valid)."""
+    errors = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        err = validate_line(line)
+        if err is not None:
+            errors.append(f"line {i + 1}: {err}")
+    return errors

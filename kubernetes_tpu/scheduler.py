@@ -81,6 +81,11 @@ class SchedulerConfig:
     # around the bind, with Permit's WaitingPods map parking pods across
     # cycles — the in-process plugin registration point of SURVEY §8.2.
     out_of_tree_plugins: tuple = ()
+    # observability (kubernetes_tpu/obs): an ObsConfig enabling span
+    # tracing and/or the per-pod decision journal + flight recorder.
+    # None = all off; the hot path then pays one attribute check per
+    # would-be span and zero journal work.
+    obs: object = None
 
 
 class _Rejected(Exception):
@@ -149,6 +154,7 @@ class _PreparedGroup:
     fence: int = 0  # _conflict_seq INSIDE the tensorize lock (the snapshot
     # consistency point — capturing it any later would mask events landing
     # between lock release and dispatch; review-caught)
+    step: int = 0  # the batch's span/trace id (Scheduler._trace_step)
     tensorize_seconds: float = 0.0  # host prep cost (set at dispatch)
     unsched_reason: dict = field(default_factory=dict)
     dra_prefold: dict = field(default_factory=dict)
@@ -189,6 +195,22 @@ class Scheduler:
         self.cluster = cluster
         self.config = config or SchedulerConfig()
         self.clock = clock or Clock()
+        # span/batch id shared by the jax-profiler step annotation and
+        # the obs span layer — initialized here instead of being
+        # conjured via getattr at the call site, so profiler steps and
+        # trace spans number identically
+        self._trace_step = 0
+        from .obs import build_obs
+
+        # tracer (span layer), per-pod decision journal, flight
+        # recorder — a disabled tracer and two Nones unless config.obs
+        # turns them on
+        self.obs, self.journal, self.flight = build_obs(
+            self.config.obs, self.clock
+        )
+        import logging
+
+        self._log = logging.getLogger("kubernetes_tpu.scheduler")
         from .utils.featuregate import FeatureGates
 
         self.feature_gates = self.config.feature_gates or FeatureGates()
@@ -213,6 +235,13 @@ class Scheduler:
             pre_enqueue=_pre_enqueue if self.registry.pre_enqueue else None,
             less=qs[0].less if qs else None,
         )
+        # cached pending_pods gauge children: the gauge refreshes on
+        # every queue transition (including per watch event), so the
+        # label lookup must not be paid each time
+        self._pending_gauges = {
+            name: metrics.pending_pods.labels(name)
+            for name in ("active", "backoff", "unschedulable", "gated")
+        }
         # Permit WaitingPods map (runtime/waiting_pods_map.go): pod key ->
         # (WaitingPod, its QueuedPodInfo, scheduling cycle, CycleState,
         # pop timestamp). Verdicts recorded via WaitingPod.allow/reject
@@ -308,6 +337,18 @@ class Scheduler:
     def _on_event(self, ev: Event) -> None:
         if ev.kind == "Event":
             return  # the scheduler's own recorder output
+        if self.obs.enabled:
+            with self.obs.span("enqueue", kind=ev.kind, type=ev.type):
+                self._ingest_event(ev)
+        else:
+            self._ingest_event(ev)
+        # any non-Event kind can have moved pods between queues: keep
+        # the pending_pods gauge current (it used to refresh only in
+        # the solve-recording path and went stale between solves)
+        self._refresh_pending_gauge()
+
+    # ktpu: holds(cluster.lock)
+    def _ingest_event(self, ev: Event) -> None:
         if ev.kind in ("ResourceSlice", "DeviceClass", "ResourceClaim"):
             # DRA inventory/claim changes can unblock claim-bearing pods
             # (eventhandlers.go registers the dynamicresources plugin's
@@ -400,7 +441,7 @@ class Scheduler:
                     # reservation (next cycle would otherwise bind it)
                     entry = self._waiting.pop(pod.key, None)
                     if entry is not None:
-                        wp, _info, _cycle, state, _t0 = entry
+                        wp, _info, _cycle, state, _t0, _step = entry
                         self._unreserve_all(state, wp.pod, wp.node_name)
         else:  # Node
             if ev.type == "ADDED":
@@ -514,11 +555,38 @@ class Scheduler:
         binding goroutines accept)."""
         from .utils import tracing
 
+        self._trace_step += 1
+        step = self._trace_step
         if tracing.enabled():
-            self._trace_step = getattr(self, "_trace_step", 0) + 1
-            with tracing.step("schedule_batch", self._trace_step):
-                return self._schedule_cycle()
-        return self._schedule_cycle()
+            with tracing.step("schedule_batch", step):
+                return self._cycle_observed(step)
+        return self._cycle_observed(step)
+
+    def _cycle_observed(self, step: int) -> BatchResult:
+        """One cycle under the obs root span, with the flight recorder
+        dumped if the cycle dies (the crash trigger). The span and the
+        jax-profiler step annotation share the ``_trace_step`` id."""
+        if not self.obs.enabled and self.flight is None:
+            return self._schedule_cycle()
+        try:
+            with self.obs.span(
+                "schedule_batch", trace_id=step, step=step
+            ) as sp:
+                res = self._schedule_cycle()
+                sp.set(
+                    scheduled=len(res.scheduled),
+                    unschedulable=len(res.unschedulable),
+                    bind_failures=len(res.bind_failures),
+                )
+                return res
+        except Exception:
+            if self.flight is not None:
+                path = self.flight.dump(trigger="crash")
+                self._log.exception(
+                    "scheduling cycle failed; flight recorder dump: %s",
+                    path, extra={"step": step},
+                )
+            raise
 
     # every caller requeues inside its locked region (watch events must
     # not interleave with the bookkeeping): ktpu: holds(cluster.lock)
@@ -533,7 +601,7 @@ class Scheduler:
         pending: list[tuple] = []
         res = BatchResult()
         t0 = self.clock.perf()
-        with self.cluster.lock:
+        with self.cluster.lock, self.obs.span("pop") as sp:
             # WaitOnPermit analog: settle WaitingPods whose verdict or
             # deadline arrived since the last cycle, before popping new
             # work
@@ -546,6 +614,10 @@ class Scheduler:
             infos = self.queue.pop_batch(self.config.batch_size)
             for i in infos:
                 self._in_flight[i.key] = i
+            sp.set(pods=len(infos))
+            # idle/empty cycles change the queues too (waiting
+            # settlement, leftover flush, the pop itself)
+            self._refresh_pending_gauge()
         return self._run_popped(infos, t0, res, pending)
 
     def _run_popped(
@@ -597,6 +669,7 @@ class Scheduler:
             for info in infos:
                 if info.key not in handled:
                     self._requeue(info, base)
+            self._refresh_pending_gauge()
 
     def _commit_all(
         self, infos: list[QueuedPodInfo], pending: list, res: BatchResult
@@ -607,18 +680,29 @@ class Scheduler:
         first_err = None
         for entry in pending:
             tb = self.clock.perf()
-            try:
-                ok = self._commit_binding(entry, res)
-            except Exception as e:  # a buggy PreBind/PostBind plugin
-                # must not strand the REST of the approved batch:
-                # roll this pod back, keep committing, re-raise last
-                ok = False
-                first_err = first_err or e
-                state, info, pod, node_name, cycle, _ts = entry
-                with self.cluster.lock:
-                    self._unreserve_all(state, pod, node_name)
-                    res.bind_failures.append((pod.key, repr(e)))
-                    self._requeue(info, cycle)
+            with self.obs.span(
+                "bind", trace_id=entry[6], pod=entry[2].key,
+                node=entry[3],
+            ) as bsp:
+                try:
+                    ok = self._commit_binding(entry, res)
+                except Exception as e:  # a buggy PreBind/PostBind plugin
+                    # must not strand the REST of the approved batch:
+                    # roll this pod back, keep committing, re-raise last
+                    ok = False
+                    first_err = first_err or e
+                    state, info, pod, node_name, cycle, _ts, step = entry
+                    with self.cluster.lock:
+                        self._unreserve_all(state, pod, node_name)
+                        res.bind_failures.append((pod.key, repr(e)))
+                        self._requeue(info, cycle)
+                        if self.journal is not None:
+                            self.journal.record(
+                                step, cycle, pod, "bind_failure",
+                                node=node_name, reason=repr(e),
+                                attempts=info.attempts,
+                            )
+                bsp.set(ok=ok)
             metrics.framework_extension_point_duration_seconds.labels(
                 "Bind", "Success" if ok else "Error", "all"
             ).observe(self.clock.perf() - tb)
@@ -630,6 +714,8 @@ class Scheduler:
                 self._in_flight.pop(info.key, None)
             for entry in pending:
                 self._in_flight.pop(entry[1].key, None)
+            # bind failures above requeued pods with backoff
+            self._refresh_pending_gauge()
         if first_err is not None:
             raise first_err
 
@@ -681,7 +767,12 @@ class Scheduler:
         prep = self._tensorize_group(
             profile, infos, cycle_offsets, base_cycle, t0
         )
-        self._fold_group(prep)
+        with self.obs.span(
+            "fold", trace_id=prep.step, profile=profile,
+            extenders=len(self.extender_clients),
+            plugins=len(self.config.out_of_tree_plugins),
+        ):
+            self._fold_group(prep)
         flight = self._dispatch_group(prep, defer=False)
         self._apply_group(flight, res, pending)
 
@@ -697,9 +788,16 @@ class Scheduler:
         view of cache + cluster."""
         solver = self.solvers[profile]
         gs = self.clock.perf()
-        with self.cluster.lock:
+        with self.cluster.lock, self.obs.span(
+            # explicit trace id: the pipelined loop has no root span, so
+            # parent inheritance alone would leave these spans on trace 0
+            "tensorize", trace_id=self._trace_step,
+            profile=profile, pods=len(infos),
+        ) as tsp:
             # phase 2a: snapshot + tensorize against a consistent view
-            batch = self.snapshot.update(self.cache)
+            with self.obs.span("snapshot"):
+                batch = self.snapshot.update(self.cache)
+            tsp.set(nodes=batch.num_nodes, fence=self._conflict_seq)
             pods = [i.pod for i in infos]
 
             def has_pod_affinity(p: Pod) -> bool:
@@ -935,6 +1033,7 @@ class Scheduler:
                 slot_nodes=slot_nodes, names=list(self.snapshot.names),
                 volume_ctx=volume_ctx, services=services,
                 dra_active=dra_active, fence=self._conflict_seq,
+                step=self._trace_step,
             )
 
     def _fold_group(self, prep: _PreparedGroup) -> None:
@@ -1076,15 +1175,19 @@ class Scheduler:
         t1 = self.clock.perf()
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
-        handle = solver.solve(
-            prep.batch, prep.pbatch, prep.static, prep.ports, prep.spread,
-            prep.interpod,
-            col_versions=self.snapshot.col_versions,
-            nominated=prep.nominated if not prep.nominated.empty else None,
-            nominated_slot=prep.nominated_slot,
-            defer_read=defer,
-            allow_heal=allow_heal,
-        )
+        with self.obs.span(
+            "dispatch", trace_id=prep.step, profile=prep.profile,
+            defer=defer, healed=heal_stale,
+        ):
+            handle = solver.solve(
+                prep.batch, prep.pbatch, prep.static, prep.ports,
+                prep.spread, prep.interpod,
+                col_versions=self.snapshot.col_versions,
+                nominated=prep.nominated if not prep.nominated.empty else None,
+                nominated_slot=prep.nominated_slot,
+                defer_read=defer,
+                allow_heal=allow_heal,
+            )
         dispatch_dt = self.clock.perf() - t1
         prep.tensorize_seconds = max(t1 - prep.gs, 0.0)
         metrics.tensorize_seconds.observe(prep.tensorize_seconds)
@@ -1140,8 +1243,12 @@ class Scheduler:
             "Filter", "Success", profile
         ).observe(solve_dt)
 
-        with self.cluster.lock:
+        with self.cluster.lock, self.obs.span(
+            "apply", trace_id=prep.step, profile=profile, pods=len(infos),
+            read_seconds=flight.read_seconds,
+        ) as asp:
             if fence is not None and fence != self._conflict_seq:
+                asp.set(fence_stale=True)
                 return False  # went stale during the device read
             # phase 2b: apply assignments — assume / Reserve / Permit /
             # PostFilter — atomically with the watch-event consumers
@@ -1150,6 +1257,9 @@ class Scheduler:
             cluster_has_affinity = False
             postfilter_reasons: dict | None = None
             preempt_dt = 0.0
+            preempt_ran = False  # a zero-duration run (FakeClock) still
+            # counts as an observation — gating on the float hid the
+            # PostFilter series from virtual-time runs
             bind_dt = 0.0
             # FitError diagnosis (schedule_one.go#FitError [U]): per-node
             # reasons don't exist inside the fused device pipeline, so the
@@ -1254,6 +1364,7 @@ class Scheduler:
                     # out-of-tree PostFilter plugins (first success nominates)
                     nominated_node = None
                     if self.config.enable_preemption:
+                        preempt_ran = True
                         if preempt_placed is None:
                             # shared across this batch's failures: occupancy
                             # snapshot, PDB list, and the cluster-wide
@@ -1273,6 +1384,7 @@ class Scheduler:
                         )
                         preempt_dt += self.clock.perf() - tpf
                     if nominated_node is None and self.registry.post_filter:
+                        preempt_ran = True
                         if postfilter_reasons is None:
                             # NodeToStatusMap analog, shared across this
                             # batch's failures: per-node reasons don't exist
@@ -1291,12 +1403,18 @@ class Scheduler:
                         preempt_dt += self.clock.perf() - tpf
                     res.unschedulable.append(pod.key)
                     self._requeue(info, cycle)
-                    self._event(
-                        pod, "FailedScheduling",
-                        unsched_reason.get(pod.key)
-                        or fit_error_for(pod, idx),
-                        type_="Warning",
+                    why = unsched_reason.get(pod.key) or fit_error_for(
+                        pod, idx
                     )
+                    self._event(
+                        pod, "FailedScheduling", why, type_="Warning",
+                    )
+                    if self.journal is not None:
+                        self.journal.unschedulable(
+                            prep.step, cycle, pod, prep, idx,
+                            reason=why, nominated=nominated_node or "",
+                            attempts=info.attempts,
+                        )
                     continue
                 node_name = prep.names[int(a)]
                 try:
@@ -1307,6 +1425,12 @@ class Scheduler:
                     self.snapshot.touch(int(a))
                     res.bind_failures.append((pod.key, str(e)))
                     self._requeue(info, cycle)
+                    if self.journal is not None:
+                        self.journal.record(
+                            prep.step, cycle, pod, "bind_failure",
+                            node=node_name, reason=str(e), profile=profile,
+                            attempts=info.attempts,
+                        )
                     continue
 
                 # Reserve point: in-tree volumebinding Reserve
@@ -1352,6 +1476,12 @@ class Scheduler:
                     self._event(
                         pod, "FailedScheduling", str(e), type_="Warning",
                     )
+                    if self.journal is not None:
+                        self.journal.record(
+                            prep.step, cycle, pod, "bind_failure",
+                            node=node_name, reason=str(e), profile=profile,
+                            attempts=info.attempts,
+                        )
                     continue
 
                 # Permit point: approve / reject / wait
@@ -1361,23 +1491,42 @@ class Scheduler:
                 verdict = self._run_permit(state, pod, node_name)
                 if isinstance(verdict, dict):
                     wp = WaitingPod(pod, node_name, verdict, self.clock.now())
-                    self._waiting[pod.key] = (wp, info, cycle, state, t0)
+                    self._waiting[pod.key] = (
+                        wp, info, cycle, state, t0, prep.step,
+                    )
+                    if self.journal is not None:
+                        self.journal.record(
+                            prep.step, cycle, pod, "permit_wait",
+                            node=node_name, profile=profile,
+                            reason=",".join(sorted(verdict)),
+                            attempts=info.attempts,
+                        )
                     continue
                 if verdict is not None:  # (plugin name, Status) rejection
                     self._unreserve_all(state, pod, node_name)
                     res.unschedulable.append(pod.key)
                     self._requeue(info, cycle)
-                    self._event(
-                        pod, "FailedScheduling",
+                    permit_why = (
                         f"permit plugin {verdict[0]} rejected: "
-                        + "; ".join(verdict[1].reasons),
+                        + "; ".join(verdict[1].reasons)
+                    )
+                    self._event(
+                        pod, "FailedScheduling", permit_why,
                         type_="Warning", action="Permit",
                     )
+                    if self.journal is not None:
+                        self.journal.record(
+                            prep.step, cycle, pod, "permit_rejected",
+                            node=node_name, reason=permit_why,
+                            profile=profile, attempts=info.attempts,
+                        )
                     continue
 
                 # approved: the binding cycle commits AFTER the lock drops
                 # (schedule_batch's pending pass)
-                pending.append((state, info, pod, node_name, cycle, t0))
+                pending.append(
+                    (state, info, pod, node_name, cycle, t0, prep.step)
+                )
                 # keep the lazily-snapshotted preemption view in sync with
                 # assumes made later in this batch, so a subsequent failing
                 # pod's dry-run sees current node occupancy (the cache-backed
@@ -1385,7 +1534,7 @@ class Scheduler:
                 # forgets it, making this at worst conservative)
                 if preempt_placed is not None:
                     preempt_placed.setdefault(int(a), []).append(pod)
-        if preempt_dt:
+        if preempt_ran:
             metrics.framework_extension_point_duration_seconds.labels(
                 "PostFilter", "Success", profile
             ).observe(preempt_dt)
@@ -1507,7 +1656,7 @@ class Scheduler:
         bookkeeping re-acquires it briefly. Any failure unreserves and
         requeues with backoff (the bindingCycle failure path).
         Returns True when the pod bound."""
-        state, info, pod, node_name, cycle, t_start = entry
+        state, info, pod, node_name, cycle, t_start, step = entry
         try:
             for p in self.registry.pre_bind:
                 st = p.pre_bind(state, pod, node_name)
@@ -1539,6 +1688,12 @@ class Scheduler:
             with self.cluster.lock:
                 self._unreserve_all(state, pod, node_name)
                 res.bind_failures.append((pod.key, reason))
+                if self.journal is not None:
+                    self.journal.record(
+                        step, cycle, pod, "bind_failure",
+                        node=node_name, reason=reason,
+                        attempts=info.attempts,
+                    )
                 try:
                     self.cluster.get_pod(pod.namespace, pod.name)
                 except ApiError:
@@ -1562,6 +1717,11 @@ class Scheduler:
                 action="Binding",
             )
             res.scheduled.append((pod.key, node_name))
+            if self.journal is not None:
+                self.journal.record(
+                    step, cycle, pod, "bound",
+                    node=node_name, attempts=info.attempts,
+                )
         res.latencies.append(self.clock.perf() - t_start)
         # pod-level SLIs: attempts-to-success histogram and e2e latency
         # from first queue entry, labeled by attempt count
@@ -1583,7 +1743,7 @@ class Scheduler:
         timed-out pods unreserve and requeue; fully-allowed pods complete
         their binding cycle in the post-lock pending pass."""
         now = self.clock.now()
-        for key, (wp, info, cycle, state, t_start) in list(
+        for key, (wp, info, cycle, state, t_start, step) in list(
             self._waiting.items()
         ):
             expired = wp.expired(now)
@@ -1602,6 +1762,15 @@ class Scheduler:
                     wp.pod, "FailedScheduling", why,
                     type_="Warning", action="Permit",
                 )
+                if self.journal is not None:
+                    self.journal.record(
+                        step, cycle, wp.pod,
+                        "permit_rejected"
+                        if wp.rejected_by is not None
+                        else "permit_timeout",
+                        node=wp.node_name, reason=why,
+                        attempts=info.attempts,
+                    )
             elif wp.allowed:
                 del self._waiting[key]
                 # back under the in-flight fence until the bind commits:
@@ -1609,7 +1778,8 @@ class Scheduler:
                 # re-enqueue a pod that is about to bind (review-caught)
                 self._in_flight[key] = info
                 pending.append(
-                    (state, info, wp.pod, wp.node_name, cycle, t_start)
+                    (state, info, wp.pod, wp.node_name, cycle, t_start,
+                     step)
                 )
 
     def waiting_pods(self) -> dict[str, WaitingPod]:
@@ -1649,8 +1819,16 @@ class Scheduler:
         for _, _, victims in res.preemptions:
             metrics.preemption_attempts_total.inc()
             metrics.preemption_victims.observe(len(victims))
+        self._refresh_pending_gauge()
+
+    def _refresh_pending_gauge(self) -> None:
+        """Set the pending_pods gauge from the queue's O(1) counters —
+        called wherever queue contents change (watch ingest, pops,
+        requeues, discards), not just the solve-recording path, so the
+        gauge cannot go stale on idle cycles or queue-only
+        transitions."""
         for queue_name, count in self.queue.pending_counts().items():
-            metrics.pending_pods.labels(queue_name).set(count)
+            self._pending_gauges[queue_name].set(count)
 
     # -- PostFilter: defaultpreemption (preemption.go#Evaluator.Preempt) --
 
@@ -2010,10 +2188,19 @@ class Scheduler:
         be chained on it)."""
         metrics.solves_discarded_total.inc()
         self._discard_streak += 1
-        with self.cluster.lock:
+        prep = flight.prep
+        with self.cluster.lock, self.obs.span(
+            "fence", trace_id=prep.step, action="discard",
+            pods=len(prep.infos), fence=prep.fence,
+        ):
             self._session_stale = True
-            for info in flight.prep.infos:
+            for info in prep.infos:
                 self._in_flight.pop(info.key, None)
+                if self.journal is not None:
+                    self.journal.record(
+                        prep.step, prep.base_cycle, info.pod, "discarded",
+                        profile=prep.profile, attempts=info.attempts,
+                    )
                 try:
                     cur = self.cluster.get_pod(
                         info.pod.namespace, info.pod.name
@@ -2024,6 +2211,7 @@ class Scheduler:
                     continue  # bound externally while in flight
                 info.pod = cur
                 self.queue.requeue_popped(info)
+            self._refresh_pending_gauge()
 
     # per-batch apply path: device reads only through the sanctioned
     # _InFlightSolve.assignments boundary: ktpu: hot
@@ -2142,12 +2330,16 @@ class Scheduler:
                     plain = bool(infos) and self._plain_batch(
                         [i.pod for i in infos]
                     )
+                    self._refresh_pending_gauge()
                 if not infos:
                     if flight is not None:
                         apply_flight()
                         continue  # discards/failures may requeue work
                     break
                 batches += 1
+                # batch id for this pop's spans/journal (the sync branch
+                # below re-enters via _run_popped, not schedule_batch)
+                self._trace_step += 1
                 fallback = (
                     self._discard_streak >= self._PIPELINE_FALLBACK_AFTER
                 )
@@ -2162,6 +2354,12 @@ class Scheduler:
                     # guaranteeing at least one batch lands per N
                     # discards under sustained churn.
                     metrics.pipeline_fallback_total.inc()
+                    self._log.warning(
+                        "pipeline livelock backstop engaged after %d "
+                        "consecutive fence discards: one synchronous "
+                        "cycle", self._discard_streak,
+                        extra={"step": self._trace_step},
+                    )
                     plain = False
                 # ``owned``: popped but not yet handed to a cycle or a
                 # flight — an exception below must requeue exactly these
@@ -2234,6 +2432,16 @@ class Scheduler:
                 flight, nxt = nxt, None
             if flight is not None:
                 apply_flight()
+        except Exception:
+            # the crash trigger for the pipelined loop (the synchronous
+            # loop dumps from schedule_batch)
+            if self.flight is not None:
+                path = self.flight.dump(trigger="crash")
+                self._log.exception(
+                    "pipelined loop failed; flight recorder dump: %s",
+                    path, extra={"step": self._trace_step},
+                )
+            raise
         finally:
             # exception escape hatch: a dispatched-but-unapplied solve
             # must not strand its pods in _in_flight nor leave the device

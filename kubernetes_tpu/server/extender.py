@@ -79,10 +79,20 @@ class ExtenderCore:
         node_cache_capable: bool = False,
         backend: str = "device",
         solver_config=None,
+        tracer=None,
     ):
         self.cluster = cluster
         self.node_cache_capable = node_cache_capable
         self.backend = backend
+        # obs span layer (kubernetes_tpu/obs): shared with the embedded
+        # Scheduler in --mode scheduler so webhook evaluation spans and
+        # solve spans land in one flight recorder; a disabled tracer
+        # otherwise (one attribute check per request group)
+        if tracer is None:
+            from ..obs import Tracer
+
+            tracer = Tracer(enabled=False)
+        self.tracer = tracer
         if backend == "device":
             from ..solver.evaluate import BatchEvaluator
 
@@ -170,6 +180,10 @@ class ExtenderCore:
         that fails to decode gets a per-request error (filter: the wire's
         {"error"} shape; prioritize: a DecodeError the HTTP layer turns
         into a 500 for that request alone) — it never poisons the batch."""
+        with self.tracer.span("extender_batch", requests=len(requests)):
+            return self._run_many(requests)
+
+    def _run_many(self, requests: list[tuple[str, Mapping]]) -> list:
         import hashlib
         import json
 
@@ -472,12 +486,19 @@ def _load_state_file(cluster: ClusterState, path: str) -> None:
             cluster.create_resource_claim(ResourceClaim.from_dict(cd))
 
 
-def make_app(core: ExtenderCore, scheduler=None, batch_window: float = 0.002):
+def make_app(
+    core: ExtenderCore,
+    scheduler=None,
+    batch_window: float = 0.002,
+    recorder=None,
+):
     """aiohttp application wiring the pure handlers to the wire.
 
     With ``scheduler`` (a Scheduler over the same ClusterState), a
     background task drains the queue: ingested pods are bound by device
-    solves — serve --mode scheduler."""
+    solves — serve --mode scheduler. ``recorder`` (an
+    obs.FlightRecorder, defaulting to the scheduler's) backs the
+    ``/debug/flightrecorder`` and ``/debug/spans`` endpoints."""
     import asyncio
 
     from aiohttp import web
@@ -513,6 +534,35 @@ def make_app(core: ExtenderCore, scheduler=None, batch_window: float = 0.002):
 
     async def healthz(request):
         return web.Response(text="ok")
+
+    # -- flight recorder / span debug surface (kubernetes_tpu/obs) --
+
+    if recorder is None and scheduler is not None:
+        recorder = getattr(scheduler, "flight", None)
+
+    async def debug_flightrecorder(request):
+        if recorder is None:
+            return web.json_response(
+                {"error": "observability disabled (serve --obs)"},
+                status=404,
+            )
+        # one snapshot backs both the response and the optional disk
+        # dump (?dump=1), so the two can never diverge; plain GETs (a
+        # poller) don't touch the disk
+        snap = recorder.snapshot()
+        if request.query.get("dump"):
+            snap["dumped_to"] = recorder.dump(
+                trigger="manual", snapshot=snap
+            )
+        return web.json_response(snap)
+
+    async def debug_spans(request):
+        if recorder is None:
+            return web.json_response(
+                {"error": "observability disabled (serve --obs)"},
+                status=404,
+            )
+        return web.json_response({"spans": recorder.spans()})
 
     # -- ingest surface (the watch-fed view's write side) --
 
@@ -584,6 +634,8 @@ def make_app(core: ExtenderCore, scheduler=None, batch_window: float = 0.002):
     app.router.add_get("/metrics", metrics_)
     for route in ("/healthz", "/livez", "/readyz"):
         app.router.add_get(route, healthz)
+    app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
+    app.router.add_get("/debug/spans", debug_spans)
     app.router.add_post("/api/nodes", post_nodes)
     app.router.add_delete("/api/nodes/{name}", delete_node)
     app.router.add_post("/api/pods", post_pods)
@@ -656,17 +708,37 @@ def run_server(
     healthz+metrics on 10259). mode="scheduler" also runs the batching
     scheduler loop over the ingested state; grpc_port > 0 additionally
     serves the bulk tensor gRPC path (SURVEY §6.8)."""
+    import logging
+
     from aiohttp import web
 
+    log = logging.getLogger("kubernetes_tpu.serve")
     if state_file:
         _load_state_file(cluster, state_file)
     scheduler = None
+    tracer = recorder = None
+    obs_cfg = getattr(scheduler_config, "obs", None)
     if mode == "scheduler":
         from ..scheduler import Scheduler
 
         scheduler = Scheduler(cluster, scheduler_config)
+        tracer, recorder = scheduler.obs, scheduler.flight
+    elif obs_cfg is not None:
+        # extender-only mode still gets webhook spans + debug endpoints
+        from ..obs import build_obs
+
+        tracer, _journal, recorder = build_obs(obs_cfg)
     core = ExtenderCore(
-        cluster, node_cache_capable, solver_config=solver_config
+        cluster, node_cache_capable, solver_config=solver_config,
+        tracer=tracer,
+    )
+    log.info(
+        "serving on %s:%d", host, port,
+        extra={
+            "mode": mode,
+            "grpc_port": grpc_port,
+            "observability": bool(recorder),
+        },
     )
     grpc_server = None
     if grpc_port:
@@ -675,7 +747,7 @@ def run_server(
         grpc_server = serve_bulk(
             cluster, port=grpc_port, solver_config=solver_config
         )
-    app = make_app(core, scheduler=scheduler)
+    app = make_app(core, scheduler=scheduler, recorder=recorder)
     try:
         web.run_app(app, host=host, port=port)
     finally:

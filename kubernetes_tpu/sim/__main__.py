@@ -52,7 +52,13 @@ def _print_result(res) -> None:
         f"fallbacks={s['pipeline_fallbacks']:.0f} "
         f"preemptions={s['preemptions']:.0f}"
     )
+    print(
+        f"  journal: records={s['journal_records']} "
+        f"digest={s['journal_digest'][:16]}"
+    )
     print(f"  trace_digest={res.trace.digest()}")
+    if res.flight_dump:
+        print(f"  flight recorder dumped: {res.flight_dump}")
     if res.replay_divergence:
         print(f"  REPLAY DIVERGED: {res.replay_divergence}")
     elif res.violations:
@@ -86,6 +92,16 @@ def main(argv=None) -> int:
         help="re-execute a recorded trace instead of a fresh run",
     )
     parser.add_argument(
+        "--journal", metavar="PATH",
+        help="write the per-pod decision journal (kubernetes_tpu/obs "
+        "JSONL; explain pods with `python -m kubernetes_tpu.obs "
+        "explain <pod> --trace PATH`)",
+    )
+    parser.add_argument(
+        "--flight-dump", metavar="PATH",
+        help="dump the flight recorder here when an invariant fires",
+    )
+    parser.add_argument(
         "--selfcheck", action="store_true",
         help="run twice and verify the traces are byte-identical",
     )
@@ -117,7 +133,7 @@ def main(argv=None) -> int:
     try:
         res = run_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
-            pipelined=pipelined,
+            pipelined=pipelined, flight_dump=args.flight_dump,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -126,11 +142,26 @@ def main(argv=None) -> int:
     if args.trace:
         res.trace.dump(args.trace)
         print(f"  trace written: {args.trace}")
+    if args.journal:
+        from pathlib import Path
+
+        Path(args.journal).write_text(
+            "\n".join(res.journal_lines) + "\n"
+        )
+        print(f"  journal written: {args.journal}")
     if args.selfcheck:
         res2 = run_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
             pipelined=pipelined,
         )
+        if res.journal_lines != res2.journal_lines:
+            print(
+                "NON-DETERMINISTIC: decision journals differ "
+                f"({len(res.journal_lines)} vs {len(res2.journal_lines)} "
+                "records)",
+                file=sys.stderr,
+            )
+            return 1
         if res.trace.lines != res2.trace.lines:
             for i, (a, b) in enumerate(
                 zip(res.trace.lines, res2.trace.lines)

@@ -42,12 +42,14 @@ from .faults import (
     StallingPermitPlugin,
 )
 from .generators import ChurnGenerator, apply_event
+from ..obs import ObsConfig
 from .invariants import (
     BindTransitionTracker,
     MonotonicCounters,
     Violation,
     _record,
     check_capacity,
+    check_journal_completeness,
     check_lost_pods,
 )
 from .profiles import Profile, get_profile
@@ -66,6 +68,10 @@ class SimResult:
     summary: dict
     trace: TraceWriter
     replay_divergence: str | None = None  # replay mode only
+    # per-pod decision journal (kubernetes_tpu/obs), canonical JSONL:
+    # same seed+profile => byte-identical lines
+    journal_lines: list[str] = None
+    flight_dump: str | None = None  # written on invariant violation
 
     @property
     def ok(self) -> bool:
@@ -99,6 +105,8 @@ class SimHarness:
         pipelined: bool | None = None,
         replay: TraceReader | None = None,
         max_settle_rounds: int = 12,
+        spans: bool = False,
+        flight_dump: str | None = None,
     ) -> None:
         self.profile = (
             get_profile(profile) if isinstance(profile, str) else profile
@@ -157,6 +165,7 @@ class SimHarness:
                     node_cache_capable=True,
                 ),
             )
+        self.flight_dump_path = flight_dump
         self.scheduler = Scheduler(
             self.cluster,
             SchedulerConfig(
@@ -166,6 +175,13 @@ class SimHarness:
                 ),
                 extenders=extenders,
                 out_of_tree_plugins=plugins,
+                # the decision journal is always on in the sim: the
+                # trace-completeness invariant and the byte-identical-
+                # journal determinism contract both ride on it. Spans
+                # are opt-in (they multiply recorder traffic).
+                obs=ObsConfig(
+                    spans=spans, journal=True, dump_path=flight_dump
+                ),
             ),
             clock=self.clock,
         )
@@ -199,6 +215,10 @@ class SimHarness:
         self.tracker = BindTransitionTracker(self.cluster)
         self.monotonic = MonotonicCounters()
         self.violations: list[Violation] = []
+        # binds THIS scheduler reported (vs external churn binds): the
+        # journal-completeness invariant holds exactly these to a
+        # terminal 'bound' record
+        self._sched_bound: set[str] = set()
         self._events_applied = 0
         self._extender_aborts = 0
         self._counters0 = {
@@ -239,6 +259,7 @@ class SimHarness:
                 return
             for r in results:
                 self.tracker.record_results(r.scheduled)
+                self._sched_bound.update(k for k, _ in r.scheduled)
             return
         # sync mode drives batch-by-batch (observationally identical to
         # run_until_settled) so an injected non-ignorable extender abort
@@ -255,6 +276,7 @@ class SimHarness:
             if not (r.scheduled or r.unschedulable or r.bind_failures):
                 return
             self.tracker.record_results(r.scheduled)
+            self._sched_bound.update(k for k, _ in r.scheduled)
 
     def _check(self, cycle: int) -> None:
         self.tracker.drain(cycle, self.violations)
@@ -370,6 +392,19 @@ class SimHarness:
         return False
 
     def _finish(self, settled: bool) -> SimResult:
+        # trace completeness (the obs tentpole's sim contract): every
+        # pod this scheduler owned has a journal history ending in a
+        # terminal outcome
+        journal = self.scheduler.journal
+        check_journal_completeness(
+            self.cluster,
+            self.scheduler,
+            self.cycles + self.max_settle_rounds,
+            self.violations,
+            journal.last_outcomes(),
+            self._sched_bound,
+            undelivered=self.bus.pending_pod_adds(),
+        )
         bindings = {
             p.key: p.node_name
             for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
@@ -382,6 +417,11 @@ class SimHarness:
             k: _counter_value(c) - self._counters0[k]
             for k, c in _DELTA_COUNTERS.items()
         }
+        import hashlib
+
+        journal_digest = hashlib.sha256(
+            ("\n".join(journal.lines) + "\n").encode()
+        ).hexdigest()
         summary = {
             "pipelined": self.pipelined,
             "events": self._events_applied,
@@ -396,6 +436,10 @@ class SimHarness:
             "permit_stalls": (
                 self.permit_plugin.stalls if self.permit_plugin else 0
             ),
+            # the journal digest rides in the footer, so the trace
+            # selfcheck also proves journal byte-identity across runs
+            "journal_records": len(journal.lines),
+            "journal_digest": journal_digest,
             **deltas,
         }
         self.trace.footer(
@@ -407,6 +451,13 @@ class SimHarness:
         divergence = None
         if self._reader is not None:
             divergence = self._diff_replay(bindings)
+        flight_dump = None
+        if self.violations and self.scheduler.flight is not None:
+            # the invariant trigger: dump the recent-history ring next
+            # to the violation report (no-op without a configured path)
+            flight_dump = self.scheduler.flight.dump(
+                path=self.flight_dump_path, trigger="invariant"
+            )
         return SimResult(
             profile=self.profile.name,
             seed=self.seed,
@@ -418,6 +469,8 @@ class SimHarness:
             summary=summary,
             trace=self.trace,
             replay_divergence=divergence,
+            journal_lines=list(journal.lines),
+            flight_dump=flight_dump,
         )
 
     def _diff_replay(self, bindings: dict[str, str]) -> str | None:
@@ -452,10 +505,13 @@ def run_sim(
     cycles: int = 10,
     *,
     pipelined: bool | None = None,
+    spans: bool = False,
+    flight_dump: str | None = None,
 ) -> SimResult:
     """One fresh seeded run (library entry; the CLI and tests use this)."""
     return SimHarness(
-        profile, seed=seed, cycles=cycles, pipelined=pipelined
+        profile, seed=seed, cycles=cycles, pipelined=pipelined,
+        spans=spans, flight_dump=flight_dump,
     ).run()
 
 

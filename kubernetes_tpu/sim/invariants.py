@@ -153,6 +153,61 @@ def check_lost_pods(
             )
 
 
+def check_journal_completeness(
+    cluster: ClusterState,
+    scheduler,
+    cycle: int,
+    violations: list[Violation],
+    last_outcomes: dict[str, dict],
+    sched_bound: set[str],
+    undelivered: set[str] = frozenset(),
+) -> None:
+    """Trace-completeness invariant for the obs decision journal: every
+    pod the scheduler ever owned has a journal history with a terminal
+    outcome — scheduler-bound pods end on ``bound``; unbound (and
+    delivered, ungated) pods end on a terminal failure outcome. A pod
+    ending on a non-terminal record (``discarded``/``permit_wait``)
+    after quiescence means a code path dropped the pod without
+    journaling its fate — exactly the blind spot the journal exists to
+    close."""
+    from ..obs.journal import TERMINAL_OUTCOMES
+
+    entries = scheduler.queue.entries()
+    for pod in sorted(cluster.list_pods(), key=lambda p: p.key):
+        if pod.key in undelivered:
+            continue  # the scheduler cannot journal what it never saw
+        rec = last_outcomes.get(pod.key)
+        if pod.node_name:
+            # externally-bound pods never enter a scheduling cycle;
+            # only binds this scheduler reported are held to account
+            if pod.key in sched_bound and (
+                rec is None or rec["outcome"] != "bound"
+            ):
+                _record(
+                    violations, "journal", cycle,
+                    f"scheduler-bound pod {pod.key} lacks a terminal "
+                    "'bound' journal record (last: "
+                    f"{rec['outcome'] if rec else None})",
+                )
+            continue
+        if pod.scheduler_name not in scheduler.solvers:
+            continue  # ignored at queue-add, like frameworkForPod misses
+        if entries.get(pod.key) == "gated":
+            continue  # never entered a scheduling cycle
+        if rec is None:
+            _record(
+                violations, "journal", cycle,
+                f"unbound pod {pod.key} never appeared in the decision "
+                "journal",
+            )
+        elif rec["outcome"] not in TERMINAL_OUTCOMES:
+            _record(
+                violations, "journal", cycle,
+                f"unbound pod {pod.key}'s last journal outcome "
+                f"{rec['outcome']!r} is non-terminal",
+            )
+
+
 class MonotonicCounters:
     """Counter series must never decrease between checks. ``sample``
     is injectable so known-bad tests can feed a regressing series; the

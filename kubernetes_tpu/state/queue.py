@@ -110,6 +110,10 @@ class PriorityQueue:
         self._info: dict[str, QueuedPodInfo] = {}
         # which structure a pod key lives in: active|backoff|unsched|gated
         self._where: dict[str, str] = {}
+        # incremental per-structure sizes so pending_counts is O(1) — the
+        # scheduler refreshes the pending_pods gauge on every queue
+        # transition, which must not cost an O(pods) scan per watch event
+        self._counts = {"active": 0, "backoff": 0, "unsched": 0, "gated": 0}
 
         self.scheduling_cycle = 0
         self._move_request_cycle = -1
@@ -119,19 +123,28 @@ class PriorityQueue:
     def __len__(self) -> int:
         return len(self._info)
 
+    def _set_where(self, key: str, where: str) -> None:
+        old = self._where.get(key)
+        if old is not None:
+            self._counts[old] -= 1
+        self._counts[where] += 1
+        self._where[key] = where
+
+    def _unset_where(self, key: str) -> None:
+        old = self._where.pop(key, None)
+        if old is not None:
+            self._counts[old] -= 1
+
     def pending_counts(self) -> dict[str, int]:
-        """pending_pods{queue=...} metric shape."""
-        out = {"active": 0, "backoff": 0, "unschedulable": 0, "gated": 0}
-        for w in self._where.values():
-            out[
-                {
-                    "active": "active",
-                    "backoff": "backoff",
-                    "unsched": "unschedulable",
-                    "gated": "gated",
-                }[w]
-            ] += 1
-        return out
+        """pending_pods{queue=...} metric shape (O(1): incrementally
+        maintained by the _set_where/_unset_where transitions)."""
+        c = self._counts
+        return {
+            "active": c["active"],
+            "backoff": c["backoff"],
+            "unschedulable": c["unsched"],
+            "gated": c["gated"],
+        }
 
     def entries(self) -> dict[str, str]:
         """Pod key -> structure it currently lives in (``active`` |
@@ -157,7 +170,7 @@ class PriorityQueue:
                     info.key,
                 ),
             )
-        self._where[info.key] = "active"
+        self._set_where(info.key, "active")
 
     def _gate(self, pod: Pod) -> bool:
         """PreEnqueue verdict: True = park as gated. The in-tree
@@ -177,7 +190,7 @@ class PriorityQueue:
             info.gated = True
             self._gated[info.key] = info
             self._info[info.key] = info
-            self._where[info.key] = "gated"
+            self._set_where(info.key, "gated")
             return False
         info.gated = False
         self._push_active(info)
@@ -199,7 +212,7 @@ class PriorityQueue:
         heapq.heappush(
             self._backoff, (self._backoff_ready_at(info), next(self._seq), info.key)
         )
-        self._where[info.key] = "backoff"
+        self._set_where(info.key, "backoff")
 
     # -- add / update / delete (informer handlers) --
 
@@ -213,7 +226,7 @@ class PriorityQueue:
             info.gated = True
             self._gated[pod.key] = info
             self._info[pod.key] = info
-            self._where[pod.key] = "gated"
+            self._set_where(pod.key, "gated")
             metrics.queue_incoming_pods_total.labels("gated", "PodAdd").inc()
             return
         self._info[pod.key] = info
@@ -241,7 +254,7 @@ class PriorityQueue:
         self._info.pop(pod_key, None)
         self._gated.pop(pod_key, None)
         self._unschedulable.pop(pod_key, None)
-        self._where.pop(pod_key, None)
+        self._unset_where(pod_key)
         # lazy deletion for heap entries: popping skips stale keys
 
     # -- pop --
@@ -257,7 +270,7 @@ class PriorityQueue:
             info = self._info[key]
             info.attempts += 1
             self.scheduling_cycle += 1
-            del self._where[key]
+            self._unset_where(key)
             del self._info[key]
             out.append(info)
         return out
@@ -293,7 +306,7 @@ class PriorityQueue:
             ).inc()
         else:
             self._unschedulable[info.key] = info
-            self._where[info.key] = "unsched"
+            self._set_where(info.key, "unsched")
             metrics.queue_incoming_pods_total.labels(
                 "unschedulable", "ScheduleAttemptFailure"
             ).inc()

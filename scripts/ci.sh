@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
-# CI gate: the three correctness/perf layers in order of cost —
+# CI gate: the correctness/perf layers in order of cost —
 #   1. static analysis (scripts/lint.py — TPU001..MET001, instant)
 #   2. tier-1 tests   (ROADMAP.md invocation, minus the soak marker)
 #   3. sim smokes     (one fixed-seed run per scenario profile, plus a
 #      determinism self-check on the flagship churn profile)
+#   4. obs smoke      (journaled fixed-seed sim -> JSONL schema check ->
+#      explain one pod from the recorded trace)
 #
 # Usage: scripts/ci.sh            # everything
 #        SKIP_TESTS=1 scripts/ci.sh   # lint + sim only (fast local loop)
@@ -30,5 +32,14 @@ done
 echo "== sim determinism self-check =="
 python -m kubernetes_tpu.sim --seed 0 --cycles 6 --profile churn_heavy \
     --selfcheck
+
+echo "== obs smoke: journaled sim -> schema check -> explain =="
+obs_journal=$(mktemp /tmp/ktpu_obs_journal.XXXXXX.jsonl)
+python -m kubernetes_tpu.sim --seed 0 --cycles 6 --profile churn_heavy \
+    --journal "$obs_journal"
+python -m kubernetes_tpu.obs validate "$obs_journal"
+obs_pod=$(python -c "import json,sys; print(json.loads(open(sys.argv[1]).readline())['pod'])" "$obs_journal")
+python -m kubernetes_tpu.obs explain "$obs_pod" --trace "$obs_journal"
+rm -f "$obs_journal"
 
 echo "CI gate: OK"

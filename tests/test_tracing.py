@@ -1,0 +1,89 @@
+"""utils/tracing.py (the jax-profiler step-trace wrapper) — previously
+the only untested module in utils/: lazy session start on first step,
+stop() as a no-op when never started, and the disabled path yielding
+without importing jax."""
+
+import sys
+
+import pytest
+
+from kubernetes_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def reset_tracing_state():
+    """The module is global-state by design (one profiler session per
+    process); isolate each test."""
+    old_dir, old_started = tracing._trace_dir, tracing._started
+    tracing._trace_dir = None
+    tracing._started = False
+    yield
+    tracing._trace_dir, tracing._started = old_dir, old_started
+
+
+class _FakeProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, d):
+        self.calls.append(("start", d))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+
+    class StepTraceAnnotation:
+        def __init__(self, name, step_num=0):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+
+def test_disabled_path_yields_without_importing_jax(monkeypatch):
+    # a poisoned jax module would explode on any attribute access: the
+    # disabled path must never get that far
+    class _Poison:
+        def __getattr__(self, name):
+            raise AssertionError(f"disabled tracing touched jax.{name}")
+
+    monkeypatch.setitem(sys.modules, "jax", _Poison())
+    assert not tracing.enabled()
+    ran = False
+    with tracing.step("batch", 1):
+        ran = True
+    assert ran
+    tracing.stop()  # still a no-op: never started
+
+
+def test_stop_is_noop_when_never_started(monkeypatch):
+    class _Poison:
+        def __getattr__(self, name):
+            raise AssertionError("stop() touched jax without a session")
+
+    monkeypatch.setitem(sys.modules, "jax", _Poison())
+    tracing.enable("/tmp/traces")
+    assert tracing.enabled()
+    tracing.stop()  # enabled but no step ran: must not import/stop jax
+
+
+def test_lazy_start_on_first_step_and_stop_flushes(monkeypatch):
+    import types
+
+    prof = _FakeProfiler()
+    fake_jax = types.SimpleNamespace(profiler=prof)
+    monkeypatch.setitem(sys.modules, "jax", fake_jax)
+    tracing.enable("/tmp/traces")
+    assert prof.calls == []  # enable alone starts nothing
+    with tracing.step("batch", 1):
+        pass
+    assert prof.calls == [("start", "/tmp/traces")]
+    with tracing.step("batch", 2):
+        pass
+    assert prof.calls == [("start", "/tmp/traces")]  # started once
+    tracing.stop()
+    assert prof.calls[-1] == ("stop",)
+    tracing.stop()  # idempotent after flush
+    assert prof.calls.count(("stop",)) == 1
