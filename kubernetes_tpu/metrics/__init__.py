@@ -131,6 +131,23 @@ pipeline_fallback_total = Counter(
     "under sustained capacity/mask-affecting event churn.",
     registry=REGISTRY,
 )
+pipeline_mode_total = Counter(
+    "scheduler_pipeline_mode_total",
+    "Popped batches by pipelined-loop mode: overlap (plain fit shapes "
+    "dispatched before the previous solve's read lands), carry (hard "
+    "shapes — ports/spread/interpod/volumes/DRA/nominated/multi-"
+    "profile — drained-then-chained through the occupancy-carrying "
+    "sub-batch split), sync (livelock-backstop synchronous cycle).",
+    ["mode"],
+    registry=REGISTRY,
+)
+pipeline_subbatches_total = Counter(
+    "scheduler_pipeline_subbatches_total",
+    "Chained sub-batch solves dispatched by the RTT-hiding batch split "
+    "(run_pipelined): sub-batch i's assignment read overlaps sub-batch "
+    "i+1's device solve.",
+    registry=REGISTRY,
+)
 # -- scheduling trace layer (kubernetes_tpu/obs) --
 
 trace_spans_total = Counter(
@@ -179,7 +196,7 @@ sim_invariant_violations_total = Counter(
     "scheduler_sim_invariant_violations_total",
     "Invariant violations the simulator's checkers flagged, by "
     "invariant (double_bind|capacity|lost_pod|progress|monotonic|"
-    "journal).",
+    "constraint|journal).",
     ["invariant"],
     registry=REGISTRY,
 )

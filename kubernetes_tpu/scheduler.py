@@ -57,6 +57,13 @@ class SchedulerConfig:
     batch_size: int = 1024  # max pods per device solve
     solver: ExactSolverConfig = field(default_factory=ExactSolverConfig)
     assume_ttl: float = 30.0
+    # RTT-hiding batch split for run_pipelined: a popped batch may be
+    # dispatched as up to K chained sub-solves so the assignment read of
+    # sub-batch i overlaps the solve of i+1 (only the last read pays an
+    # un-hidden tunnel round trip). 0 = adaptive (split when the
+    # estimated device solve time exceeds the estimated read RTT, from
+    # per-batch EWMAs); 1 = never split; >1 = fixed cap per batch.
+    pipeline_split: int = 0
     # defaultpreemption: run the PostFilter dry-run for unschedulable pods
     enable_preemption: bool = True
     # multi-profile (profile.NewMap): schedulerName -> solver config for
@@ -154,6 +161,18 @@ class _PreparedGroup:
     fence: int = 0  # _conflict_seq INSIDE the tensorize lock (the snapshot
     # consistency point — capturing it any later would mask events landing
     # between lock release and dispatch; review-caught)
+    # the occupancy fence (_occupancy_seq at tensorize time): bumped by
+    # events only HARD-shaped batches are sensitive to — assigned-pod
+    # deletes / label changes that free or re-key port/spread/interpod
+    # occupancy, external DRA claim writes, waiting-pod rollbacks.
+    # (Nominator-map changes deliberately do NOT bump it: nominated load
+    # is advisory, and our own preemption nominations land mid-apply —
+    # see _ingest_event.) Plain fit batches ignore it (the device fit
+    # carry absorbs frees conservatively), so delete-churn cannot
+    # degrade the plain pipeline.
+    occ_fence: int = 0
+    occ_sensitive: bool = False  # batch reads occupancy/ctx the occ
+    # fence guards (ports/spread/interpod/volumes/DRA/nominated)
     step: int = 0  # the batch's span/trace id (Scheduler._trace_step)
     tensorize_seconds: float = 0.0  # host prep cost (set at dispatch)
     unsched_reason: dict = field(default_factory=dict)
@@ -165,12 +184,30 @@ class _InFlightSolve:
     """A dispatched solve whose assignments may not have been read yet.
     Its conflict fence is ``prep.fence`` — captured inside the tensorize
     lock, NOT at dispatch (re-reading _conflict_seq any later would mask
-    events landing between lock release and dispatch)."""
+    events landing between lock release and dispatch).
+
+    A chained sub-batch solve (the RTT-hiding batch split) shares one
+    prep with its siblings and covers only prep pods [lo, hi); the
+    unsplit case is the trivial slice [0, None). ``tensorize_share`` is
+    the portion of the shared tensorize cost this flight reports (full
+    for the first sub-flight, 0 for the rest)."""
 
     prep: _PreparedGroup
     handle: object  # np.ndarray (sync) | DeferredAssignments (pipelined)
     dispatch_seconds: float
     read_seconds: float = 0.0  # blocking device-read wait (set at apply)
+    lo: int = 0
+    hi: int | None = None
+    tensorize_share: float | None = None  # None = prep.tensorize_seconds
+
+    def infos(self) -> list:
+        return self.prep.infos[self.lo : self.hi]
+
+    def pods(self) -> list:
+        return self.prep.pods[self.lo : self.hi]
+
+    def cycle_offsets(self) -> list:
+        return self.prep.cycle_offsets[self.lo : self.hi]
 
     # sanctioned deferred-read point (analysis/registry.py) — the ONE
     # place the apply path may block on the device: ktpu: hot
@@ -266,17 +303,40 @@ class Scheduler:
         # solve (node capacity/mask changes, external pod placements). A
         # deferred solve whose fence no longer matches is discarded.
         self._conflict_seq = 0  # ktpu: guarded-by(cluster.lock)
-        # set when a deferred solve was discarded: the device session's
-        # carried state counted the discarded placements and must be
-        # re-uploaded from host truth before the next dispatch (done at
-        # _dispatch_group once no other solve is in flight)
-        self._session_stale = False  # ktpu: guarded-by(cluster.lock)
+        # occupancy fence for HARD-shaped deferred solves (ports/spread/
+        # interpod/volumes/DRA/nominated): bumped by events that free or
+        # re-key occupancy the shape's carried state cannot absorb —
+        # assigned-pod deletes, assigned-pod label changes, external DRA
+        # claim writes, nominator-map changes. Kept separate from
+        # _conflict_seq so delete-churn never discards plain fit solves
+        # (whose device carry absorbs frees conservatively).
+        self._occupancy_seq = 0  # ktpu: guarded-by(cluster.lock)
+        # RTT-hiding batch-split estimators (config.pipeline_split == 0):
+        # EWMAs of the blocking device-read wait (≈ tunnel RTT + residual
+        # solve) and of per-pod device time, updated on applied flights.
+        # Driver-thread only.
+        self._rtt_ewma = 0.0
+        self._pod_solve_ewma = 0.0
+        # profiles whose deferred solve was discarded: that profile's
+        # device session carried the discarded placements and must
+        # re-upload from host truth before its next dispatch (done at
+        # _dispatch_group once no other solve is in flight). A set, not
+        # a bool: multi-profile configs pipeline too, and healing the
+        # WRONG profile's session would leave the polluted carry live.
+        self._session_stale = set()  # ktpu: guarded-by(cluster.lock)
         # consecutive fence discards with no successful apply (driver
         # thread only — never touched by watch ingest): once it reaches
         # _PIPELINE_FALLBACK_AFTER, run_pipelined falls back to one
         # synchronous cycle so sustained event churn cannot livelock the
-        # pipelined loop (ADVICE r5 #2)
+        # pipelined loop (ADVICE r5 #2). The streak counts PREPS, not
+        # sub-flights: one event discarding a whole K-sub-batch chain is
+        # ONE conflicting window, and counting it K times would engage
+        # the fence-free backstop off a single isolated event
+        # (review-caught); _last_discard_step dedupes within a chain —
+        # an int, not the prep itself, so a discarded batch's tensors
+        # aren't pinned on this 1-vCPU host until the next apply.
         self._discard_streak = 0
+        self._last_discard_step = -1
         # sim/fault-injection seam (kubernetes_tpu/sim): called with the
         # in-flight solve right after every dispatch, while NO lock is
         # held — the one real boundary where a concurrent actor's watch
@@ -359,6 +419,9 @@ class Scheduler:
             # the whole unschedulable map per bind defeats backoff).
             # Unreserve rollbacks FREE devices and are not suppressed.
             if self._dra and not self.claim_allocator.writing:
+                # an external writer changed claim/inventory state a
+                # DRA-active deferred solve folded at tensorize time
+                self._occupancy_seq += 1
                 self.queue.move_all_to_active_or_backoff(ev.kind + ev.type)
             return
         if ev.kind == "Pod":
@@ -366,6 +429,10 @@ class Scheduler:
             # nominator-map maintenance: an unbound pod with a nomination is
             # indexed; binding or clearing the nomination drops it
             if ev.type != "DELETED" and not pod.node_name and pod.nominated_node_name:
+                # nominated-load changes stay advisory (the reference's
+                # best-effort nominator semantics): they do NOT bump the
+                # occupancy fence — our own preemption nominations land
+                # mid-apply and would self-discard the rest of a chain
                 self.nominated_pods[pod.key] = pod
             else:
                 self.nominated_pods.pop(pod.key, None)
@@ -400,6 +467,13 @@ class Scheduler:
                             != pod.resource_request()
                         ):
                             self._conflict_seq += 1
+                        if old is None or old.labels != pod.labels:
+                            # a placed pod's labels re-key spread domain
+                            # counts and interpod term matching: only
+                            # occupancy-carrying solves care (plain fit
+                            # solves must not discard on label flaps —
+                            # the original pipeline-degeneration hazard)
+                            self._occupancy_seq += 1
                         self.cache.update_pod(pod)
                         # a pod this scheduler still had queued was bound
                         # by someone else: drop it (upstream's filtering
@@ -429,6 +503,13 @@ class Scheduler:
                 if pod.node_name:
                     freed_node = pod.node_name
                     self.cache.remove_pod(pod.key)
+                    # freed ports / spread counts / interpod terms: for
+                    # the fit carry a free is conservative, but a spread
+                    # count overstated in the MIN domain loosens other
+                    # domains' quotas and a vanished affinity peer can
+                    # wrongly admit a placement — occupancy-carrying
+                    # solves in flight must discard
+                    self._occupancy_seq += 1
                     # AssignedPodDelete frees resources on ONE node: wake
                     # only pods whose requests fit its new free capacity
                     self.queue.move_all_to_active_or_backoff(
@@ -443,6 +524,9 @@ class Scheduler:
                     if entry is not None:
                         wp, _info, _cycle, state, _t0, _step = entry
                         self._unreserve_all(state, wp.pod, wp.node_name)
+                        # the rollback freed assumed occupancy a deferred
+                        # hard-shape solve may have counted
+                        self._occupancy_seq += 1
         else:  # Node
             if ev.type == "ADDED":
                 # node add/remove remaps snapshot slots: any in-flight
@@ -719,32 +803,41 @@ class Scheduler:
         if first_err is not None:
             raise first_err
 
+    def _group_by_profile(
+        self, infos: list
+    ) -> list[tuple[str, list, list[int]]]:
+        """Profile sub-batches in pop order
+        (schedule_one.go#frameworkForPod routing): (profile, infos,
+        cycle offsets) per group — shared by the synchronous and
+        pipelined loops so their batch composition can never diverge.
+        Single-profile configs skip the bucketing pass."""
+        if len(self.solvers) == 1:
+            only = next(iter(self.solvers))
+            return [(only, infos, list(range(len(infos))))]
+        by_profile: dict[str, list] = {}
+        order: list[str] = []
+        for off, info in enumerate(infos):
+            name = info.pod.scheduler_name
+            if name not in by_profile:
+                by_profile[name] = []
+                order.append(name)
+            by_profile[name].append((off, info))
+        return [
+            (
+                name,
+                [i for _, i in by_profile[name]],
+                [off for off, _ in by_profile[name]],
+            )
+            for name in order
+        ]
+
     def _run_groups(
         self, infos: list, res: BatchResult, pending: list, t0: float
     ) -> None:
         base_cycle = self.queue.scheduling_cycle - len(infos)
-
-        if len(self.solvers) == 1:
-            only = next(iter(self.solvers))
-            groups = [(only, infos, list(range(len(infos))))]
-        else:
-            by_profile: dict[str, list] = {}
-            order: list[str] = []
-            for off, info in enumerate(infos):
-                name = info.pod.scheduler_name
-                if name not in by_profile:
-                    by_profile[name] = []
-                    order.append(name)
-                by_profile[name].append((off, info))
-            groups = [
-                (
-                    name,
-                    [i for _, i in by_profile[name]],
-                    [off for off, _ in by_profile[name]],
-                )
-                for name in order
-            ]
-        for name, group_infos, cycle_offsets in groups:
+        for name, group_infos, cycle_offsets in self._group_by_profile(
+            infos
+        ):
             self._solve_group(
                 name, group_infos, cycle_offsets, base_cycle, res, t0,
                 pending,
@@ -1033,6 +1126,15 @@ class Scheduler:
                 slot_nodes=slot_nodes, names=list(self.snapshot.names),
                 volume_ctx=volume_ctx, services=services,
                 dra_active=dra_active, fence=self._conflict_seq,
+                occ_fence=self._occupancy_seq,
+                occ_sensitive=bool(
+                    need_ports
+                    or need_spread
+                    or need_interpod
+                    or dra_active
+                    or volume_ctx is not None
+                    or nom_pairs
+                ),
                 step=self._trace_step,
             )
 
@@ -1152,19 +1254,27 @@ class Scheduler:
                 "DynamicResources", "PreFilter", "Success"
             ).observe(self.clock.perf() - tdra)
     def _dispatch_group(
-        self, prep: _PreparedGroup, defer: bool, allow_heal: bool = True
-    ) -> _InFlightSolve:
+        self,
+        prep: _PreparedGroup,
+        defer: bool,
+        allow_heal: bool = True,
+        split: int = 1,
+    ) -> "_InFlightSolve | list[_InFlightSolve]":
         """Upload + launch the device solve. ``defer=False`` blocks on
         the assignment read (the synchronous path); ``defer=True``
         returns immediately with an async device→host copy in flight so
         the read overlaps later host work (run_pipelined).
         ``allow_heal=False`` defers dirty-column healing while an
-        earlier solve is still unapplied (see _DeviceSession.sync)."""
+        earlier solve is still unapplied (see _DeviceSession.sync).
+        ``split > 1`` (deferred only) dispatches the batch as chained
+        sub-solves (ExactSolver.solve's RTT-hiding batch split) and
+        returns one in-flight solve per sub-batch, all sharing this
+        prep and its fences."""
         solver = self.solvers[prep.profile]
         with self.cluster.lock:
-            heal_stale = self._session_stale and allow_heal
+            heal_stale = prep.profile in self._session_stale and allow_heal
             if heal_stale:
-                self._session_stale = False
+                self._session_stale.discard(prep.profile)
         if heal_stale:
             # a discarded solve polluted the device carry; with no other
             # solve in flight (allow_heal implies the pipeline drained),
@@ -1177,7 +1287,7 @@ class Scheduler:
         # dirty snapshot columns heal by version; only assignments download
         with self.obs.span(
             "dispatch", trace_id=prep.step, profile=prep.profile,
-            defer=defer, healed=heal_stale,
+            defer=defer, healed=heal_stale, split=split,
         ):
             handle = solver.solve(
                 prep.batch, prep.pbatch, prep.static, prep.ports,
@@ -1187,6 +1297,7 @@ class Scheduler:
                 nominated_slot=prep.nominated_slot,
                 defer_read=defer,
                 allow_heal=allow_heal,
+                split=split,
             )
         dispatch_dt = self.clock.perf() - t1
         prep.tensorize_seconds = max(t1 - prep.gs, 0.0)
@@ -1196,6 +1307,40 @@ class Scheduler:
         metrics.framework_extension_point_duration_seconds.labels(
             "PreFilter", "Success", prep.profile
         ).observe(prep.tensorize_seconds)
+        if split > 1:
+            # chained sub-solves: one flight per sub-batch, sharing the
+            # prep. The chain's dispatch wall spreads EVENLY across the
+            # sub-flights (totals stay honest, and the adaptive-split
+            # estimator's per-pod rate isn't inflated by charging the
+            # whole chain's dispatch to one sub-batch); the shared
+            # tensorize cost reports on the first flight only.
+            share = dispatch_dt / len(handle)
+            flights = [
+                _InFlightSolve(
+                    prep=prep,
+                    handle=h,
+                    dispatch_seconds=share,
+                    lo=h.lo,
+                    hi=h.lo + h.count,
+                    tensorize_share=None if i == 0 else 0.0,
+                )
+                for i, h in enumerate(handle)
+            ]
+            if len(flights) > 1:
+                # a clamped split (indivisible padding, nominated batch)
+                # is NOT a chain: counting it would let a regression
+                # that always clamps keep the chain metric (and the
+                # tests reading it) green
+                metrics.pipeline_subbatches_total.inc(len(flights))
+            hook = self._post_dispatch_hook
+            if hook is not None:
+                # per sub-flight, honoring the seam's contract ("after
+                # every dispatch"): the sim gets one fault-injection
+                # point per dispatch→apply window, so mid-chain fence
+                # interleavings are reachable from the smokes too
+                for f in flights:
+                    hook(f)
+            return flights
         flight = _InFlightSolve(
             prep=prep, handle=handle, dispatch_seconds=dispatch_dt,
         )
@@ -1222,12 +1367,15 @@ class Scheduler:
         prep = flight.prep
         profile = prep.profile
         solver = self.solvers[profile]
-        infos, pods = prep.infos, prep.pods
+        # a chained sub-flight covers prep pods [lo, hi); idx below is
+        # slice-local — pod-indexed prep tensors use pod_base + idx
+        pod_base = flight.lo
+        infos, pods = flight.infos(), flight.pods()
         static, slot_nodes = prep.static, prep.slot_nodes
         volume_ctx, services = prep.volume_ctx, prep.services
         dra_active, dra_prefold = prep.dra_active, prep.dra_prefold
         unsched_reason = prep.unsched_reason
-        base_cycle, cycle_offsets = prep.base_cycle, prep.cycle_offsets
+        base_cycle, cycle_offsets = prep.base_cycle, flight.cycle_offsets()
         t0, gs = prep.t0, prep.gs
         pending_before = len(pending)
         unsched_before = len(res.unschedulable)
@@ -1247,7 +1395,13 @@ class Scheduler:
             "apply", trace_id=prep.step, profile=profile, pods=len(infos),
             read_seconds=flight.read_seconds,
         ) as asp:
-            if fence is not None and fence != self._conflict_seq:
+            if fence is not None and (
+                fence != self._conflict_seq
+                or (
+                    prep.occ_sensitive
+                    and prep.occ_fence != self._occupancy_seq
+                )
+            ):
                 asp.set(fence_stale=True)
                 return False  # went stale during the device read
             # phase 2b: apply assignments — assume / Reserve / Permit /
@@ -1378,7 +1532,8 @@ class Scheduler:
                             )
                         tpf = self.clock.perf()
                         nominated_node = self._try_preempt(
-                            pod, static, idx, res, preempt_placed, slot_nodes,
+                            pod, static, pod_base + idx, res,
+                            preempt_placed, slot_nodes,
                             preempt_pdbs, cluster_has_affinity, solver,
                             dra_prefold=dra_prefold,
                         )
@@ -1404,14 +1559,14 @@ class Scheduler:
                     res.unschedulable.append(pod.key)
                     self._requeue(info, cycle)
                     why = unsched_reason.get(pod.key) or fit_error_for(
-                        pod, idx
+                        pod, pod_base + idx
                     )
                     self._event(
                         pod, "FailedScheduling", why, type_="Warning",
                     )
                     if self.journal is not None:
                         self.journal.unschedulable(
-                            prep.step, cycle, pod, prep, idx,
+                            prep.step, cycle, pod, prep, pod_base + idx,
                             reason=why, nominated=nominated_node or "",
                             attempts=info.attempts,
                         )
@@ -2142,7 +2297,9 @@ class Scheduler:
         (the device session carries the fit/balanced node state forward
         on its own). Ports/spread/interpod occupancy, volume and DRA
         context, and nominated-pod load are all rebuilt from the cache
-        each batch, so any of them forces the synchronous path."""
+        each batch, so any of them routes to the pipelined CARRY mode
+        instead: drain in-flight solves before tensorizing, then overlap
+        via the chained sub-batch split (run_pipelined)."""
         if self.nominated_pods or self._waiting:
             return False
         for p in pods:
@@ -2187,14 +2344,17 @@ class Scheduler:
         host truth once the pipeline has drained (a later solve may still
         be chained on it)."""
         metrics.solves_discarded_total.inc()
-        self._discard_streak += 1
         prep = flight.prep
+        if prep.step != self._last_discard_step:
+            self._discard_streak += 1
+            self._last_discard_step = prep.step
+        infos = flight.infos()
         with self.cluster.lock, self.obs.span(
             "fence", trace_id=prep.step, action="discard",
-            pods=len(prep.infos), fence=prep.fence,
+            pods=len(infos), fence=prep.fence,
         ):
-            self._session_stale = True
-            for info in prep.infos:
+            self._session_stale.add(prep.profile)
+            for info in infos:
                 self._in_flight.pop(info.key, None)
                 if self.journal is not None:
                     self.journal.record(
@@ -2220,9 +2380,12 @@ class Scheduler:
         res = BatchResult()
         pending: list = []
         prep = flight.prep
-        infos = prep.infos
+        infos = flight.infos()
         # ktpu: ignore[LOCK001]: deliberately unlocked pre-check — a torn read can only misroute to the locked re-check inside _apply_group or to a discard, both safe
-        if prep.fence == self._conflict_seq:
+        fence_fresh = prep.fence == self._conflict_seq
+        # ktpu: ignore[LOCK001]: same deliberately unlocked pre-check; the locked re-check inside _apply_group is authoritative
+        occ_fresh = not prep.occ_sensitive or prep.occ_fence == self._occupancy_seq
+        if fence_fresh and occ_fresh:
             applied = False
             ta = self.clock.perf()
             try:
@@ -2232,12 +2395,19 @@ class Scheduler:
                 applied = self._apply_group(
                     flight, res, pending, fence=prep.fence
                 )
+                self._note_flight_timing(flight, len(infos))
                 if applied:
                     # host cost = this batch's own tensorize + apply
                     # phases; wall-since-pop would charge the overlapped
                     # batches' work and the hidden RTT to this batch
-                    # (review-caught)
-                    res.host_seconds = prep.tensorize_seconds + (
+                    # (review-caught). Chained sub-flights report the
+                    # shared tensorize cost on the first flight only.
+                    tshare = (
+                        prep.tensorize_seconds
+                        if flight.tensorize_share is None
+                        else flight.tensorize_share
+                    )
+                    res.host_seconds = tshare + (
                         self.clock.perf() - ta - flight.read_seconds
                     )
                     self._record_metrics(res, len(infos))
@@ -2249,12 +2419,15 @@ class Scheduler:
                 # dispatch re-uploads from host truth instead of counting
                 # phantom placements against future solves (ADVICE r5 #3)
                 with self.cluster.lock:
-                    self._session_stale = True
+                    self._session_stale.add(prep.profile)
                 self._requeue_unhandled(infos, pending, res)
                 self._commit_all(infos, pending, res)
                 raise
             if applied:
-                self._discard_streak = 0  # forward progress: reset backstop
+                # forward progress: reset the backstop (and the
+                # within-chain discard dedup)
+                self._discard_streak = 0
+                self._last_discard_step = -1
                 self._commit_all(infos, pending, res)
                 res.completed_at = self.clock.perf()
                 return res
@@ -2262,56 +2435,122 @@ class Scheduler:
         res.completed_at = self.clock.perf()
         return res
 
+    def _note_flight_timing(self, flight: _InFlightSolve, n_pods: int) -> None:
+        """Feed the adaptive batch-split estimators from an applied (or
+        read-then-discarded) flight. Only reads that actually BLOCKED
+        (>1 ms) carry signal: they approximate residual solve + tunnel
+        RTT, an upper bound on the RTT. Post-overlap reads (~0.2 ms on
+        axon) are the overlap WORKING and say nothing about the RTT —
+        folding them in would drive the estimate to ~0 and make the
+        adaptive rule split every batch to the max. EWMAs, not running
+        extrema, so the estimates track tunnel mood both ways. Driver
+        thread only."""
+        read = flight.read_seconds
+        if read < 1e-3 or n_pods <= 0:
+            return
+        self._rtt_ewma = (
+            read
+            if self._rtt_ewma <= 0
+            else 0.7 * self._rtt_ewma + 0.3 * read
+        )
+        per_pod = (flight.dispatch_seconds + read) / n_pods
+        self._pod_solve_ewma = (
+            per_pod
+            if self._pod_solve_ewma <= 0
+            else 0.7 * self._pod_solve_ewma + 0.3 * per_pod
+        )
+
+    _MAX_PIPELINE_SPLIT = 8
+
+    def _choose_split(self, n_pods: int) -> int:
+        """Sub-batch count for one popped batch (the RTT-hiding batch
+        split). A fixed config wins; the adaptive default splits once the
+        estimated device solve time for the batch exceeds the estimated
+        read round trip, so the assignment read of sub-batch i can
+        overlap the solve of i+1 — the knob that attacks the per-batch
+        RTT floor. The solver clamps the request to a feasible
+        (group-aligned) divisor of the padded pod axis."""
+        cfg = self.config.pipeline_split
+        if cfg == 1:
+            return 1
+        if cfg > 1:
+            return min(cfg, self._MAX_PIPELINE_SPLIT)
+        if self._rtt_ewma <= 0 or self._pod_solve_ewma <= 0:
+            return 1
+        est_solve = n_pods * self._pod_solve_ewma
+        if est_solve <= 2 * self._rtt_ewma:
+            return 1
+        return max(
+            2,
+            min(
+                int(est_solve / self._rtt_ewma), self._MAX_PIPELINE_SPLIT
+            ),
+        )
+
     def run_pipelined(self, max_batches: int = 10_000) -> list[BatchResult]:
-        """Drain the queue with up to TWO solves in flight: batch k+1 is
-        tensorized and dispatched while batch k's assignments are still
-        crossing the device→host tunnel, so steady-state throughput pays
-        host work, not round trips (VERDICT r4 #1; the reference's
+        """Drain the queue with deferred solves in flight: host work for
+        the NEXT dispatch overlaps the device→host tunnel round trip of
+        solves already dispatched, so steady-state throughput pays host
+        work, not round trips (VERDICT r4 #1; the reference's
         scheduleOne overlaps binding the same way —
         schedule_one.go#scheduleOne's bind goroutine [U] — extended here
-        to the device boundary).
+        to the device boundary). Every popped batch takes one of three
+        modes (scheduler_pipeline_mode_total):
 
-        Safety: only _plain_batch batches overlap (their tensorization
-        reads nothing a previous apply writes; the device session carries
-        node fit state forward itself, so batch k+1's solve already sees
-        batch k's placements). Every dispatched solve is fenced on
-        _conflict_seq; a conflicting watch event between dispatch and
-        apply discards the solve, resets the device session, and requeues
-        the pods for an immediate retry. Batches that are not plain (or
-        arrive while pods wait at Permit) drain the pipeline and run the
-        synchronous cycle. Multi-profile, extender, and out-of-tree
-        plugin configurations fall back to run_until_settled entirely.
+        - **overlap**: _plain_batch shapes — batch k+1 is tensorized and
+          dispatched BEFORE batch k's assignments land (the device
+          session carries fit state forward, so k+1's solve already sees
+          k's placements). Extender / out-of-tree Filter+Score folding
+          is a pre-dispatch host stage here: verdicts fold into the
+          class tables per batch and read nothing a previous apply
+          writes, so they ride the overlap instead of forcing the
+          synchronous loop.
+        - **carry**: hard shapes (ports/spread/interpod, volumes, DRA,
+          nominated pods) and multi-profile sub-batches — in-flight
+          solves drain FIRST so tensorization reads exact occupancy,
+          then the batch dispatches as up to K chained sub-solves whose
+          occupancy rows stay device-resident between them
+          (BatchCarriedUsage): the assignment read of sub-batch i
+          overlaps the solve of i+1, and each sub-batch's apply/bind
+          work overlaps the next sub-batch's solve. Only the final read
+          pays an un-hidden RTT per popped batch.
+        - **sync**: the livelock backstop (below) and WaitingPod
+          settlement, via the fence-free synchronous cycle.
+
+        Safety: every dispatched solve is fenced on _conflict_seq, and
+        occupancy-sensitive solves additionally on _occupancy_seq
+        (assigned-pod deletes/label re-keys, external DRA claim writes —
+        the event kinds whose effects the carried state cannot absorb).
+        A conflicting event between dispatch and apply discards the
+        solve, resets the device session, and requeues the pods for an
+        immediate retry.
 
         Livelock backstop (ADVICE r5 #2): _PIPELINE_FALLBACK_AFTER
         consecutive fence discards force one synchronous (fence-free)
         cycle — counted by scheduler_pipeline_fallback_total — so
         sustained capacity/mask event churn degrades to the synchronous
         path's throughput instead of zero forward progress."""
-        can_pipeline = (
-            len(self.solvers) == 1
-            and not self.config.out_of_tree_plugins
-            and not self.extender_clients
-        )
-        if not can_pipeline:
-            return self.run_until_settled(max_batches)
-        profile = next(iter(self.solvers))
         out: list[BatchResult] = []
-        flight: _InFlightSolve | None = None
-        nxt: _InFlightSolve | None = None
+        flights: list[_InFlightSolve] = []
 
-        def apply_flight() -> None:
-            nonlocal flight
-            f, flight = flight, None
+        def apply_one() -> None:
+            f = flights.pop(0)
             r = self._apply_flight(f)
             if r.scheduled or r.unschedulable or r.bind_failures:
                 out.append(r)
+
+        def drain() -> None:
+            while flights:
+                apply_one()
 
         batches = 0
         try:
             while batches < max_batches:
                 if self._waiting:
-                    if flight is not None:
-                        apply_flight()
+                    drain()
+                    # WaitingPod settlement is a synchronous cycle: it
+                    # counts under mode="sync" like the backstop does
+                    metrics.pipeline_mode_total.labels("sync").inc()
                     r = self.schedule_batch()
                     batches += 1
                     if not (
@@ -2332,18 +2571,15 @@ class Scheduler:
                     )
                     self._refresh_pending_gauge()
                 if not infos:
-                    if flight is not None:
-                        apply_flight()
+                    if flights:
+                        drain()
                         continue  # discards/failures may requeue work
                     break
                 batches += 1
                 # batch id for this pop's spans/journal (the sync branch
                 # below re-enters via _run_popped, not schedule_batch)
                 self._trace_step += 1
-                fallback = (
-                    self._discard_streak >= self._PIPELINE_FALLBACK_AFTER
-                )
-                if fallback and plain:
+                if self._discard_streak >= self._PIPELINE_FALLBACK_AFTER:
                     # livelock backstop (ADVICE r5 #2): N consecutive
                     # fence discards mean conflicting events are landing
                     # faster than one per dispatch→apply window, and the
@@ -2354,84 +2590,49 @@ class Scheduler:
                     # guaranteeing at least one batch lands per N
                     # discards under sustained churn.
                     metrics.pipeline_fallback_total.inc()
+                    metrics.pipeline_mode_total.labels("sync").inc()
                     self._log.warning(
                         "pipeline livelock backstop engaged after %d "
                         "consecutive fence discards: one synchronous "
                         "cycle", self._discard_streak,
                         extra={"step": self._trace_step},
                     )
-                    plain = False
-                # ``owned``: popped but not yet handed to a cycle or a
-                # flight — an exception below must requeue exactly these
-                # (handing off clears it; review-caught leak)
-                owned: list | None = infos
+                    drain()
+                    r = self._run_popped(infos, t0)
+                    # the synchronous cycle applied (no fence): the
+                    # backstop counter restarts from real progress
+                    self._discard_streak = 0
+                    self._last_discard_step = -1
+                    if r.scheduled or r.unschedulable or r.bind_failures:
+                        out.append(r)
+                    continue
+                # profile sub-batches in pop order (multi-profile configs
+                # pipeline per group; single-profile is one group)
+                groups = self._group_by_profile(infos)
+                overlap_ok = plain and len(groups) == 1
+                metrics.pipeline_mode_total.labels(
+                    "overlap" if overlap_ok else "carry"
+                ).inc()
+                # ``owned``: popped groups not yet handed to a flight —
+                # an exception below must requeue exactly these (handing
+                # off removes a group; review-caught leak)
+                owned: list[list[QueuedPodInfo]] = [g[1] for g in groups]
                 try:
-                    if not plain:
-                        # this batch's tensorization must see every prior
-                        # assume: drain the pipeline, then run the
-                        # synchronous cycle body
-                        if flight is not None:
-                            apply_flight()
-                        owned = None
-                        r = self._run_popped(infos, t0)
-                        # the synchronous cycle applied (no fence): the
-                        # backstop counter restarts from real progress
-                        self._discard_streak = 0
-                        if (
-                            r.scheduled
-                            or r.unschedulable
-                            or r.bind_failures
-                        ):
-                            out.append(r)
-                        continue
-                    with self.cluster.lock:
-                        stale = self._session_stale
-                    if stale and flight is not None:
-                        # last apply discarded a solve: drain the survivor
-                        # so the stale device carry re-uploads at dispatch
-                        apply_flight()
-                    prep = self._tensorize_group(
-                        profile, infos, list(range(len(infos))),
-                        base_cycle, t0,
-                    )
-                    if (
-                        flight is not None
-                        and prep.fence != flight.prep.fence
-                    ):
-                        # an event landed since the in-flight solve's
-                        # snapshot. The deferred heal (allow_heal=False)
-                        # is only conservative for USAGE columns — node
-                        # TABLES (allocatable/valid) can shrink, and a
-                        # solve against stale tables would carry THIS
-                        # prep's fresh fence and apply a capacity
-                        # violation (review-caught). Drain first: the
-                        # stale flight discards itself, and this dispatch
-                        # heals with current tables.
-                        apply_flight()
-                    try:
-                        nxt = self._dispatch_group(
-                            prep, defer=True, allow_heal=flight is None
+                    for profile, group_infos, offsets in groups:
+                        self._pipeline_group(
+                            profile, group_infos, offsets, base_cycle,
+                            t0, overlap_ok, flights, apply_one, drain,
+                            owned,
                         )
-                    except SessionDrainRequired:
-                        # node/vocab shape change with a solve still in
-                        # flight: apply it, then dispatch with healing
-                        apply_flight()
-                        nxt = self._dispatch_group(
-                            prep, defer=True, allow_heal=True
-                        )
-                    owned = None  # the batch now lives in nxt
                 except Exception:
-                    if owned is not None:
+                    if owned:
                         with self.cluster.lock:
                             base = self.queue.scheduling_cycle
-                            for info in owned:
-                                self._requeue(info, base)
+                            for group_infos in owned:
+                                for info in group_infos:
+                                    self._requeue(info, base)
                     raise
-                if flight is not None:
-                    apply_flight()
-                flight, nxt = nxt, None
-            if flight is not None:
-                apply_flight()
+            drain()
         except Exception:
             # the crash trigger for the pipelined loop (the synchronous
             # loop dumps from schedule_batch)
@@ -2443,13 +2644,104 @@ class Scheduler:
                 )
             raise
         finally:
-            # exception escape hatch: a dispatched-but-unapplied solve
-            # must not strand its pods in _in_flight nor leave the device
-            # session silently ahead of host truth (review-caught)
-            for f in (flight, nxt):
-                if f is not None:
-                    self._discard_flight(f)
+            # exception escape hatch: dispatched-but-unapplied solves
+            # must not strand their pods in _in_flight nor leave the
+            # device session silently ahead of host truth (review-caught)
+            for f in flights:
+                self._discard_flight(f)
+            flights.clear()
         return out
+
+    def _pipeline_group(
+        self,
+        profile: str,
+        infos: list[QueuedPodInfo],
+        cycle_offsets: list[int],
+        base_cycle: int,
+        t0: float,
+        overlap_ok: bool,
+        flights: list,
+        apply_one,
+        drain,
+        owned: list,
+    ) -> None:
+        """Tensorize, fold, and dispatch one profile group through the
+        pipeline, leaving its LAST sub-flight in ``flights`` so the next
+        pop/tensorize overlaps its read. Carry-mode groups (overlap_ok
+        False) drain first: their occupancy tensors and volume/claim
+        contexts must see every prior apply — the RTT hiding then comes
+        from the chained sub-batch split and from each sub-batch's
+        apply/bind work overlapping its successor's solve."""
+        if not overlap_ok:
+            drain()
+        elif flights:
+            with self.cluster.lock:
+                stale = bool(self._session_stale)
+            if stale or flights[0].prep.profile != profile:
+                # drain before dispatch when (a) the last apply
+                # discarded a solve — the stale device carry must
+                # re-upload at dispatch — or (b) the in-flight solve
+                # belongs to ANOTHER profile: its placements live only
+                # in that profile's session carry, so this profile's
+                # tensorize/session would double-book the capacity it
+                # claimed (multi-profile configs overlap only
+                # same-profile consecutive batches)
+                drain()
+        prep = self._tensorize_group(
+            profile, infos, cycle_offsets, base_cycle, t0
+        )
+        with self.obs.span(
+            "fold", trace_id=prep.step, profile=profile,
+            extenders=len(self.extender_clients),
+            plugins=len(self.config.out_of_tree_plugins),
+        ):
+            # extender / out-of-tree / DRA folding as a pre-dispatch
+            # host stage: pure per (class, node) by contract, so it
+            # overlaps an in-flight solve's tunnel RTT
+            self._fold_group(prep)
+        if flights and prep.fence != flights[0].prep.fence:
+            # an event landed since the in-flight solve's snapshot. The
+            # deferred heal (allow_heal=False) is only conservative for
+            # USAGE columns — node TABLES (allocatable/valid) can
+            # shrink, and a solve against stale tables would carry THIS
+            # prep's fresh fence and apply a capacity violation
+            # (review-caught). Drain first: the stale flight discards
+            # itself, and this dispatch heals with current tables.
+            drain()
+        split = self._choose_split(len(infos))
+        try:
+            new = self._dispatch(prep, allow_heal=not flights, split=split)
+        except SessionDrainRequired:
+            # node/vocab shape change with a solve still in flight:
+            # apply it, then dispatch with healing
+            drain()
+            new = self._dispatch(prep, allow_heal=True, split=split)
+        flights.extend(new)
+        # handoff point: from here the flights own this group's pods —
+        # a later exception must requeue them via the flight-discard
+        # path, NOT the owned-groups requeue (double-requeue hazard)
+        owned.pop(0)
+        # apply everything but the newest sub-flight now: each read was
+        # overlapped by the dispatches above (or by the next sub-solve
+        # already running on device); the survivor overlaps the next
+        # pop/tensorize
+        while len(flights) > 1:
+            apply_one()
+
+    def _dispatch(
+        self, prep: _PreparedGroup, allow_heal: bool, split: int
+    ) -> list[_InFlightSolve]:
+        """Deferred dispatch normalized to a flight list (split == 1
+        keeps the legacy single-flight _dispatch_group signature the
+        fence tests and the sim monkeypatch)."""
+        if split > 1:
+            got = self._dispatch_group(
+                prep, defer=True, allow_heal=allow_heal, split=split
+            )
+            return got if isinstance(got, list) else [got]
+        return [
+            self._dispatch_group(prep, defer=True, allow_heal=allow_heal)
+        ]
 
     @property
     def pending(self) -> int:

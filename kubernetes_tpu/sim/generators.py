@@ -38,12 +38,33 @@ def make_node(
     return b.obj()
 
 
-def make_pod(name: str, cpu: str, priority: int = 0) -> Pod:
+ZONE_KEY = "topology.kubernetes.io/zone"
+HOST_KEY = "kubernetes.io/hostname"
+SIM_PORTS = (8080, 8081)  # small pool: conflicts actually happen
+
+
+def make_pod(
+    name: str, cpu: str, priority: int = 0, shape: str = "plain",
+    port: int = 0,
+) -> Pod:
+    """``shape``: plain | spread (hard maxSkew=1 zone spread over the
+    app=spread cohort) | anti (required hostname anti-affinity over
+    app=anti) | ports (hostPort ``port``)."""
     from ..api.wrappers import MakePod
 
     b = MakePod().name(name).req({"cpu": cpu, "memory": "1Gi"})
     if priority:
         b = b.priority(priority)
+    if shape == "spread":
+        b = b.label("app", "spread").spread_constraint(
+            1, ZONE_KEY, "DoNotSchedule", {"app": "spread"}
+        )
+    elif shape == "anti":
+        b = b.label("app", "anti").pod_anti_affinity(
+            HOST_KEY, {"app": "anti"}
+        )
+    elif shape == "ports":
+        b = b.host_port(port or SIM_PORTS[0])
     return b.obj()
 
 
@@ -71,14 +92,19 @@ class ChurnGenerator:
     def seed_nodes(self) -> list[Node]:
         out = []
         for _ in range(self.profile.nodes):
-            out.append(
-                make_node(
-                    self._next_node_name(),
-                    self.profile.node_cpu,
-                    self.profile.node_mem,
-                )
-            )
+            out.append(self._make_labeled_node())
         return out
+
+    def _make_labeled_node(self) -> Node:
+        """Node with a deterministic zone label (z{seq % zones}) so the
+        spread-shaped arrivals have topology domains to spread over."""
+        zone = f"z{self._node_seq % max(self.profile.zones, 1)}"
+        return make_node(
+            self._next_node_name(),
+            self.profile.node_cpu,
+            self.profile.node_mem,
+            labels={"topology.kubernetes.io/zone": zone},
+        )
 
     def _next_node_name(self) -> str:
         name = f"n{self._node_seq:03}"
@@ -99,12 +125,23 @@ class ChurnGenerator:
         p, rng = self.profile, self.rng
         events: list[dict] = []
 
-        # pod arrivals
+        # pod arrivals (shape drawn per arrival in a fixed order so the
+        # stream is a pure function of the gen RNG)
         for _ in range(rng.randint(*p.arrivals)):
+            shape, port = "plain", 0
+            if p.pod_spread_rate and rng.random() < p.pod_spread_rate:
+                shape = "spread"
+            elif p.pod_anti_rate and rng.random() < p.pod_anti_rate:
+                shape = "anti"
+            elif p.pod_ports_rate and rng.random() < p.pod_ports_rate:
+                shape = "ports"
+                port = rng.choice(SIM_PORTS)
             pod = make_pod(
                 self._next_pod_name(),
                 rng.choice(p.pod_cpu_choices),
                 rng.choice(p.pod_priorities),
+                shape=shape,
+                port=port,
             )
             events.append({"op": "create_pod", "pod": pod.to_dict()})
 
@@ -120,9 +157,7 @@ class ChurnGenerator:
 
         # node adds
         for _ in range(_count(rng, p.node_add_rate)):
-            node = make_node(
-                self._next_node_name(), p.node_cpu, p.node_mem
-            )
+            node = self._make_labeled_node()
             events.append({"op": "create_node", "node": node.to_dict()})
 
         # node deletes (keep at least one node alive)

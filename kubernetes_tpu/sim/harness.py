@@ -49,6 +49,7 @@ from .invariants import (
     Violation,
     _record,
     check_capacity,
+    check_constraints,
     check_journal_completeness,
     check_lost_pods,
 )
@@ -251,10 +252,12 @@ class SimHarness:
             try:
                 results = self.scheduler.run_pipelined(max_batches=200)
             except ExtenderError:
-                # only reachable when a caller forces pipelined=True with
-                # an extender profile (run_pipelined then falls back to
-                # the sync loop internally); completed batches' results
-                # are lost with the raise — acceptable for that corner
+                # extender configs pipeline now (the verdict fold is a
+                # pre-dispatch host stage), so a non-ignorable extender
+                # abort can surface here; completed batches' results are
+                # lost with the raise — acceptable for this corner, and
+                # why the extender_flaky profile defaults to the sync
+                # drive (profiles.py)
                 self._extender_aborts += 1
                 return
             for r in results:
@@ -281,6 +284,7 @@ class SimHarness:
     def _check(self, cycle: int) -> None:
         self.tracker.drain(cycle, self.violations)
         check_capacity(self.cluster, cycle, self.violations)
+        check_constraints(self.cluster, cycle, self.violations)
         check_lost_pods(
             self.cluster,
             self.scheduler,

@@ -15,6 +15,11 @@ tests/test_sim_invariants.py feeds each one a crafted violation):
   accounted for: scheduling queue (active/backoff/unschedulable/gated),
   in-flight map, WaitingPods map, or still-undelivered watch ADDs.
   Anything else fell out of the bookkeeping and would never schedule;
+- ``check_constraints``      — hard-shape placements hold: hostPort
+  exclusivity per node and required hostname anti-affinity among bound
+  pods (the checks guarding the pipelined loop's occupancy-carrying
+  path; spread skew is deliberately unchecked — node churn re-shapes
+  domains after placement);
 - ``MonotonicCounters``      — sampled Counter series never decrease;
 - eventual progress is checked by the harness's settle loop (bounded
   rounds of drain + virtual-clock advance), emitting a ``progress``
@@ -33,7 +38,8 @@ from ..state.cluster import ClusterState, Event
 
 @dataclass(frozen=True)
 class Violation:
-    invariant: str  # double_bind | capacity | lost_pod | progress | monotonic
+    invariant: str  # double_bind | capacity | lost_pod | progress |
+    # monotonic | constraint | journal
     cycle: int
     detail: str
 
@@ -128,6 +134,75 @@ def check_capacity(
                 f"node {name}: {count[name]} pods > allowed "
                 f"{node.allowed_pod_number}",
             )
+
+
+def check_constraints(
+    cluster: ClusterState, cycle: int, violations: list[Violation]
+) -> None:
+    """Hard-shape placement invariants over the CURRENT bound pods —
+    the checks that guard the pipelined loop's occupancy-carrying path:
+
+    - **hostPort exclusivity**: no two bound pods on one node share a
+      (port, protocol). Time-robust: a real kubelet would refuse the
+      second pod no matter when each bound.
+    - **required hostname anti-affinity**: a bound pod whose required
+      anti term (topologyKey kubernetes.io/hostname) matches ANOTHER
+      pod bound to the same node. Sound here because sim pod labels are
+      immutable, hostname labels never flap, and the profiles that
+      generate anti shapes run no external binds (a delayed watch can
+      only make the scheduler OVER-count peers — conservative).
+
+    Topology-spread skew is deliberately NOT checked: node churn moves
+    domain membership after placement, so a historical placement can
+    look skewed without any scheduler bug.
+    """
+    by_node: dict[str, list] = {}
+    for pod in cluster.list_pods():
+        if pod.node_name:
+            by_node.setdefault(pod.node_name, []).append(pod)
+    for name in sorted(by_node):
+        pods = sorted(by_node[name], key=lambda q: q.key)
+        ports_seen: dict[tuple, str] = {}
+        for pod in pods:
+            for port in pod.host_ports():
+                prev = ports_seen.get(port)
+                if prev is not None:
+                    _record(
+                        violations, "constraint", cycle,
+                        f"node {name}: hostPort {port} held by both "
+                        f"{prev} and {pod.key}",
+                    )
+                else:
+                    ports_seen[port] = pod.key
+        for pod in pods:
+            anti = (
+                pod.affinity.pod_anti_affinity
+                if pod.affinity is not None
+                else None
+            )
+            if anti is None or not anti.required:
+                continue
+            for term in anti.required:
+                if (
+                    term.topology_key != "kubernetes.io/hostname"
+                    or term.label_selector is None
+                ):
+                    continue
+                for other in pods:
+                    if other.key == pod.key:
+                        continue
+                    if not term.matches_namespace(
+                        pod.namespace, other.namespace
+                    ):
+                        continue
+                    if term.label_selector.matches(other.labels):
+                        _record(
+                            violations, "constraint", cycle,
+                            f"node {name}: {pod.key} requires hostname "
+                            f"anti-affinity but co-resides with "
+                            f"matching pod {other.key}",
+                        )
+                        break
 
 
 def check_lost_pods(

@@ -37,6 +37,15 @@ class Profile:
     arrivals: tuple[int, int] = (2, 6)
     pod_cpu_choices: tuple[str, ...] = ("500m", "1", "2")
     pod_priorities: tuple[int, ...] = (0,)
+    # hard-shape mix: P(an arrival carries the shape), drawn in order
+    # spread -> anti -> ports (first hit wins; remainder is a plain fit
+    # pod). Non-zero rates drive the pipelined loop's occupancy-carrying
+    # path (ports/spread/interpod batches no longer drain to the
+    # synchronous cycle) plus the constraint invariants that guard it.
+    pod_spread_rate: float = 0.0  # zone topology spread, hard maxSkew=1
+    pod_anti_rate: float = 0.0  # required hostname anti-affinity
+    pod_ports_rate: float = 0.0  # hostPort from a 2-port pool
+    zones: int = 3  # node zone labels: z{seq % zones}
     # -- churn rates (events per cycle; fractional = probability) --
     delete_pod_rate: float = 0.0
     node_add_rate: float = 0.0
@@ -79,6 +88,12 @@ PROFILES: dict[str, Profile] = {
         Profile(
             name="churn_heavy",
             arrivals=(2, 6),
+            # hard-shape arrivals (spread/anti/ports) drive the
+            # occupancy-carrying pipelined path and its occ fence under
+            # the same delete/label churn the fit fence already faces
+            pod_spread_rate=0.25,
+            pod_anti_rate=0.15,
+            pod_ports_rate=0.2,
             delete_pod_rate=0.8,
             node_add_rate=0.3,
             node_delete_rate=0.25,
@@ -125,17 +140,23 @@ PROFILES: dict[str, Profile] = {
         # exercising the mid-cycle-outage requeue path every few cycles.
         Profile(
             name="extender_flaky",
-            pipelined=False,  # extenders force the synchronous loop anyway
+            # extenders pipeline now (the verdict fold is a pre-dispatch
+            # host stage), but this profile stays on the sync drive: a
+            # non-ignorable extender abort mid-run_pipelined loses the
+            # completed batches' results, which would silently weaken
+            # the double-bind tracker's accounting (harness._drive)
+            pipelined=False,
             arrivals=(2, 5),
             extender=True,
             extender_fault_rate=0.3,
             bind_fault_rate=0.1,
         ),
         # Permit-point stalls: pods park in the WaitingPods map and are
-        # later allowed or timed out on the virtual clock.
+        # later allowed or timed out on the virtual clock — driven
+        # through the pipelined loop (waiting settlement drains the
+        # pipeline and runs a synchronous cycle per tick).
         Profile(
             name="permit_stalls",
-            pipelined=False,  # out-of-tree plugins force the sync loop
             arrivals=(2, 5),
             permit=True,
             permit_stall_rate=0.5,

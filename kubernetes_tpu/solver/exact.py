@@ -1047,8 +1047,10 @@ def _solve_grouped(
 def _run_packed(
     nt,  # node tables {alloc, max_pods, node_valid}
     ct,  # class tables {static_mask, taint_cnt, nodeaff_pref, image_score, spr, ipa}
-    persist,  # {used, nonzero_used, pod_count} — donated
-    bstate,  # [B, N] int32 packed per-batch state
+    persist,  # {used, nonzero_used, pod_count} — donated; with chain_in it
+    #           ALSO carries the batch-state rows from the previous
+    #           chained sub-solve (BatchCarriedUsage)
+    bstate,  # [B, N] int32 packed per-batch state ([1, 1] dummy with chain_in)
     xi64,  # [P, *] int64 packed per-pod inputs ([C, *] in compact mode)
     xi32,  # [P, *] int32
     xbool,  # [P, *] bool
@@ -1066,10 +1068,19 @@ def _run_packed(
 ):
     pack_result = kw.pop("pack_result", False)
     compact = kw.pop("compact", False)
+    # chained sub-batch dispatch (run_pipelined's RTT-hiding batch split):
+    # chain_in consumes the previous sub-solve's carried batch-state rows
+    # (port/spread/interpod occupancy) straight from the donated persist
+    # dict instead of re-uploading host bstate — the occupancy the earlier
+    # sub-batches placed stays device-resident. chain_out returns the full
+    # carried state so the next sub-solve can chain on it.
+    chain_in = kw.pop("chain_in", False)
+    chain_out = kw.pop("chain_out", False)
     tables = {**nt, **ct}
     state0 = dict(persist)
-    for name, s, w in bspec:
-        state0[name] = bstate[s : s + w]
+    if not chain_in:
+        for name, s, w in bspec:
+            state0[name] = bstate[s : s + w]
     if kw.get("use_nominated"):
         tables["nom_used"] = nom_used
         tables["nom_cnt"] = state0.pop("nom_cnt")
@@ -1093,9 +1104,14 @@ def _run_packed(
         )
     else:
         assignments, state = _solve_scan(tables, state0, xs, key, **kw)
-    out_state = {
-        k: state[k] for k in ("used", "nonzero_used", "pod_count")
-    }
+    if chain_out:
+        # the whole carried state rides to the next chained sub-solve
+        # (fit rows AND the batch occupancy rows)
+        out_state = dict(state)
+    else:
+        out_state = {
+            k: state[k] for k in ("used", "nonzero_used", "pod_count")
+        }
     if pack_result:
         # Standalone mode downloads everything host-side; on the axon
         # tunnel EACH device->host read costs ~0.25 s regardless of size
@@ -1144,6 +1160,8 @@ _RUN_PACKED_STATICS = (
     "use_extra_score",
     "pack_result",
     "compact",
+    "chain_in",
+    "chain_out",
 )
 
 # Session mode donates the device-resident persist buffers through each call.
@@ -1207,22 +1225,50 @@ class DeferredAssignments:
     (``copy_to_host_async``), so the tunnel round trip overlaps whatever
     host work happens before ``get()`` — on axon the post-overlap read
     costs ~0.2 ms instead of ~1 RTT. ``get()`` blocks until the transfer
-    lands and returns the trimmed int32 assignment vector."""
+    lands and returns the trimmed int32 assignment vector.
 
-    __slots__ = ("_dev", "_num_pods")
+    ``lo``/``count`` locate a chained sub-batch's pods within the popped
+    batch (solve(..., split=K)): this handle covers batch pods
+    [lo, lo + count). An unsplit solve is the trivial chain lo=0,
+    count=num_pods."""
 
-    def __init__(self, dev, num_pods: int) -> None:
+    __slots__ = ("_dev", "_num_pods", "lo")
+
+    def __init__(self, dev, num_pods: int, lo: int = 0) -> None:
         self._dev = dev
         self._num_pods = num_pods
+        self.lo = lo
         try:
             dev.copy_to_host_async()
         except Exception:
             pass  # platform without async D2H: get() falls back to a sync read
 
+    @property
+    def count(self) -> int:
+        return self._num_pods
+
     # sanctioned deferred-read point (analysis/registry.py) — the async
     # D2H copy started in __init__ makes this read post-overlap: ktpu: hot
     def get(self) -> np.ndarray:
         return np.asarray(self._dev)[: self._num_pods]
+
+
+class BatchCarriedUsage:
+    """Device-resident occupancy carry between chained sub-batch solves
+    of ONE popped batch (the RTT-hiding batch split): the port-vocab
+    occupancy rows, spread domain counts, and interpod term counts the
+    earlier sub-batches' placements advanced, alongside the fit rows —
+    everything ``_run_packed`` needs as ``state0`` for the next chained
+    dispatch. Sub-batches of one batch share one tensorize (one
+    occupancy vocab / domain id space / class table), which is exactly
+    what makes the device-side carry well-defined; the carry dies with
+    the chain (the next popped batch re-tensorizes a fresh vocab from
+    host truth)."""
+
+    __slots__ = ("state",)
+
+    def __init__(self, state: dict) -> None:
+        self.state = state  # device arrays, donated through the chain
 
 
 class _DeviceSession:
@@ -1423,7 +1469,8 @@ class ExactSolver:
         nominated_slot: np.ndarray | None = None,  # [num_pods] int32, -1 none
         defer_read: bool = False,
         allow_heal: bool = True,
-    ) -> np.ndarray | DeferredAssignments:
+        split: int = 1,
+    ) -> np.ndarray | DeferredAssignments | list[DeferredAssignments]:
         """Returns assignments [num_pods] of node indices (-1 = unschedulable).
 
         Standalone mode (col_versions=None): uploads everything, downloads
@@ -1441,6 +1488,26 @@ class ExactSolver:
         be dispatched before the handle is read — the double-buffered
         scheduling loop's overlap point (the caller is responsible for
         discarding/fencing stale handles; see Scheduler.run_pipelined).
+
+        ``split`` (session + defer_read only): chop the padded pod axis
+        into up to ``split`` contiguous sub-batches dispatched
+        back-to-back, each chained on the previous one's device-resident
+        carried state (fit rows AND the batch occupancy rows —
+        BatchCarriedUsage), and return one DeferredAssignments per
+        sub-batch. The assignment read of sub-batch i then overlaps the
+        solve of i+1 — only the LAST read pays an un-hidden tunnel RTT.
+        Sequential semantics are identical to the unsplit solve (same
+        scan order over the same carried state); with tie_break="first"
+        the assignments are bit-identical, with "random" each sub-batch
+        draws its own fold_in(key, i) stream, so placements are a valid
+        sequential outcome whose distribution differs from the unsplit
+        solve (the grouped-path caveat, ExactSolverConfig.group_size).
+        The requested split is clamped to the largest feasible divisor
+        of the padded pod axis (group-aligned when the grouped path
+        engages); nominated-pod batches always dispatch unsplit (their
+        correction carry is per-solve). When ``split > 1`` the return
+        value is ALWAYS a list, even if the clamp lands on one
+        sub-batch.
 
         Without ``static``/``ports``/``spread``/``interpod`` tensors, a
         trivial single-class mask (valid ∧ schedulable) reproduces the
@@ -1689,7 +1756,22 @@ class ExactSolver:
         else:
             group = 1
             kinds = jnp.zeros(1, dtype=jnp.int32)
+            kinds_host = None
             self.dispatch_counts["scan"] += 1
+
+        want_chain = split > 1 and session and defer_read
+        if want_chain and not use_nominated:
+            k_split = self._feasible_split(
+                split, pods.padded, grouped, group
+            )
+            if k_split > 1:
+                return self._solve_chain(
+                    k_split, nt, ct, bstate, xi64, xi32, xbool,
+                    kinds_host if grouped else None, vcnt_host, compact,
+                    nom_used, nom_ports, key, pods,
+                    bspec=tuple(bspec), xspec=xspec, grouped=grouped,
+                    group=group, **kw,
+                )
 
         run = _run_packed_jit if session else _run_packed_jit_nodonate
         out = run(
@@ -1717,7 +1799,11 @@ class ExactSolver:
             assignments, new_persist = out
             self._session.persist = new_persist
             if defer_read:
-                return DeferredAssignments(assignments, pods.num_pods)
+                handle = DeferredAssignments(assignments, pods.num_pods)
+                # split requested but clamped/ineligible (nominated batch,
+                # indivisible padding): the contract stays "list in, list
+                # out" so the pipelined caller never type-switches
+                return [handle] if want_chain else handle
             return np.asarray(assignments)[: pods.num_pods]
         # standalone: ONE packed download (np.array = writable copy; the
         # unpacked slices below are views of it, so later in-place
@@ -1733,6 +1819,116 @@ class ExactSolver:
         nodes.pod_count = flat[o : o + npad].astype(np.int32)
         o += npad
         return flat[o:].astype(np.int32)[: pods.num_pods]
+
+    @staticmethod
+    def _feasible_split(
+        split: int, pod_pad: int, grouped: bool, group: int
+    ) -> int:
+        """Largest K <= split such that the padded pod axis cuts into K
+        equal sub-batches the dispatch machinery can chain: K divides
+        pod_pad, and — when the grouped path engages — each sub-batch
+        stays a whole number of group chunks (the chunk-kind dispatch
+        and the compact-wire representative rows both slice along the
+        chunk axis)."""
+        for k in range(min(split, pod_pad), 1, -1):
+            if pod_pad % k:
+                continue
+            if grouped and (pod_pad // k) % group:
+                continue
+            return k
+        return 1
+
+    def _solve_chain(
+        self,
+        k_split: int,
+        nt,
+        ct,
+        bstate,
+        xi64,
+        xi32,
+        xbool,
+        kinds_host,  # [C] int32 (grouped) | None (per-pod scan)
+        vcnt_host,
+        compact: bool,
+        nom_used,
+        nom_ports,
+        key,
+        pods: PodBatch,
+        *,
+        bspec,
+        xspec,
+        grouped: bool,
+        group: int,
+        **kw,
+    ) -> list[DeferredAssignments]:
+        """Dispatch one tensorized batch as ``k_split`` chained
+        sub-solves (see ``solve``'s ``split`` doc). The per-pod packed
+        arrays slice along the (chunk-aligned) pod axis; sub-solve i+1's
+        ``state0`` is sub-solve i's full carried state
+        (BatchCarriedUsage) donated straight through — no host sync
+        anywhere in the chain. Trailing all-padding sub-batches are
+        never dispatched."""
+        sub = pods.padded // k_split
+        cpk = sub // group  # chunks per sub-batch (grouped/compact axes)
+        handles: list[DeferredAssignments] = []
+        carry: BatchCarriedUsage | None = None
+        dummy_b = np.zeros((1, 1), dtype=np.int32)
+        nom_used_j = jnp.asarray(nom_used)
+        nom_ports_j = jnp.asarray(nom_ports)
+        try:
+            for i in range(k_split):
+                lo = i * sub
+                if lo >= pods.num_pods:
+                    break
+                sl = slice(i * cpk, (i + 1) * cpk) if compact else slice(
+                    lo, lo + sub
+                )
+                first = carry is None
+                out = _run_packed_jit(
+                    nt,
+                    ct,
+                    self._session.persist if first else carry.state,
+                    jnp.asarray(bstate if first else dummy_b),
+                    jnp.asarray(xi64[sl]),
+                    jnp.asarray(xi32[sl]),
+                    jnp.asarray(xbool[sl]),
+                    jnp.asarray(kinds_host[i * cpk : (i + 1) * cpk])
+                    if grouped
+                    else jnp.zeros(1, dtype=jnp.int32),
+                    jnp.asarray(vcnt_host[i * cpk : (i + 1) * cpk])
+                    if compact
+                    else jnp.zeros(1, dtype=jnp.int32),
+                    nom_used_j,
+                    nom_ports_j,
+                    jax.random.fold_in(key, i),
+                    bspec=bspec,
+                    xspec=xspec,
+                    grouped=grouped,
+                    group=group,
+                    pack_result=False,
+                    compact=compact,
+                    chain_in=not first,
+                    chain_out=True,
+                    **kw,
+                )
+                assignments, st = out
+                carry = BatchCarriedUsage(st)
+                handles.append(
+                    DeferredAssignments(
+                        assignments, min(sub, pods.num_pods - lo), lo=lo
+                    )
+                )
+        except Exception:
+            # the chain donated session buffers before dying: the resident
+            # state is unusable — drop it so the next solve re-uploads
+            self.reset_session()
+            raise
+        self._session.persist = {
+            name: carry.state[name]
+            for name in ("used", "nonzero_used", "pod_count")
+        }
+        self.dispatch_counts["chained_subbatches"] += len(handles)
+        return handles
 
     @staticmethod
     def _chunk_kinds(

@@ -428,9 +428,15 @@ class ClusterState:
         except KeyError:
             raise ApiError("NotFound", key) from None
 
-    def update_resource_claim(self, c) -> object:
-        if c.key not in self._resource_claims:
+    def update_resource_claim(self, c, expect_rv: int | None = None) -> object:
+        cur = self._resource_claims.get(c.key)
+        if cur is None:
             raise ApiError("NotFound", c.key)
+        if expect_rv is not None and cur.resource_version != expect_rv:
+            raise ApiError(
+                "Conflict",
+                f"{c.key} rv {cur.resource_version} != {expect_rv}",
+            )
         c.resource_version = self._next_rv()
         self.dra_generation += 1
         self._resource_claims[c.key] = c

@@ -66,6 +66,29 @@ class LeaderElector:
     clock: Clock = field(default_factory=Clock)
     is_leader: bool = False
 
+    def __post_init__(self) -> None:
+        """leaderelection.go#LeaderElectionConfig validation: the
+        protocol is only sound when leaseDuration > renewDeadline >
+        retryPeriod (all positive) — a renew deadline at or beyond the
+        lease duration lets a challenger take over while the holder
+        still believes it leads, and a retry period at or beyond the
+        renew deadline guarantees missing the deadline on one lost
+        renewal."""
+        if self.retry_period <= 0:
+            raise ValueError(
+                f"retry_period must be positive, got {self.retry_period}"
+            )
+        if self.renew_deadline <= self.retry_period:
+            raise ValueError(
+                "renew_deadline must exceed retry_period "
+                f"({self.renew_deadline} <= {self.retry_period})"
+            )
+        if self.lease_duration <= self.renew_deadline:
+            raise ValueError(
+                "lease_duration must exceed renew_deadline "
+                f"({self.lease_duration} <= {self.renew_deadline})"
+            )
+
     @property
     def _key(self) -> str:
         return f"{self.namespace}/{self.name}"

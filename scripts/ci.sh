@@ -33,6 +33,18 @@ echo "== sim determinism self-check =="
 python -m kubernetes_tpu.sim --seed 0 --cycles 6 --profile churn_heavy \
     --selfcheck
 
+echo "== pipelined hard-shape sim smoke =="
+# churn_heavy now generates spread/anti/ports arrivals, so this fixed-seed
+# run drives the occupancy-carrying pipelined path (hard shapes no longer
+# drain to the synchronous loop) under delete/label churn; --selfcheck
+# re-runs it and asserts byte-identical traces + journal digest. The
+# preemption_pressure run covers the pipelined loop under PostFilter/
+# nominated-pod traffic the same way.
+python -m kubernetes_tpu.sim --seed 1 --cycles 8 --profile churn_heavy \
+    --selfcheck
+python -m kubernetes_tpu.sim --seed 1 --cycles 8 \
+    --profile preemption_pressure --selfcheck
+
 echo "== obs smoke: journaled sim -> schema check -> explain =="
 obs_journal=$(mktemp /tmp/ktpu_obs_journal.XXXXXX.jsonl)
 python -m kubernetes_tpu.sim --seed 0 --cycles 6 --profile churn_heavy \

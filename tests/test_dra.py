@@ -795,3 +795,36 @@ def test_fuzz_invariants_under_churn():
                     assert k in pod_keys, (
                         f"{c.key} reserves deleted pod {k}"
                     )
+
+
+def test_update_resource_claim_expect_rv_conflict():
+    """update_resource_claim matches the other update verbs' optimistic
+    concurrency (r4 advisor finding): a stale expect_rv is rejected with
+    Conflict and the store keeps the current object."""
+    cs = mk_cluster(n_nodes=1)
+    claim = ResourceClaim(
+        name="c0",
+        namespace="default",
+        requests=(DeviceRequest(name="r0", device_class_name="gpu"),),
+    )
+    cs.create_resource_claim(claim)
+    cur = cs.get_resource_claim("default", "c0")
+    rv = cur.resource_version
+    gen = cs.dra_generation
+    # matching expect_rv succeeds and advances the version
+    updated = cs.update_resource_claim(cur, expect_rv=rv)
+    assert updated.resource_version > rv
+    assert cs.dra_generation == gen + 1
+    # the original rv is now stale: Conflict, nothing written
+    gen2 = cs.dra_generation
+    import dataclasses
+
+    stale = dataclasses.replace(
+        cs.get_resource_claim("default", "c0"), allocated_node="n0"
+    )
+    with pytest.raises(ApiError, match="Conflict"):
+        cs.update_resource_claim(stale, expect_rv=rv)
+    assert cs.dra_generation == gen2
+    assert cs.get_resource_claim("default", "c0").allocated_node == ""
+    # expect_rv omitted keeps the unconditional-update behavior
+    cs.update_resource_claim(cs.get_resource_claim("default", "c0"))

@@ -181,3 +181,35 @@ def test_run_loop_reports_loss_after_renew_deadline():
     stop.set()
     t.join(timeout=10)
     th.join(timeout=10)
+
+
+def test_timing_invariants_validated_at_construction():
+    """leaderelection.go#LeaderElectionConfig validation (r4 advisor
+    finding): the protocol is only sound with
+    leaseDuration > renewDeadline > retryPeriod > 0 — each inversion
+    must be rejected before the elector ever touches the store."""
+    import pytest
+
+    cs = ClusterState()
+    clock = FakeClock()
+
+    def mk_cfg(lease, renew, retry):
+        return LeaderElector(
+            cs,
+            identity="x",
+            lease_duration=lease,
+            renew_deadline=renew,
+            retry_period=retry,
+            clock=clock,
+        )
+
+    # valid defaults construct fine
+    assert mk_cfg(15.0, 10.0, 2.0) is not None
+    with pytest.raises(ValueError, match="lease_duration must exceed"):
+        mk_cfg(10.0, 10.0, 2.0)  # lease == renew deadline
+    with pytest.raises(ValueError, match="lease_duration must exceed"):
+        mk_cfg(5.0, 10.0, 2.0)  # lease < renew deadline
+    with pytest.raises(ValueError, match="renew_deadline must exceed"):
+        mk_cfg(15.0, 2.0, 2.0)  # renew deadline == retry period
+    with pytest.raises(ValueError, match="retry_period must be positive"):
+        mk_cfg(15.0, 10.0, 0.0)

@@ -145,3 +145,39 @@ def test_unschedulable_counted(tmp_path):
     # default pods want 1 cpu: only 2 fit on the tiny node
     assert r.scheduled == 2
     assert r.unschedulable >= 2
+
+
+def test_threshold_gates_steady_state_not_avg():
+    """The threshold assert gates POST-WARMUP steady-state pods/s: the
+    first measured batch (the compile stall) is excluded, time-weighted
+    over the rest — an avg dominated by one slow compile must neither
+    flake a healthy run nor hide a sustained regression."""
+    from kubernetes_tpu.perf.runner import WorkloadResult
+
+    # healthy run, slow first batch: avg ~18 pods/s, steady 100 pods/s
+    r = WorkloadResult(
+        "t", "w", threshold=50.0, measured_pods=200, measure_seconds=11.0
+    )
+    r.batch_samples = [(10.0, 100), (0.5, 50), (0.5, 50)]
+    r.samples = [10.0, 100.0, 100.0]
+    r.check_threshold()
+    assert r.passed  # avg (~18) would have failed the 50 floor
+    assert r.steady_pods_per_sec() == 100.0
+    assert r.throughput_summary()["steady"] == 100.0
+
+    # sustained regression hidden under a fast compile: steady gates it
+    r2 = WorkloadResult(
+        "t", "w", threshold=50.0, measured_pods=200, measure_seconds=3.0
+    )
+    r2.batch_samples = [(0.1, 100), (5.0, 50), (5.0, 50)]
+    r2.samples = [1000.0, 10.0, 10.0]
+    r2.check_threshold()
+    assert not r2.passed
+    # single-batch runs fall back to the overall avg
+    r3 = WorkloadResult(
+        "t", "w", threshold=50.0, measured_pods=100, measure_seconds=1.0
+    )
+    r3.batch_samples = [(1.0, 100)]
+    r3.samples = [100.0]
+    r3.check_threshold()
+    assert r3.passed

@@ -257,9 +257,11 @@ def test_deferred_heal_skips_and_later_heals():
     assert int(sess.seen_versions[0]) == 1
 
 
-def test_pipelined_nonplain_batch_falls_back():
-    """Spread-constrained pods force the synchronous path per batch; the
-    result must still match the pure-sync loop."""
+def test_pipelined_nonplain_batch_matches_sync():
+    """Spread-constrained pods take the occupancy-carrying pipelined
+    mode (drain-then-chain — see test_pipelined_shapes.py for the
+    no-drain regression); the result must still match the pure-sync
+    loop."""
 
     def mk():
         cs = ClusterState()

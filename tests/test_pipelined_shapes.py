@@ -1,0 +1,565 @@
+"""The pipelined hard path (ISSUE 4 tentpole): batches with
+ports/spread/interpod/volumes/DRA terms — and multi-profile / extender /
+out-of-tree configs — schedule through Scheduler.run_pipelined instead
+of draining to the synchronous loop. These tests pin:
+
+1. no-drain regression — hard-shape batches take the occupancy-carrying
+   ``carry`` mode (scheduler_pipeline_mode_total), never the sync
+   fallback, and the chained sub-batch split actually dispatches;
+2. per-shape binding equivalence — with tie_break="first", pipelined
+   bindings (including split>1 chains) are identical to the synchronous
+   loop's, per shape;
+3. the occupancy fence — one discard test per newly-carried event kind
+   (assigned-pod delete for ports/interpod, assigned-pod label change
+   for spread, external ResourceClaim writes for DRA), plus the
+   selectivity half: plain fit solves must NOT discard on those events
+   (delete-churn degrading the plain pipeline was the original reason
+   hard shapes were excluded).
+"""
+
+import time
+
+import numpy as np
+
+from kubernetes_tpu import metrics
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+
+
+def mk_cluster(n_nodes=6, cpu="8"):
+    cs = ClusterState()
+    for i in range(n_nodes):
+        cs.create_node(
+            MakeNode()
+            .name(f"n{i}")
+            .capacity({"cpu": cpu, "memory": "32Gi", "pods": "110"})
+            .label(ZONE, f"z{i % 3}")
+            .label(HOST, f"n{i}")
+            .obj()
+        )
+    return cs
+
+
+def mk_sched(cs, batch=16, group=8, split=0, **cfg):
+    return Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=batch,
+            pipeline_split=split,
+            solver=ExactSolverConfig(tie_break="first", group_size=group),
+            **cfg,
+        ),
+    )
+
+
+def shape_pod(i: int, kind: str):
+    b = MakePod().name(f"{kind}{i:03}").req({"cpu": "100m", "memory": "256Mi"})
+    if kind == "spread":
+        b = b.label("app", "spread").spread_constraint(
+            1, ZONE, "DoNotSchedule", {"app": "spread"}
+        )
+    elif kind == "anti":
+        b = b.label("app", "anti").pod_anti_affinity(HOST, {"app": "anti"})
+    elif kind == "ports":
+        b = b.host_port(8000 + i % 3)
+    return b.obj()
+
+
+def bindings(cs):
+    return sorted((p.name, p.node_name) for p in cs.list_pods())
+
+
+def mode_delta():
+    return {
+        m: metrics.pipeline_mode_total.labels(m)._value.get()
+        for m in ("overlap", "carry", "sync")
+    }
+
+
+# -- 1. no-drain regression -------------------------------------------------
+
+
+def test_hard_shapes_take_carry_mode_not_sync():
+    """ports/spread/interpod batches must run the pipelined carry path:
+    deferred dispatch with the chained sub-batch split — zero sync-mode
+    batches (the old behavior drained every hard batch to _run_popped)."""
+    for kind in ("ports", "spread", "anti"):
+        cs = mk_cluster()
+        s = mk_sched(cs, split=2)
+        for i in range(20):
+            cs.create_pod(shape_pod(i, kind))
+        before = mode_delta()
+        sub0 = metrics.pipeline_subbatches_total._value.get()
+        results = s.run_pipelined()
+        after = mode_delta()
+        assert after["carry"] > before["carry"], kind
+        assert after["sync"] == before["sync"], kind
+        assert after["overlap"] == before["overlap"], kind
+        assert metrics.pipeline_subbatches_total._value.get() > sub0, kind
+        # every pod reached a terminal outcome through the carry path
+        # (ports/anti overflow capacity by design: the surplus must land
+        # as unschedulable, not vanish)
+        outcomes = sum(
+            len(r.scheduled) + len(r.unschedulable) for r in results
+        )
+        assert outcomes >= 20, kind
+        assert sum(len(r.scheduled) for r in results) > 0, kind
+
+
+def test_plain_batches_still_overlap():
+    cs = mk_cluster()
+    s = mk_sched(cs)
+    for i in range(20):
+        cs.create_pod(shape_pod(i, "plain"))
+    before = mode_delta()
+    s.run_pipelined()
+    after = mode_delta()
+    assert after["overlap"] > before["overlap"]
+    assert after["carry"] == before["carry"]
+
+
+# -- 2. per-shape pipelined-vs-sync equivalence -----------------------------
+
+
+def _equivalence(kind, n_pods=30, split=0, n_nodes=6):
+    cs1 = mk_cluster(n_nodes)
+    s1 = mk_sched(cs1)
+    for i in range(n_pods):
+        cs1.create_pod(shape_pod(i, kind))
+    s1.run_until_settled()
+    cs2 = mk_cluster(n_nodes)
+    s2 = mk_sched(cs2, split=split)
+    for i in range(n_pods):
+        cs2.create_pod(shape_pod(i, kind))
+    s2.run_pipelined()
+    assert bindings(cs1) == bindings(cs2), kind
+    return cs2, s2
+
+
+def test_ports_pipelined_matches_sync():
+    cs, _ = _equivalence("ports", split=2)
+    # hostPort exclusivity held under the pipelined path
+    per = {}
+    for p in cs.list_pods():
+        if p.node_name:
+            for port in p.host_ports():
+                key = (p.node_name, port)
+                assert key not in per, f"hostPort clash on {key}"
+                per[key] = p.name
+
+
+def test_spread_pipelined_matches_sync():
+    cs, _ = _equivalence("spread", split=2)
+    from collections import Counter
+
+    zones = Counter()
+    node_zone = {n.name: n.labels[ZONE] for n in cs.list_nodes()}
+    for p in cs.list_pods():
+        if p.node_name and p.name.startswith("spread"):
+            zones[node_zone[p.node_name]] += 1
+    assert max(zones.values()) - min(zones.values()) <= 1
+
+
+def test_interpod_pipelined_matches_sync():
+    cs, _ = _equivalence("anti", n_pods=6, split=2)
+    anti_nodes = [
+        p.node_name
+        for p in cs.list_pods()
+        if p.node_name and p.name.startswith("anti")
+    ]
+    assert len(set(anti_nodes)) == len(anti_nodes)  # one per node
+
+
+def test_split_chain_matches_unsplit():
+    """The RTT-hiding batch split is semantics-free: split=4 chains
+    produce bit-identical bindings to split=1 (tie_break='first'), for
+    both a plain and a hard shape."""
+    for kind in ("plain", "spread"):
+        cs1 = mk_cluster()
+        s1 = mk_sched(cs1, split=1)
+        for i in range(32):
+            cs1.create_pod(shape_pod(i, kind))
+        s1.run_pipelined()
+        cs2 = mk_cluster()
+        s2 = mk_sched(cs2, split=4)
+        for i in range(32):
+            cs2.create_pod(shape_pod(i, kind))
+        sub0 = metrics.pipeline_subbatches_total._value.get()
+        s2.run_pipelined()
+        assert bindings(cs1) == bindings(cs2), kind
+        assert metrics.pipeline_subbatches_total._value.get() > sub0
+
+
+def test_multi_profile_pipelined_matches_sync():
+    from kubernetes_tpu.api.objects import DEFAULT_SCHEDULER_NAME
+
+    def mk(pipelined):
+        cs = mk_cluster(4)
+        s = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=8,
+                profiles={
+                    DEFAULT_SCHEDULER_NAME: ExactSolverConfig(
+                        tie_break="first", group_size=4
+                    ),
+                    "alt": ExactSolverConfig(
+                        tie_break="first", group_size=4
+                    ),
+                },
+            ),
+        )
+        for i in range(6):
+            cs.create_pod(
+                MakePod().name(f"a{i}").req({"cpu": "500m"}).obj()
+            )
+            cs.create_pod(
+                MakePod()
+                .name(f"b{i}")
+                .scheduler_name("alt")
+                .req({"cpu": "500m"})
+                .obj()
+            )
+        return cs, s
+
+    cs1, s1 = mk(False)
+    s1.run_until_settled()
+    cs2, s2 = mk(True)
+    before = mode_delta()
+    s2.run_pipelined()
+    after = mode_delta()
+    assert bindings(cs1) == bindings(cs2)
+    # multi-profile no longer bails to run_until_settled: its groups
+    # ride the carry path
+    assert after["carry"] > before["carry"]
+
+
+def test_multi_profile_cross_profile_batches_do_not_overcommit():
+    """Consecutive PLAIN batches of different profiles must not
+    overlap: profile X's unapplied placements live only in X's device
+    session, so dispatching profile Y before X applies would double-book
+    the capacity X claimed. The loop drains on profile change; with
+    capacity exactly equal to demand, any double-booking shows up as a
+    capacity violation or a binding divergence."""
+    from kubernetes_tpu.api.objects import DEFAULT_SCHEDULER_NAME
+
+    def mk():
+        cs = ClusterState()
+        for i in range(2):
+            cs.create_node(
+                MakeNode()
+                .name(f"n{i}")
+                .capacity({"cpu": "8", "memory": "32Gi", "pods": "110"})
+                .label(HOST, f"n{i}")
+                .obj()
+            )
+        s = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=8,
+                profiles={
+                    DEFAULT_SCHEDULER_NAME: ExactSolverConfig(
+                        tie_break="first", group_size=4
+                    ),
+                    "alt": ExactSolverConfig(
+                        tie_break="first", group_size=4
+                    ),
+                },
+            ),
+        )
+        # 8 default-profile pods, then 8 alt-profile pods: pop order
+        # yields one all-X batch followed by one all-Y batch, both plain
+        for i in range(8):
+            cs.create_pod(MakePod().name(f"x{i}").req({"cpu": "1"}).obj())
+        for i in range(8):
+            cs.create_pod(
+                MakePod()
+                .name(f"y{i}")
+                .scheduler_name("alt")
+                .req({"cpu": "1"})
+                .obj()
+            )
+        return cs, s
+
+    cs1, s1 = mk()
+    s1.run_until_settled()
+    cs2, s2 = mk()
+    s2.run_pipelined()
+    assert bindings(cs1) == bindings(cs2)
+    per_node: dict = {}
+    for p in cs2.list_pods():
+        assert p.node_name  # demand == capacity: everything places
+        per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+    assert all(v <= 8 for v in per_node.values())
+
+
+def test_out_of_tree_filter_pipelines_as_prefold():
+    """A Filter plugin config used to force the whole call into
+    run_until_settled; the fold is now a pre-dispatch host stage and
+    plain batches keep overlapping."""
+    from kubernetes_tpu.framework.interface import FilterPlugin, Status
+
+    class VetoN0(FilterPlugin):
+        def name(self):
+            return "veto-n0"
+
+        def filter(self, state, pod, node, placed=()):
+            return (
+                Status.unschedulable("no n0")
+                if node.name == "n0"
+                else Status.success()
+            )
+
+    def mk(pipelined_cfg):
+        cs = mk_cluster(4)
+        s = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=8,
+                solver=ExactSolverConfig(tie_break="first", group_size=4),
+                out_of_tree_plugins=(VetoN0(),),
+            ),
+        )
+        for i in range(12):
+            cs.create_pod(
+                MakePod().name(f"p{i:02}").req({"cpu": "500m"}).obj()
+            )
+        return cs, s
+
+    cs1, s1 = mk(False)
+    s1.run_until_settled()
+    cs2, s2 = mk(True)
+    before = mode_delta()
+    s2.run_pipelined()
+    after = mode_delta()
+    assert bindings(cs1) == bindings(cs2)
+    assert after["overlap"] > before["overlap"]
+    assert not any(
+        p.node_name == "n0" for p in cs2.list_pods() if p.node_name
+    )
+
+
+# -- 3. occupancy-fence discards per newly-carried event kind ---------------
+
+
+def _flight(s, expect_pods):
+    t0 = time.perf_counter()
+    with s.cluster.lock:
+        infos = s.queue.pop_batch(s.config.batch_size)
+        base = s.queue.scheduling_cycle - len(infos)
+        for i in infos:
+            s._in_flight[i.key] = i
+    assert len(infos) == expect_pods
+    prep = s._tensorize_group(
+        next(iter(s.solvers)), infos, list(range(len(infos))), base, t0
+    )
+    s._fold_group(prep)
+    return s._dispatch_group(prep, defer=True, allow_heal=True)
+
+
+def _assert_discards(s, flight, discarded=True):
+    before = metrics.solves_discarded_total._value.get()
+    res = s._apply_flight(flight)
+    n = metrics.solves_discarded_total._value.get() - before
+    if discarded:
+        assert n == 1 and not res.scheduled
+    else:
+        assert n == 0
+    return res
+
+
+def test_ports_flight_discards_on_assigned_pod_delete():
+    """An assigned-pod delete frees its hostPorts: a ports-carrying
+    deferred solve that counted them must discard."""
+    cs = mk_cluster(2)
+    s = mk_sched(cs, batch=4)
+    cs.create_pod(MakePod().name("old").req({"cpu": "1"}).host_port(8000).obj())
+    cs.bind("default", "old", "n0")
+    for i in range(2):
+        cs.create_pod(shape_pod(i * 3, "ports"))  # both want port 8000
+    flight = _flight(s, 2)
+    assert flight.prep.occ_sensitive
+    cs.delete_pod("default", "old")
+    _assert_discards(s, flight)
+    s.run_until_settled()
+    assert all(p.node_name for p in cs.list_pods())
+
+
+def test_spread_flight_discards_on_assigned_pod_label_change():
+    """A placed pod's label change re-keys spread domain counts: a
+    spread-carrying deferred solve must discard (a pure label flap on a
+    running pod is NOT a _conflict_seq event, so only the occupancy
+    fence catches it)."""
+    import dataclasses
+
+    cs = mk_cluster()
+    s = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("old").label("app", "spread").req({"cpu": "1"}).obj()
+    )
+    cs.bind("default", "old", "n0")
+    for i in range(4):
+        cs.create_pod(shape_pod(i, "spread"))
+    flight = _flight(s, 4)
+    assert flight.prep.occ_sensitive
+    old = cs.get_pod("default", "old")
+    relabeled = dataclasses.replace(old, labels={"app": "other"})
+    cs.update_pod(relabeled)
+    _assert_discards(s, flight)
+    s.run_until_settled()
+    assert all(p.node_name for p in cs.list_pods())
+
+
+def test_interpod_flight_discards_on_assigned_pod_delete():
+    cs = mk_cluster()
+    s = mk_sched(cs)
+    cs.create_pod(
+        MakePod().name("old").label("app", "anti").req({"cpu": "1"}).obj()
+    )
+    cs.bind("default", "old", "n0")
+    for i in range(3):
+        cs.create_pod(shape_pod(i, "anti"))
+    flight = _flight(s, 3)
+    assert flight.prep.occ_sensitive
+    cs.delete_pod("default", "old")
+    _assert_discards(s, flight)
+    s.run_until_settled()
+    anti_nodes = [
+        p.node_name for p in cs.list_pods() if p.node_name
+    ]
+    assert len(set(anti_nodes)) == len(anti_nodes)
+
+
+def test_dra_flight_discards_on_external_claim_write():
+    from kubernetes_tpu.api.dra import (
+        Device,
+        DeviceClass,
+        DeviceRequest,
+        ResourceClaim,
+        ResourceSlice,
+    )
+    from kubernetes_tpu.utils.featuregate import FeatureGates
+
+    cs = ClusterState()
+    for i in range(2):
+        cs.create_node(
+            MakeNode()
+            .name(f"n{i}")
+            .capacity({"cpu": "8", "memory": "32Gi", "pods": "20"})
+            .obj()
+        )
+        cs.create_resource_slice(
+            ResourceSlice(
+                name=f"slice-n{i}",
+                node_name=f"n{i}",
+                driver="gpu.example.com",
+                devices=(Device(name="gpu-0"),),
+            )
+        )
+    cs.create_device_class(
+        DeviceClass(name="gpu", driver="gpu.example.com")
+    )
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="c0",
+            namespace="default",
+            requests=(DeviceRequest(name="r0", device_class_name="gpu"),),
+        )
+    )
+    # the claim the external writer will touch mid-flight
+    cs.create_resource_claim(
+        ResourceClaim(
+            name="other",
+            namespace="default",
+            requests=(DeviceRequest(name="r0", device_class_name="gpu"),),
+        )
+    )
+    s = Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=4,
+            solver=ExactSolverConfig(tie_break="first", group_size=1),
+            feature_gates=FeatureGates.parse(
+                "DynamicResourceAllocation=true"
+            ),
+        ),
+    )
+    cs.create_pod(
+        MakePod().name("p0").req({"cpu": "1"}).resource_claim("c0").obj()
+    )
+    flight = _flight(s, 1)
+    assert flight.prep.occ_sensitive
+    # external claim write (not this scheduler's allocator): occ fence
+    other = cs.get_resource_claim("default", "other")
+    cs.update_resource_claim(other)
+    _assert_discards(s, flight)
+    s.run_until_settled()
+    assert cs.get_pod("default", "p0").node_name
+
+
+def test_plain_flight_survives_occupancy_events():
+    """Selectivity: the occupancy fence must NOT discard plain fit
+    solves — an assigned-pod delete or label flap mid-flight leaves the
+    plain pipeline untouched (its device carry absorbs frees
+    conservatively)."""
+    import dataclasses
+
+    cs = mk_cluster(2)
+    s = mk_sched(cs, batch=4)
+    cs.create_pod(
+        MakePod().name("old").label("app", "x").req({"cpu": "1"}).obj()
+    )
+    cs.bind("default", "old", "n0")
+    for i in range(3):
+        cs.create_pod(shape_pod(i, "plain"))
+    flight = _flight(s, 3)
+    assert not flight.prep.occ_sensitive
+    old = cs.get_pod("default", "old")
+    cs.update_pod(dataclasses.replace(old, labels={"app": "y"}))
+    cs.delete_pod("default", "old")
+    res = _assert_discards(s, flight, discarded=False)
+    assert len(res.scheduled) == 3
+
+
+def test_mid_chain_occupancy_event_discards_remaining_subflights():
+    """A chain of K sub-flights shares one occupancy fence: an event
+    between sub-applies discards every remaining sub-flight, and the
+    retry schedules everything against post-event truth."""
+    cs = mk_cluster()
+    s = mk_sched(cs, batch=16, split=4)
+    cs.create_pod(
+        MakePod().name("old").label("app", "spread").req({"cpu": "1"}).obj()
+    )
+    cs.bind("default", "old", "n0")
+    for i in range(16):
+        cs.create_pod(shape_pod(i, "spread"))
+    t0 = time.perf_counter()
+    with s.cluster.lock:
+        infos = s.queue.pop_batch(16)
+        base = s.queue.scheduling_cycle - len(infos)
+        for i in infos:
+            s._in_flight[i.key] = i
+    prep = s._tensorize_group(
+        next(iter(s.solvers)), infos, list(range(len(infos))), base, t0
+    )
+    flights = s._dispatch_group(prep, defer=True, allow_heal=True, split=4)
+    assert isinstance(flights, list) and len(flights) >= 2
+    # first sub-flight applies cleanly...
+    r0 = s._apply_flight(flights[0])
+    assert r0.scheduled
+    # ...then the event lands: every remaining sub-flight discards
+    cs.delete_pod("default", "old")
+    before = metrics.solves_discarded_total._value.get()
+    for f in flights[1:]:
+        s._apply_flight(f)
+    assert (
+        metrics.solves_discarded_total._value.get() - before
+        == len(flights) - 1
+    )
+    s.run_until_settled()
+    assert all(p.node_name for p in cs.list_pods())

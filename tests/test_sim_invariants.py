@@ -9,6 +9,7 @@ from kubernetes_tpu.sim.invariants import (
     BindTransitionTracker,
     MonotonicCounters,
     check_capacity,
+    check_constraints,
     check_lost_pods,
 )
 from kubernetes_tpu.solver.exact import ExactSolverConfig
@@ -113,6 +114,64 @@ def test_capacity_flags_pod_count_overflow():
     violations = []
     check_capacity(cs, 0, violations)
     assert any("pods > allowed" in v.detail for v in violations)
+
+
+# -- constraint (hard-shape placements) -------------------------------------
+
+
+def test_constraint_flags_hostport_clash():
+    cs = _cluster(n_nodes=1, cpu="8")
+    for i in range(2):
+        cs.create_pod(
+            MakePod()
+            .name(f"p{i}")
+            .req({"cpu": "1"})
+            .host_port(8080)
+            .obj()
+        )
+        cs.bind("default", f"p{i}", "n0")
+    violations = []
+    check_constraints(cs, 0, violations)
+    assert [v.invariant for v in violations] == ["constraint"]
+    assert "hostPort" in violations[0].detail
+
+
+def test_constraint_flags_anti_affinity_coresidence():
+    cs = _cluster(n_nodes=1, cpu="8")
+    for i in range(2):
+        cs.create_pod(
+            MakePod()
+            .name(f"a{i}")
+            .label("app", "anti")
+            .req({"cpu": "1"})
+            .pod_anti_affinity("kubernetes.io/hostname", {"app": "anti"})
+            .obj()
+        )
+        cs.bind("default", f"a{i}", "n0")
+    violations = []
+    check_constraints(cs, 0, violations)
+    assert violations and all(
+        v.invariant == "constraint" for v in violations
+    )
+    assert "anti-affinity" in violations[0].detail
+
+
+def test_constraint_clean_on_separate_nodes():
+    cs = _cluster(n_nodes=2, cpu="8")
+    for i in range(2):
+        cs.create_pod(
+            MakePod()
+            .name(f"a{i}")
+            .label("app", "anti")
+            .req({"cpu": "1"})
+            .pod_anti_affinity("kubernetes.io/hostname", {"app": "anti"})
+            .host_port(8080)
+            .obj()
+        )
+        cs.bind("default", f"a{i}", f"n{i}")
+    violations = []
+    check_constraints(cs, 0, violations)
+    assert violations == []
 
 
 # -- lost_pod ---------------------------------------------------------------
