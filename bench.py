@@ -18,6 +18,12 @@ Measures the FULL BASELINE.md target ladder (VERDICT r2 #3):
      run_pipelined's occupancy-carrying sub-batch split. Emits
      sustained_pods_per_sec + sustained_p99_pod_latency_s (also hoisted
      to the top level from the pipelined plain shape).
+  #7 Multichip A/B: the exact-parity session solve at the north-star
+     shape on 1 device vs the full node-axis mesh
+     (ExactSolver.solve(mesh=...)), plus the 8x-node shape (~81,920
+     nodes) on the full mesh. Emits multichip_pods_per_sec +
+     multichip_speedup (hoisted to the top level); skips with a reason
+     string when only one device is visible.
 
 Each ladder reports steady-state (warm-start) pods/s, best of 3 full
 passes — compiles happen in a same-shaped warmup pass (persistent compile
@@ -752,6 +758,97 @@ def _north_star_exact() -> dict:
     }
 
 
+def ladder7_multichip() -> dict:
+    """#7: multichip A/B — the exact-parity grouped SESSION solve at the
+    north-star shape (51,200 x 10,240) on 1 device vs the full node-axis
+    mesh, plus the 8x-node shape (~81,920 nodes — the HBM-growth target)
+    on the full mesh only. Each timed rep is a fresh device session
+    (upload + solve + assignment read), symmetric across both arms; the
+    sharded arm must pick bit-identical nodes (the device-count
+    invariance contract). Skips cleanly when only one device is
+    visible."""
+    import jax
+    import numpy as np
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {
+            "skipped": (
+                f"only {n_dev} device visible; the multichip A/B needs a "
+                "multi-device mesh (virtual-CPU variant: "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+            )
+        }
+
+    from kubernetes_tpu.parallel.sharding import node_mesh
+    from kubernetes_tpu.server.bulk import columnar_pod_batch
+    from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+    from kubernetes_tpu.tensorize.schema import ResourceVocab, pad_to
+
+    mesh = node_mesh()
+    vocab = ResourceVocab(("cpu", "memory", "ephemeral-storage"))
+    cfg = ExactSolverConfig(tie_break="random", group_size=1024)
+
+    def run(n_nodes, n_pods, use_mesh, reps=3):
+        npad = pad_to(n_nodes)
+        alloc = np.zeros((3, npad), dtype=np.int64)
+        alloc[0, :n_nodes] = 16_000
+        alloc[1, :n_nodes] = 64 << 30
+        cpu = np.full(n_pods, 1000, np.int64)
+        mem = np.full(n_pods, 2 << 30, np.int64)
+        pb = columnar_pod_batch(cpu, mem, None, vocab)
+        m = mesh if use_mesh else None
+        cv = np.ones(npad, dtype=np.int64)
+        # compile warm (untimed); the timed reps then pay a fresh
+        # session upload + solve + read each
+        ExactSolver(cfg).solve(
+            _synthetic_node_batch(vocab, n_nodes, alloc), pb,
+            col_versions=cv, mesh=m,
+        )
+        best = float("inf")
+        a = None
+        for _ in range(reps):
+            batch = _synthetic_node_batch(vocab, n_nodes, alloc)
+            solver = ExactSolver(cfg)
+            t0 = time.perf_counter()
+            a = solver.solve(batch, pb, col_versions=cv, mesh=m)
+            best = min(best, time.perf_counter() - t0)
+        a = np.asarray(a)
+        placed = int((a >= 0).sum())
+        assert placed == n_pods, (
+            f"multichip {n_pods}x{n_nodes}: placed {placed}/{n_pods}"
+        )
+        assert int(a.max()) < n_nodes  # no padding-row bindings
+        return best, a
+
+    t1, a1 = run(NS_NODES, NS_PODS, False)
+    tn, an = run(NS_NODES, NS_PODS, True)
+    # the device-count-invariance contract AT SCALE: the sharded arm must
+    # pick bit-identical nodes, or the speedup below is meaningless
+    assert np.array_equal(a1, an), (
+        "multichip: sharded solve diverged from the 1-device solve"
+    )
+    t8x, _ = run(NS_NODES * 8, NS_PODS, True, reps=2)
+    return {
+        "config": (
+            "exact grouped session solve, fresh session per rep "
+            "(upload+solve+read), min over reps; A/B at the north-star "
+            "shape, 8x-node shape on the full mesh"
+        ),
+        "devices": n_dev,
+        "pods": NS_PODS,
+        "nodes": NS_NODES,
+        "solve_1dev_s": round(t1, 3),
+        "solve_mesh_s": round(tn, 3),
+        "multichip_pods_per_sec": round(NS_PODS / tn, 1),
+        "multichip_speedup": round(t1 / tn, 2),
+        "bit_invariant_vs_1dev": True,  # asserted above
+        "nodes_8x": NS_NODES * 8,
+        "solve_8x_nodes_mesh_s": round(t8x, 3),
+        "latency_ratio_8x_vs_1x": round(t8x / tn, 2),
+    }
+
+
 def served_grpc() -> dict:
     """Ladder #2's workload THROUGH THE WIRE: columnar pod batch over the
     bulk gRPC boundary (SyncNodes + Solve), measuring end-to-end wire
@@ -860,6 +957,8 @@ def main() -> None:
         ),
         **sustained,
     }
+    multichip = ladder7_multichip()
+    ladders["7_multichip"] = multichip
     ladders["served_grpc_5kx1k"] = served_grpc()
     ladders["tunnel"] = {
         "pre_first_read_dispatch_ms": round(pre_read_ms, 3),
@@ -892,6 +991,15 @@ def main() -> None:
                 "sustained_p99_pod_latency_s": sus_head[
                     "sustained_p99_pod_latency_s"
                 ],
+                # ladder #7 hoist: real numbers when a mesh ran, the skip
+                # reason string when only one device is visible
+                "multichip_pods_per_sec": multichip.get(
+                    "multichip_pods_per_sec",
+                    multichip.get("skipped"),
+                ),
+                "multichip_speedup": multichip.get(
+                    "multichip_speedup", multichip.get("skipped")
+                ),
                 "vs_baseline": round(headline / BAND_TOP_PODS_PER_SEC, 2),
                 "baseline_note": (
                     "vs_baseline divides by the TOP of the reference's "

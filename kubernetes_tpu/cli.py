@@ -64,6 +64,7 @@ def cmd_config(args) -> int:
             "tieBreak": cfg.tpu_solver.tie_break,
             "enablePreemption": cfg.tpu_solver.enable_preemption,
             "groupSize": cfg.tpu_solver.group_size,
+            "meshDevices": cfg.tpu_solver.mesh_devices,
         },
         "warnings": cfg.warnings,
     }

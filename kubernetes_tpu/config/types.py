@@ -15,7 +15,8 @@ Covered surface:
 - extenders[]: urlPrefix, filterVerb/prioritizeVerb/preemptVerb/bindVerb,
   weight, nodeCacheCapable, ignorable, managedResources
 - tpuSolver (ours): batchSize, tieBreak, seed, balancedFdtype, singleShot
-  {maxRounds, priceStep, topT}, enablePreemption
+  {maxRounds, priceStep, topT}, enablePreemption, groupSize, meshDevices
+  (node-axis solve mesh: 0 = all visible devices)
 
 Unknown plugin names and unsupported pluginConfig args are collected into
 `warnings` rather than rejected — the validation posture of a scheduler that
@@ -112,6 +113,10 @@ class TpuSolverSection:
     enable_preemption: bool = True
     # grouped fast-path chunk size (ExactSolverConfig.group_size; 0 = off)
     group_size: int = 64
+    # node-axis mesh device count (SchedulerConfig.mesh_devices):
+    # 0 = all visible devices, 1 = force single-device, N > 1 = first N.
+    # Results are bit-exactly device-count invariant.
+    mesh_devices: int = 0
     single_shot: SingleShotSection = field(default_factory=SingleShotSection)
 
 
@@ -251,6 +256,7 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
         balanced_fdtype=ts.get("balancedFdtype") or "float32",
         enable_preemption=bool(ts.get("enablePreemption", True)),
         group_size=int(ts.get("groupSize", 64)),
+        mesh_devices=int(ts.get("meshDevices", 0)),
         single_shot=SingleShotSection(
             max_rounds=int(ss.get("maxRounds") or 32),
             price_step=int(ss.get("priceStep") or 8),
@@ -375,6 +381,7 @@ def scheduler_config(cfg: KubeSchedulerConfiguration):
     return SchedulerConfig(
         batch_size=cfg.tpu_solver.batch_size,
         enable_preemption=cfg.tpu_solver.enable_preemption,
+        mesh_devices=cfg.tpu_solver.mesh_devices,
         solver=profiles[cfg.profiles[0].scheduler_name],
         profiles=profiles,
         # honored, not just parsed: the scheduler consults these via the

@@ -148,6 +148,28 @@ pipeline_subbatches_total = Counter(
     "i+1's device solve.",
     registry=REGISTRY,
 )
+mesh_devices = Gauge(
+    "scheduler_mesh_devices",
+    "Devices in the node-axis solve mesh the scheduler dispatches "
+    "against (SchedulerConfig.mesh_devices; 1 = the unsharded "
+    "single-device path).",
+    registry=REGISTRY,
+)
+h2d_bytes_total = Counter(
+    "scheduler_tpu_host_to_device_bytes_total",
+    "Host->device bytes uploaded by ExactSolver.solve: per-pod packed "
+    "arrays, per-batch occupancy rows, dirty-column heals, class-table "
+    "cache misses, and full session (re)uploads.",
+    registry=REGISTRY,
+)
+d2h_bytes_total = Counter(
+    "scheduler_tpu_device_to_host_bytes_total",
+    "Device->host bytes downloaded by ExactSolver.solve: the per-batch "
+    "assignment vector in session mode, the packed result buffer in "
+    "standalone mode.",
+    registry=REGISTRY,
+)
+
 # -- scheduling trace layer (kubernetes_tpu/obs) --
 
 trace_spans_total = Counter(

@@ -9,15 +9,30 @@ step reductions (argmax, cumsum, segment sums) become XLA collectives over
 ICI — the scaling-book recipe: pick a mesh, annotate shardings, let GSPMD
 insert the collectives.
 
-Conventions (used by SingleShotSolver.solve(mesh=...), the exact scan's
-multichip dryrun, and tests/test_sharding.py):
-- node-resident arrays carry the node axis LAST -> P(None, "nodes") for
-  2-D tables, P("nodes") for 1-D columns;
+Coverage: the PRODUCTION solve path is sharded end to end —
+`ExactSolver.solve(mesh=...)` (per-pod scan, grouped fast path, the
+compact wire, and the chained sub-batch split all dispatch against
+node-axis-sharded resident tables), the device session (dirty-column
+heals scatter into the sharded residents; only the owning shard's slice
+changes), and the scheduler (`SchedulerConfig.mesh_devices` threads one
+mesh through both scheduling loops, so overlap/carry/sync batches all
+run sharded). `SingleShotSolver.solve(mesh=...)` and the driver's
+`dryrun_multichip` ride the same helpers.
+
+Conventions (used by both solvers, the device session, and
+tests/test_sharding.py):
+- node-resident arrays carry the node axis LAST -> P(None, ..., "nodes")
+  for n-D tables, P("nodes") for 1-D columns; the node padding must be a
+  device-count multiple (Snapshot.pad_multiple / schema.pad_to handle
+  this), with padded rows masked unschedulable everywhere;
 - per-pod / per-class / per-instance arrays replicate (they are small and
-  every shard needs them for its local mask/score block);
+  every shard needs them for its local mask/score block) —
+  REPLICATED_TABLE_NAMES is the authoritative name set for the class
+  tables without a node axis;
 - results are device-count invariant BIT-EXACTLY: integer score
-  arithmetic and stable reductions make sharded == unsharded, which the
-  tests assert on the 8-device virtual CPU mesh.
+  arithmetic and stable reductions make sharded == unsharded, which
+  tests/test_sharding.py asserts for BOTH solvers (and end-to-end
+  through the Scheduler) on the 8-device virtual CPU mesh.
 """
 
 from __future__ import annotations
@@ -25,6 +40,27 @@ from __future__ import annotations
 import numpy as np
 
 NODE_AXIS = "nodes"
+
+# Class/instance tables WITHOUT a trailing node axis (per-instance spread
+# scalars, per-class term index rows, per-term flags): replicated. Every
+# other solver table shards over its trailing node axis.
+REPLICATED_TABLE_NAMES = frozenset(
+    {
+        # spread (SpreadTensors device dict)
+        "max_skew",
+        "min_domains",
+        "self_match",
+        "is_hostname",
+        "hard",
+        "soft",
+        # interpod (InterpodTensors device dict)
+        "in_pref_w",
+        "cls_req_aff",
+        "cls_req_anti",
+        "cls_pref",
+        "ex_anti",
+    }
+)
 
 
 def node_mesh(n_devices: int | None = None):
@@ -40,6 +76,35 @@ def node_mesh(n_devices: int | None = None):
     return Mesh(np.array(devices), axis_names=(NODE_AXIS,))
 
 
+def resolve_mesh(mesh_devices: int):
+    """SchedulerConfig.mesh_devices -> Mesh | None.
+
+    0 = all visible devices; 1 = single-device (no mesh, the unsharded
+    fast path); N > 1 = the first min(N, visible) devices. A resolved
+    count of 1 returns None — a 1-way mesh would pay GSPMD lowering for
+    nothing."""
+    if mesh_devices == 1:
+        return None
+    import jax
+
+    visible = len(jax.devices())
+    n = visible if mesh_devices <= 0 else min(mesh_devices, visible)
+    if n < 2:
+        return None
+    return node_mesh(n)
+
+
+def mesh_fingerprint(mesh) -> tuple | None:
+    """Hashable identity for jit/session cache keys: device set + shape.
+    None for the unsharded path."""
+    if mesh is None:
+        return None
+    return (
+        tuple(d.id for d in mesh.devices.flat),
+        mesh.axis_names,
+    )
+
+
 def node_sharding(mesh, ndim: int):
     """NamedSharding for a node-resident array: node axis last."""
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -53,6 +118,43 @@ def replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     return NamedSharding(mesh, P())
+
+
+def put_node_table(mesh, a, node_pad: int | None = None):
+    """Place one solver table: sharded over its trailing node axis when
+    that axis is the node padding (or ``node_pad`` is None), replicated
+    otherwise (dummy [1, 1] placeholders). Known scalar class tables are
+    placed by NAME via REPLICATED_TABLE_NAMES in the callers — the shape
+    test here is only for arrays that are either true node tables or
+    trailing-dim-1 dummies, where it cannot collide."""
+    import jax
+
+    a = np.asarray(a)
+    if node_pad is not None and (a.ndim == 0 or a.shape[-1] != node_pad):
+        return jax.device_put(a, replicated(mesh))
+    return jax.device_put(a, node_sharding(mesh, a.ndim))
+
+
+def placers(mesh, node_pad: int | None = None):
+    """The (replicated-put, node-table-put) pair every solve-side
+    placement site needs: ``dev`` replicates (per-pod packed arrays,
+    scalars, heal payloads), ``dev_n`` shards over the trailing node
+    axis via put_node_table. mesh=None degrades both to jnp.asarray —
+    the unsharded single-device path."""
+    import jax
+    import jax.numpy as jnp
+
+    if mesh is None:
+        return jnp.asarray, jnp.asarray
+    repl = replicated(mesh)
+
+    def dev(a):
+        return jax.device_put(np.ascontiguousarray(a), repl)
+
+    def dev_n(a):
+        return put_node_table(mesh, a, node_pad)
+
+    return dev, dev_n
 
 
 def shard_node_tree(mesh, tree, replicate_names: frozenset[str] = frozenset()):

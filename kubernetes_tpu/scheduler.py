@@ -66,6 +66,20 @@ class SchedulerConfig:
     pipeline_split: int = 0
     # defaultpreemption: run the PostFilter dry-run for unschedulable pods
     enable_preemption: bool = True
+    # node-axis mesh for the device solve (parallel/sharding.py): number
+    # of devices to shard the node axis over. 0 = all visible devices,
+    # 1 = force the single-device (unsharded) path, N > 1 = the first
+    # min(N, visible) devices. A resolved count of 1 is the unsharded
+    # path either way. The mesh threads through BOTH scheduling loops —
+    # overlap, carry, and sync batches all dispatch sharded — and
+    # results are bit-exactly device-count invariant
+    # (tests/test_sharding.py). Note for tier-1: conftest forces 8
+    # virtual CPU devices, so default-config Scheduler tests exercise
+    # the SHARDED path; the UNSHARDED path keeps coverage through the
+    # sim suite (SimHarness pins mesh_devices=1), the direct-solver
+    # parity tests (ExactSolver defaults to mesh=None), and the
+    # mesh_devices=1 arms of the equivalence tests.
+    mesh_devices: int = 0
     # multi-profile (profile.NewMap): schedulerName -> solver config for
     # that profile; pods whose schedulerName matches no profile are ignored
     # at queue-add, like the reference's frameworkForPod miss. None = the
@@ -344,7 +358,20 @@ class Scheduler:
         # simulator delivers delayed watch events here to exercise the
         # conflict fence and the livelock backstop deterministically.
         self._post_dispatch_hook = None
+        # node-axis solve mesh (SchedulerConfig.mesh_devices): resolved
+        # once — every dispatch (overlap/carry/sync, all profiles) runs
+        # against it. None = single-device. The snapshot's node padding
+        # is forced to a device-count multiple so the trailing node axis
+        # always shards evenly; padded rows stay masked unschedulable.
+        from .parallel.sharding import resolve_mesh
+
+        self.mesh = resolve_mesh(self.config.mesh_devices)
+        self._mesh_devices = (
+            int(self.mesh.size) if self.mesh is not None else 1
+        )
+        metrics.mesh_devices.set(self._mesh_devices)
         self.snapshot = Snapshot()
+        self.snapshot.pad_multiple = self._mesh_devices
         from .state.volume_binder import VolumeBinder
 
         self.volume_binder = VolumeBinder(cluster)
@@ -1288,6 +1315,7 @@ class Scheduler:
         with self.obs.span(
             "dispatch", trace_id=prep.step, profile=prep.profile,
             defer=defer, healed=heal_stale, split=split,
+            mesh_devices=self._mesh_devices,
         ):
             handle = solver.solve(
                 prep.batch, prep.pbatch, prep.static, prep.ports,
@@ -1298,6 +1326,7 @@ class Scheduler:
                 defer_read=defer,
                 allow_heal=allow_heal,
                 split=split,
+                mesh=self.mesh,
             )
         dispatch_dt = self.clock.perf() - t1
         prep.tensorize_seconds = max(t1 - prep.gs, 0.0)

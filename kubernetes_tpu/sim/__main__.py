@@ -21,10 +21,22 @@ import argparse
 import sys
 
 
-def _configure_jax() -> None:
+def _configure_jax(mesh_devices: int = 1) -> None:
     """Force CPU + 64-bit resource arithmetic BEFORE the solver imports
     jax (tests get this from tests/conftest.py; the CLI must do it
-    itself — on this toolchain only jax.config.update is honored)."""
+    itself — on this toolchain only jax.config.update is honored).
+    ``mesh_devices > 1`` additionally forces that many virtual CPU
+    devices (must land before the backend initializes) so the sim can
+    drive the node-axis-sharded solve path."""
+    import os
+
+    if mesh_devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={mesh_devices}"
+            ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -102,6 +114,13 @@ def main(argv=None) -> int:
         help="dump the flight recorder here when an invariant fires",
     )
     parser.add_argument(
+        "--mesh-devices", type=int, default=1, metavar="N",
+        help="shard the node-axis solve over N virtual CPU devices "
+        "(SchedulerConfig.mesh_devices; forces the device count before "
+        "jax initializes). Results are bit-exactly device-count "
+        "invariant, so traces match the single-device run.",
+    )
+    parser.add_argument(
         "--selfcheck", action="store_true",
         help="run twice and verify the traces are byte-identical",
     )
@@ -116,7 +135,7 @@ def main(argv=None) -> int:
             print(f"{name}: pipelined={p.pipelined} nodes={p.nodes}")
         return 0
 
-    _configure_jax()
+    _configure_jax(args.mesh_devices)
     from .harness import replay_trace, run_sim
     from .trace import TraceError
 
@@ -134,6 +153,7 @@ def main(argv=None) -> int:
         res = run_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
             pipelined=pipelined, flight_dump=args.flight_dump,
+            mesh_devices=args.mesh_devices,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -152,7 +172,7 @@ def main(argv=None) -> int:
     if args.selfcheck:
         res2 = run_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
-            pipelined=pipelined,
+            pipelined=pipelined, mesh_devices=args.mesh_devices,
         )
         if res.journal_lines != res2.journal_lines:
             print(

@@ -108,6 +108,7 @@ class SimHarness:
         max_settle_rounds: int = 12,
         spans: bool = False,
         flight_dump: str | None = None,
+        mesh_devices: int = 1,
     ) -> None:
         self.profile = (
             get_profile(profile) if isinstance(profile, str) else profile
@@ -171,6 +172,12 @@ class SimHarness:
             self.cluster,
             SchedulerConfig(
                 batch_size=self.profile.batch_size,
+                # node-axis solve mesh: results are bit-exactly device-
+                # count invariant, so a mesh_devices=N run's trace and
+                # journal must be byte-identical to the single-device run
+                # with the same seed (the multichip CI smoke leans on
+                # this). Default 1: sim runs are usually single-device.
+                mesh_devices=mesh_devices,
                 solver=ExactSolverConfig(
                     tie_break="first", group_size=self.profile.group_size
                 ),
@@ -511,11 +518,12 @@ def run_sim(
     pipelined: bool | None = None,
     spans: bool = False,
     flight_dump: str | None = None,
+    mesh_devices: int = 1,
 ) -> SimResult:
     """One fresh seeded run (library entry; the CLI and tests use this)."""
     return SimHarness(
         profile, seed=seed, cycles=cycles, pipelined=pipelined,
-        spans=spans, flight_dump=flight_dump,
+        spans=spans, flight_dump=flight_dump, mesh_devices=mesh_devices,
     ).run()
 
 

@@ -39,11 +39,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax import ops as jops
 
+from .. import metrics
 from ..ops import fastmath
 from ..ops import interpod as ip
 from ..ops import noderesources as nr
 from ..ops import plugins as pl
 from ..ops import spread as sp
+from ..parallel.sharding import (
+    REPLICATED_TABLE_NAMES,
+    mesh_fingerprint,
+    placers,
+    replicated,
+)
 from ..tensorize.interpod import InterpodTensors, trivial_interpod_tensors
 from ..tensorize.plugins import (
     PortTensors,
@@ -1271,6 +1278,74 @@ class BatchCarriedUsage:
         self.state = state  # device arrays, donated through the chain
 
 
+def _class_table_arrays(static, spread, interpod) -> list:
+    """The flat array list behind one class-table upload — the content
+    hash AND the transfer-byte accounting both walk exactly this."""
+    arrays = [
+        static.mask, static.taint_cnt, static.nodeaff_pref,
+        static.image_score, spread.dom, spread.elig, spread.max_skew,
+        spread.min_domains, spread.self_match, spread.is_hostname,
+        spread.hard, spread.soft, interpod.in_dom, interpod.in_pref_w,
+        interpod.cls_req_aff, interpod.cls_req_anti, interpod.cls_pref,
+        interpod.ex_dom, interpod.ex_anti,
+    ]
+    if static.extra_score is not None:
+        arrays.append(static.extra_score)
+    return arrays
+
+
+def _place_class_tables(static, spread, interpod, mesh, node_pad: int):
+    """Device placement for the per-batch class tables: tables with a
+    trailing node axis ([*, N]) shard over the mesh's node axis, the
+    per-class / per-instance scalar tables replicate BY NAME
+    (parallel.sharding.REPLICATED_TABLE_NAMES — a shape test alone
+    could collide when an instance-axis pow2 pad happens to equal the
+    node pad). mesh=None is the plain single-device upload."""
+    dev, dev_n = placers(mesh, node_pad)
+
+    def put(name, a):
+        return dev(a) if name in REPLICATED_TABLE_NAMES else dev_n(a)
+
+    names_arrays = {
+        "static_mask": static.mask,
+        "taint_cnt": static.taint_cnt,
+        "nodeaff_pref": static.nodeaff_pref,
+        "image_score": static.image_score,
+        **(
+            {"extra_score": static.extra_score}
+            if static.extra_score is not None
+            else {}
+        ),
+        "spr": {
+            "dom": spread.dom,
+            "elig": spread.elig,
+            "max_skew": spread.max_skew,
+            "min_domains": spread.min_domains,
+            "self_match": spread.self_match,
+            "is_hostname": spread.is_hostname,
+            "hard": spread.hard,
+            "soft": spread.soft,
+        },
+        "ipa": {
+            "in_dom": interpod.in_dom,
+            "in_pref_w": interpod.in_pref_w,
+            "cls_req_aff": interpod.cls_req_aff,
+            "cls_req_anti": interpod.cls_req_anti,
+            "cls_pref": interpod.cls_pref,
+            "ex_dom": interpod.ex_dom,
+            "ex_anti": interpod.ex_anti,
+        },
+    }
+    return {
+        name: (
+            {n: put(n, a) for n, a in v.items()}
+            if isinstance(v, dict)
+            else put(name, v)
+        )
+        for name, v in names_arrays.items()
+    }
+
+
 class _DeviceSession:
     """Device-resident mirror of one snapshot's node tensors (SURVEY §8.3).
 
@@ -1286,14 +1361,20 @@ class _DeviceSession:
         self.nt = None
         self.persist = None
         self.seen_versions: np.ndarray | None = None
-        self.class_cache: dict[bytes, object] = {}
+        self.class_cache: dict[tuple, object] = {}
+        # node-axis mesh the resident tables are sharded over (None =
+        # single-device). A mesh change is a full re-upload: the resident
+        # buffers' shardings no longer match the dispatch's expectations.
+        self.mesh = None
+        self.mesh_key: tuple | None = None
 
     def sync(
         self,
         nodes: NodeBatch,
         col_versions: np.ndarray,
         allow_heal: bool = True,
-    ):
+        mesh=None,
+    ) -> int:
         """Bring resident node tables/state up to date with the snapshot.
 
         ``allow_heal=False`` (pipelined dispatch with an EARLIER solve
@@ -1305,29 +1386,49 @@ class _DeviceSession:
         device carry or usage-decreasing rollbacks), so deferring the
         heal is conservative — never a capacity violation. A shape
         change in this mode raises SessionDrainRequired instead of
-        re-uploading over the in-flight solve's carried state."""
-        if self.padded != nodes.padded or self.k != nodes.allocatable.shape[0]:
+        re-uploading over the in-flight solve's carried state.
+
+        With ``mesh`` set, the resident node tables and carried state
+        live SHARDED over the mesh's node axis (node axis last); dirty-
+        column heals scatter into the sharded residents, so only the
+        owning shard's slice actually changes. Returns the host->device
+        bytes this sync uploaded (the per-solve transfer counters)."""
+        mesh_key = mesh_fingerprint(mesh)
+        if (
+            self.padded != nodes.padded
+            or self.k != nodes.allocatable.shape[0]
+            or self.mesh_key != mesh_key
+        ):
             if not allow_heal and self.padded != -1:
                 raise SessionDrainRequired()
             self.padded = nodes.padded
             self.k = nodes.allocatable.shape[0]
+            self.mesh = mesh
+            self.mesh_key = mesh_key
+            _, put = placers(mesh, nodes.padded)
             self.nt = {
-                "alloc": jnp.asarray(nodes.allocatable),
-                "max_pods": jnp.asarray(nodes.max_pods),
-                "node_valid": jnp.asarray(nodes.valid),
+                "alloc": put(nodes.allocatable),
+                "max_pods": put(nodes.max_pods),
+                "node_valid": put(nodes.valid),
             }
             self.persist = {
-                "used": jnp.asarray(nodes.used),
-                "nonzero_used": jnp.asarray(nodes.nonzero_used),
-                "pod_count": jnp.asarray(nodes.pod_count),
+                "used": put(nodes.used),
+                "nonzero_used": put(nodes.nonzero_used),
+                "pod_count": put(nodes.pod_count),
             }
             self.seen_versions = col_versions[: nodes.padded].copy()
-            return
+            return sum(
+                a.nbytes
+                for a in (
+                    nodes.allocatable, nodes.max_pods, nodes.valid,
+                    nodes.used, nodes.nonzero_used, nodes.pod_count,
+                )
+            )
         dirty = np.nonzero(
             col_versions[: self.padded] > self.seen_versions
         )[0]
         if dirty.size and not allow_heal:
-            return  # defer: seen_versions untouched, a later sync heals
+            return 0  # defer: seen_versions untouched, a later sync heals
         if dirty.size:
             d_pad = 1
             while d_pad < dirty.size:
@@ -1345,82 +1446,61 @@ class _DeviceSession:
                 [nodes.max_pods[idx], nodes.pod_count[idx]]
             )
             cols_bool = _pack_cols([nodes.valid[idx]])
+            # heal payloads replicate (every shard scatters; GSPMD keeps
+            # only the owning shard's columns — the others are out of its
+            # index range)
+            put_r, _ = placers(self.mesh)
             self.nt, self.persist = _heal_jit(
                 self.nt,
                 self.persist,
-                jnp.asarray(cols_i64),
-                jnp.asarray(cols_i32),
-                jnp.asarray(cols_bool),
-                jnp.asarray(idx),
+                put_r(cols_i64),
+                put_r(cols_i32),
+                put_r(cols_bool),
+                put_r(idx),
             )
         self.seen_versions = col_versions[: self.padded].copy()
+        return (
+            cols_i64.nbytes + cols_i32.nbytes + cols_bool.nbytes + idx.nbytes
+            if dirty.size
+            else 0
+        )
 
-    def class_tables(self, static, spread, interpod):
-        """Content-addressed device cache of the per-batch class tables."""
+    def class_tables(self, static, spread, interpod, mesh=None):
+        """Content-addressed device cache of the per-batch class tables.
+        Returns (tables, bytes_uploaded) — 0 bytes on a cache hit. The
+        cache key includes the mesh fingerprint: the same content placed
+        for a different topology is a different device resident."""
         import hashlib
 
         h = hashlib.blake2b(digest_size=16)
-        arrays = [
-            static.mask, static.taint_cnt, static.nodeaff_pref,
-            static.image_score, spread.dom, spread.elig, spread.max_skew,
-            spread.min_domains, spread.self_match, spread.is_hostname,
-            spread.hard, spread.soft, interpod.in_dom, interpod.in_pref_w,
-            interpod.cls_req_aff, interpod.cls_req_anti, interpod.cls_pref,
-            interpod.ex_dom, interpod.ex_anti,
-        ]
-        if static.extra_score is not None:
-            arrays.append(static.extra_score)
+        arrays = _class_table_arrays(static, spread, interpod)
         for a in arrays:
             arr = np.ascontiguousarray(a)
             h.update(str(arr.shape).encode())
             h.update(arr.tobytes())
-        key = h.digest()
+        key = (h.digest(), mesh_fingerprint(mesh))
         ct = self.class_cache.pop(key, None)
         if ct is not None:
             self.class_cache[key] = ct  # re-insert: LRU refresh on hit
-        else:
-            ct = {
-                "static_mask": jnp.asarray(static.mask),
-                "taint_cnt": jnp.asarray(static.taint_cnt),
-                "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
-                "image_score": jnp.asarray(static.image_score),
-                **(
-                    {"extra_score": jnp.asarray(static.extra_score)}
-                    if static.extra_score is not None
-                    else {}
-                ),
-                "spr": {
-                    "dom": jnp.asarray(spread.dom),
-                    "elig": jnp.asarray(spread.elig),
-                    "max_skew": jnp.asarray(spread.max_skew),
-                    "min_domains": jnp.asarray(spread.min_domains),
-                    "self_match": jnp.asarray(spread.self_match),
-                    "is_hostname": jnp.asarray(spread.is_hostname),
-                    "hard": jnp.asarray(spread.hard),
-                    "soft": jnp.asarray(spread.soft),
-                },
-                "ipa": {
-                    "in_dom": jnp.asarray(interpod.in_dom),
-                    "in_pref_w": jnp.asarray(interpod.in_pref_w),
-                    "cls_req_aff": jnp.asarray(interpod.cls_req_aff),
-                    "cls_req_anti": jnp.asarray(interpod.cls_req_anti),
-                    "cls_pref": jnp.asarray(interpod.cls_pref),
-                    "ex_dom": jnp.asarray(interpod.ex_dom),
-                    "ex_anti": jnp.asarray(interpod.ex_anti),
-                },
-            }
-            if len(self.class_cache) >= 8:
-                self.class_cache.pop(next(iter(self.class_cache)))
-            self.class_cache[key] = ct
-        return ct
+            return ct, 0
+        ct = _place_class_tables(static, spread, interpod, mesh, self.padded)
+        if len(self.class_cache) >= 8:
+            self.class_cache.pop(next(iter(self.class_cache)))
+        self.class_cache[key] = ct
+        return ct, sum(np.asarray(a).nbytes for a in arrays)
 
 
 class ExactSolver:
     """Host-facing wrapper: NodeBatch/PodBatch (+ plugin tensors) in,
     assignments out, node state written back (the device-side 'assume')."""
 
-    def __init__(self, config: ExactSolverConfig | None = None):
+    def __init__(self, config: ExactSolverConfig | None = None, mesh=None):
         self.config = config or ExactSolverConfig()
+        # default jax.sharding.Mesh for every solve (node axis sharded over
+        # its devices); solve(mesh=...) overrides per call. None = the
+        # single-device path. The scheduler threads its
+        # SchedulerConfig.mesh_devices mesh through here.
+        self.mesh = mesh
         self._step_count = 0
         self._session = _DeviceSession()
         # Cumulative executable-dispatch histogram: "scan" counts whole
@@ -1470,6 +1550,7 @@ class ExactSolver:
         defer_read: bool = False,
         allow_heal: bool = True,
         split: int = 1,
+        mesh=None,
     ) -> np.ndarray | DeferredAssignments | list[DeferredAssignments]:
         """Returns assignments [num_pods] of node indices (-1 = unschedulable).
 
@@ -1509,11 +1590,22 @@ class ExactSolver:
         value is ALWAYS a list, even if the clamp lands on one
         sub-batch.
 
+        ``mesh`` (default: the constructor's mesh): a jax.sharding.Mesh
+        with a "nodes" axis — every node-resident table/state array
+        shards over its trailing node axis (which must be a multiple of
+        the device count; Snapshot.pad_multiple guarantees this on the
+        scheduler path), per-pod/per-class inputs replicate, and GSPMD
+        inserts the cross-shard collectives. Assignments are bit-
+        identical to the single-device solve for any device count
+        (integer scores, stable reductions — tests/test_sharding.py).
+
         Without ``static``/``ports``/``spread``/``interpod`` tensors, a
         trivial single-class mask (valid ∧ schedulable) reproduces the
         resources-only pipeline.
         """
         cfg = self.config
+        if mesh is None:
+            mesh = self.mesh
         fdtype = jnp.float64 if cfg.balanced_fdtype == "float64" else jnp.float32
         key = jax.random.PRNGKey(cfg.seed + self._step_count)
         self._step_count += 1
@@ -1530,52 +1622,42 @@ class ExactSolver:
         use_nominated = nominated is not None and not nominated.empty
         session = col_versions is not None
 
+        h2d_bytes = 0
         if session:
-            self._session.sync(nodes, col_versions, allow_heal=allow_heal)
+            h2d_bytes += self._session.sync(
+                nodes, col_versions, allow_heal=allow_heal, mesh=mesh
+            )
             nt = self._session.nt
             persist = self._session.persist
-            ct = self._session.class_tables(static, spread, interpod)
+            ct, ct_bytes = self._session.class_tables(
+                static, spread, interpod, mesh=mesh
+            )
+            h2d_bytes += ct_bytes
         else:
+            _, put = placers(mesh, nodes.padded)
             nt = {
-                "alloc": jnp.asarray(nodes.allocatable),
-                "max_pods": jnp.asarray(nodes.max_pods),
-                "node_valid": jnp.asarray(nodes.valid),
+                "alloc": put(nodes.allocatable),
+                "max_pods": put(nodes.max_pods),
+                "node_valid": put(nodes.valid),
             }
             persist = {
-                "used": jnp.asarray(nodes.used),
-                "nonzero_used": jnp.asarray(nodes.nonzero_used),
-                "pod_count": jnp.asarray(nodes.pod_count),
+                "used": put(nodes.used),
+                "nonzero_used": put(nodes.nonzero_used),
+                "pod_count": put(nodes.pod_count),
             }
-            ct = {
-                "static_mask": jnp.asarray(static.mask),
-                "taint_cnt": jnp.asarray(static.taint_cnt),
-                "nodeaff_pref": jnp.asarray(static.nodeaff_pref),
-                "image_score": jnp.asarray(static.image_score),
-                **(
-                    {"extra_score": jnp.asarray(static.extra_score)}
-                    if static.extra_score is not None
-                    else {}
-                ),
-                "spr": {
-                    "dom": jnp.asarray(spread.dom),
-                    "elig": jnp.asarray(spread.elig),
-                    "max_skew": jnp.asarray(spread.max_skew),
-                    "min_domains": jnp.asarray(spread.min_domains),
-                    "self_match": jnp.asarray(spread.self_match),
-                    "is_hostname": jnp.asarray(spread.is_hostname),
-                    "hard": jnp.asarray(spread.hard),
-                    "soft": jnp.asarray(spread.soft),
-                },
-                "ipa": {
-                    "in_dom": jnp.asarray(interpod.in_dom),
-                    "in_pref_w": jnp.asarray(interpod.in_pref_w),
-                    "cls_req_aff": jnp.asarray(interpod.cls_req_aff),
-                    "cls_req_anti": jnp.asarray(interpod.cls_req_anti),
-                    "cls_pref": jnp.asarray(interpod.cls_pref),
-                    "ex_dom": jnp.asarray(interpod.ex_dom),
-                    "ex_anti": jnp.asarray(interpod.ex_anti),
-                },
-            }
+            ct = _place_class_tables(
+                static, spread, interpod, mesh, nodes.padded
+            )
+            h2d_bytes += sum(
+                a.nbytes
+                for a in (
+                    nodes.allocatable, nodes.max_pods, nodes.valid,
+                    nodes.used, nodes.nonzero_used, nodes.pod_count,
+                )
+            ) + sum(
+                np.asarray(a).nbytes
+                for a in _class_table_arrays(static, spread, interpod)
+            )
 
         # per-batch node-state rows, one int32 upload
         b_arrs = [ports.used]
@@ -1759,6 +1841,31 @@ class ExactSolver:
             kinds_host = None
             self.dispatch_counts["scan"] += 1
 
+        # per-solve transfer accounting + mesh placement: per-pod packed
+        # arrays and scalars replicate; node-axis rows (bstate, nominated
+        # load) shard over the mesh's node axis
+        h2d_bytes += (
+            bstate.nbytes + xi64.nbytes + xi32.nbytes + xbool.nbytes
+            + vcnt_host.nbytes + np.asarray(nom_used).nbytes
+            + np.asarray(nom_ports).nbytes
+        )
+        if grouped:
+            h2d_bytes += kinds_host.nbytes
+        metrics.h2d_bytes_total.inc(int(h2d_bytes))
+        if session:
+            # the only per-batch download: the (padded) assignment vector
+            metrics.d2h_bytes_total.inc(int(pods.padded) * 4)
+        else:
+            metrics.d2h_bytes_total.inc(
+                ((nodes.allocatable.shape[0] + 3) * nodes.padded
+                 + pods.padded) * 8
+            )
+        dev, dev_n = placers(mesh, nodes.padded)
+        if mesh is not None:
+            _repl = replicated(mesh)
+            key = jax.device_put(key, _repl)
+            kinds = jax.device_put(kinds, _repl)
+
         want_chain = split > 1 and session and defer_read
         if want_chain and not use_nominated:
             k_split = self._feasible_split(
@@ -1768,7 +1875,7 @@ class ExactSolver:
                 return self._solve_chain(
                     k_split, nt, ct, bstate, xi64, xi32, xbool,
                     kinds_host if grouped else None, vcnt_host, compact,
-                    nom_used, nom_ports, key, pods,
+                    nom_used, nom_ports, key, pods, mesh,
                     bspec=tuple(bspec), xspec=xspec, grouped=grouped,
                     group=group, **kw,
                 )
@@ -1778,20 +1885,26 @@ class ExactSolver:
             nt,
             ct,
             persist,
-            jnp.asarray(bstate),
-            jnp.asarray(xi64),
-            jnp.asarray(xi32),
-            jnp.asarray(xbool),
+            dev_n(bstate),
+            dev(xi64),
+            dev(xi32),
+            dev(xbool),
             kinds,
-            jnp.asarray(vcnt_host),
-            jnp.asarray(nom_used),
-            jnp.asarray(nom_ports),
+            dev(vcnt_host),
+            dev_n(nom_used),
+            dev_n(nom_ports),
             key,
             bspec=tuple(bspec),
             xspec=xspec,
             grouped=grouped,
             group=group,
-            pack_result=not session,
+            # packed single-buffer download only on the unsharded path:
+            # the SPMD partitioner rejects the flatten+concat of the
+            # sharded state with a dtype-mixed dynamic_update_slice
+            # (s64 index vs s32 shard offset, XLA verifier error), and a
+            # sharded standalone solve is a dryrun/bench/test context
+            # where four reads instead of one is acceptable
+            pack_result=not session and mesh is None,
             compact=compact,
             **kw,
         )
@@ -1805,6 +1918,15 @@ class ExactSolver:
                 # out" so the pipelined caller never type-switches
                 return [handle] if want_chain else handle
             return np.asarray(assignments)[: pods.num_pods]
+        if mesh is not None:
+            # sharded standalone: unpacked results (see pack_result above)
+            assignments, out_state = out
+            nodes.used = np.array(out_state["used"])
+            nodes.nonzero_used = np.array(out_state["nonzero_used"])
+            nodes.pod_count = np.array(out_state["pod_count"]).astype(
+                np.int32
+            )
+            return np.asarray(assignments).astype(np.int32)[: pods.num_pods]
         # standalone: ONE packed download (np.array = writable copy; the
         # unpacked slices below are views of it, so later in-place
         # dirty-column writes to ``nodes`` stay legal)
@@ -1854,6 +1976,7 @@ class ExactSolver:
         nom_ports,
         key,
         pods: PodBatch,
+        mesh=None,
         *,
         bspec,
         xspec,
@@ -1873,8 +1996,11 @@ class ExactSolver:
         handles: list[DeferredAssignments] = []
         carry: BatchCarriedUsage | None = None
         dummy_b = np.zeros((1, 1), dtype=np.int32)
-        nom_used_j = jnp.asarray(nom_used)
-        nom_ports_j = jnp.asarray(nom_ports)
+        # node pad = bstate's trailing axis (chained solves are
+        # session-mode only; nominated dummies replicate)
+        dev, dev_n = placers(mesh, bstate.shape[1])
+        nom_used_j = dev_n(nom_used)
+        nom_ports_j = dev_n(nom_ports)
         try:
             for i in range(k_split):
                 lo = i * sub
@@ -1888,16 +2014,16 @@ class ExactSolver:
                     nt,
                     ct,
                     self._session.persist if first else carry.state,
-                    jnp.asarray(bstate if first else dummy_b),
-                    jnp.asarray(xi64[sl]),
-                    jnp.asarray(xi32[sl]),
-                    jnp.asarray(xbool[sl]),
-                    jnp.asarray(kinds_host[i * cpk : (i + 1) * cpk])
+                    dev_n(bstate) if first else dev(dummy_b),
+                    dev(xi64[sl]),
+                    dev(xi32[sl]),
+                    dev(xbool[sl]),
+                    dev(kinds_host[i * cpk : (i + 1) * cpk])
                     if grouped
-                    else jnp.zeros(1, dtype=jnp.int32),
-                    jnp.asarray(vcnt_host[i * cpk : (i + 1) * cpk])
+                    else dev(np.zeros(1, dtype=np.int32)),
+                    dev(vcnt_host[i * cpk : (i + 1) * cpk])
                     if compact
-                    else jnp.zeros(1, dtype=jnp.int32),
+                    else dev(np.zeros(1, dtype=np.int32)),
                     nom_used_j,
                     nom_ports_j,
                     jax.random.fold_in(key, i),

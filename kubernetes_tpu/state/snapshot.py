@@ -22,6 +22,13 @@ from .cache import SchedulerCache
 class Snapshot:
     def __init__(self) -> None:
         self.batch: NodeBatch | None = None
+        # node-padding multiple beyond the LANE/pow2 bucket: the mesh
+        # device count when the solve is sharded over the node axis (a
+        # NamedSharding needs the trailing axis evenly divisible). Set by
+        # the Scheduler from SchedulerConfig.mesh_devices before the
+        # first update; padding columns stay valid=False/schedulable=
+        # False so they are masked out of every filter/score/argmax path.
+        self.pad_multiple = 1
         self.names: list[str] = []  # slot -> node name ("" = free)
         self._slot_of: dict[str, int] = {}
         self._free: list[int] = []
@@ -60,6 +67,14 @@ class Snapshot:
             return
         # never shrink: existing slot indices must remain valid
         new_cap = bucket_pow2(max(n, cap, LANE))
+        if self.pad_multiple > 1:
+            # keep LANE alignment AND device-count divisibility (the
+            # sharded node axis): round up to lcm(LANE, devices). For
+            # power-of-two device counts <= LANE this is a no-op.
+            import math
+
+            q = math.lcm(LANE, self.pad_multiple)
+            new_cap = ((new_cap + q - 1) // q) * q
         k = len(vocab)
         old = self.batch
         b = NodeBatch(

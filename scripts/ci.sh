@@ -45,6 +45,30 @@ python -m kubernetes_tpu.sim --seed 1 --cycles 8 --profile churn_heavy \
 python -m kubernetes_tpu.sim --seed 1 --cycles 8 \
     --profile preemption_pressure --selfcheck
 
+echo "== multichip: 8-device forced-host mesh smoke =="
+# sharded-vs-unsharded exact-path equivalence on an 8-way virtual CPU
+# mesh (conftest.py forces the device count before jax initializes):
+# ExactSolver.solve(mesh=...) standalone + the full Scheduler session
+# path must be bit-identical to the single-device solve, and padding
+# rows must never take a binding.
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_sharding.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+# one fixed-seed sim drive against the sharded solve, its trace digest
+# byte-compared against the single-device run with identical flags —
+# the device-count-invariance contract end to end through the sim
+mesh_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 6 \
+    --profile churn_heavy --mesh-devices 8)
+echo "$mesh_out"
+mesh_digest=$(echo "$mesh_out" | grep -o 'trace_digest=[0-9a-f]*')
+one_digest=$(python -m kubernetes_tpu.sim --seed 0 --cycles 6 \
+    --profile churn_heavy | grep -o 'trace_digest=[0-9a-f]*')
+if [ "$mesh_digest" != "$one_digest" ] || [ -z "$mesh_digest" ]; then
+    echo "MULTICHIP DIVERGENCE: mesh=$mesh_digest vs 1-device=$one_digest"
+    exit 1
+fi
+echo "-- mesh-vs-1-device trace digests identical: $mesh_digest --"
+
 echo "== obs smoke: journaled sim -> schema check -> explain =="
 obs_journal=$(mktemp /tmp/ktpu_obs_journal.XXXXXX.jsonl)
 python -m kubernetes_tpu.sim --seed 0 --cycles 6 --profile churn_heavy \

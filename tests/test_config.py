@@ -49,6 +49,7 @@ extenders:
 tpuSolver:
   batchSize: 2048
   tieBreak: first
+  meshDevices: 4
 """
 
 
@@ -68,6 +69,8 @@ def test_reference_style_yaml_parses():
     assert cfg.extenders[0].node_cache_capable
     assert cfg.tpu_solver.batch_size == 2048
     assert cfg.tpu_solver.tie_break == "first"
+    assert cfg.tpu_solver.mesh_devices == 4
+    assert ct.scheduler_config(cfg).mesh_devices == 4
 
 
 def test_duplicate_profile_rejected():

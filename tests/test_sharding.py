@@ -136,6 +136,186 @@ def test_parallel_sharding_helpers(mesh):
     np.testing.assert_array_equal(np.asarray(placed["alloc"]), tree["alloc"])
 
 
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+
+
+def _shape_pod(i: int, kind: str):
+    b = MakePod().name(f"{kind}{i:03}").req(
+        {"cpu": "100m", "memory": "256Mi"}
+    )
+    if kind == "spread":
+        b = b.label("app", "spread").spread_constraint(
+            1, ZONE, "DoNotSchedule", {"app": "spread"}
+        )
+    elif kind == "anti":
+        b = b.label("app", "anti").pod_anti_affinity(HOST, {"app": "anti"})
+    elif kind == "ports":
+        b = b.host_port(8000 + i % 3)
+    return b.obj()
+
+
+def _mk_cluster(n_nodes=6):
+    from kubernetes_tpu.state.cluster import ClusterState
+
+    cs = ClusterState()
+    for i in range(n_nodes):
+        cs.create_node(
+            MakeNode()
+            .name(f"n{i}")
+            .capacity({"cpu": "8", "memory": "32Gi", "pods": "110"})
+            .label(ZONE, f"z{i % 3}")
+            .label(HOST, f"n{i}")
+            .obj()
+        )
+    return cs
+
+
+def _mk_sched(cs, mesh_devices, **cfg):
+    from kubernetes_tpu.obs import ObsConfig
+    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.solver.exact import ExactSolverConfig
+
+    return Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=16,
+            mesh_devices=mesh_devices,
+            solver=ExactSolverConfig(tie_break="first", group_size=8),
+            obs=ObsConfig(journal=True),
+            **cfg,
+        ),
+    )
+
+
+def _exact_standalone(kind, mesh, n_nodes=512, n_pods=48):
+    """One standalone ExactSolver.solve over the production tensorizers
+    for a hard shape; returns (assignments, NodeBatch) for comparison."""
+    from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+    from kubernetes_tpu.tensorize.interpod import build_interpod_tensors
+    from kubernetes_tpu.tensorize.plugins import (
+        build_port_tensors,
+        build_static_tensors,
+    )
+    from kubernetes_tpu.tensorize.spread import build_spread_tensors
+
+    nodes = [
+        MakeNode()
+        .name(f"n-{i:04}")
+        .capacity({"cpu": "8", "memory": "32Gi", "pods": "40"})
+        .label(ZONE, f"z{i % 3}")
+        .label(HOST, f"n-{i:04}")
+        .obj()
+        for i in range(n_nodes)
+    ]
+    pods = [_shape_pod(i, kind) for i in range(n_pods)]
+    from kubernetes_tpu.tensorize.schema import pad_to
+
+    npad = pad_to(n_nodes)  # LANE multiple => divisible by the 8-way mesh
+    batch = build_node_batch(nodes, pad=npad)
+    pbatch = build_pod_batch(pods, batch.vocab)
+    slots = list(nodes) + [None] * (npad - n_nodes)
+    static = build_static_tensors(pods, pbatch, slots, npad)
+    ports = build_port_tensors(pods, pbatch, slots, {}, npad)
+    spread = build_spread_tensors(
+        pods, static.reps, pbatch, slots, {}, npad, static.c_pad
+    )
+    interpod = build_interpod_tensors(
+        pods, static.reps, pbatch, slots, {}, npad, static.c_pad
+    )
+    solver = ExactSolver(ExactSolverConfig(tie_break="first", group_size=16))
+    asg = solver.solve(
+        batch, pbatch, static, ports, spread, interpod, mesh=mesh
+    )
+    return np.asarray(asg), batch
+
+
+@pytest.mark.parametrize("kind", ["plain", "ports", "spread", "anti"])
+def test_exact_solver_sharded_equals_unsharded(mesh, kind):
+    """The PRODUCTION exact path — ExactSolver.solve through the real
+    tensorizers — sharded 8 ways over the node axis must produce the
+    bit-identical assignment vector AND final node state (the objective:
+    identical used/pod_count columns) for every hard shape."""
+    sharded, batch_sh = _exact_standalone(kind, mesh)
+    ref, batch_ref = _exact_standalone(kind, None)
+    np.testing.assert_array_equal(sharded, ref, err_msg=kind)
+    np.testing.assert_array_equal(batch_sh.used, batch_ref.used)
+    np.testing.assert_array_equal(batch_sh.pod_count, batch_ref.pod_count)
+    assert int((sharded >= 0).sum()) > 0
+
+
+@pytest.mark.parametrize("kind", ["plain", "ports", "spread", "anti"])
+def test_scheduler_mesh_end_to_end_equivalence(mesh, kind):
+    """End to end through the Scheduler (session mode, dirty-column
+    heals, the pipelined carry/overlap modes): mesh_devices=8 must bind
+    the same pods to the same nodes as the single-device path, with the
+    same per-pod journal outcomes."""
+
+    def drive(mesh_devices):
+        cs = _mk_cluster()
+        s = _mk_sched(cs, mesh_devices)
+        for i in range(20):
+            cs.create_pod(_shape_pod(i, kind))
+        s.run_pipelined()
+        bindings = sorted((p.name, p.node_name) for p in cs.list_pods())
+        outcomes = {
+            pod: (rec.get("outcome"), rec.get("node"))
+            for pod, rec in s.journal.last_outcomes().items()
+        }
+        return bindings, outcomes
+
+    b8, o8 = drive(8)
+    b1, o1 = drive(1)
+    assert b8 == b1, kind
+    assert o8 == o1, kind
+    assert any(n for _, n in b8)  # something actually bound
+
+
+def test_padding_rows_never_bound(mesh):
+    """Padded node columns (node count not divisible by the device
+    count) must stay masked out of every filter/score/argmax/occupancy
+    path: under delete churn with 5 live nodes on an 8-way mesh, no pod
+    may ever bind to a padding slot (which would surface as a binding to
+    a node name that does not exist)."""
+    cs = _mk_cluster(n_nodes=5)  # 5 % 8 != 0; snapshot pads to 128
+    s = _mk_sched(cs, 8)
+    for i in range(12):
+        cs.create_pod(_shape_pod(i, "spread"))
+    s.run_pipelined()
+    live = {f"n{i}" for i in range(5)}
+    # churn: delete a node (its column becomes a padding-like invalid
+    # slot) and keep scheduling
+    victims = [p for p in cs.list_pods() if p.node_name == "n4"]
+    for p in victims:
+        cs.delete_pod(p.namespace, p.name)
+    cs.delete_node("n4")
+    live.discard("n4")
+    for i in range(12, 20):
+        cs.create_pod(_shape_pod(i, "anti"))
+    s.run_pipelined()
+    for p in cs.list_pods():
+        if p.node_name:
+            assert p.node_name in live, (p.name, p.node_name)
+    # direct solver-level guard: assignments never reference a padded or
+    # invalid slot
+    asg, batch = _exact_standalone("plain", mesh, n_nodes=5, n_pods=8)
+    assert int(asg.max()) < 5
+    assert int((asg >= 0).sum()) == 8
+
+
+def test_sim_trace_device_count_invariant(mesh):
+    """Same seed, same profile, different device count => byte-identical
+    trace AND decision journal (the bit-exact invariance contract,
+    proven end to end through the simulator's churn/fault machinery)."""
+    from kubernetes_tpu.sim.harness import run_sim
+
+    r1 = run_sim("churn_heavy", seed=0, cycles=3, mesh_devices=1)
+    r8 = run_sim("churn_heavy", seed=0, cycles=3, mesh_devices=8)
+    assert r1.ok and r8.ok
+    assert r1.journal_lines == r8.journal_lines
+    assert r1.trace.lines == r8.trace.lines
+
+
 def test_single_shot_sharded_equals_unsharded(mesh):
     """The auction solver — the 50k x 10k rebalance engine, i.e. the actual
     v5e-8 workload — sharded over the node axis must commit the identical
