@@ -40,6 +40,7 @@ METRIC_SCAN_PATHS = (
     "kubernetes_tpu/solver/",
     "kubernetes_tpu/sim/",
     "kubernetes_tpu/obs/",
+    "kubernetes_tpu/fleet/",
 )
 
 

@@ -1,0 +1,55 @@
+"""Fleet mode: the active-active scale-out tier (ISSUE 6 / ROADMAP
+open item #1).
+
+``--leader-elect`` style HA is active/passive — one process solves,
+the rest idle. Fleet mode instead partitions the *cluster* across N
+active scheduler replicas: each owns a deterministic shard of nodes
+(fleet/ring.py — zone-keyed, balance-capped, bounded remap on
+membership change), schedules the pods the ring routes to it, and
+solves its shard concurrently with its peers. Cross-shard
+``PodTopologySpread`` / inter-pod anti-affinity is resolved without a
+global lock: replicas exchange compact occupancy rows
+(fleet/occupancy.py, the host-side mirror of the device
+``BatchCarriedUsage`` carry, framed by the same tensorcodec wire) and
+re-validate each placement pre-assume (fleet/reconciler.py), retrying
+conflicts through the scheduler's existing requeue machinery.
+
+Wiring: set ``SchedulerConfig.fleet = FleetConfig(replica=...,
+replicas=(...))``; replicas sharing a process (sim, tests, bench)
+share one ``OccupancyExchange``; cross-process replicas exchange rows
+over the bulk gRPC service's ``ExchangeOccupancy`` method.
+"""
+
+from .membership import FleetMembership, shard_index
+from .occupancy import (
+    COMMITTED,
+    PENDING,
+    NodeRow,
+    OccupancyExchange,
+    PeerView,
+    PodRow,
+    decode_rows,
+    encode_rows,
+)
+from .reconciler import CrossShardReconciler
+from .ring import HashRing, RingNode, ring_nodes_from
+from .runtime import FleetConfig, FleetRuntime
+
+__all__ = [
+    "COMMITTED",
+    "PENDING",
+    "CrossShardReconciler",
+    "FleetConfig",
+    "FleetMembership",
+    "FleetRuntime",
+    "HashRing",
+    "NodeRow",
+    "OccupancyExchange",
+    "PeerView",
+    "PodRow",
+    "RingNode",
+    "decode_rows",
+    "encode_rows",
+    "ring_nodes_from",
+    "shard_index",
+]

@@ -1,0 +1,317 @@
+"""Cross-shard occupancy exchange: the compact rows fleet replicas
+trade before committing placements, so cross-shard
+``PodTopologySpread`` / inter-pod anti-affinity stay enforceable
+without a global lock.
+
+A replica's shard-filtered cache (state/cluster.py filtered watch)
+deliberately contains ONLY its own nodes and pods — peers' placements
+are invisible to it. The exchange is the one channel that crosses the
+partition: each replica publishes
+
+- **node rows** — (node, zone) for every node it owns: the domain
+  inventory peers need to compute global spread skew (an empty peer
+  zone is a min-count domain even though no pod row mentions it);
+- **pod rows** — (pod, node, zone, namespace, labels) for every
+  *label-bearing* pod it has assumed (``pending``) or bound
+  (``committed``) on its shard. Label-free pods can never match a
+  spread selector or an (anti-)affinity term, so they stay off the
+  wire — that is what keeps the rows compact.
+
+Rows are the host-side mirror of the device-resident
+``BatchCarriedUsage`` occupancy carry (solver/exact.py): the same
+"placements earlier in flight count against constraints solved later"
+idea, stretched across replicas instead of chained sub-batches — and
+they ride the same tensorcodec wire framing over the bulk gRPC
+boundary (server/bulk.py ``ExchangeOccupancy``).
+
+Concurrency contract: the hub serializes every mutation under one lock
+and bumps a monotonically increasing ``version``. Admission soundness
+for IN-PROCESS fleets (the sim, tests, the bench, thread-per-replica
+serving) comes from the shared ClusterState lock: every replica's
+``admit`` + ``stage`` run inside it, so two replicas can never both
+admit against the same stale view. Cross-process replicas get the row
+TRANSPORT here (the ``ExchangeOccupancy`` RPC below) but not yet an
+atomic admit — a hub-side compare-and-stage keyed on ``version`` is
+the designed extension point; until it lands, multi-process fleets
+should partition constraint cohorts by zone (the ring's zone affinity
+makes cross-shard spread domains rare by construction).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, replace
+from typing import Iterable, Mapping
+
+import numpy as np
+
+from .. import metrics
+
+PENDING = "pending"
+COMMITTED = "committed"
+
+
+@dataclass(frozen=True)
+class NodeRow:
+    """Domain-inventory row: one owned node and its zone key."""
+
+    node: str
+    zone: str = ""
+
+
+@dataclass(frozen=True)
+class PodRow:
+    """One label-bearing placement a replica holds (assumed or
+    bound)."""
+
+    pod: str  # ns/name key
+    node: str
+    zone: str
+    namespace: str
+    labels: tuple[tuple[str, str], ...]  # sorted items
+    state: str = PENDING  # pending | committed
+
+    @staticmethod
+    def for_pod(pod, node: str, zone: str, state: str = PENDING) -> "PodRow":
+        return PodRow(
+            pod=pod.key,
+            node=node,
+            zone=zone,
+            namespace=pod.namespace,
+            labels=tuple(sorted(pod.labels.items())),
+            state=state,
+        )
+
+
+@dataclass(frozen=True)
+class PeerView:
+    """One consistent snapshot of every OTHER replica's rows, plus the
+    hub version it was taken at — the Conflict-on-stale fence value."""
+
+    version: int
+    node_rows: tuple[NodeRow, ...]
+    pod_rows: tuple[PodRow, ...]
+
+
+class OccupancyExchange:
+    """The in-process hub (one per fleet; the sim's replicas share it
+    directly, cross-process deployments reach it through the bulk
+    service's ``ExchangeOccupancy`` RPC). All iteration is sorted so
+    any serialized view is deterministic."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._version = 0
+        # metric children resolved once: stage/commit run per placed
+        # pod on the scheduler's apply path, and the label lookup is
+        # measurable there (ops mirror the metric help string)
+        self._m = {
+            op: metrics.fleet_occupancy_rows_total.labels(op)
+            for op in ("staged", "committed", "withdrawn", "retired",
+                       "handoff")
+        }
+        self._node_rows: dict[str, dict[str, NodeRow]] = {}  # replica -> node -> row
+        self._pod_rows: dict[str, dict[str, PodRow]] = {}  # replica -> pod -> row
+        # pod handoffs: to-replica -> pod key -> hop count. A replica
+        # whose shard cannot legally host a routed pod (persistent
+        # cross-shard conflict) releases it here for the next replica
+        # in the pod's rendezvous chain (fleet/runtime.py).
+        self._handoffs: dict[str, dict[str, int]] = {}
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    # -- publishing --
+
+    def publish_nodes(self, replica: str, rows: Iterable[NodeRow]) -> None:
+        """Replace ``replica``'s domain inventory (called at startup
+        and on every resync — the owned set is replaced wholesale, not
+        diffed, so a missed event can never leave a stale row)."""
+        with self._lock:
+            self._version += 1
+            self._node_rows[replica] = {r.node: r for r in rows}
+
+    def stage(self, replica: str, row: PodRow) -> None:
+        with self._lock:
+            self._version += 1
+            self._pod_rows.setdefault(replica, {})[row.pod] = row
+        self._m["staged"].inc()
+
+    def replace_pod_rows(self, replica: str, rows: Iterable[PodRow]) -> None:
+        """Replace ``replica``'s pod rows wholesale (resync): rows are
+        rebuilt from cluster truth whenever the partition moves, so a
+        pod whose DELETE the shard filter later hides from this
+        replica can never leave a ghost row behind."""
+        with self._lock:
+            self._version += 1
+            self._pod_rows[replica] = {r.pod: r for r in rows}
+
+    def commit(self, replica: str, pod_key: str) -> None:
+        with self._lock:
+            row = self._pod_rows.get(replica, {}).get(pod_key)
+            if row is None or row.state == COMMITTED:
+                return
+            self._version += 1
+            self._pod_rows[replica][pod_key] = replace(row, state=COMMITTED)
+        self._m["committed"].inc()
+
+    def withdraw(self, replica: str, pod_key: str) -> None:
+        with self._lock:
+            if self._pod_rows.get(replica, {}).pop(pod_key, None) is None:
+                return
+            self._version += 1
+        self._m["withdrawn"].inc()
+
+    def retire(self, replica: str) -> None:
+        """Drop a dead replica's rows: its committed placements become
+        visible to the adopting replica through its own resync re-list,
+        so keeping them here would double-count. Unclaimed handoffs
+        addressed to it revert to plain hash routing — the new route
+        owner adopts the pod at its membership-change resync."""
+        with self._lock:
+            had = (
+                bool(self._node_rows.pop(replica, None))
+                | bool(self._pod_rows.pop(replica, None))
+                | bool(self._handoffs.pop(replica, None))
+            )
+            if had:
+                self._version += 1
+        self._m["retired"].inc()
+
+    # -- pod handoffs --
+
+    def hand_off(self, to_replica: str, pod_key: str, hops: int) -> None:
+        with self._lock:
+            self._version += 1
+            self._handoffs.setdefault(to_replica, {})[pod_key] = hops
+        self._m["handoff"].inc()
+
+    def claim_handoffs(self, replica: str) -> list[tuple[str, int]]:
+        """Pop every handoff addressed to ``replica`` (sorted, so
+        claim order is deterministic)."""
+        with self._lock:
+            rows = self._handoffs.pop(replica, None)
+            if not rows:
+                return []
+            self._version += 1
+            return sorted(rows.items())
+
+    def pending_handoff_keys(self) -> set[str]:
+        """Pods released by one replica and not yet claimed by the
+        next — the fleet lost-pod invariant counts these as tracked."""
+        with self._lock:
+            return {
+                k for rows in self._handoffs.values() for k in rows
+            }
+
+    # -- reading --
+
+    def peers_view(self, replica: str) -> PeerView:
+        with self._lock:
+            node_rows = tuple(
+                self._node_rows[r][n]
+                for r in sorted(self._node_rows)
+                if r != replica
+                for n in sorted(self._node_rows[r])
+            )
+            pod_rows = tuple(
+                self._pod_rows[r][p]
+                for r in sorted(self._pod_rows)
+                if r != replica
+                for p in sorted(self._pod_rows[r])
+            )
+            return PeerView(self._version, node_rows, pod_rows)
+
+    def replica_rows(self, replica: str) -> tuple[tuple[NodeRow, ...], tuple[PodRow, ...]]:
+        with self._lock:
+            return (
+                tuple(
+                    self._node_rows.get(replica, {})[n]
+                    for n in sorted(self._node_rows.get(replica, {}))
+                ),
+                tuple(
+                    self._pod_rows.get(replica, {})[p]
+                    for p in sorted(self._pod_rows.get(replica, {}))
+                ),
+            )
+
+
+# -- wire framing (server/tensorcodec.py, the BatchCarriedUsage wire) --
+
+
+def encode_rows(
+    replica: str,
+    version: int,
+    node_rows: Iterable[NodeRow],
+    pod_rows: Iterable[PodRow],
+) -> bytes:
+    """One occupancy payload: row identities/labels in the JSON meta,
+    the numeric columns (pending/committed flags) as wire arrays —
+    the same meta + column framing the bulk solve path uses."""
+    from ..server import tensorcodec
+
+    node_rows = list(node_rows)
+    pod_rows = list(pod_rows)
+    meta = {
+        "replica": replica,
+        "version": int(version),
+        "nodes": [[r.node, r.zone] for r in node_rows],
+        "pods": [
+            [r.pod, r.node, r.zone, r.namespace, [list(kv) for kv in r.labels]]
+            for r in pod_rows
+        ],
+    }
+    committed = np.fromiter(
+        (1 if r.state == COMMITTED else 0 for r in pod_rows),
+        dtype=np.int8,
+        count=len(pod_rows),
+    )
+    return tensorcodec.encode(meta, {"committed": committed})
+
+
+def decode_rows(
+    data: bytes,
+) -> tuple[str, int, list[NodeRow], list[PodRow]]:
+    from ..server import tensorcodec
+
+    meta, arrays = tensorcodec.decode(data)
+    node_rows = [NodeRow(node=n, zone=z) for n, z in meta.get("nodes") or []]
+    committed = arrays.get("committed")
+    pod_rows = []
+    for i, (pod, node, zone, ns, labels) in enumerate(meta.get("pods") or []):
+        pod_rows.append(
+            PodRow(
+                pod=pod,
+                node=node,
+                zone=zone,
+                namespace=ns,
+                labels=tuple((k, v) for k, v in labels),
+                state=(
+                    COMMITTED
+                    if committed is not None and i < len(committed) and committed[i]
+                    else PENDING
+                ),
+            )
+        )
+    return (
+        str(meta.get("replica") or ""),
+        int(meta.get("version") or 0),
+        node_rows,
+        pod_rows,
+    )
+
+
+def ingest_payload(exchange: OccupancyExchange, data: bytes) -> bytes:
+    """Server half of the ``ExchangeOccupancy`` RPC: replace the
+    sender's rows wholesale, reply with the hub's merged view of every
+    OTHER replica (encoded the same way)."""
+    replica, _version, node_rows, pod_rows = decode_rows(data)
+    exchange.publish_nodes(replica, node_rows)
+    with exchange._lock:
+        exchange._version += 1
+        exchange._pod_rows[replica] = {r.pod: r for r in pod_rows}
+    exchange._m["staged"].inc()
+    view = exchange.peers_view(replica)
+    return encode_rows("", view.version, view.node_rows, view.pod_rows)

@@ -1,0 +1,483 @@
+"""FleetRuntime: one replica's view of the active-active fleet, wired
+into the Scheduler.
+
+Responsibilities:
+
+- **partition** — maintain the ring assignment (node -> replica) for
+  the current membership + node set, recomputed synchronously inside
+  the watch filter (under the cluster lock) so ownership answers are
+  never staler than the event stream;
+- **shard-filtered watch** — the predicate passed to
+  ``ClusterState.subscribe(..., filter=...)``: Node events for owned
+  nodes, bound-Pod events for pods on owned nodes (plus the routing
+  replica, so its queue bookkeeping sees external binds), unbound-Pod
+  events for pods the ring routes here; cluster-scoped kinds pass
+  through. The replica's cache therefore IS its shard — the smaller
+  snapshot is where the fleet's pods/s scaling comes from;
+- **resync** — when membership or the partition shifts beyond single
+  delivered events, rebuild cache/queue from cluster truth before the
+  next solve and re-publish the node inventory;
+- **occupancy** — stage/commit/withdraw this replica's label-bearing
+  placements on the exchange, and ``admit()`` each solved placement
+  against peers' rows before it is assumed (fleet/reconciler.py).
+
+Ownership admission is the overcommit fence: even before a resync has
+rebuilt the cache, ``admit`` rejects placements on nodes the current
+assignment no longer grants this replica, so two replicas can never
+both commit onto one node (the no-global-overcommit invariant the
+fleet sim checks).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import metrics
+from ..api.objects import Pod
+from ..state.cluster import ClusterState, Event
+from .membership import FleetMembership, shard_index
+from .occupancy import OccupancyExchange, PodRow, NodeRow, COMMITTED, PENDING
+from .reconciler import CrossShardReconciler, ZONE_KEY
+from .ring import HashRing, RingNode, _h, ring_nodes_from
+
+
+@dataclass
+class FleetConfig:
+    """SchedulerConfig.fleet: turning this on makes the Scheduler one
+    active replica of an N-way fleet instead of the sole owner of the
+    cluster."""
+
+    replica: str  # this replica's identity
+    replicas: tuple[str, ...] = ()  # the configured universe (incl. self)
+    # base lease name for the per-shard LeaderElector identity
+    # (<lease>-shard-<i>, i = rank of the replica in the sorted universe)
+    lease: str = "kubernetes-tpu-scheduler"
+    # the occupancy exchange hub. In-process fleets (the sim, tests, the
+    # bench A/B) share one OccupancyExchange; cross-process replicas use
+    # a client for the bulk service's ExchangeOccupancy RPC. None =
+    # private hub (single-replica fleet degenerates gracefully).
+    exchange: object = None
+    # production liveness: poll peers' per-shard leases every
+    # lease_poll_s seconds and flip membership when one goes stale
+    # (utils/leaderelection.py shard= + membership.refresh_from_leases).
+    # Off by default: in-process fleets (the sim, tests) drive
+    # membership explicitly via set_alive, and polling a lease-less
+    # store would mark every peer dead.
+    lease_membership: bool = False
+    lease_poll_s: float = 2.0
+
+    def __post_init__(self) -> None:
+        if not self.replicas:
+            self.replicas = (self.replica,)
+        self.replicas = tuple(sorted(set(self.replicas) | {self.replica}))
+
+
+class FleetRuntime:
+    def __init__(
+        self, config: FleetConfig, cluster: ClusterState, clock
+    ) -> None:
+        self.config = config
+        self.cluster = cluster
+        self.clock = clock
+        self.replica = config.replica
+        self.exchange: OccupancyExchange = (
+            config.exchange
+            if config.exchange is not None
+            else OccupancyExchange()
+        )
+        self.membership = FleetMembership(config.replicas, config.replica)
+        self.ring = HashRing(self.membership.universe)
+        # alive-subset ring, cached per membership version: routes_pod
+        # runs inside the watch filter for every pod event, and
+        # rebuilding the ring there would tax the whole ingest path
+        self._alive_ring = self.ring
+        self._alive_ring_version = self.membership.version
+        self.reconciler = CrossShardReconciler(config.replica)
+        self.shard = shard_index(self.membership.universe, config.replica)
+        self.lease_name = f"{config.lease}-shard-{self.shard}"
+        # node -> replica, recomputed on every Node event and membership
+        # change. Reads/writes happen under cluster.lock (the watch
+        # filter and the scheduler's apply phase both hold it).
+        self._assignment: dict[str, str] = {}  # ktpu: guarded-by(cluster.lock)
+        self._needs_resync = False  # ktpu: guarded-by(cluster.lock)
+        self._seen_membership_version = self.membership.version
+        # cross-shard retry wakeup: pods parked by a reconcile conflict
+        # have no waking watch event when a PEER's occupancy changes
+        # (peer placements are invisible to this replica's informer by
+        # design). Track rejections and the exchange version; when the
+        # exchange has moved since the last conflict, the next cycle
+        # requeues parked pods for another admission attempt.
+        self._conflicts_since_wake = 0  # ktpu: guarded-by(cluster.lock)
+        self._wake_version = self.exchange.version
+        # pod-routing overrides (the handoff protocol): a pod this
+        # replica released to a peer no longer routes here even though
+        # the hash says so, and a pod claimed from a peer routes here
+        # even though the hash says otherwise. Maintained under
+        # cluster.lock; swept against cluster truth on every resync.
+        self._routed_away: set[str] = set()  # ktpu: guarded-by(cluster.lock)
+        self._routed_here: dict[str, int] = {}  # key -> hops  # ktpu: guarded-by(cluster.lock)
+        # consecutive reconcile rejections per pod — the handoff
+        # trigger (>= _HANDOFF_AFTER with an alive peer to take it)
+        self._reject_counts: dict[str, int] = {}  # ktpu: guarded-by(cluster.lock)
+        # per-shard lease poll throttle (config.lease_membership)
+        self._last_lease_poll = float("-inf")
+        with cluster.lock:
+            self._recompute(cluster.list_nodes())
+        metrics.fleet_replicas.set(len(self.membership.alive()))
+
+    _HANDOFF_AFTER = 2
+
+    # -- partition maintenance --
+
+    def _ring_alive(self) -> HashRing:
+        if self._alive_ring_version != self.membership.version:
+            self._alive_ring = self.ring.with_alive(
+                self.membership.alive()
+            )
+            self._alive_ring_version = self.membership.version
+        return self._alive_ring
+
+    # callers hold the cluster lock (watch filter, init, set_alive): ktpu: holds(cluster.lock)
+    def _recompute(self, nodes) -> None:
+        """Rebuild the assignment; flag a resync when any node other
+        than freshly added/deleted ones changed owner relative to this
+        replica (those moves have no dedicated watch event)."""
+        ring = self._ring_alive()
+        new = ring.assign(ring_nodes_from(nodes))
+        old = self._assignment
+        if old:
+            for name in set(old) & set(new):
+                mine_before = old[name] == self.replica
+                mine_after = new[name] == self.replica
+                if mine_before != mine_after:
+                    self._needs_resync = True
+        self._assignment = new
+
+    # reads the assignment the filter maintains under the lock: ktpu: holds(cluster.lock)
+    def owns_node(self, name: str) -> bool:
+        return self._assignment.get(name) == self.replica
+
+    # same locked callers as owns_node: ktpu: holds(cluster.lock)
+    def routes_pod(self, pod_key: str) -> bool:
+        if pod_key in self._routed_here:
+            return True
+        if pod_key in self._routed_away:
+            return False
+        return self._ring_alive().route(pod_key) == self.replica
+
+    def set_alive(self, replicas) -> bool:
+        """Membership transition (the sim's replica_loss driver; the
+        production path calls refresh_membership below). Flags a
+        resync; the scheduler applies it before its next solve."""
+        changed = self.membership.set_alive(replicas)
+        if changed:
+            with self.cluster.lock:
+                self._recompute(self.cluster.list_nodes())
+                self._needs_resync = True
+            metrics.fleet_replicas.set(len(self.membership.alive()))
+        return changed
+
+    def refresh_membership(self) -> bool:
+        """Poll peers' per-shard leases (production liveness)."""
+        changed = self.membership.refresh_from_leases(
+            self.cluster, self.config.lease, self.clock.now()
+        )
+        if changed:
+            with self.cluster.lock:
+                self._recompute(self.cluster.list_nodes())
+                self._needs_resync = True
+            metrics.fleet_replicas.set(len(self.membership.alive()))
+        return changed
+
+    # -- the shard-filtered watch predicate --
+
+    # ClusterState._emit calls this under its lock: ktpu: holds(cluster.lock)
+    def event_filter(self, ev: Event) -> bool:
+        if ev.kind == "Node":
+            # keep the partition current BEFORE answering ownership —
+            # an add/delete changes K, so the capped fill can move
+            # other nodes too (flagged for resync by _recompute)
+            owned_before = self.owns_node(ev.obj.name)
+            self._recompute(self.cluster.list_nodes())
+            if ev.type == "DELETED":
+                # deliver to the previous owner so its cache drops the
+                # node (the new assignment no longer mentions it)
+                return owned_before
+            return self.owns_node(ev.obj.name)
+        if ev.kind == "Pod":
+            pod = ev.obj
+            if pod.node_name:
+                # bound: the owning replica maintains its cache; the
+                # routing replica also listens so its queue/in-flight
+                # bookkeeping sees external binds of pods it tracked
+                return self.owns_node(pod.node_name) or self.routes_pod(
+                    pod.key
+                )
+            return self.routes_pod(pod.key)
+        # cluster-scoped kinds (DRA objects, Events, ...) pass through
+        return True
+
+    # -- resync --
+
+    def maybe_resync(self, scheduler) -> bool:
+        """Apply a pending partition change: rebuild the shard-scoped
+        cache and queue from cluster truth, invalidate in-flight
+        solves, re-publish the node inventory. Called by both
+        scheduling loops before popping a batch."""
+        if self.config.lease_membership:
+            # production liveness: a dead peer's shard lease going
+            # stale is the membership signal (the sim drives set_alive
+            # directly instead)
+            now = self.clock.now()
+            if now - self._last_lease_poll >= self.config.lease_poll_s:
+                self._last_lease_poll = now
+                self.refresh_membership()
+        with self.cluster.lock:
+            # adopt pods peers handed off to this replica (sorted,
+            # deterministic): the claim makes this replica the pod's
+            # route owner, so its watch events flow here from now on
+            for key, hops in self.exchange.claim_handoffs(self.replica):
+                try:
+                    ns, name = key.split("/", 1)
+                    pod = self.cluster.get_pod(ns, name)
+                except Exception:
+                    continue  # deleted while in handoff flight
+                if pod.node_name:
+                    continue  # bound while in handoff flight
+                self._routed_here[key] = hops
+                self._routed_away.discard(key)
+                if (
+                    key not in scheduler.queue.entries()
+                    and key not in scheduler._in_flight
+                    and key not in scheduler._waiting
+                    and pod.scheduler_name in scheduler.solvers
+                ):
+                    scheduler.queue.add(pod)
+            if self._conflicts_since_wake:
+                version = self.exchange.version
+                if version != self._wake_version:
+                    # peers' occupancy moved since this replica parked
+                    # pods on reconcile conflicts: give them another
+                    # admission attempt (backoff still applies)
+                    self._wake_version = version
+                    self._conflicts_since_wake = 0
+                    scheduler.queue.move_all_to_active_or_backoff(
+                        "FleetOccupancyExchange"
+                    )
+            if (
+                not self._needs_resync
+                and self._seen_membership_version == self.membership.version
+            ):
+                return False
+            self._needs_resync = False
+            self._seen_membership_version = self.membership.version
+            self._resync_locked(scheduler)
+        return True
+
+    # ktpu: holds(cluster.lock)
+    def _resync_locked(self, scheduler) -> None:
+        metrics.fleet_resyncs_total.inc()
+        owned = {
+            n for n, r in self._assignment.items() if r == self.replica
+        }
+        cache = scheduler.cache
+        # drop nodes (and their pods) that left the shard
+        for name in sorted(set(cache.nodes) - owned):
+            cache.remove_node(name)
+        # adopt nodes that joined the shard, with their bound pods
+        pods = self.cluster.list_pods()
+        for node in self.cluster.list_nodes():
+            if node.name in owned and node.name not in cache.nodes:
+                cache.add_node(node)
+        known_nodes = {
+            n for n, info in cache.nodes.items() if info.node is not None
+        }
+        tracked = scheduler.queue.entries()
+        for pod in pods:
+            if pod.node_name:
+                if (
+                    pod.node_name in known_nodes
+                    and pod.key
+                    not in cache.nodes[pod.node_name].pods
+                ):
+                    cache.add_pod(pod)
+                continue
+            # unbound: adopt pods now routed here (a dead replica's
+            # orphans), shed pods routed away
+            routed = self.routes_pod(pod.key)
+            is_tracked = (
+                pod.key in tracked
+                or pod.key in scheduler._in_flight
+                or pod.key in scheduler._waiting
+            )
+            if routed and not is_tracked:
+                if pod.scheduler_name in scheduler.solvers:
+                    scheduler.queue.add(pod)
+            elif not routed and pod.key in tracked:
+                scheduler.queue.delete(pod.key)
+        # rebuild this replica's pod ROWS from cluster truth: a node
+        # that changed owner takes its pods' future DELETE events to
+        # the NEW owner's filter, so withdraw() would never fire here
+        # and a ghost row would distort peers' admission forever
+        # (review-caught). Committed rows = labeled pods bound on
+        # currently-owned nodes; pending rows survive only while this
+        # replica still assumes the pod.
+        fresh_rows = []
+        node_zone = {
+            n.name: n.labels.get(ZONE_KEY, "")
+            for n in self.cluster.list_nodes()
+            if self._assignment.get(n.name) == self.replica
+        }
+        for pod in pods:
+            if pod.labels and pod.node_name in node_zone:
+                fresh_rows.append(
+                    PodRow.for_pod(
+                        pod, pod.node_name,
+                        node_zone[pod.node_name], COMMITTED,
+                    )
+                )
+        for pod_key in list(cache._assumed):
+            node = cache.pod_node(pod_key)
+            if node in node_zone:
+                info = cache.nodes.get(node)
+                q = info.pods.get(pod_key) if info is not None else None
+                if q is not None and q.labels:
+                    fresh_rows.append(
+                        PodRow.for_pod(q, node, node_zone[node], PENDING)
+                    )
+        self.exchange.replace_pod_rows(self.replica, fresh_rows)
+        # sweep routing overrides and reject counts against cluster
+        # truth (bound/deleted pods need no routing state)
+        live_unbound = {p.key for p in pods if not p.node_name}
+        self._routed_away &= live_unbound
+        self._routed_here = {
+            k: v for k, v in self._routed_here.items() if k in live_unbound
+        }
+        self._reject_counts = {
+            k: v
+            for k, v in self._reject_counts.items()
+            if k in live_unbound
+        }
+        # in-flight deferred solves were computed against the old shard
+        scheduler._conflict_seq += 1
+        scheduler._occupancy_seq += 1
+        self.publish_inventory()
+        metrics.fleet_owned_nodes.set(len(owned))
+        scheduler._refresh_pending_gauge()
+
+    # -- occupancy --
+
+    # called from locked regions of the scheduler: ktpu: holds(cluster.lock)
+    def publish_inventory(self) -> None:
+        rows = [
+            NodeRow(node=n.name, zone=n.labels.get(ZONE_KEY, ""))
+            for n in self.cluster.list_nodes()
+            if self._assignment.get(n.name) == self.replica
+        ]
+        self.exchange.publish_nodes(self.replica, rows)
+
+    def _zone_of(self, cache, node_name: str) -> str:
+        info = cache.nodes.get(node_name)
+        if info is None or info.node is None:
+            return ""
+        return info.node.labels.get(ZONE_KEY, "")
+
+    @staticmethod
+    def _needs_reconcile(pod: Pod) -> bool:
+        """Does this pod carry a constraint whose scope can cross the
+        shard boundary (hard topology spread, required anti-affinity)?
+        Everything else is fully enforced by the shard-local solve."""
+        if any(
+            c.when_unsatisfiable == "DoNotSchedule"
+            for c in pod.topology_spread_constraints
+        ):
+            return True
+        anti = (
+            pod.affinity.pod_anti_affinity
+            if pod.affinity is not None
+            else None
+        )
+        return anti is not None and bool(anti.required)
+
+    # called from _apply_group's locked apply phase: ktpu: holds(cluster.lock)
+    def admit(self, pod: Pod, node_name: str, cache) -> str | None:
+        """Pre-assume fleet admission: ownership fence first (the
+        no-global-overcommit guarantee), then the cross-shard
+        constraint recheck against peers' occupancy rows."""
+        if not self.owns_node(node_name):
+            metrics.fleet_reconcile_conflicts_total.labels(
+                "ownership"
+            ).inc()
+            return (
+                f"node {node_name} is no longer owned by replica "
+                f"{self.replica} (partition moved)"
+            )
+        if not self._needs_reconcile(pod):
+            # no cross-shard-scoped constraint: ownership (disjoint
+            # shards) is the whole fleet story for this pod — skip the
+            # O(peer rows) view (the bench's plain sustained arm would
+            # otherwise pay it per pod)
+            self._reject_counts.pop(pod.key, None)
+            return None
+        peers = self.exchange.peers_view(self.replica)
+        why = self.reconciler.admit(
+            pod, node_name, self._zone_of(cache, node_name), cache, peers
+        )
+        if why is not None:
+            metrics.fleet_reconcile_conflicts_total.labels(
+                "spread" if "spread" in why else "anti"
+            ).inc()
+            self._conflicts_since_wake += 1
+            self._wake_version = peers.version
+            self._reject_counts[pod.key] = (
+                self._reject_counts.get(pod.key, 0) + 1
+            )
+        else:
+            self._reject_counts.pop(pod.key, None)
+        return why
+
+    # called from the scheduler's admit-reject branch under
+    # cluster.lock: ktpu: holds(cluster.lock)
+    def maybe_hand_off(self, pod: Pod) -> str | None:
+        """After _HANDOFF_AFTER consecutive reconcile rejections,
+        release the pod to the next alive replica in its rendezvous
+        chain — its shard may be able to host what this one legally
+        cannot (e.g. the under-filled spread domain lives there). Hop
+        counts cap the walk at one lap of the fleet; a pod the whole
+        fleet rejected parks unschedulable wherever it stands. Returns
+        the receiving replica, or None to keep the pod local."""
+        key = pod.key
+        if self._reject_counts.get(key, 0) < self._HANDOFF_AFTER:
+            return None
+        alive = self.membership.alive()
+        if len(alive) < 2:
+            return None
+        hops = self._routed_here.get(key, 0)
+        if hops + 1 >= len(alive):
+            return None  # walked the whole fleet: stay parked here
+        chain = sorted(alive, key=lambda r: (-_h("pod", key, r), r))
+        target = chain[(chain.index(self.replica) + 1) % len(chain)]
+        if target == self.replica:
+            return None
+        self.exchange.hand_off(target, key, hops + 1)
+        self._routed_here.pop(key, None)
+        self._routed_away.add(key)
+        self._reject_counts.pop(key, None)
+        return target
+
+    # called from _apply_group's locked apply phase: ktpu: holds(cluster.lock)
+    def stage(self, pod: Pod, node_name: str, cache) -> None:
+        if not pod.labels:
+            return  # label-free pods can never match a selector/term
+        self.exchange.stage(
+            self.replica,
+            PodRow.for_pod(
+                pod, node_name, self._zone_of(cache, node_name), PENDING
+            ),
+        )
+
+    def commit(self, pod_key: str) -> None:
+        self.exchange.commit(self.replica, pod_key)
+
+    def withdraw(self, pod_key: str) -> None:
+        self.exchange.withdraw(self.replica, pod_key)

@@ -170,6 +170,50 @@ d2h_bytes_total = Counter(
     registry=REGISTRY,
 )
 
+# -- fleet tier (kubernetes_tpu/fleet) --
+
+fleet_replicas = Gauge(
+    "scheduler_fleet_replicas",
+    "Alive replicas in this replica's fleet membership view "
+    "(fleet/membership.py; the configured universe is static).",
+    registry=REGISTRY,
+)
+fleet_owned_nodes = Gauge(
+    "scheduler_fleet_owned_nodes",
+    "Nodes the ring partition currently assigns to this replica's "
+    "shard (fleet/ring.py).",
+    registry=REGISTRY,
+)
+fleet_resyncs_total = Counter(
+    "scheduler_fleet_resyncs_total",
+    "Shard resyncs: the partition moved (membership change or "
+    "ring remap) and the replica rebuilt its shard-scoped cache and "
+    "queue from cluster truth.",
+    registry=REGISTRY,
+)
+fleet_occupancy_rows_total = Counter(
+    "scheduler_fleet_occupancy_rows_total",
+    "Occupancy-exchange row operations, by op "
+    "(staged|committed|withdrawn|retired|handoff).",
+    ["op"],
+    registry=REGISTRY,
+)
+fleet_reconcile_conflicts_total = Counter(
+    "scheduler_fleet_reconcile_conflicts_total",
+    "Placements the cross-shard reconciliation rejected pre-assume, "
+    "by constraint family (ownership|spread|anti); the pods retried "
+    "through the ordinary requeue machinery.",
+    ["constraint"],
+    registry=REGISTRY,
+)
+bulk_retry_total = Counter(
+    "scheduler_bulk_retry_total",
+    "Transient bulk-gRPC call failures retried by BulkClient's "
+    "bounded exponential backoff, by method.",
+    ["method"],
+    registry=REGISTRY,
+)
+
 # -- scheduling trace layer (kubernetes_tpu/obs) --
 
 trace_spans_total = Counter(
@@ -218,7 +262,7 @@ sim_invariant_violations_total = Counter(
     "scheduler_sim_invariant_violations_total",
     "Invariant violations the simulator's checkers flagged, by "
     "invariant (double_bind|capacity|lost_pod|progress|monotonic|"
-    "constraint|journal).",
+    "constraint|journal|global_overcommit).",
     ["invariant"],
     registry=REGISTRY,
 )

@@ -160,6 +160,10 @@ class PodDecisionJournal:
             from collections import deque
 
             self.lines = deque(maxlen=capacity)
+        # constant fields merged into every record (e.g. the fleet
+        # replica identity) — set once at wiring time, before any
+        # record is written, so same-seed runs stay byte-identical
+        self.tags: dict = {}
 
     def record(
         self,
@@ -197,6 +201,8 @@ class PodDecisionJournal:
             rec["attempts"] = attempts
         if nominated:
             rec["nominated"] = nominated
+        if self.tags:
+            rec.update(self.tags)
         self.lines.append(canonical(rec))
         metrics.journal_records_total.labels(outcome).inc()
         if self.recorder is not None:

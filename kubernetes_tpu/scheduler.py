@@ -107,6 +107,15 @@ class SchedulerConfig:
     # None = all off; the hot path then pays one attribute check per
     # would-be span and zero journal work.
     obs: object = None
+    # fleet mode (kubernetes_tpu/fleet): a FleetConfig making this
+    # scheduler ONE active replica of an N-way fleet. The replica's
+    # informer stream is shard-filtered (its cache and snapshot hold
+    # only the nodes its ring partition owns, and only the pending
+    # pods the ring routes to it), every solved placement passes the
+    # cross-shard occupancy admission before it is assumed, and
+    # label-bearing placements are published to the fleet's occupancy
+    # exchange. None = the classic sole-owner scheduler.
+    fleet: object = None
 
 
 class _Rejected(Exception):
@@ -259,6 +268,22 @@ class Scheduler:
         self.obs, self.journal, self.flight = build_obs(
             self.config.obs, self.clock
         )
+        # fleet runtime (kubernetes_tpu/fleet): partition view, shard
+        # watch filter, occupancy exchange client. Built before the
+        # initial informer sync so the sync itself is shard-scoped.
+        self.fleet = None
+        self._span_tags: dict = {}
+        if self.config.fleet is not None:
+            from .fleet.runtime import FleetRuntime
+
+            self.fleet = FleetRuntime(
+                self.config.fleet, cluster, self.clock
+            )
+            # fleet-tagged observability: every journal record and the
+            # per-batch root span carry the replica identity
+            self._span_tags = {"replica": self.fleet.replica}
+            if self.journal is not None:
+                self.journal.tags["replica"] = self.fleet.replica
         import logging
 
         self._log = logging.getLogger("kubernetes_tpu.scheduler")
@@ -402,19 +427,37 @@ class Scheduler:
 
         # initial informer sync (WaitForCacheSync equivalent) — atomic with
         # the subscription so a concurrent writer can't slip an object
-        # between the list and the watch start
+        # between the list and the watch start. Fleet replicas sync (and
+        # subscribe) shard-scoped: owned nodes, pods bound on them, and
+        # the pending pods the ring routes here.
         with cluster.lock:
             for node in cluster.list_nodes():
-                self.cache.add_node(node)
+                if self.fleet is None or self.fleet.owns_node(node.name):
+                    self.cache.add_node(node)
             for pod in cluster.list_pods():
                 if pod.node_name:
-                    self.cache.add_pod(pod)
+                    if self.fleet is None or self.fleet.owns_node(
+                        pod.node_name
+                    ):
+                        self.cache.add_pod(pod)
                 else:
+                    if self.fleet is not None and not self.fleet.routes_pod(
+                        pod.key
+                    ):
+                        continue
                     if pod.nominated_node_name:
                         self.nominated_pods[pod.key] = pod
                     if pod.scheduler_name in self.solvers:
                         self.queue.add(pod)
-            cluster.subscribe(self._on_event)
+            cluster.subscribe(
+                self._on_event,
+                filter=self.fleet.event_filter
+                if self.fleet is not None
+                else None,
+            )
+            if self.fleet is not None:
+                self.fleet.publish_inventory()
+                metrics.fleet_owned_nodes.set(len(self.cache.nodes))
 
     # -- eventhandlers.go#addAllEventHandlers routing --
 
@@ -530,6 +573,11 @@ class Scheduler:
                 if pod.node_name:
                     freed_node = pod.node_name
                     self.cache.remove_pod(pod.key)
+                    if self.fleet is not None:
+                        # drop this replica's occupancy row (no-op on
+                        # the non-owning replicas that also saw the
+                        # event — withdraw only pops own rows)
+                        self.fleet.withdraw(pod.key)
                     # freed ports / spread counts / interpod terms: for
                     # the fit carry a free is conservative, but a spread
                     # count overstated in the MIN domain loosens other
@@ -681,7 +729,8 @@ class Scheduler:
             return self._schedule_cycle()
         try:
             with self.obs.span(
-                "schedule_batch", trace_id=step, step=step
+                "schedule_batch", trace_id=step, step=step,
+                **self._span_tags,
             ) as sp:
                 res = self._schedule_cycle()
                 sp.set(
@@ -711,6 +760,11 @@ class Scheduler:
     def _schedule_cycle(self) -> BatchResult:
         pending: list[tuple] = []
         res = BatchResult()
+        if self.fleet is not None:
+            # apply any pending partition change (membership or
+            # ring move) before popping, so this cycle solves against
+            # the current shard
+            self.fleet.maybe_resync(self)
         t0 = self.clock.perf()
         with self.cluster.lock, self.obs.span("pop") as sp:
             # WaitOnPermit analog: settle WaitingPods whose verdict or
@@ -779,6 +833,14 @@ class Scheduler:
             base = self.queue.scheduling_cycle
             for info in infos:
                 if info.key not in handled:
+                    if self.fleet is not None and not self.fleet.routes_pod(
+                        info.key
+                    ):
+                        # handed off to a peer earlier in this batch:
+                        # requeueing locally would double-track the pod
+                        # (the peer claims it from the exchange)
+                        self._in_flight.pop(info.key, None)
+                        continue
                     self._requeue(info, base)
             self._refresh_pending_gauge()
 
@@ -1601,6 +1663,48 @@ class Scheduler:
                         )
                     continue
                 node_name = prep.names[int(a)]
+                if self.fleet is not None:
+                    # cross-shard admission (fleet/reconciler.py):
+                    # ownership fence + occupancy recheck against
+                    # peers' exchanged rows. A rejection is the
+                    # fleet's Conflict-on-stale: requeue and retry,
+                    # never block the fleet. The device session's
+                    # carry counted the placement, so it heals before
+                    # the next dispatch.
+                    fleet_why = self.fleet.admit(pod, node_name, self.cache)
+                    if fleet_why is not None:
+                        self._session_stale.add(profile)
+                        handed_to = self.fleet.maybe_hand_off(pod)
+                        if handed_to is not None:
+                            # released to a peer whose shard may host
+                            # it: drop every local claim on the pod
+                            # (its watch events now route to the peer)
+                            self._in_flight.pop(pod.key, None)
+                            self.queue.delete(pod.key)
+                            if self.journal is not None:
+                                self.journal.record(
+                                    prep.step, cycle, pod, "discarded",
+                                    node=node_name, profile=profile,
+                                    reason=(
+                                        f"handed off to {handed_to}: "
+                                        + fleet_why
+                                    ),
+                                    attempts=info.attempts,
+                                )
+                            continue
+                        res.unschedulable.append(pod.key)
+                        self._requeue(info, cycle)
+                        self._event(
+                            pod, "FailedScheduling", fleet_why,
+                            type_="Warning",
+                        )
+                        if self.journal is not None:
+                            self.journal.record(
+                                prep.step, cycle, pod, "unschedulable",
+                                node=node_name, reason=fleet_why,
+                                profile=profile, attempts=info.attempts,
+                            )
+                        continue
                 try:
                     self.cache.assume_pod(pod, node_name)
                 except Exception as e:  # cache inconsistency: requeue
@@ -1616,6 +1720,12 @@ class Scheduler:
                             attempts=info.attempts,
                         )
                     continue
+                if self.fleet is not None:
+                    # publish the assumed placement to the occupancy
+                    # exchange so peers' admissions count it; every
+                    # rollback path routes through _unreserve_all,
+                    # which withdraws the row
+                    self.fleet.stage(pod, node_name, self.cache)
 
                 # Reserve point: in-tree volumebinding Reserve
                 # (AssumePodVolumes) then out-of-tree ReservePlugins in
@@ -1815,6 +1925,8 @@ class Scheduler:
             p.unreserve(state, pod, node_name)
         self.volume_binder.unreserve(pod.key)
         self.claim_allocator.unreserve(pod.key)
+        if self.fleet is not None:
+            self.fleet.withdraw(pod.key)
         try:
             self.cache.forget_pod(pod.key)
         except Exception:
@@ -1895,6 +2007,11 @@ class Scheduler:
             self.cache.finish_binding(pod.key)
             self.volume_binder.finish(pod.key)
             self.claim_allocator.finish(pod.key)
+            if self.fleet is not None:
+                # pending -> committed on the exchange: the row now
+                # represents durable occupancy peers must respect
+                # until the pod is deleted
+                self.fleet.commit(pod.key)
             self._event(
                 pod, "Scheduled",
                 f"Successfully assigned {pod.key} to {node_name}",
@@ -2575,6 +2692,13 @@ class Scheduler:
         batches = 0
         try:
             while batches < max_batches:
+                if self.fleet is not None and self.fleet.maybe_resync(
+                    self
+                ):
+                    # the partition moved: in-flight solves are fenced
+                    # stale (resync bumped both fences) — drain so
+                    # they discard before the next shard-scoped pop
+                    drain()
                 if self._waiting:
                     drain()
                     # WaitingPod settlement is a synchronous cycle: it

@@ -102,7 +102,7 @@ class BulkCore:
     """Method implementations as bytes -> bytes functions (testable without
     a socket, like ExtenderCore's dict -> dict handlers)."""
 
-    def __init__(self, cluster: ClusterState, solver_config=None):
+    def __init__(self, cluster: ClusterState, solver_config=None, exchange=None):
         self.cluster = cluster
         self._lock = threading.Lock()
         from ..solver.evaluate import BatchEvaluator
@@ -112,6 +112,10 @@ class BulkCore:
         self.exact = ExactSolver(solver_config)
         self.evaluator = BatchEvaluator(solver_config)
         self.single_shot = SingleShotSolver()
+        # fleet occupancy hub (fleet/occupancy.py): lazily created on
+        # the first ExchangeOccupancy call unless an in-process fleet
+        # shares its hub explicitly
+        self.exchange = exchange
 
     # -- helpers --
 
@@ -234,6 +238,21 @@ class BulkCore:
             {"assignments": np.asarray(assignments, dtype=np.int32)},
         )
 
+    def exchange_occupancy(self, data: bytes) -> bytes:
+        """Fleet cross-shard occupancy exchange (fleet/occupancy.py):
+        the sender's node inventory + pod rows replace its previous
+        view on the hub; the reply carries the merged rows of every
+        OTHER replica, framed the same way. One unary call per
+        reconcile refresh — compact by construction (label-bearing
+        placements only)."""
+        from ..fleet.occupancy import OccupancyExchange, ingest_payload
+
+        with self._lock:
+            if self.exchange is None:
+                self.exchange = OccupancyExchange()
+            exchange = self.exchange
+        return ingest_payload(exchange, data)
+
     def evaluate(self, data: bytes) -> bytes:
         meta, arrays = tensorcodec.decode(data)
         from ..tensorize.interpod import trivial_interpod_tensors
@@ -292,6 +311,7 @@ def make_grpc_server(core: BulkCore, port: int = 0, host: str = "127.0.0.1"):
             "SyncNodes": unary(core.sync_nodes),
             "Solve": unary(core.solve),
             "Evaluate": unary(core.evaluate),
+            "ExchangeOccupancy": unary(core.exchange_occupancy),
         },
     )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
@@ -313,12 +333,45 @@ def serve_bulk(
     return server
 
 
-class BulkClient:
-    """Thin client for tests/benchmarks: columnar in, columnar out."""
+# transient gRPC status codes worth retrying: the server is alive but
+# this call lost (connection churn, queue overflow, deadline) — the
+# request is idempotent on the bulk surface (SyncNodes upserts, Solve
+# without commit is advisory, ExchangeOccupancy replaces wholesale)
+_RETRYABLE_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED")
 
-    def __init__(self, target: str):
+
+class BulkClient:
+    """Columnar in, columnar out — now with production-grade call
+    hygiene: every RPC carries a deadline, and transient failures
+    (UNAVAILABLE / DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED, plus broken
+    connections) retry with bounded exponential backoff, counted by
+    ``scheduler_bulk_retry_total``. A call that keeps failing raises
+    the last error — the caller sees exactly one exception after the
+    budget, not a raw flake on the first blip.
+
+    ``Solve`` with ``commit=True`` is NOT blindly idempotent (a lost
+    reply can leave bindings committed), so commit calls do not
+    retry; the per-pod ``commitErrors`` map is the recovery surface.
+    """
+
+    def __init__(
+        self,
+        target: str,
+        *,
+        retries: int = 3,
+        deadline_s: float = 30.0,
+        backoff_base_s: float = 0.05,
+        clock=None,
+    ):
         import grpc
 
+        from ..utils.clock import Clock
+
+        self._grpc = grpc
+        self.retries = max(int(retries), 0)
+        self.deadline_s = float(deadline_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self._clock = clock or Clock()
         ident = lambda b: b  # noqa: E731
         self._channel = grpc.insecure_channel(target)
         self._solve = self._channel.unary_unary(
@@ -336,6 +389,40 @@ class BulkClient:
             request_serializer=ident,
             response_deserializer=ident,
         )
+        self._exchange = self._channel.unary_unary(
+            f"/{SERVICE}/ExchangeOccupancy",
+            request_serializer=ident,
+            response_deserializer=ident,
+        )
+
+    def _retryable(self, err: Exception) -> bool:
+        if isinstance(err, ConnectionError):
+            return True
+        if isinstance(err, self._grpc.RpcError):
+            code = getattr(err, "code", lambda: None)()
+            return code is not None and code.name in _RETRYABLE_CODES
+        return False
+
+    def _call(self, method: str, fn, payload: bytes, retry: bool = True):
+        """One deadline-bounded RPC with bounded-backoff retries on
+        transient errors."""
+        attempts = self.retries + 1 if retry else 1
+        last = None
+        for attempt in range(attempts):
+            if attempt:
+                from .. import metrics
+
+                metrics.bulk_retry_total.labels(method).inc()
+                self._clock.sleep(
+                    self.backoff_base_s * (2 ** (attempt - 1))
+                )
+            try:
+                return fn(payload, timeout=self.deadline_s)
+            except Exception as e:
+                if not self._retryable(e):
+                    raise
+                last = e
+        raise last
 
     def sync_nodes(self, names, cpu_milli, mem_bytes, max_pods=None, labels=None):
         arrays = {
@@ -347,7 +434,9 @@ class BulkClient:
         meta = {"names": list(names)}
         if labels is not None:
             meta["labels"] = list(labels)
-        reply = self._sync(tensorcodec.encode(meta, arrays))
+        reply = self._call(
+            "SyncNodes", self._sync, tensorcodec.encode(meta, arrays)
+        )
         return tensorcodec.decode(reply)[0]
 
     def solve(self, cpu_milli, mem_bytes, priority=None, mode="exact",
@@ -365,7 +454,12 @@ class BulkClient:
             # commit fallback namespace for bare (un-prefixed) names;
             # "ns/name"-shaped names carry their own
             meta["namespace"] = namespace
-        reply = self._solve(tensorcodec.encode(meta, arrays))
+        reply = self._call(
+            "Solve", self._solve, tensorcodec.encode(meta, arrays),
+            # a committing solve mutates cluster state: a lost REPLY
+            # would make the retry double-create — surface the error
+            retry=not commit,
+        )
         return tensorcodec.decode(reply)
 
     def evaluate(self, cpu_milli, mem_bytes, priority=None):
@@ -375,8 +469,22 @@ class BulkClient:
         }
         if priority is not None:
             arrays["priority"] = np.asarray(priority, dtype=np.int32)
-        reply = self._eval(tensorcodec.encode({}, arrays))
+        reply = self._call(
+            "Evaluate", self._eval, tensorcodec.encode({}, arrays)
+        )
         return tensorcodec.decode(reply)
+
+    def exchange_occupancy(self, replica, version, node_rows, pod_rows):
+        """Fleet occupancy exchange round trip: publish this replica's
+        rows, return (version, peer node rows, peer pod rows)."""
+        from ..fleet.occupancy import decode_rows, encode_rows
+
+        reply = self._call(
+            "ExchangeOccupancy", self._exchange,
+            encode_rows(replica, version, node_rows, pod_rows),
+        )
+        _replica, v, nodes, pods = decode_rows(reply)
+        return v, nodes, pods
 
     def close(self):
         self._channel.close()

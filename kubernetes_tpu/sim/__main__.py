@@ -81,6 +81,72 @@ def _print_result(res) -> None:
         print("  invariants: OK")
 
 
+def _print_fleet_result(res) -> None:
+    s = res.summary
+    print(
+        f"profile={res.profile} seed={res.seed} cycles={res.cycles} "
+        f"fleet={res.replicas} alive={s['alive']} "
+        f"lost={s['lost_replica'] or '-'}"
+    )
+    print(
+        f"  events={s['events']} bound={s['bound']} "
+        f"unbound={s['unbound']} settled={s['settled']} "
+        f"binds_by_replica={s['binds_by_replica']}"
+    )
+    for rid in sorted(res.journal_digests):
+        print(f"  journal[{rid}]={res.journal_digests[rid]}")
+    if res.violations:
+        print(f"  {len(res.violations)} INVARIANT VIOLATION(S):")
+        for v in res.violations[:20]:
+            print(f"    [{v.invariant}] cycle {v.cycle}: {v.detail}")
+    else:
+        print("  invariants: OK")
+
+
+def _run_fleet(args) -> int:
+    from .fleet import run_fleet_sim
+
+    try:
+        res = run_fleet_sim(
+            args.profile, seed=args.seed, cycles=args.cycles,
+            replicas=args.fleet,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    _print_fleet_result(res)
+    if args.journal:
+        from pathlib import Path
+
+        for rid, lines in sorted(res.journals.items()):
+            path = f"{args.journal}.{rid}"
+            Path(path).write_text("\n".join(lines) + "\n")
+            print(f"  journal written: {path}")
+    if args.selfcheck:
+        res2 = run_fleet_sim(
+            args.profile, seed=args.seed, cycles=args.cycles,
+            replicas=args.fleet,
+        )
+        if res.journal_digests != res2.journal_digests:
+            print(
+                "NON-DETERMINISTIC: per-replica journal digests differ "
+                f"({res.journal_digests} vs {res2.journal_digests})",
+                file=sys.stderr,
+            )
+            return 1
+        if res.bindings != res2.bindings:
+            print(
+                "NON-DETERMINISTIC: final bindings differ",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            "  selfcheck: two runs produced byte-identical per-replica "
+            "journals"
+        )
+    return 0 if res.ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m kubernetes_tpu.sim",
@@ -124,6 +190,15 @@ def main(argv=None) -> int:
         "--selfcheck", action="store_true",
         help="run twice and verify the traces are byte-identical",
     )
+    parser.add_argument(
+        "--fleet", type=int, default=0, metavar="N",
+        help="drive N active scheduler replicas sharding the cluster "
+        "(sim/fleet.py): shard-filtered watches, occupancy exchange, "
+        "no-global-overcommit + fleet journal invariants. 0 = the "
+        "single-scheduler drive; use with the fleet_mixed / "
+        "replica_loss profiles. --selfcheck byte-compares per-replica "
+        "journal digests across two runs.",
+    )
     parser.add_argument("--list-profiles", action="store_true")
     args = parser.parse_args(argv)
 
@@ -136,6 +211,8 @@ def main(argv=None) -> int:
         return 0
 
     _configure_jax(args.mesh_devices)
+    if args.fleet:
+        return _run_fleet(args)
     from .harness import replay_trace, run_sim
     from .trace import TraceError
 

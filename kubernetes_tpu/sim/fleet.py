@@ -1,0 +1,358 @@
+"""Multi-scheduler fleet drive: N REAL Schedulers sharding one REAL
+ClusterState through the watch bus, on one virtual timeline.
+
+The single-scheduler harness (sim/harness.py) validates the engine's
+concurrency story for one process; this drive validates the fleet
+tier's (kubernetes_tpu/fleet): every replica subscribes with its
+shard filter, solves its own partition, exchanges occupancy rows
+through one shared in-process hub, and hands off pods it cannot
+legally host. After every cycle the fleet-wide invariants run:
+
+- **no-global-overcommit** (the tentpole's flagship check): every
+  bind each replica reported landed on a node the ring assigned to
+  that replica at the time, and global per-node capacity holds across
+  all replicas' commits;
+- the single-scheduler checks (double-bind, constraints, monotonic
+  counters) over the shared cluster state;
+- **fleet lost-pod**: every unbound routed pod is tracked by SOME
+  replica's queue/in-flight/waiting maps or by a pending handoff row;
+- **fleet journal completeness** (at the end): each pod's merged
+  journal history — across every replica it traversed — ends on a
+  terminal outcome.
+
+The ``replica_loss`` profile kills one replica mid-drive
+(unsubscribe + stop driving + retire its exchange rows, exactly what
+a process crash looks like to the others); the survivors' membership
+flip re-owns its shard and adopts its orphaned pods.
+
+Determinism: same contract as the single harness — one thread,
+FakeClock, string-seeded RNG, sorted iteration, round-robin replica
+drive order — so same seed + profile produce byte-identical
+per-replica journals (the ci.sh fleet smoke byte-compares the
+digests).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass, field
+
+from .. import metrics
+from ..fleet import FleetConfig, OccupancyExchange
+from ..obs import ObsConfig
+from ..scheduler import Scheduler, SchedulerConfig
+from ..solver.exact import ExactSolverConfig
+from ..state.cluster import ClusterState
+from ..utils.clock import FakeClock
+from .generators import ChurnGenerator, apply_event
+from .invariants import (
+    BindTransitionTracker,
+    MonotonicCounters,
+    Violation,
+    _record,
+    check_constraints,
+    check_fleet_journal_completeness,
+    check_no_global_overcommit,
+)
+from .profiles import Profile, get_profile
+
+
+@dataclass
+class FleetSimResult:
+    profile: str
+    seed: int
+    cycles: int
+    replicas: int
+    bindings: dict[str, str]  # pod key -> node (final)
+    unbound: list[str]
+    violations: list[Violation]
+    settled: bool
+    summary: dict
+    # per-replica decision journals (canonical JSONL) + digests
+    journals: dict[str, list[str]] = field(default_factory=dict)
+    journal_digests: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and self.settled
+
+
+def _digest(lines: list[str]) -> str:
+    return hashlib.sha256(("\n".join(lines) + "\n").encode()).hexdigest()
+
+
+class FleetSimHarness:
+    def __init__(
+        self,
+        profile: Profile | str,
+        seed: int = 0,
+        cycles: int = 10,
+        replicas: int | None = None,
+        *,
+        pipelined: bool | None = None,
+        max_settle_rounds: int = 12,
+    ) -> None:
+        self.profile = (
+            get_profile(profile) if isinstance(profile, str) else profile
+        )
+        self.profile.validate()
+        if self.profile.watch_delay or self.profile.external_bind_rate:
+            raise ValueError(
+                f"profile {self.profile.name}: the fleet drive needs "
+                "prompt delivery and no external binds (the ownership "
+                "invariant and the fleet≡single equivalence both lean "
+                "on it)"
+            )
+        self.seed = seed
+        self.cycles = cycles
+        self.n = replicas or self.profile.fleet_replicas or 2
+        self.pipelined = (
+            self.profile.pipelined if pipelined is None else pipelined
+        )
+        self.max_settle_rounds = max_settle_rounds
+        # the same "{seed}/gen" stream as the single-scheduler harness:
+        # with no external binds/shrinks the event stream is identical,
+        # which is what makes fleet-vs-single binding equivalence a
+        # meaningful assertion
+        self._gen_rng = random.Random(f"{seed}/gen")
+        self.clock = FakeClock()
+        self.cluster = ClusterState(clock=self.clock)
+        self.generator = ChurnGenerator(
+            self.profile, self._gen_rng, self.cluster
+        )
+        for node in self.generator.seed_nodes():
+            self.cluster.create_node(node)
+
+        self.exchange = OccupancyExchange()
+        self.universe = tuple(f"r{i}" for i in range(self.n))
+        self.schedulers: dict[str, Scheduler] = {}
+        for rid in self.universe:
+            self.schedulers[rid] = Scheduler(
+                self.cluster,
+                SchedulerConfig(
+                    batch_size=self.profile.batch_size,
+                    mesh_devices=1,
+                    solver=ExactSolverConfig(
+                        tie_break="first",
+                        group_size=self.profile.group_size,
+                    ),
+                    obs=ObsConfig(journal=True),
+                    fleet=FleetConfig(
+                        replica=rid,
+                        replicas=self.universe,
+                        exchange=self.exchange,
+                    ),
+                ),
+                clock=self.clock,
+            )
+        self.alive: dict[str, bool] = {rid: True for rid in self.universe}
+        self.tracker = BindTransitionTracker(self.cluster)
+        self.monotonic = MonotonicCounters()
+        self.violations: list[Violation] = []
+        self._sched_bound: set[str] = set()
+        self._binds_by_replica: dict[str, int] = {
+            rid: 0 for rid in self.universe
+        }
+        self._events_applied = 0
+        self._lost_replica: str | None = None
+
+    # -- drive --
+
+    def _drive_replica(self, rid: str, cycle: int) -> None:
+        sched = self.schedulers[rid]
+        if self.pipelined:
+            results = sched.run_pipelined(max_batches=200)
+        else:
+            results = sched.run_until_settled(max_batches=200)
+        scheduled = [
+            (pod, node) for r in results for pod, node in r.scheduled
+        ]
+        self.tracker.record_results(scheduled)
+        self._sched_bound.update(pod for pod, _ in scheduled)
+        self._binds_by_replica[rid] += len(scheduled)
+        # ownership half of no-global-overcommit: the binds this
+        # replica just reported, against its assignment RIGHT NOW
+        # (single-threaded: nothing moved since the bind committed)
+        with self.cluster.lock:
+            owners = dict(sched.fleet._assignment)
+        check_no_global_overcommit(
+            self.cluster,
+            cycle,
+            self.violations,
+            binds=[(rid, pod, node) for pod, node in scheduled],
+            owners=owners,
+        )
+
+    def _drive(self, cycle: int) -> None:
+        for rid in self.universe:
+            if self.alive[rid]:
+                self._drive_replica(rid, cycle)
+
+    def _kill_replica(self, rid: str, cycle: int) -> None:
+        """A process crash as the rest of the fleet perceives it: the
+        watch subscription vanishes, the shard lease goes stale (the
+        survivors' membership flips), its exchange rows retire. Its
+        journal is retained — the fleet-wide completeness check merges
+        it with the survivors'."""
+        self.alive[rid] = False
+        self._lost_replica = rid
+        dead = self.schedulers[rid]
+        self.cluster.unsubscribe(dead._on_event)
+        self.exchange.retire(rid)
+        survivors = [r for r in self.universe if self.alive[r]]
+        for r in survivors:
+            self.schedulers[r].fleet.set_alive(survivors)
+
+    def _check(self, cycle: int) -> None:
+        self.tracker.drain(cycle, self.violations)
+        check_constraints(self.cluster, cycle, self.violations)
+        self._check_fleet_lost_pods(cycle)
+        self.monotonic.observe(cycle, self.violations)
+
+    def _check_fleet_lost_pods(self, cycle: int) -> None:
+        """Fleet lost-pod accounting: every unbound pod some alive
+        replica routes must be tracked by a queue / in-flight map /
+        WaitingPods map somewhere, or sit in a pending handoff row."""
+        tracked: set[str] = set(self.exchange.pending_handoff_keys())
+        solver_names: set[str] = set()
+        for rid, sched in self.schedulers.items():
+            if not self.alive[rid]:
+                continue
+            tracked |= set(sched.queue.entries())
+            tracked |= set(sched._in_flight)
+            tracked |= set(sched._waiting)
+            solver_names |= set(sched.solvers)
+        for pod in self.cluster.list_pods():
+            if pod.node_name or pod.scheduler_name not in solver_names:
+                continue
+            if pod.key not in tracked:
+                _record(
+                    self.violations, "lost_pod", cycle,
+                    f"pod {pod.key} is unbound but tracked by no alive "
+                    "replica's queue/in-flight/waiting maps nor a "
+                    "pending handoff row",
+                )
+
+    def _settled(self) -> bool:
+        if self.exchange.pending_handoff_keys():
+            return False
+        for rid, sched in self.schedulers.items():
+            if not self.alive[rid]:
+                continue
+            if sched._waiting or sched._in_flight:
+                return False
+            live = set(sched.queue.entries().values())
+            if live & {"active", "backoff"}:
+                return False
+        return True
+
+    def run(self) -> FleetSimResult:
+        for cycle in range(self.cycles):
+            metrics.sim_cycles_total.inc()
+            if cycle == self.profile.replica_loss_at and self.n > 1:
+                self._kill_replica(self.universe[-1], cycle)
+            for ev in self.generator.generate(cycle):
+                apply_event(self.cluster, ev)
+                self._events_applied += 1
+            self.clock.advance(1.0)
+            self._drive(cycle)
+            self._check(cycle)
+        settled = self._quiesce()
+        if not settled:
+            queues = {
+                rid: self.schedulers[rid].queue.pending_counts()
+                for rid in self.universe
+                if self.alive[rid]
+            }
+            _record(
+                self.violations, "progress",
+                self.cycles + self.max_settle_rounds,
+                "fleet failed to quiesce after churn stopped: "
+                f"queues={queues} "
+                f"handoffs={sorted(self.exchange.pending_handoff_keys())}",
+            )
+        return self._finish(settled)
+
+    def _quiesce(self) -> bool:
+        """Same settle ladder as the single harness: 11s rounds clear
+        backoff, one 301s round forces the unschedulable-leftover
+        flush (cross-shard-rejected pods park unschedulable and the
+        flush is their guaranteed retry path once churn stops)."""
+        advances = [11.0, 11.0, 301.0] + [11.0] * max(
+            self.max_settle_rounds - 3, 0
+        )
+        flush_round = 2
+        for i, adv in enumerate(advances):
+            cycle = self.cycles + i
+            self.clock.advance(adv)
+            self._drive(cycle)
+            self._check(cycle)
+            if i >= flush_round and self._settled():
+                return True
+        return False
+
+    def _finish(self, settled: bool) -> FleetSimResult:
+        check_fleet_journal_completeness(
+            self.cluster,
+            list(self.schedulers.values()),
+            self.cycles + self.max_settle_rounds,
+            self.violations,
+            self._sched_bound,
+        )
+        bindings = {
+            p.key: p.node_name
+            for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
+            if p.node_name
+        }
+        unbound = sorted(
+            p.key for p in self.cluster.list_pods() if not p.node_name
+        )
+        journals = {
+            rid: list(s.journal.lines)
+            for rid, s in self.schedulers.items()
+        }
+        digests = {rid: _digest(lines) for rid, lines in journals.items()}
+        summary = {
+            "replicas": self.n,
+            "alive": sum(self.alive.values()),
+            "lost_replica": self._lost_replica,
+            "pipelined": self.pipelined,
+            "events": self._events_applied,
+            "bound": len(bindings),
+            "unbound": len(unbound),
+            "settled": settled,
+            "violations": len(self.violations),
+            "binds_by_replica": dict(
+                sorted(self._binds_by_replica.items())
+            ),
+            "journal_digests": digests,
+        }
+        return FleetSimResult(
+            profile=self.profile.name,
+            seed=self.seed,
+            cycles=self.cycles,
+            replicas=self.n,
+            bindings=bindings,
+            unbound=unbound,
+            violations=self.violations,
+            settled=settled,
+            summary=summary,
+            journals=journals,
+            journal_digests=digests,
+        )
+
+
+def run_fleet_sim(
+    profile: str,
+    seed: int = 0,
+    cycles: int = 10,
+    replicas: int | None = None,
+    *,
+    pipelined: bool | None = None,
+) -> FleetSimResult:
+    """One fresh seeded fleet run (library entry; CLI and tests)."""
+    return FleetSimHarness(
+        profile, seed=seed, cycles=cycles, replicas=replicas,
+        pipelined=pipelined,
+    ).run()

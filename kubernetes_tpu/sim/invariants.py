@@ -39,7 +39,7 @@ from ..state.cluster import ClusterState, Event
 @dataclass(frozen=True)
 class Violation:
     invariant: str  # double_bind | capacity | lost_pod | progress |
-    # monotonic | constraint | journal
+    # monotonic | constraint | journal | global_overcommit
     cycle: int
     detail: str
 
@@ -280,6 +280,119 @@ def check_journal_completeness(
                 violations, "journal", cycle,
                 f"unbound pod {pod.key}'s last journal outcome "
                 f"{rec['outcome']!r} is non-terminal",
+            )
+
+
+def check_no_global_overcommit(
+    cluster: ClusterState,
+    cycle: int,
+    violations: list[Violation],
+    binds: Iterable[tuple[str, str, str]] = (),
+    owners: "dict[str, str] | None" = None,
+) -> None:
+    """The fleet tier's flagship invariant (ISSUE 6): with N active
+    replicas each solving a shard concurrently, the FLEET as a whole
+    must never overcommit a node. Two halves:
+
+    - **disjoint ownership** — every bind a replica reported this
+      drive landed on a node the ring assigned to that replica at the
+      time (``binds`` = (replica, pod key, node), ``owners`` = the
+      node -> replica assignment snapshotted right after the drive).
+      A buggy ring or a stale partition view shows up here even when
+      capacity happens to hold;
+    - **global capacity** — the bound-pod request sum per node never
+      exceeds allocatable, counted across ALL replicas' commits (the
+      single-scheduler capacity check, re-run fleet-wide — two
+      replicas double-booking one node trips this even if each
+      replica's local view was consistent).
+    """
+    if owners is not None:
+        for replica, pod_key, node in binds:
+            actual = owners.get(node)
+            if actual != replica:
+                _record(
+                    violations, "global_overcommit", cycle,
+                    f"replica {replica} bound {pod_key} to node {node} "
+                    f"owned by {actual!r} (shards must be disjoint)",
+                )
+    check_capacity(cluster, cycle, violations)
+
+
+def check_fleet_journal_completeness(
+    cluster: ClusterState,
+    schedulers: list,
+    cycle: int,
+    violations: list[Violation],
+    sched_bound: set[str],
+) -> None:
+    """Journal completeness held FLEET-WIDE: a pod may legitimately
+    traverse several replicas' journals (routed, handed off, adopted
+    after a replica loss), so the invariant merges every replica's
+    records — latest by (t, step), terminal preferred on ties — and
+    requires each owned pod's merged history to end terminally, and
+    each fleet-bound pod to end ``bound``. The blind spot this closes:
+    a replica loss orphaning pods that then never reach a terminal
+    outcome anywhere."""
+    from ..obs.journal import TERMINAL_OUTCOMES
+    import json
+
+    merged: dict[str, dict] = {}
+    for sched in schedulers:
+        if sched.journal is None:
+            continue
+        for line in sched.journal.lines:
+            rec = json.loads(line)
+            cur = merged.get(rec["pod"])
+            key = (
+                rec["t"], 1 if rec["outcome"] in TERMINAL_OUTCOMES else 0,
+                rec["step"],
+            )
+            cur_key = (
+                (
+                    cur["t"],
+                    1 if cur["outcome"] in TERMINAL_OUTCOMES else 0,
+                    cur["step"],
+                )
+                if cur is not None
+                else None
+            )
+            if cur_key is None or key >= cur_key:
+                merged[rec["pod"]] = rec
+    solver_names = set()
+    for sched in schedulers:
+        solver_names |= set(sched.solvers)
+    tracked_entries: dict[str, str] = {}
+    for sched in schedulers:
+        tracked_entries.update(sched.queue.entries())
+    for pod in sorted(cluster.list_pods(), key=lambda p: p.key):
+        rec = merged.get(pod.key)
+        if pod.node_name:
+            if pod.key in sched_bound and (
+                rec is None or rec["outcome"] != "bound"
+            ):
+                _record(
+                    violations, "journal", cycle,
+                    f"fleet-bound pod {pod.key} lacks a terminal "
+                    "'bound' record in any replica's journal (last: "
+                    f"{rec['outcome'] if rec else None})",
+                )
+            continue
+        if pod.scheduler_name not in solver_names:
+            continue
+        if tracked_entries.get(pod.key) == "gated":
+            continue
+        if rec is None:
+            _record(
+                violations, "journal", cycle,
+                f"unbound pod {pod.key} never appeared in any "
+                "replica's decision journal",
+            )
+        elif rec["outcome"] not in TERMINAL_OUTCOMES:
+            _record(
+                violations, "journal", cycle,
+                f"unbound pod {pod.key}'s merged last outcome "
+                f"{rec['outcome']!r} (replica "
+                f"{rec.get('replica', '?')}) is non-terminal",
             )
 
 

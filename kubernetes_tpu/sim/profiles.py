@@ -63,6 +63,12 @@ class Profile:
     permit: bool = False  # register the stalling Permit plugin
     permit_stall_rate: float = 0.0  # P(first attempt of a pod WAITs)
     permit_timeout: float = 5.0
+    # -- fleet mode (sim/fleet.py multi-scheduler drive) --
+    fleet_replicas: int = 0  # default replica count for --fleet runs
+    # kill one replica at this cycle (replica_loss fault): its shard is
+    # re-owned by the survivors and every orphaned pod must still reach
+    # a terminal journal outcome fleet-wide. -1 = never.
+    replica_loss_at: int = -1
 
     def validate(self) -> None:
         if self.watch_delay and (
@@ -162,6 +168,43 @@ PROFILES: dict[str, Profile] = {
             permit_stall_rate=0.5,
             permit_timeout=5.0,
             delete_pod_rate=0.2,
+        ),
+        # fleet mode: two active replicas sharding one cluster through
+        # the watch bus, with a hard-shape mix that exercises the
+        # cross-shard occupancy exchange (spread skew is global) and
+        # the handoff protocol. Node churn stays off so the ownership
+        # half of the no-global-overcommit invariant is exact; pod
+        # deletes churn occupancy rows. Also drivable single-scheduler
+        # (the fleet≡single binding-equivalence test leans on the
+        # event stream being identical either way: no external binds,
+        # no shrinks).
+        Profile(
+            name="fleet_mixed",
+            nodes=9,
+            zones=3,
+            arrivals=(2, 5),
+            pod_spread_rate=0.3,
+            pod_anti_rate=0.15,
+            pod_ports_rate=0.15,
+            delete_pod_rate=0.4,
+            fleet_replicas=2,
+        ),
+        # replica_loss: fleet_mixed plus one replica killed mid-drive.
+        # The survivors must re-own its shard (ring orphan
+        # redistribution + resync) and every pod it owned — queued,
+        # in-flight, or handed off — must still reach a terminal
+        # journal outcome somewhere in the fleet.
+        Profile(
+            name="replica_loss",
+            nodes=9,
+            zones=3,
+            arrivals=(2, 5),
+            pod_spread_rate=0.3,
+            pod_anti_rate=0.15,
+            pod_ports_rate=0.15,
+            delete_pod_rate=0.4,
+            fleet_replicas=2,
+            replica_loss_at=4,
         ),
     )
 }

@@ -149,30 +149,41 @@ class ClusterState:
         self.event_ttl = 3600.0  # reference --event-ttl default
         self._events_sweep_at = 256  # next TTL size-sweep threshold
         self._events_last_sweep = 0.0
-        self._watchers: list[Watcher] = []
+        # (watcher, optional event filter) pairs — see subscribe()
+        self._watchers: list[tuple[Watcher, Callable[[Event], bool] | None]] = []
         # fault injection: called with (pod, node_name) before a bind commits;
         # raise ApiError to simulate apiserver-side rejection
         self.bind_fault: Callable[[Pod, str], None] | None = None
 
     # -- watch plumbing --
 
-    def subscribe(self, w: Watcher) -> None:
-        self._watchers.append(w)
+    def subscribe(self, w: Watcher, filter: Callable[[Event], bool] | None = None) -> None:
+        """Register a watcher, optionally behind a server-side event
+        filter — the analog of an apiserver field-selector watch. The
+        fleet tier subscribes each scheduler replica with its
+        shard-filter predicate (fleet/runtime.py#event_filter) so a
+        replica's informer stream — and therefore its cache — covers
+        exactly the nodes and pods its shard owns. Filters run under
+        the cluster lock in commit order, like the watchers they
+        guard."""
+        self._watchers.append((w, filter))
 
     def unsubscribe(self, w: Watcher) -> None:
         """Remove a watcher (bound methods compare equal by func +
         instance, so ``unsubscribe(obj.handler)`` works). The sim's
         fault harness uses this to interpose a delayed/duplicating
         delivery bus between the state service and the scheduler."""
-        try:
-            self._watchers.remove(w)
-        except ValueError:
-            raise ApiError("NotFound", "watcher not subscribed") from None
+        for i, (cb, _flt) in enumerate(self._watchers):
+            if cb == w:
+                del self._watchers[i]
+                return
+        raise ApiError("NotFound", "watcher not subscribed")
 
     def _emit(self, etype: EventType, kind: str, obj: Pod | Node) -> None:
         ev = Event(etype, kind, obj, self._rv)
-        for w in list(self._watchers):
-            w(ev)
+        for w, flt in list(self._watchers):
+            if flt is None or flt(ev):
+                w(ev)
 
     def _next_rv(self) -> int:
         self._rv += 1

@@ -27,6 +27,12 @@ class Clock:
     def perf(self) -> float:
         return time.perf_counter()
 
+    def sleep(self, seconds: float) -> None:
+        """Blocking wait on the clock's timeline (BulkClient's retry
+        backoff); the fake clock advances virtually instead, so
+        backoff paths are testable without real delay."""
+        time.sleep(seconds)
+
 
 class FakeClock(Clock):
     def __init__(self, start: float = 0.0):
@@ -43,3 +49,6 @@ class FakeClock(Clock):
 
     def set(self, t: float) -> None:
         self._now = t
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)

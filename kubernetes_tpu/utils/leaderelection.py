@@ -65,6 +65,14 @@ class LeaderElector:
     retry_period: float = 2.0
     clock: Clock = field(default_factory=Clock)
     is_leader: bool = False
+    # fleet mode (kubernetes_tpu/fleet): per-shard lease identity.
+    # Replica i of an active-active fleet elects on its OWN lease
+    # ``<name>-shard-<i>`` instead of contending with its peers on one
+    # global lease — N replicas hold N leases concurrently, and a
+    # shard lease going stale is exactly the membership signal
+    # FleetMembership.refresh_from_leases reads. None = the classic
+    # single active/passive lease.
+    shard: int | None = None
 
     def __post_init__(self) -> None:
         """leaderelection.go#LeaderElectionConfig validation: the
@@ -88,6 +96,12 @@ class LeaderElector:
                 "lease_duration must exceed renew_deadline "
                 f"({self.lease_duration} <= {self.renew_deadline})"
             )
+        if self.shard is not None:
+            if self.shard < 0:
+                raise ValueError(
+                    f"shard must be non-negative, got {self.shard}"
+                )
+            self.name = f"{self.name}-shard-{self.shard}"
 
     @property
     def _key(self) -> str:

@@ -45,6 +45,19 @@ python -m kubernetes_tpu.sim --seed 1 --cycles 8 --profile churn_heavy \
 python -m kubernetes_tpu.sim --seed 1 --cycles 8 \
     --profile preemption_pressure --selfcheck
 
+echo "== fleet smoke: 2-replica sharded drive =="
+# two active replicas sharding one cluster (shard-filtered watches,
+# cross-shard occupancy exchange, handoff protocol) under the
+# fleet_mixed hard-shape churn, with the no-global-overcommit and
+# fleet journal-completeness invariants enabled; --selfcheck re-runs
+# the drive and byte-compares the per-replica journal digests. The
+# replica_loss run kills one replica mid-drive and requires its shard
+# re-owned with every orphaned pod reaching a terminal outcome.
+python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile fleet_mixed \
+    --fleet 2 --selfcheck
+python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile replica_loss \
+    --fleet 2
+
 echo "== multichip: 8-device forced-host mesh smoke =="
 # sharded-vs-unsharded exact-path equivalence on an 8-way virtual CPU
 # mesh (conftest.py forces the device count before jax initializes):
