@@ -31,6 +31,13 @@ Measures the FULL BASELINE.md target ladder (VERDICT r2 #3):
      horizontal scaling (ladder #7 owns device scaling, and N
      processes cannot share one TPU). Emits fleet_pods_per_sec +
      fleet_speedup (hoisted to the top level).
+  #9 Degraded-mode A/B (kubernetes_tpu/resilience): the same sustained
+     open-loop workload at the top fallback-ladder tier vs pinned to
+     the pure-host serial-greedy rung (force_tier="host") — the floor
+     the scheduler degrades to when every accelerator tier's breaker
+     is open. Emits degraded_pods_per_sec (hoisted to the top level)
+     + degradation_factor, so the cost of degradation is a measured
+     number.
 
 Each ladder reports steady-state (warm-start) pods/s, best of 3 full
 passes — compiles happen in a same-shaped warmup pass (persistent compile
@@ -183,7 +190,7 @@ def _run_ladder(
             tb = time.perf_counter()
             r = sched.schedule_batch()
             n = len(r.scheduled)
-            if not (r.scheduled or r.unschedulable or r.bind_failures):
+            if not r.progressed:
                 break
             batch_times.append((time.perf_counter() - tb, n))
             solve_s += r.solve_seconds
@@ -245,6 +252,8 @@ def _sustained_shape(
     batch: int = 2_048,
     group: int = 256,
     split: int = 4,
+    resilience=None,  # ResilienceConfig override (ladder #9's forced
+    # host-greedy arm); None = defaults (top tier)
 ) -> dict:
     """One open-loop sustained-arrival run: pods arrive at ``rate``/s
     while the scheduler drains concurrently — pipelined
@@ -275,6 +284,7 @@ def _sustained_shape(
                 solver=ExactSolverConfig(
                     tie_break="random", group_size=group
                 ),
+                resilience=resilience,
             ),
         )
         return cs, sched
@@ -327,9 +337,7 @@ def _sustained_shape(
                 res.measured_pods += n
                 res.pod_latencies.extend(r.e2e_latencies)
             prev_at = at
-            made_progress = made_progress or bool(
-                r.scheduled or r.unschedulable or r.bind_failures
-            )
+            made_progress = made_progress or r.progressed
         if created >= n_pods and not made_progress:
             break  # drained (or only stuck pods remain)
     res.measure_seconds = time.perf_counter() - t0
@@ -493,9 +501,7 @@ def _fleet_replica_worker(
                 completions.append((time.time(), n))
                 latencies.extend(r.e2e_latencies)
             unschedulable += len(r.unschedulable)
-            progressed = progressed or bool(
-                r.scheduled or r.unschedulable or r.bind_failures
-            )
+            progressed = progressed or r.progressed
         if created >= n_pods and not progressed and not sched.pending:
             break
     out_q.put(
@@ -619,6 +625,45 @@ def ladder8_fleet(n_replicas: int = 4) -> dict:
         "fleet": fleet,
         "fleet_pods_per_sec": fleet["fleet_pods_per_sec"],
         "fleet_speedup": speedup,
+    }
+
+
+def ladder9_degraded() -> dict:
+    """#9: degraded-mode A/B (kubernetes_tpu/resilience) — sustained
+    pods/s at the TOP ladder tier vs the same workload pinned to the
+    pure-host serial-greedy rung (ResilienceConfig.force_tier="host"),
+    so the cost of full degradation is a measured number, not a guess.
+    The host rung is the fallback ladder's floor: what the scheduler
+    still delivers when every accelerator tier's breaker is open. The
+    shape is kept small — the host rung is O(pods x nodes x plugins)
+    Python per batch, and the point is the RATIO, not the absolute."""
+    from kubernetes_tpu.resilience import ResilienceConfig
+
+    shape = dict(
+        kind="plain", n_nodes=200, n_pods=1_000, rate=8_000.0,
+        batch=256, group=64, split=1,
+    )
+    top = _sustained_shape(pipelined=True, **shape)
+    host = _sustained_shape(
+        pipelined=True,  # force_tier routes every batch through the
+        # synchronous resilient cycle either way; keeping the flag
+        # equal keeps the arrival/drive loop identical for the A/B
+        resilience=ResilienceConfig(force_tier="host"),
+        **shape,
+    )
+    degraded = host["sustained_pods_per_sec"]
+    return {
+        "config": (
+            "open-loop sustained arrival, top ladder tier vs forced "
+            "host-greedy tier (ResilienceConfig.force_tier='host'), "
+            f"{shape['n_pods']} pods x {shape['n_nodes']} nodes"
+        ),
+        "top": top,
+        "host": host,
+        "degraded_pods_per_sec": degraded,
+        "degradation_factor": round(
+            top["sustained_pods_per_sec"] / max(degraded, 1e-9), 3
+        ),
     }
 
 
@@ -1201,6 +1246,8 @@ def main() -> None:
     ladders["7_multichip"] = multichip
     fleet = ladder8_fleet()
     ladders["8_fleet"] = fleet
+    degraded = ladder9_degraded()
+    ladders["9_degraded"] = degraded
     ladders["served_grpc_5kx1k"] = served_grpc()
     ladders["tunnel"] = {
         "pre_first_read_dispatch_ms": round(pre_read_ms, 3),
@@ -1246,6 +1293,11 @@ def main() -> None:
                 # and its speedup over the 1-replica arm
                 "fleet_pods_per_sec": fleet["fleet_pods_per_sec"],
                 "fleet_speedup": fleet["fleet_speedup"],
+                # ladder #9 hoist: sustained pods/s on the fallback
+                # ladder's pure-host floor — what degraded mode costs
+                "degraded_pods_per_sec": degraded[
+                    "degraded_pods_per_sec"
+                ],
                 "vs_baseline": round(headline / BAND_TOP_PODS_PER_SEC, 2),
                 "baseline_note": (
                     "vs_baseline divides by the TOP of the reference's "

@@ -36,6 +36,7 @@ DTYPE_PATHS = (
 # MET001 scans these for metric usage against metrics/__init__.py.
 METRIC_SCAN_PATHS = (
     "kubernetes_tpu/scheduler.py",
+    "kubernetes_tpu/resilience.py",
     "kubernetes_tpu/server/",
     "kubernetes_tpu/solver/",
     "kubernetes_tpu/sim/",

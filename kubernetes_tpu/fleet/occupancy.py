@@ -117,6 +117,12 @@ class OccupancyExchange:
         # cross-shard conflict) releases it here for the next replica
         # in the pod's rendezvous chain (fleet/runtime.py).
         self._handoffs: dict[str, dict[str, int]] = {}
+        # replicas whose solve breaker is open (degraded-mode solve
+        # resilience): peers prefer them LAST in rendezvous handoff
+        # chains — don't route refugees to a sick replica. The replica
+        # keeps serving its own shard (the fallback ladder guarantees
+        # forward progress); this flag only shapes cross-shard routing.
+        self._degraded: set[str] = set()
 
     @property
     def version(self) -> int:
@@ -176,9 +182,29 @@ class OccupancyExchange:
                 | bool(self._pod_rows.pop(replica, None))
                 | bool(self._handoffs.pop(replica, None))
             )
+            self._degraded.discard(replica)
             if had:
                 self._version += 1
         self._m["retired"].inc()
+
+    # -- degraded flags (solve-resilience breaker state) --
+
+    def set_degraded(self, replica: str, degraded: bool) -> None:
+        """Publish/clear a replica's degraded flag (its solve circuit
+        breaker tripped / re-closed). Bumps the version so peers'
+        conflict-parked pods re-evaluate their handoff chains."""
+        with self._lock:
+            if degraded == (replica in self._degraded):
+                return
+            if degraded:
+                self._degraded.add(replica)
+            else:
+                self._degraded.discard(replica)
+            self._version += 1
+
+    def degraded_replicas(self) -> frozenset:
+        with self._lock:
+            return frozenset(self._degraded)
 
     # -- pod handoffs --
 

@@ -455,7 +455,15 @@ class FleetRuntime:
         hops = self._routed_here.get(key, 0)
         if hops + 1 >= len(alive):
             return None  # walked the whole fleet: stay parked here
-        chain = sorted(alive, key=lambda r: (-_h("pod", key, r), r))
+        # degraded replicas (open solve breakers, published through the
+        # exchange) sort LAST: refugees route to healthy peers first.
+        # Every replica reads the same flag set, so the chain stays a
+        # fleet-wide consistent rendezvous order.
+        degraded = self.exchange.degraded_replicas()
+        chain = sorted(
+            alive,
+            key=lambda r: (r in degraded, -_h("pod", key, r), r),
+        )
         target = chain[(chain.index(self.replica) + 1) % len(chain)]
         if target == self.replica:
             return None
@@ -464,6 +472,14 @@ class FleetRuntime:
         self._routed_away.add(key)
         self._reject_counts.pop(key, None)
         return target
+
+    def set_solver_degraded(self, degraded: bool) -> None:
+        """Resilience hook (Scheduler wires it to the solve breaker):
+        publish this replica's degraded flag through the exchange so
+        peers prefer it last in handoff chains. The replica keeps
+        serving its shard — the fallback ladder guarantees forward
+        progress — it just stops attracting refugees while sick."""
+        self.exchange.set_degraded(self.replica, degraded)
 
     # called from _apply_group's locked apply phase: ktpu: holds(cluster.lock)
     def stage(self, pod: Pod, node_name: str, cache) -> None:

@@ -148,6 +148,58 @@ pipeline_subbatches_total = Counter(
     "i+1's device solve.",
     registry=REGISTRY,
 )
+batch_failure_total = Counter(
+    "scheduler_batch_failure_total",
+    "Batched solves that failed before applying, by reason "
+    "(tensorize|dispatch|read|corrupt) — each failure requeues or "
+    "bisects the batch through the resilience ladder instead of "
+    "silently dropping it, and journals a non-terminal solver_error "
+    "per pod.",
+    ["reason"],
+    registry=REGISTRY,
+)
+solve_tier = Gauge(
+    "scheduler_tpu_solve_tier",
+    "Fallback-ladder tier the profile's solves currently dispatch at "
+    "(0 = the top tier; higher = more degraded, last = pure-host "
+    "serial greedy).",
+    ["profile"],
+    registry=REGISTRY,
+)
+breaker_state = Gauge(
+    "scheduler_tpu_breaker_state",
+    "Solve circuit-breaker state per profile "
+    "(0 closed | 1 open | 2 half-open probe).",
+    ["profile"],
+    registry=REGISTRY,
+)
+breaker_transitions_total = Counter(
+    "scheduler_tpu_breaker_transitions_total",
+    "Solve circuit-breaker transitions, by kind "
+    "(rebuild|trip|probe|reclose).",
+    ["transition"],
+    registry=REGISTRY,
+)
+fallback_solves_total = Counter(
+    "scheduler_tpu_fallback_solves_total",
+    "Batches solved below the top ladder tier, by tier "
+    "(single|cpu|host).",
+    ["tier"],
+    registry=REGISTRY,
+)
+quarantined_pods_total = Counter(
+    "scheduler_tpu_quarantined_pods_total",
+    "Pods quarantined by poison-batch bisection: the solve fails "
+    "deterministically at every ladder tier only when this pod is in "
+    "the batch.",
+    registry=REGISTRY,
+)
+quarantine_readmits_total = Counter(
+    "scheduler_tpu_quarantine_readmits_total",
+    "Quarantined pods re-admitted to the scheduling queue after their "
+    "TTL'd backoff elapsed.",
+    registry=REGISTRY,
+)
 mesh_devices = Gauge(
     "scheduler_mesh_devices",
     "Devices in the node-axis solve mesh the scheduler dispatches "
@@ -228,14 +280,14 @@ journal_records_total = Counter(
     "scheduler_tpu_trace_journal_records_total",
     "Per-pod decision-journal records written, by outcome "
     "(bound|unschedulable|bind_failure|permit_wait|permit_rejected|"
-    "permit_timeout|discarded).",
+    "permit_timeout|discarded|solver_error|quarantined).",
     ["outcome"],
     registry=REGISTRY,
 )
 flight_recorder_dumps_total = Counter(
     "scheduler_tpu_flight_recorder_dumps_total",
     "Flight-recorder ring dumps, by trigger "
-    "(crash|invariant|manual).",
+    "(crash|invariant|manual|breaker).",
     ["trigger"],
     registry=REGISTRY,
 )
@@ -254,7 +306,7 @@ sim_faults_injected_total = Counter(
     "scheduler_sim_faults_injected_total",
     "Faults the simulator injected at real boundaries, by fault kind "
     "(bind_conflict|watch_delay|watch_duplicate|extender_timeout|"
-    "extender_5xx|permit_stall).",
+    "extender_5xx|permit_stall|solver_fault|poison_pod).",
     ["fault"],
     registry=REGISTRY,
 )
@@ -262,7 +314,7 @@ sim_invariant_violations_total = Counter(
     "scheduler_sim_invariant_violations_total",
     "Invariant violations the simulator's checkers flagged, by "
     "invariant (double_bind|capacity|lost_pod|progress|monotonic|"
-    "constraint|journal|global_overcommit).",
+    "constraint|journal|global_overcommit|resilience).",
     ["invariant"],
     registry=REGISTRY,
 )

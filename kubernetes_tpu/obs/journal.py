@@ -55,12 +55,24 @@ OUTCOMES = frozenset(
         "permit_rejected",
         "permit_timeout",
         "discarded",
+        # a solve-boundary failure (device error / corrupt output /
+        # poison batch) requeued this pod for a retry — the retry
+        # history `explain <pod>` shows (non-terminal)
+        "solver_error",
+        # poison-batch bisection isolated the solve failure to this
+        # pod: it sits out a TTL'd backoff before re-admission
+        "quarantined",
     }
 )
 # a pod whose LAST journal record is one of these has a settled fate for
-# the run; permit_wait and discarded always lead to another attempt
+# the run; permit_wait, discarded, and solver_error always lead to
+# another attempt. quarantined IS terminal: the pod's fate is settled
+# and attributable (the re-admit after the TTL starts a new history).
 TERMINAL_OUTCOMES = frozenset(
-    {"bound", "unschedulable", "bind_failure", "permit_rejected", "permit_timeout"}
+    {
+        "bound", "unschedulable", "bind_failure", "permit_rejected",
+        "permit_timeout", "quarantined",
+    }
 )
 
 _REQUIRED_KEYS = ("k", "v", "step", "cycle", "pod", "outcome", "t")

@@ -277,9 +277,7 @@ class PerfRunner:
                 for r in results:
                     prev_at = consume(r, measure, prev_at)
                     got_sched = got_sched or bool(r.scheduled)
-                    got_any = got_any or bool(
-                        r.scheduled or r.unschedulable or r.bind_failures
-                    )
+                    got_any = got_any or r.progressed
                 if not got_any:
                     break
                 if not got_sched:
@@ -348,9 +346,7 @@ class PerfRunner:
                         else [sched.schedule_batch()]
                     ):
                         prev_at = consume(r, measure, prev_at)
-                        made_progress = made_progress or bool(
-                            r.scheduled or r.unschedulable or r.bind_failures
-                        )
+                        made_progress = made_progress or r.progressed
                     if created >= count and not made_progress:
                         break  # drained (or only stuck pods remain)
                 if measure:

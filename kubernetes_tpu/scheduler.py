@@ -27,6 +27,21 @@ from . import metrics
 from .api.objects import Pod
 from .framework.interface import CycleState, StatusCode
 from .framework.runtime import WaitingPod
+from .resilience import (
+    ACT_BISECT,
+    ACT_DESCEND,
+    ACT_REBUILD,
+    TIER_HOST,
+    TIER_MESH,
+    SolveCorruptError,
+    SolveResilience,
+    SolverFaultError,
+    SolverReadError,
+    build_ladder,
+    host_greedy_assign,
+    tier_device_context as _tier_device_context,
+    validate_assignments,
+)
 from .server.extender_client import ExtenderError
 from .solver.exact import (
     DeferredAssignments,
@@ -107,6 +122,14 @@ class SchedulerConfig:
     # None = all off; the hot path then pays one attribute check per
     # would-be span and zero journal work.
     obs: object = None
+    # degraded-mode solve resilience (kubernetes_tpu/resilience): a
+    # ResilienceConfig tuning the fallback ladder (sharded mesh →
+    # single device → CPU backend → pure-host serial greedy), the
+    # per-profile circuit breaker in front of it, pre-apply output
+    # validation, and the poison-batch bisection quarantine. None =
+    # defaults (the layer is always on — it only acts on failures, so
+    # the fault-free hot path is unchanged).
+    resilience: object = None
     # fleet mode (kubernetes_tpu/fleet): a FleetConfig making this
     # scheduler ONE active replica of an N-way fleet. The replica's
     # informer stream is shard-filtered (its cache and snapshot hold
@@ -140,6 +163,10 @@ class BatchResult:
     scheduled: list[tuple[str, str]] = field(default_factory=list)  # (pod, node)
     unschedulable: list[str] = field(default_factory=list)
     bind_failures: list[tuple[str, str]] = field(default_factory=list)  # (pod, err)
+    # pods the poison-batch bisection quarantined this cycle: their
+    # solve failure is isolated and terminal-journaled; they re-admit
+    # after a TTL'd backoff (kubernetes_tpu/resilience)
+    quarantined: list[str] = field(default_factory=list)
     # (pod, nominated node, victim keys) per successful preemption
     preemptions: list[tuple[str, str, list[str]]] = field(default_factory=list)
     solve_seconds: float = 0.0
@@ -152,6 +179,18 @@ class BatchResult:
     # perf_counter when this batch's bindings finished committing; lets
     # throughput collectors sample pods/s across overlapped batches
     completed_at: float = 0.0
+
+    @property
+    def progressed(self) -> bool:
+        """Did this cycle do ANY work a drive loop should keep ticking
+        for? One definition for every drain/settle/bench loop, so a new
+        outcome field can't silently go missing from some call sites."""
+        return bool(
+            self.scheduled
+            or self.unschedulable
+            or self.bind_failures
+            or self.quarantined
+        )
 
 
 @dataclass
@@ -200,6 +239,16 @@ class _PreparedGroup:
     tensorize_seconds: float = 0.0  # host prep cost (set at dispatch)
     unsched_reason: dict = field(default_factory=dict)
     dra_prefold: dict = field(default_factory=dict)
+    # pre-apply validation accumulator (resilience.validate_assignments):
+    # per-slot usage this prep's already-validated flights placed, the
+    # host mirror of the device-resident chain carry. Built lazily on
+    # the first validated flight.
+    validated_usage: object = None
+    # tensorize-duration metrics observed (once per prep: ladder-rung
+    # retries reuse the prep, and re-observing would inflate the
+    # tensorize/PreFilter histograms exactly when operators are
+    # reading them to diagnose an outage)
+    timing_observed: bool = False
 
 
 @dataclass
@@ -395,6 +444,36 @@ class Scheduler:
             int(self.mesh.size) if self.mesh is not None else 1
         )
         metrics.mesh_devices.set(self._mesh_devices)
+        # degraded-mode solve resilience (kubernetes_tpu/resilience):
+        # the fallback ladder + per-profile circuit breaker both
+        # scheduling loops dispatch through, pre-apply output
+        # validation, and the poison-batch quarantine. In fleet mode a
+        # breaker trip publishes the replica's degraded flag through
+        # the occupancy exchange so peers route refugees elsewhere.
+        self.resilience = SolveResilience(
+            self.config.resilience,
+            self.clock,
+            build_ladder(self.mesh is not None),
+            on_degraded=(
+                self.fleet.set_solver_degraded
+                if self.fleet is not None
+                else None
+            ),
+        )
+        # poison-batch quarantine: pod key -> (QueuedPodInfo, release
+        # time). Entries re-admit through _release_quarantine at the
+        # next pop once their TTL'd backoff elapses.
+        self._quarantine: dict[str, tuple] = {}  # ktpu: guarded-by(cluster.lock)
+        self._quarantine_counts: dict[str, int] = {}  # ktpu: guarded-by(cluster.lock)
+        # ladder tier each profile last dispatched at: a tier change
+        # moves the solve to different devices, so the resident session
+        # must re-upload from host truth (driver thread only)
+        self._tier_last: dict[str, str] = {}
+        # sim/fault-injection seam (kubernetes_tpu/sim): called with
+        # (pods, tier) right before every solve attempt at every ladder
+        # tier — dispatch, probe, bisection sub-solve, host rung. May
+        # raise to inject a solver-boundary fault deterministically.
+        self._solve_fault = None
         self.snapshot = Snapshot()
         self.snapshot.pad_multiple = self._mesh_devices
         from .state.volume_binder import VolumeBinder
@@ -767,6 +846,8 @@ class Scheduler:
             self.fleet.maybe_resync(self)
         t0 = self.clock.perf()
         with self.cluster.lock, self.obs.span("pop") as sp:
+            # re-admit quarantined pods whose TTL'd backoff elapsed
+            self._release_quarantine()
             # WaitOnPermit analog: settle WaitingPods whose verdict or
             # deadline arrived since the last cycle, before popping new
             # work
@@ -827,6 +908,7 @@ class Scheduler:
             {e[2].key for e in pending}
             | set(res.unschedulable)
             | {k for k, _ in res.bind_failures}
+            | set(res.quarantined)
             | set(self._waiting)
         )
         with self.cluster.lock:
@@ -941,22 +1023,294 @@ class Scheduler:
         res: BatchResult,
         t0: float,
         pending: list,
+        _depth: int = 0,
     ) -> None:
         """One profile sub-batch, synchronously: tensorize -> fold ->
-        dispatch (blocking read) -> apply. run_pipelined drives the same
-        four phases with a deferred read between dispatch and apply so
-        the next batch's host work overlaps this one's tunnel RTT."""
-        prep = self._tensorize_group(
-            profile, infos, cycle_offsets, base_cycle, t0
-        )
+        dispatch (blocking read) -> validate -> apply. run_pipelined
+        drives the same phases with a deferred read between dispatch
+        and apply so the next batch's host work overlaps this one's
+        tunnel RTT.
+
+        This is also the RESILIENT path (kubernetes_tpu/resilience):
+        every dispatch runs at the tier the fallback ladder currently
+        allows. A solve failure (exception, read death, or pre-apply
+        validation rejecting the output) triggers one device-session
+        rebuild and a retry; a deterministic failure trips the tier's
+        circuit breaker and the batch retries one rung lower, down to
+        the pure-host serial greedy — so a sick device degrades
+        throughput, never progress. A batch that fails even the host
+        rung (or dies in tensorize, which no tier can fix) is
+        data-shaped: it bisects to the offending pod(s), which are
+        quarantined with a terminal journal outcome while the rest of
+        the batch proceeds (``_bisect_or_quarantine``)."""
+        solver = self.solvers[profile]
+        try:
+            prep = self._tensorize_group(
+                profile, infos, cycle_offsets, base_cycle, t0
+            )
+        except Exception as e:
+            # tensorize is tier-independent: no ladder rung can fix a
+            # batch whose data breaks it — isolate the poison instead
+            self._solver_failed(
+                infos, e, "tensorize", self._trace_step, base_cycle
+            )
+            self._bisect_or_quarantine(
+                profile, infos, cycle_offsets, base_cycle, res, t0,
+                pending, e, _depth,
+            )
+            return
         with self.obs.span(
             "fold", trace_id=prep.step, profile=profile,
             extenders=len(self.extender_clients),
             plugins=len(self.config.out_of_tree_plugins),
         ):
+            # extender/plugin folding keeps its own failure semantics
+            # (a non-ignorable extender outage aborts the batch): NOT
+            # wrapped by the ladder
             self._fold_group(prep)
-        flight = self._dispatch_group(prep, defer=False)
-        self._apply_group(flight, res, pending)
+        while True:
+            tier_idx, tier = self.resilience.acquire(profile)
+            act = err = None
+            try:
+                if tier == TIER_HOST:
+                    flight = self._host_dispatch(prep)
+                else:
+                    flight = self._dispatch_group(
+                        prep, defer=False, tier=tier
+                    )
+            except SessionDrainRequired:
+                raise  # pipelined-protocol control flow, not a fault
+            except Exception as e:
+                err = e
+                self._solver_failed(
+                    infos, e, None, prep.step, base_cycle
+                )
+                act = self.resilience.on_failure(profile, tier_idx)
+            else:
+                try:
+                    # pre-apply validation runs inside _apply_group
+                    # BEFORE any mutation: a SolverFaultError here is a
+                    # failed solve, retryable at a lower rung
+                    self._apply_group(flight, res, pending)
+                except SolverFaultError as e:
+                    err = e
+                    self._solver_failed(
+                        infos, e, None, prep.step, base_cycle
+                    )
+                    act = self.resilience.on_failure(profile, tier_idx)
+                else:
+                    self.resilience.on_success(profile, tier_idx)
+                    if tier != self.resilience.ladder[0]:
+                        metrics.fallback_solves_total.labels(tier).inc()
+                    return
+            # breaker span + flight-recorder dump: the trip is the
+            # moment worth a forensic snapshot (the ring still holds
+            # the failing dispatch's spans/decisions)
+            with self.obs.span(
+                "breaker", trace_id=prep.step, profile=profile,
+                tier=tier, action=act,
+            ):
+                pass
+            if act == ACT_DESCEND and self.flight is not None:
+                self.flight.dump(trigger="breaker")
+            if act == ACT_REBUILD:
+                solver.reset_session()
+                continue
+            if act != ACT_BISECT:
+                continue  # retry / descend: re-acquire the tier
+            # the last rung failed: data-shaped — isolate it
+            self._bisect_or_quarantine(
+                profile, infos, cycle_offsets, base_cycle, res, t0,
+                pending, err, _depth,
+            )
+            return
+
+    def _host_dispatch(self, prep: _PreparedGroup) -> _InFlightSolve:
+        """The ladder's last rung: solve the prepared group with the
+        pure-host serial greedy (resilience.host_greedy_assign) —
+        zero accelerator surface, so device loss cannot take it down.
+        Returns a flight shaped exactly like a device dispatch so the
+        apply path downstream is identical."""
+        solver = self.solvers[prep.profile]
+        hook = self._solve_fault
+        if hook is not None:
+            hook(prep.pods, TIER_HOST)
+        t1 = self.clock.perf()
+        with self.cluster.lock:
+            placed = self._placed_by_slot()
+        with self.obs.span(
+            "dispatch", trace_id=prep.step, profile=prep.profile,
+            defer=False, tier=TIER_HOST,
+        ):
+            assignments = host_greedy_assign(
+                prep, placed, solver.config
+            )
+        # the next device-tier dispatch must re-upload the session:
+        # host-rung placements never touched the device carry
+        self._tier_last[prep.profile] = TIER_HOST
+        dispatch_dt = self.clock.perf() - t1
+        if not prep.timing_observed:
+            prep.timing_observed = True
+            prep.tensorize_seconds = max(t1 - prep.gs, 0.0)
+            metrics.tensorize_seconds.observe(prep.tensorize_seconds)
+            metrics.framework_extension_point_duration_seconds.labels(
+                "PreFilter", "Success", prep.profile
+            ).observe(prep.tensorize_seconds)
+        return _InFlightSolve(
+            prep=prep, handle=assignments, dispatch_seconds=dispatch_dt
+        )
+
+    def _solver_failed(
+        self,
+        infos: list[QueuedPodInfo],
+        exc: Exception,
+        reason: str | None,
+        step: int,
+        base_cycle: int,
+    ) -> None:
+        """Journal + count a failed batched solve: a
+        scheduler_batch_failure_total{reason} tick and a non-terminal
+        ``solver_error`` journal record per pod, so `explain <pod>`
+        shows the retry history instead of a silent requeue."""
+        if reason is None:
+            if isinstance(exc, SolveCorruptError):
+                reason = "corrupt"
+            elif isinstance(exc, SolverReadError):
+                reason = "read"
+            else:
+                reason = "dispatch"
+        metrics.batch_failure_total.labels(reason).inc()
+        self._log.warning(
+            "batched solve failed (%s, %d pods): %r",
+            reason, len(infos), exc, extra={"step": step},
+        )
+        if self.journal is not None:
+            for info in infos:
+                self.journal.record(
+                    step, base_cycle, info.pod, "solver_error",
+                    reason=f"{reason}: {exc!r}", attempts=info.attempts,
+                )
+
+    def _bisect_or_quarantine(
+        self,
+        profile: str,
+        infos: list[QueuedPodInfo],
+        cycle_offsets: list[int],
+        base_cycle: int,
+        res: BatchResult,
+        t0: float,
+        pending: list,
+        exc: Exception,
+        depth: int,
+    ) -> None:
+        """Poison-batch isolation: the batch failed every ladder rung
+        (or tensorize itself), so the failure is data-dependent. Bisect
+        to the offending pod(s): each half re-enters the resilient
+        solve, halves without the poison proceed normally, and a
+        singleton that still fails is quarantined with a terminal
+        journal outcome and a TTL'd backoff re-admit."""
+        if len(infos) == 1:
+            self._quarantine_pod(
+                infos[0], base_cycle + cycle_offsets[0] + 1, exc, res
+            )
+            return
+        mid = len(infos) // 2
+        with self.obs.span(
+            "bisect", trace_id=self._trace_step, profile=profile,
+            pods=len(infos), depth=depth,
+        ):
+            for lo, hi in ((0, mid), (mid, len(infos))):
+                self._solve_group(
+                    profile, infos[lo:hi], cycle_offsets[lo:hi],
+                    base_cycle, res, t0, pending, _depth=depth + 1,
+                )
+
+    def _quarantine_pod(
+        self, info: QueuedPodInfo, cycle: int, exc: Exception,
+        res: BatchResult,
+    ) -> None:
+        """Terminal quarantine for a pod whose presence deterministically
+        breaks the solve: journaled ``quarantined`` with the exception,
+        out of every queue, re-admitted after a TTL'd backoff
+        (_release_quarantine)."""
+        cfg = self.resilience.config
+        pod = info.pod
+        with self.cluster.lock:
+            self._in_flight.pop(info.key, None)
+            self.queue.delete(info.key)
+            n = self._quarantine_counts.get(info.key, 0) + 1
+            self._quarantine_counts[info.key] = n
+            ttl = min(
+                cfg.quarantine_ttl * cfg.quarantine_backoff ** (n - 1),
+                cfg.max_quarantine_ttl,
+            )
+            self._quarantine[info.key] = (info, self.clock.now() + ttl)
+            res.quarantined.append(info.key)
+            metrics.quarantined_pods_total.inc()
+            self._log.warning(
+                "pod %s quarantined for %.0fs (quarantine #%d): solve "
+                "failure isolated to this pod: %r",
+                info.key, ttl, n, exc, extra={"step": self._trace_step},
+            )
+            self._event(
+                pod, "FailedScheduling",
+                f"quarantined: the batched solve fails whenever this "
+                f"pod is included: {exc!r}", type_="Warning",
+            )
+            if self.journal is not None:
+                self.journal.record(
+                    self._trace_step, cycle, pod, "quarantined",
+                    reason=repr(exc), attempts=info.attempts,
+                )
+            self._refresh_pending_gauge()
+
+    # called from the locked pop regions of both loops: ktpu: holds(cluster.lock)
+    def _release_quarantine(self) -> None:
+        """Re-admit quarantined pods whose TTL'd backoff elapsed (the
+        retry may succeed — the poison may have been a transient data
+        interaction, a since-fixed webhook, or a healed tier). Pods
+        deleted or bound while quarantined just drop out."""
+        if not self._quarantine:
+            return
+        now = self.clock.now()
+        for key in sorted(self._quarantine):
+            info, release = self._quarantine[key]
+            if release > now:
+                continue
+            del self._quarantine[key]
+            try:
+                ns, name = key.split("/", 1)
+                cur = self.cluster.get_pod(ns, name)
+            except ApiError:
+                self._quarantine_counts.pop(key, None)
+                continue  # deleted while quarantined
+            if cur.node_name:
+                self._quarantine_counts.pop(key, None)
+                continue  # bound by someone else while quarantined
+            info.pod = cur
+            self.queue.requeue_popped(info)
+            metrics.quarantine_readmits_total.inc()
+
+    def _requeue_immediate(self, infos: list[QueuedPodInfo]) -> None:
+        """Requeue a batch whose deferred dispatch failed before any
+        flight existed: head of the active queue, no backoff (the
+        failure is the solve's, not the pods') — the retry routes
+        through the synchronous resilient path. Externally bound or
+        deleted pods drop out (mirrors _discard_flight)."""
+        with self.cluster.lock:
+            for info in infos:
+                self._in_flight.pop(info.key, None)
+                try:
+                    cur = self.cluster.get_pod(
+                        info.pod.namespace, info.pod.name
+                    )
+                except ApiError:
+                    continue
+                if cur.node_name:
+                    continue
+                info.pod = cur
+                self.queue.requeue_popped(info)
+            self._refresh_pending_gauge()
 
     def _tensorize_group(
         self,
@@ -1348,6 +1702,7 @@ class Scheduler:
         defer: bool,
         allow_heal: bool = True,
         split: int = 1,
+        tier: str | None = None,
     ) -> "_InFlightSolve | list[_InFlightSolve]":
         """Upload + launch the device solve. ``defer=False`` blocks on
         the assignment read (the synchronous path); ``defer=True``
@@ -1358,8 +1713,12 @@ class Scheduler:
         ``split > 1`` (deferred only) dispatches the batch as chained
         sub-solves (ExactSolver.solve's RTT-hiding batch split) and
         returns one in-flight solve per sub-batch, all sharing this
-        prep and its fences."""
+        prep and its fences. ``tier`` (the resilient synchronous path)
+        pins the fallback-ladder rung: TIER_MESH/None keep the
+        configured mesh, TIER_SINGLE drops to one device, TIER_CPU
+        additionally forces the CPU backend; None means the top tier."""
         solver = self.solvers[prep.profile]
+        tier_name = tier or self.resilience.ladder[0]
         with self.cluster.lock:
             heal_stale = prep.profile in self._session_stale and allow_heal
             if heal_stale:
@@ -1371,6 +1730,19 @@ class Scheduler:
             # cleared under the lock, the device reset runs outside it
             # (only the drain thread resets sessions)
             solver.reset_session()
+        if self._tier_last.get(prep.profile) != tier_name:
+            # a ladder-tier change moves the solve (and its resident
+            # session state) to a different device set: re-upload from
+            # host truth. Only the drain/sync thread changes tiers, so
+            # no other solve is in flight here.
+            solver.reset_session()
+            self._tier_last[prep.profile] = tier_name
+        hook = self._solve_fault
+        if hook is not None:
+            # sim seam: after the heal bookkeeping (a raise here must
+            # not strand a consumed stale flag), before the solve
+            hook(prep.pods, tier_name)
+        mesh = self.mesh if tier_name == TIER_MESH else None
         t1 = self.clock.perf()
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
@@ -1378,7 +1750,7 @@ class Scheduler:
             "dispatch", trace_id=prep.step, profile=prep.profile,
             defer=defer, healed=heal_stale, split=split,
             mesh_devices=self._mesh_devices,
-        ):
+        ), _tier_device_context(tier_name):
             handle = solver.solve(
                 prep.batch, prep.pbatch, prep.static, prep.ports,
                 prep.spread, prep.interpod,
@@ -1388,16 +1760,19 @@ class Scheduler:
                 defer_read=defer,
                 allow_heal=allow_heal,
                 split=split,
-                mesh=self.mesh,
+                mesh=mesh,
             )
         dispatch_dt = self.clock.perf() - t1
-        prep.tensorize_seconds = max(t1 - prep.gs, 0.0)
-        metrics.tensorize_seconds.observe(prep.tensorize_seconds)
-        # extension-point durations with the reference's metric names:
-        # host tensorization maps to PreFilter (documented, SURVEY §6.5)
-        metrics.framework_extension_point_duration_seconds.labels(
-            "PreFilter", "Success", prep.profile
-        ).observe(prep.tensorize_seconds)
+        if not prep.timing_observed:
+            prep.timing_observed = True
+            prep.tensorize_seconds = max(t1 - prep.gs, 0.0)
+            metrics.tensorize_seconds.observe(prep.tensorize_seconds)
+            # extension-point durations with the reference's metric
+            # names: host tensorization maps to PreFilter (documented,
+            # SURVEY §6.5)
+            metrics.framework_extension_point_duration_seconds.labels(
+                "PreFilter", "Success", prep.profile
+            ).observe(prep.tensorize_seconds)
         if split > 1:
             # chained sub-solves: one flight per sub-batch, sharing the
             # prep. The chain's dispatch wall spreads EVENLY across the
@@ -1472,7 +1847,16 @@ class Scheduler:
         unsched_before = len(res.unschedulable)
         failures_before = len(res.bind_failures)
         tr = self.clock.perf()
-        assignments = flight.assignments()
+        try:
+            assignments = flight.assignments()
+        except Exception as e:
+            # the deferred device→host read itself died (session /
+            # transfer loss after dispatch): surface it as a solver
+            # fault so the resilience layer owns the retry instead of
+            # the loop crashing (kubernetes_tpu/resilience)
+            raise SolverReadError(
+                f"deferred assignment read failed: {e!r}"
+            ) from e
         flight.read_seconds = self.clock.perf() - tr
         solve_dt = flight.dispatch_seconds + flight.read_seconds
         res.solve_seconds += solve_dt
@@ -1495,6 +1879,19 @@ class Scheduler:
             ):
                 asp.set(fence_stale=True)
                 return False  # went stale during the device read
+            if self.resilience.config.validate:
+                # pre-apply output validation (resilience.py): a
+                # silently-corrupt solve is a solve FAILURE feeding the
+                # breaker, never applied. Runs after the fence check so
+                # prep-time capacity can only have been FREED since the
+                # solve (capacity-consuming events discard first) — a
+                # flagged overcommit is always corruption, not churn.
+                why = validate_assignments(
+                    prep, flight.lo, assignments,
+                    disabled=frozenset(solver.config.disabled_filters),
+                )
+                if why is not None:
+                    raise SolveCorruptError(why)
             # phase 2b: apply assignments — assume / Reserve / Permit /
             # PostFilter — atomically with the watch-event consumers
             preempt_placed: dict[int, list[Pod]] | None = None
@@ -2429,7 +2826,7 @@ class Scheduler:
         out = []
         for _ in range(max_batches):
             r = self.schedule_batch()
-            if not (r.scheduled or r.unschedulable or r.bind_failures):
+            if not r.progressed:
                 break
             out.append(r)
         return out
@@ -2557,6 +2954,19 @@ class Scheduler:
                         self.clock.perf() - ta - flight.read_seconds
                     )
                     self._record_metrics(res, len(infos))
+            except SolverFaultError as e:
+                # the solve is the failure (read death / corrupt
+                # output), not the fence: requeue the pods for an
+                # immediate retry and route it through the synchronous
+                # resilient path, where the fallback ladder owns it.
+                # Raised pre-mutation, so the discard is clean.
+                self.resilience.note_async_failure(prep.profile)
+                self._solver_failed(
+                    infos, e, None, prep.step, prep.base_cycle
+                )
+                self._discard_flight(flight)
+                res.completed_at = self.clock.perf()
+                return res
             except Exception:
                 # the fence matched, so _apply_group may have read the
                 # device assignments before dying: the session's carried
@@ -2682,7 +3092,7 @@ class Scheduler:
         def apply_one() -> None:
             f = flights.pop(0)
             r = self._apply_flight(f)
-            if r.scheduled or r.unschedulable or r.bind_failures:
+            if r.progressed:
                 out.append(r)
 
         def drain() -> None:
@@ -2706,14 +3116,13 @@ class Scheduler:
                     metrics.pipeline_mode_total.labels("sync").inc()
                     r = self.schedule_batch()
                     batches += 1
-                    if not (
-                        r.scheduled or r.unschedulable or r.bind_failures
-                    ):
+                    if not r.progressed:
                         break
                     out.append(r)
                     continue
                 t0 = self.clock.perf()
                 with self.cluster.lock:
+                    self._release_quarantine()
                     self.queue.flush_unschedulable_leftover()
                     infos = self.queue.pop_batch(self.config.batch_size)
                     base_cycle = self.queue.scheduling_cycle - len(infos)
@@ -2732,6 +3141,20 @@ class Scheduler:
                 # batch id for this pop's spans/journal (the sync branch
                 # below re-enters via _run_popped, not schedule_batch)
                 self._trace_step += 1
+                if self.resilience.should_sync():
+                    # degraded mode (kubernetes_tpu/resilience): a
+                    # ladder tier is tripped or probing, an async solve
+                    # failure is pending, or the ladder is pinned.
+                    # Deferred dispatch assumes the healthy top tier,
+                    # so the batch routes through the synchronous
+                    # resilient cycle, which owns rebuilds, tier
+                    # descent, probes, and quarantine.
+                    metrics.pipeline_mode_total.labels("sync").inc()
+                    drain()
+                    r = self._run_popped(infos, t0)
+                    if r.progressed:
+                        out.append(r)
+                    continue
                 if self._discard_streak >= self._PIPELINE_FALLBACK_AFTER:
                     # livelock backstop (ADVICE r5 #2): N consecutive
                     # fence discards mean conflicting events are landing
@@ -2756,7 +3179,7 @@ class Scheduler:
                     # backstop counter restarts from real progress
                     self._discard_streak = 0
                     self._last_discard_step = -1
-                    if r.scheduled or r.unschedulable or r.bind_failures:
+                    if r.progressed:
                         out.append(r)
                     continue
                 # profile sub-batches in pop order (multi-profile configs
@@ -2863,12 +3286,30 @@ class Scheduler:
             drain()
         split = self._choose_split(len(infos))
         try:
-            new = self._dispatch(prep, allow_heal=not flights, split=split)
-        except SessionDrainRequired:
-            # node/vocab shape change with a solve still in flight:
-            # apply it, then dispatch with healing
-            drain()
-            new = self._dispatch(prep, allow_heal=True, split=split)
+            try:
+                new = self._dispatch(
+                    prep, allow_heal=not flights, split=split
+                )
+            except SessionDrainRequired:
+                # node/vocab shape change with a solve still in flight:
+                # apply it, then dispatch with healing
+                drain()
+                new = self._dispatch(prep, allow_heal=True, split=split)
+        except Exception as e:
+            # deferred dispatch failed at the top tier
+            # (kubernetes_tpu/resilience): no flight exists, so requeue
+            # the batch for an immediate retry and flag the failure —
+            # the next pop routes it through the synchronous resilient
+            # cycle, where the fallback ladder owns rebuild/descent/
+            # bisection. The session may have consumed a partial
+            # upload: mark it stale so the next dispatch heals.
+            with self.cluster.lock:
+                self._session_stale.add(profile)
+            self.resilience.note_async_failure(profile)
+            self._solver_failed(infos, e, None, prep.step, base_cycle)
+            self._requeue_immediate(infos)
+            owned.pop(0)
+            return
         flights.extend(new)
         # handoff point: from here the flights own this group's pods —
         # a later exception must requeue them via the flight-discard
@@ -2898,8 +3339,14 @@ class Scheduler:
 
     @property
     def pending(self) -> int:
-        """Work the loop must still drive: queued pods AND pods parked at
-        Permit — without the latter, a serve drain loop gated on pending
-        would stop ticking while WaitingPods still need their timeout or
-        verdict settled by the next schedule_batch."""
-        return len(self.queue) + len(self._waiting)
+        """Work the loop must still drive: queued pods, pods parked at
+        Permit, AND quarantined pods — without the latter two, a serve
+        drain loop gated on pending would stop ticking while WaitingPods
+        still need their timeout settled or a quarantine TTL still needs
+        its re-admit, both of which happen at the next cycle's pop."""
+        with self.cluster.lock:
+            return (
+                len(self.queue)
+                + len(self._waiting)
+                + len(self._quarantine)
+            )

@@ -675,10 +675,7 @@ def make_app(
                             await asyncio.sleep(1.0)
                             continue
                         progressed = any(
-                            r.scheduled
-                            or r.unschedulable
-                            or r.bind_failures
-                            for r in results
+                            r.progressed for r in results
                         )
                     if not progressed:
                         # pending may count backoff/unschedulable pods the

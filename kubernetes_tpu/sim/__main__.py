@@ -64,6 +64,19 @@ def _print_result(res) -> None:
         f"fallbacks={s['pipeline_fallbacks']:.0f} "
         f"preemptions={s['preemptions']:.0f}"
     )
+    resil = s.get("resilience")
+    if resil is not None and (
+        s.get("solver_faults") or s.get("poison_hits") or resil["trips"]
+    ):
+        tiers = {
+            name: p["tier"] for name, p in resil["profiles"].items()
+        }
+        print(
+            f"  resilience: faults={s['solver_faults']} "
+            f"poison={s['poison_hits']} trips={resil['trips']} "
+            f"recloses={resil['recloses']} "
+            f"quarantined={len(s['quarantined'])} tier={tiers}"
+        )
     print(
         f"  journal: records={s['journal_records']} "
         f"digest={s['journal_digest'][:16]}"

@@ -13,7 +13,13 @@ them monkeypatch scheduler internals:
   wire seam), so timeout/5xx verdicts travel the real ExtenderError
   paths including the non-ignorable batch abort;
 - ``StallingPermitPlugin`` → a real out-of-tree PermitPlugin, parking
-  pods in the WaitingPods map.
+  pods in the WaitingPods map;
+- ``SolverFaultInjector``   → ``Scheduler._solve_fault`` (the
+  solver-boundary seam, called with (pods, tier) before every solve
+  attempt at every fallback-ladder tier): injected device/runtime
+  errors at device tiers (exercising the circuit breaker + fallback
+  ladder) and poison-pod failures at EVERY tier including host
+  (exercising the bisection quarantine).
 
 Every random draw an injector makes DURING a scheduler run goes through
 the :class:`DecisionJournal`, because the number and order of draws
@@ -232,6 +238,74 @@ class FlakyExtenderTransport:
                 ]
             return {"nodenames": names}
         return []  # prioritize: empty HostPriorityList (no opinion)
+
+
+class SolverFaultInjector:
+    """Installed as ``Scheduler._solve_fault``: raises
+    ``SolverFaultError`` from inside the dispatch path, the one real
+    boundary the sim couldn't previously reach (every other injector
+    sits above ``schedule_batch``).
+
+    Two failure modes:
+
+    - **device faults** (``rate`` within the optional virtual-clock
+      ``window``): raised at every tier EXCEPT the pure-host rung —
+      a real accelerator outage cannot take down host python, and the
+      exemption is what makes "the ladder always has a working floor"
+      testable. Draws are journaled (replay-stable).
+    - **poison pods**: any batch containing a POISON_LABEL-marked pod
+      fails at EVERY tier including host (data that breaks
+      tensorize/solve), deterministically — no RNG, no journal entry —
+      which is exactly the shape the bisection quarantine isolates.
+    """
+
+    def __init__(
+        self,
+        journal: DecisionJournal,
+        rng: random.Random,
+        clock,
+        *,
+        rate: float = 0.0,
+        window: tuple = (),
+    ) -> None:
+        self._journal = journal
+        self._rng = rng
+        self._clock = clock
+        self.rate = rate
+        self.window = tuple(window)
+        self.settling = False
+        self.injected = 0
+        self.poison_hits = 0
+
+    def __call__(self, pods, tier: str) -> None:
+        from ..resilience import TIER_HOST, SolverFaultError
+        from .generators import POISON_LABEL
+
+        poison = sorted(
+            p.key for p in pods if p.labels.get(POISON_LABEL)
+        )
+        if poison:
+            self.poison_hits += 1
+            metrics.sim_faults_injected_total.labels("poison_pod").inc()
+            raise SolverFaultError(
+                f"sim: poison pod(s) {', '.join(poison)} break the "
+                f"solve (tier {tier})"
+            )
+        if tier == TIER_HOST or self.settling or self.rate <= 0:
+            return
+        if self.window:
+            now = self._clock.now()
+            if not (self.window[0] <= now < self.window[1]):
+                return
+        fault = self._journal.decide(
+            "solver_fault", lambda: int(self._rng.random() < self.rate)
+        )
+        if fault:
+            self.injected += 1
+            metrics.sim_faults_injected_total.labels("solver_fault").inc()
+            raise SolverFaultError(
+                f"sim: injected device solve failure (tier {tier})"
+            )
 
 
 class StallingPermitPlugin(PermitPlugin):

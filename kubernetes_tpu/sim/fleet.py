@@ -222,6 +222,9 @@ class FleetSimHarness:
             tracked |= set(sched.queue.entries())
             tracked |= set(sched._in_flight)
             tracked |= set(sched._waiting)
+            # resilience-quarantined pods are parked with a TTL'd
+            # re-admit — tracked, not lost
+            tracked |= set(sched._quarantine)
             solver_names |= set(sched.solvers)
         for pod in self.cluster.list_pods():
             if pod.node_name or pod.scheduler_name not in solver_names:

@@ -41,15 +41,21 @@ def make_node(
 ZONE_KEY = "topology.kubernetes.io/zone"
 HOST_KEY = "kubernetes.io/hostname"
 SIM_PORTS = (8080, 8081)  # small pool: conflicts actually happen
+# poison marker (kubernetes_tpu/resilience poison-batch quarantine):
+# the SolverFaultInjector breaks any solve whose batch contains a pod
+# carrying this label, at every ladder tier
+POISON_LABEL = "sim.kubernetes.io/poison"
 
 
 def make_pod(
     name: str, cpu: str, priority: int = 0, shape: str = "plain",
-    port: int = 0,
+    port: int = 0, poison: bool = False,
 ) -> Pod:
     """``shape``: plain | spread (hard maxSkew=1 zone spread over the
     app=spread cohort) | anti (required hostname anti-affinity over
-    app=anti) | ports (hostPort ``port``)."""
+    app=anti) | ports (hostPort ``port``). ``poison`` marks the pod
+    with POISON_LABEL (its presence breaks the solve — the bisection
+    quarantine's food)."""
     from ..api.wrappers import MakePod
 
     b = MakePod().name(name).req({"cpu": cpu, "memory": "1Gi"})
@@ -65,6 +71,8 @@ def make_pod(
         )
     elif shape == "ports":
         b = b.host_port(port or SIM_PORTS[0])
+    if poison:
+        b = b.label(POISON_LABEL, "1")
     return b.obj()
 
 
@@ -136,12 +144,18 @@ class ChurnGenerator:
             elif p.pod_ports_rate and rng.random() < p.pod_ports_rate:
                 shape = "ports"
                 port = rng.choice(SIM_PORTS)
+            # poison draw guarded on the rate so profiles without it
+            # consume no RNG here (existing traces stay byte-identical)
+            poison = bool(
+                p.poison_rate and rng.random() < p.poison_rate
+            )
             pod = make_pod(
                 self._next_pod_name(),
                 rng.choice(p.pod_cpu_choices),
                 rng.choice(p.pod_priorities),
                 shape=shape,
                 port=port,
+                poison=poison,
             )
             events.append({"op": "create_pod", "pod": pod.to_dict()})
 

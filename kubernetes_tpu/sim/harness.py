@@ -39,6 +39,7 @@ from .faults import (
     DecisionJournal,
     DelayedWatchBus,
     FlakyExtenderTransport,
+    SolverFaultInjector,
     StallingPermitPlugin,
 )
 from .generators import ChurnGenerator, apply_event
@@ -52,6 +53,7 @@ from .invariants import (
     check_constraints,
     check_journal_completeness,
     check_lost_pods,
+    check_resilience,
 )
 from .profiles import Profile, get_profile
 from .trace import TraceReader, TraceWriter
@@ -168,10 +170,18 @@ class SimHarness:
                 ),
             )
         self.flight_dump_path = flight_dump
+        from ..resilience import ResilienceConfig
+
         self.scheduler = Scheduler(
             self.cluster,
             SchedulerConfig(
                 batch_size=self.profile.batch_size,
+                # short breaker fault window so probes and re-closes
+                # land inside the run's virtual timeline (the
+                # resilience invariant asserts the re-close)
+                resilience=ResilienceConfig(
+                    open_seconds=self.profile.resilience_open_s
+                ),
                 # node-axis solve mesh: results are bit-exactly device-
                 # count invariant, so a mesh_devices=N run's trace and
                 # journal must be byte-identical to the single-device run
@@ -219,6 +229,20 @@ class SimHarness:
             self.journal, self._fault_rng, self.profile.bind_fault_rate
         )
         self.cluster.bind_fault = self.bind_injector
+
+        # solver-boundary faults (the one boundary below schedule_batch):
+        # installed on the scheduler's _solve_fault seam, called before
+        # every solve attempt at every fallback-ladder tier
+        self.solver_injector: SolverFaultInjector | None = None
+        if self.profile.solver_fault_rate > 0 or self.profile.poison_rate > 0:
+            self.solver_injector = SolverFaultInjector(
+                self.journal,
+                self._fault_rng,
+                self.clock,
+                rate=self.profile.solver_fault_rate,
+                window=self.profile.solver_fault_window,
+            )
+            self.scheduler._solve_fault = self.solver_injector
 
         self.tracker = BindTransitionTracker(self.cluster)
         self.monotonic = MonotonicCounters()
@@ -283,7 +307,7 @@ class SimHarness:
             except ExtenderError:
                 self._extender_aborts += 1
                 return  # retry next cycle / settle round
-            if not (r.scheduled or r.unschedulable or r.bind_failures):
+            if not r.progressed:
                 return
             self.tracker.record_results(r.scheduled)
             self._sched_bound.update(k for k, _ in r.scheduled)
@@ -380,6 +404,11 @@ class SimHarness:
             self.ext_transport.settling = True
         if self.permit_plugin is not None:
             self.permit_plugin.settling = True
+        if self.solver_injector is not None:
+            # device-fault injection stops (transient outages end);
+            # poison pods keep failing — they are data, not weather,
+            # and must stay terminally quarantined through settle
+            self.solver_injector.settling = True
         self.bus.pump_all()
         # 11s rounds clear max backoff (10s) and permit timeouts; the
         # 301s round forces the unschedulable-leftover flush. The flush
@@ -416,6 +445,16 @@ class SimHarness:
             self._sched_bound,
             undelivered=self.bus.pending_pod_adds(),
         )
+        if self.solver_injector is not None:
+            # solver-boundary chaos acceptance: fallback engaged,
+            # breaker back at the top tier, poison isolated
+            check_resilience(
+                self.scheduler,
+                self.cycles + self.max_settle_rounds,
+                self.violations,
+                device_faults=self.solver_injector.injected,
+                poison_hits=self.solver_injector.poison_hits,
+            )
         bindings = {
             p.key: p.node_name
             for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
@@ -446,6 +485,24 @@ class SimHarness:
             "extender_aborts": self._extender_aborts,
             "permit_stalls": (
                 self.permit_plugin.stalls if self.permit_plugin else 0
+            ),
+            "solver_faults": (
+                self.solver_injector.injected
+                if self.solver_injector
+                else 0
+            ),
+            "poison_hits": (
+                self.solver_injector.poison_hits
+                if self.solver_injector
+                else 0
+            ),
+            # breaker-state footer (the resilience invariant's
+            # assertion target): ladder, trips/recloses/probes, and
+            # the current tier per profile — all python-side counters,
+            # so same-seed runs stay byte-identical
+            "resilience": self.scheduler.resilience.summary(),
+            "quarantined": sorted(
+                self.scheduler._quarantine_counts
             ),
             # the journal digest rides in the footer, so the trace
             # selfcheck also proves journal byte-identity across runs

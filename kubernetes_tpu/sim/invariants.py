@@ -21,6 +21,10 @@ tests/test_sim_invariants.py feeds each one a crafted violation):
   path; spread skew is deliberately unchecked — node churn re-shapes
   domains after placement);
 - ``MonotonicCounters``      — sampled Counter series never decrease;
+- ``check_resilience``       — under injected solver-boundary faults:
+  the fallback ladder engaged (breaker trips), the breaker re-closed
+  to the top tier after the fault window, and poison batches were
+  isolated into quarantine instead of lost;
 - eventual progress is checked by the harness's settle loop (bounded
   rounds of drain + virtual-clock advance), emitting a ``progress``
   violation when the loop fails to quiesce — the livelock detector the
@@ -39,7 +43,7 @@ from ..state.cluster import ClusterState, Event
 @dataclass(frozen=True)
 class Violation:
     invariant: str  # double_bind | capacity | lost_pod | progress |
-    # monotonic | constraint | journal | global_overcommit
+    # monotonic | constraint | journal | global_overcommit | resilience
     cycle: int
     detail: str
 
@@ -215,6 +219,9 @@ def check_lost_pods(
     tracked = set(scheduler.queue.entries())
     tracked |= set(scheduler._in_flight)
     tracked |= set(scheduler._waiting)
+    # quarantined pods are parked by the resilience layer with a TTL'd
+    # re-admit — tracked, not lost
+    tracked |= set(scheduler._quarantine)
     tracked |= undelivered()
     for pod in cluster.list_pods():
         if pod.node_name or pod.scheduler_name not in scheduler.solvers:
@@ -394,6 +401,69 @@ def check_fleet_journal_completeness(
                 f"{rec['outcome']!r} (replica "
                 f"{rec.get('replica', '?')}) is non-terminal",
             )
+
+
+def check_resilience(
+    scheduler,
+    cycle: int,
+    violations: list[Violation],
+    *,
+    device_faults: int = 0,
+    poison_hits: int = 0,
+) -> None:
+    """Degraded-mode resilience invariants, checked after quiescence
+    for profiles that injected solver-boundary faults:
+
+    - **fallback engaged** — injected device faults must have tripped
+      at least one breaker (the ladder actually absorbed the outage;
+      zero trips would mean the faults never reached the solve path);
+    - **breaker re-closed** — once the fault window has passed and the
+      scheduler has settled, every profile must be back at the TOP
+      ladder tier (probes climbed back up; a permanently-degraded
+      scheduler after a transient fault is a resilience bug);
+    - **poison isolated** — poison-pod hits must have produced at
+      least one quarantine (the bisection found the poison instead of
+      infinitely retrying or losing the batch). Terminal journaling of
+      the quarantined pods is covered by the journal-completeness
+      invariant (``quarantined`` is a terminal outcome).
+    """
+    r = scheduler.resilience
+    if device_faults > 0 and r.trips < 1:
+        _record(
+            violations, "resilience", cycle,
+            f"{device_faults} device solver faults were injected but "
+            "no breaker ever tripped — the ladder never engaged",
+        )
+    if device_faults > 0:
+        for profile in scheduler.solvers:
+            idx = r.tier_index(profile)
+            if idx != 0:
+                _record(
+                    violations, "resilience", cycle,
+                    f"profile {profile} is still at ladder tier "
+                    f"{r.ladder[idx]!r} after the fault window — the "
+                    "breaker never re-closed",
+                )
+        if r.trips >= 1 and r.recloses < 1:
+            # tier_index alone goes vacuous once the settle loop has
+            # advanced virtual time past every open window (elapsed
+            # windows count as the top tier) — require a PROBE to have
+            # actually succeeded, not just the clock to have moved
+            # (device-fault profiles keep arrivals flowing after the
+            # window precisely so a real probe runs)
+            _record(
+                violations, "resilience", cycle,
+                f"breaker tripped {r.trips}x but never re-closed via "
+                "a successful probe — the scheduler only LOOKS "
+                "recovered because the fault windows elapsed",
+            )
+    if poison_hits > 0 and not scheduler._quarantine_counts:
+        _record(
+            violations, "resilience", cycle,
+            f"{poison_hits} poison-batch failures were injected but "
+            "no pod was ever quarantined — the bisection never "
+            "isolated the poison",
+        )
 
 
 class MonotonicCounters:

@@ -63,6 +63,23 @@ class Profile:
     permit: bool = False  # register the stalling Permit plugin
     permit_stall_rate: float = 0.0  # P(first attempt of a pod WAITs)
     permit_timeout: float = 5.0
+    # -- solver-boundary faults (kubernetes_tpu/resilience) --
+    # P(an injected device/runtime error per solve dispatch) — exempts
+    # the pure-host ladder rung, so the fallback ladder always has a
+    # working floor (a real accelerator outage can't break host python)
+    solver_fault_rate: float = 0.0
+    # restrict injected solver faults to a virtual-clock window
+    # [start, end); () = always. A bounded window is what lets the
+    # invariants assert the breaker RE-CLOSES after the fault clears.
+    solver_fault_window: tuple = ()
+    # P(an arrival is a poison pod): its presence in ANY batch breaks
+    # the solve at EVERY tier (tensorize/solve-breaking data), driving
+    # the bisection quarantine
+    poison_rate: float = 0.0
+    # breaker fault window for the harness's ResilienceConfig: short
+    # enough that probes/re-closes happen within a sim run's virtual
+    # timeline (production default is 30s)
+    resilience_open_s: float = 3.0
     # -- fleet mode (sim/fleet.py multi-scheduler drive) --
     fleet_replicas: int = 0  # default replica count for --fleet runs
     # kill one replica at this cycle (replica_loss fault): its shard is
@@ -167,6 +184,37 @@ PROFILES: dict[str, Profile] = {
             permit=True,
             permit_stall_rate=0.5,
             permit_timeout=5.0,
+            delete_pod_rate=0.2,
+        ),
+        # solver-boundary chaos: every device-tier solve dispatch fails
+        # during the fault window (a dead accelerator runtime), then
+        # heals. The scheduler must trip the breaker, keep binding at a
+        # degraded ladder tier (ultimately the pure-host greedy), and
+        # probe back to the top tier once the window passes — asserted
+        # by the resilience invariant (breaker re-closed, zero pods
+        # lost). Window [2, 5): cycles advance the clock 1s each, so
+        # cycles at t=2..4 fault and the later cycles' arrivals drive
+        # the re-close probes with real work.
+        Profile(
+            name="solver_flaky",
+            arrivals=(2, 6),
+            delete_pod_rate=0.3,
+            solver_fault_rate=1.0,
+            solver_fault_window=(2.0, 5.0),
+        ),
+        # poison pods: a fraction of arrivals carry data that breaks
+        # tensorize/solve at EVERY ladder tier. The bisection must
+        # isolate exactly the poison pods into terminal quarantine
+        # (TTL'd re-admit) while the rest of each batch proceeds —
+        # including hard shapes riding the CARRY-mode chain. The
+        # breaker trips en route (descend-before-bisect) and re-closes
+        # once the poison is out of the batch stream.
+        Profile(
+            name="poison_pods",
+            arrivals=(2, 6),
+            pod_spread_rate=0.2,
+            pod_ports_rate=0.15,
+            poison_rate=0.12,
             delete_pod_rate=0.2,
         ),
         # fleet mode: two active replicas sharding one cluster through

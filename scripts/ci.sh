@@ -45,6 +45,23 @@ python -m kubernetes_tpu.sim --seed 1 --cycles 8 --profile churn_heavy \
 python -m kubernetes_tpu.sim --seed 1 --cycles 8 \
     --profile preemption_pressure --selfcheck
 
+echo "== chaos smoke: solver fallback ladder + poison quarantine =="
+# solver_flaky: every device-tier solve fails during the fault window
+# (virtual t in [2,5)), then heals. The run's resilience invariant
+# asserts the fallback ladder engaged (breaker tripped, batches kept
+# binding at degraded tiers down to the pure-host greedy), zero pods
+# were lost (lost-pod + journal-completeness invariants), and the
+# breaker RE-CLOSED to the top tier after the window — the footer's
+# breaker-state summary is the assertion target. poison_pods: a
+# fraction of arrivals deterministically break the solve at EVERY
+# tier; the bisection must isolate exactly them into terminal
+# quarantine while the rest of each batch proceeds. --selfcheck
+# re-runs each drive and byte-compares traces + journal digest.
+python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile solver_flaky \
+    --selfcheck
+python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile poison_pods \
+    --selfcheck
+
 echo "== fleet smoke: 2-replica sharded drive =="
 # two active replicas sharding one cluster (shard-filtered watches,
 # cross-shard occupancy exchange, handoff protocol) under the

@@ -416,12 +416,13 @@ def test_discard_storm_backstop_makes_progress():
 
 
 def test_apply_exception_marks_session_stale_and_heals():
-    """ADVICE r5 #3: an exception on the apply path (after the fence
-    matched) must mark the device session stale — its carried state
-    counted this batch's placements, but the pods were requeued. The
-    next dispatch re-uploads from host truth and schedules them all."""
-    import pytest
-
+    """ADVICE r5 #3, upgraded by the resilience layer: a deferred
+    assignment read dying (device/session loss after dispatch) no
+    longer crashes the loop — the flight discards, the device session
+    is marked stale (its carried state counted placements that never
+    bound), the failure is charged to the solve breaker, and the pods
+    requeue for an immediate retry through the resilient path."""
+    from kubernetes_tpu import metrics
     from kubernetes_tpu.solver.exact import DeferredAssignments
     from kubernetes_tpu.utils.clock import FakeClock
 
@@ -436,20 +437,28 @@ def test_apply_exception_marks_session_stale_and_heals():
         def get(self):
             raise RuntimeError("device read failed")
 
+    failures_before = metrics.batch_failure_total.labels(
+        "read"
+    )._value.get()
     flight.handle = Boom()
-    with pytest.raises(RuntimeError, match="device read failed"):
-        s._apply_flight(flight)
+    res = s._apply_flight(flight)  # no raise: the resilience layer owns it
+    assert not res.scheduled
     assert s._session_stale  # carry no longer trusted
     assert len(s.queue) == 6  # every pod requeued, none stranded
     assert not s._in_flight  # bookkeeping torn down
-    # the exception path parks the pods unschedulable (the failure was
-    # charged to their attempt); no watch event arrives to wake them, so
-    # step past the 5-min leftover flush — then the drain heals: the
-    # stale session re-uploads from host truth and everything fits
-    clock.advance(301.0)
+    # the failure was journaled/counted, not silently swallowed
+    assert (
+        metrics.batch_failure_total.labels("read")._value.get()
+        == failures_before + 1
+    )
+    # and the retry routes through the synchronous resilient path
+    assert s.resilience.should_sync()
+    # the drain heals: the stale session re-uploads from host truth
+    # and everything fits (the pods were requeued with no backoff)
     s.run_until_settled()
     assert all(p.node_name for p in cs.list_pods())
     assert not s._session_stale
+    assert not s.resilience.should_sync()  # sync retry cleared the flag
 
 
 def test_requeue_popped_uncharges_attempt():
