@@ -51,6 +51,14 @@ PENDING = "pending"
 COMMITTED = "committed"
 
 
+class ExchangeUnreachable(Exception):
+    """The occupancy hub cannot be reached from this replica (network
+    partition / hub outage). Raised by every hub operation while the
+    replica is partitioned; FleetRuntime degrades to its cached peer
+    view, whose growing age drives admission conservative
+    (fleet/runtime.py occupancy-staleness bounds)."""
+
+
 @dataclass(frozen=True)
 class NodeRow:
     """Domain-inventory row: one owned node and its zone key."""
@@ -86,11 +94,17 @@ class PodRow:
 @dataclass(frozen=True)
 class PeerView:
     """One consistent snapshot of every OTHER replica's rows, plus the
-    hub version it was taken at — the Conflict-on-stale fence value."""
+    hub version it was taken at — the Conflict-on-stale fence value.
+    ``peer_ages`` carries, per peer that has ever published, the
+    seconds since its last successful publish at view time: a peer
+    partitioned from the hub stops publishing, its age grows, and
+    admission against its frozen rows turns conservative once the age
+    passes the staleness bound (fleet/runtime.py)."""
 
     version: int
     node_rows: tuple[NodeRow, ...]
     pod_rows: tuple[PodRow, ...]
+    peer_ages: tuple[tuple[str, float], ...] = ()
 
 
 class OccupancyExchange:
@@ -99,9 +113,23 @@ class OccupancyExchange:
     service's ``ExchangeOccupancy`` RPC). All iteration is sorted so
     any serialized view is deterministic."""
 
-    def __init__(self) -> None:
+    def __init__(self, clock=None) -> None:
+        from ..utils.clock import Clock
+
         self._lock = threading.Lock()
         self._version = 0
+        # publish timestamps (staleness bounds): replica -> when it
+        # last successfully wrote anything to the hub. Off the
+        # injectable clock so the sim's virtual timeline covers row
+        # aging too.
+        self._clock = clock or Clock()
+        self._published_at: dict[str, float] = {}
+        # replicas currently partitioned from the hub (sim fault seam):
+        # every operation FROM a partitioned replica raises
+        # ExchangeUnreachable — its writes don't land, its reads fail,
+        # and its published_at freezes, which is what peers' staleness
+        # bounds key off.
+        self._partitioned: set[str] = set()
         # metric children resolved once: stage/commit run per placed
         # pod on the scheduler's apply path, and the label lookup is
         # measurable there (ops mirror the metric help string)
@@ -129,6 +157,46 @@ class OccupancyExchange:
         with self._lock:
             return self._version
 
+    # -- partition seam (hub reachability, per replica) --
+
+    def set_partitioned(self, replica: str, partitioned: bool) -> None:
+        """Sim/fault seam: model ``replica`` losing (or regaining) its
+        network path to the hub. While partitioned, every hub operation
+        from that replica raises ExchangeUnreachable."""
+        with self._lock:
+            if partitioned:
+                self._partitioned.add(replica)
+            else:
+                self._partitioned.discard(replica)
+
+    def _check_reachable(self, replica: str) -> None:
+        # callers hold self._lock or tolerate the benign race (the
+        # partition flag only ever flips between whole sim cycles)
+        if replica in self._partitioned:
+            raise ExchangeUnreachable(
+                f"replica {replica} is partitioned from the occupancy hub"
+            )
+
+    def _touch(self, replica: str) -> None:
+        """Refresh ``replica``'s liveness stamp. Rows are maintained
+        incrementally (every change stages/commits/withdraws
+        immediately), so between changes no-news-is-good-news AS LONG
+        AS the replica can still reach the hub: any successful
+        reachability-gated operation — reads included — proves its
+        rows are current and refreshes the stamp. Without the
+        read-side touch, a healthy but IDLE peer (no pod churn) would
+        age past max_row_age_s and starve every cross-shard-
+        constrained pod fleet-wide (review-caught)."""
+        self._published_at[replica] = self._clock.now()
+
+    def peers_version(self, replica: str) -> int:
+        """The hub version as seen from ``replica`` (reachability-
+        gated, unlike the raw ``version`` property)."""
+        with self._lock:
+            self._check_reachable(replica)
+            self._touch(replica)
+            return self._version
+
     # -- publishing --
 
     def publish_nodes(self, replica: str, rows: Iterable[NodeRow]) -> None:
@@ -136,13 +204,17 @@ class OccupancyExchange:
         and on every resync — the owned set is replaced wholesale, not
         diffed, so a missed event can never leave a stale row)."""
         with self._lock:
+            self._check_reachable(replica)
             self._version += 1
             self._node_rows[replica] = {r.node: r for r in rows}
+            self._touch(replica)
 
     def stage(self, replica: str, row: PodRow) -> None:
         with self._lock:
+            self._check_reachable(replica)
             self._version += 1
             self._pod_rows.setdefault(replica, {})[row.pod] = row
+            self._touch(replica)
         self._m["staged"].inc()
 
     def replace_pod_rows(self, replica: str, rows: Iterable[PodRow]) -> None:
@@ -151,23 +223,29 @@ class OccupancyExchange:
         pod whose DELETE the shard filter later hides from this
         replica can never leave a ghost row behind."""
         with self._lock:
+            self._check_reachable(replica)
             self._version += 1
             self._pod_rows[replica] = {r.pod: r for r in rows}
+            self._touch(replica)
 
     def commit(self, replica: str, pod_key: str) -> None:
         with self._lock:
+            self._check_reachable(replica)
             row = self._pod_rows.get(replica, {}).get(pod_key)
             if row is None or row.state == COMMITTED:
                 return
             self._version += 1
             self._pod_rows[replica][pod_key] = replace(row, state=COMMITTED)
+            self._touch(replica)
         self._m["committed"].inc()
 
     def withdraw(self, replica: str, pod_key: str) -> None:
         with self._lock:
+            self._check_reachable(replica)
             if self._pod_rows.get(replica, {}).pop(pod_key, None) is None:
                 return
             self._version += 1
+            self._touch(replica)
         self._m["withdrawn"].inc()
 
     def retire(self, replica: str) -> None:
@@ -183,6 +261,9 @@ class OccupancyExchange:
                 | bool(self._handoffs.pop(replica, None))
             )
             self._degraded.discard(replica)
+            # a retired replica's frozen publish stamp must not keep
+            # peers' staleness bounds conservative forever
+            self._published_at.pop(replica, None)
             if had:
                 self._version += 1
         self._m["retired"].inc()
@@ -194,6 +275,7 @@ class OccupancyExchange:
         breaker tripped / re-closed). Bumps the version so peers'
         conflict-parked pods re-evaluate their handoff chains."""
         with self._lock:
+            self._check_reachable(replica)
             if degraded == (replica in self._degraded):
                 return
             if degraded:
@@ -201,6 +283,7 @@ class OccupancyExchange:
             else:
                 self._degraded.discard(replica)
             self._version += 1
+            self._touch(replica)
 
     def degraded_replicas(self) -> frozenset:
         with self._lock:
@@ -208,8 +291,14 @@ class OccupancyExchange:
 
     # -- pod handoffs --
 
-    def hand_off(self, to_replica: str, pod_key: str, hops: int) -> None:
+    def hand_off(
+        self, to_replica: str, pod_key: str, hops: int,
+        from_replica: str | None = None,
+    ) -> None:
         with self._lock:
+            if from_replica is not None:
+                self._check_reachable(from_replica)
+                self._touch(from_replica)
             self._version += 1
             self._handoffs.setdefault(to_replica, {})[pod_key] = hops
         self._m["handoff"].inc()
@@ -218,6 +307,8 @@ class OccupancyExchange:
         """Pop every handoff addressed to ``replica`` (sorted, so
         claim order is deterministic)."""
         with self._lock:
+            self._check_reachable(replica)
+            self._touch(replica)  # liveness: the poll proves contact
             rows = self._handoffs.pop(replica, None)
             if not rows:
                 return []
@@ -236,6 +327,8 @@ class OccupancyExchange:
 
     def peers_view(self, replica: str) -> PeerView:
         with self._lock:
+            self._check_reachable(replica)
+            self._touch(replica)  # liveness: the fetch proves contact
             node_rows = tuple(
                 self._node_rows[r][n]
                 for r in sorted(self._node_rows)
@@ -248,7 +341,13 @@ class OccupancyExchange:
                 if r != replica
                 for p in sorted(self._pod_rows[r])
             )
-            return PeerView(self._version, node_rows, pod_rows)
+            now = self._clock.now()
+            peer_ages = tuple(
+                (r, max(now - self._published_at[r], 0.0))
+                for r in sorted(self._published_at)
+                if r != replica
+            )
+            return PeerView(self._version, node_rows, pod_rows, peer_ages)
 
     def replica_rows(self, replica: str) -> tuple[tuple[NodeRow, ...], tuple[PodRow, ...]]:
         with self._lock:
@@ -338,6 +437,7 @@ def ingest_payload(exchange: OccupancyExchange, data: bytes) -> bytes:
     with exchange._lock:
         exchange._version += 1
         exchange._pod_rows[replica] = {r.pod: r for r in pod_rows}
+        exchange._touch(replica)
     exchange._m["staged"].inc()
     view = exchange.peers_view(replica)
     return encode_rows("", view.version, view.node_rows, view.pod_rows)

@@ -36,7 +36,15 @@ from .. import metrics
 from ..api.objects import Pod
 from ..state.cluster import ClusterState, Event
 from .membership import FleetMembership, shard_index
-from .occupancy import OccupancyExchange, PodRow, NodeRow, COMMITTED, PENDING
+from .occupancy import (
+    COMMITTED,
+    ExchangeUnreachable,
+    NodeRow,
+    OccupancyExchange,
+    PENDING,
+    PeerView,
+    PodRow,
+)
 from .reconciler import CrossShardReconciler, ZONE_KEY
 from .ring import HashRing, RingNode, _h, ring_nodes_from
 
@@ -65,6 +73,19 @@ class FleetConfig:
     # store would mark every peer dead.
     lease_membership: bool = False
     lease_poll_s: float = 2.0
+    # occupancy-staleness bound: the maximum age (seconds) of the
+    # cross-shard occupancy view admission may trust. Staleness = the
+    # time since this replica's last successful hub fetch PLUS the
+    # oldest peer's liveness age inside that view (a peer's true
+    # silence = its age at fetch time + how long ago the fetch was;
+    # any reachability-proving hub contact refreshes a peer's
+    # stamp). Beyond the bound,
+    # admission turns CONSERVATIVE: cross-shard-constrained placements
+    # (hard spread, required anti-affinity) are rejected — requeue and
+    # retry once the exchange heals — rather than admitted against
+    # rows that may hide peers' placements. Ownership-only pods are
+    # unaffected (disjoint shards need no row exchange).
+    max_row_age_s: float = 30.0
 
     def __post_init__(self) -> None:
         if not self.replicas:
@@ -121,6 +142,19 @@ class FleetRuntime:
         self._reject_counts: dict[str, int] = {}  # ktpu: guarded-by(cluster.lock)
         # per-shard lease poll throttle (config.lease_membership)
         self._last_lease_poll = float("-inf")
+        # occupancy-staleness bounds: the last successfully fetched
+        # peer view and when it was fetched. While the hub is
+        # unreachable admission runs against this cache; its growing
+        # age (plus the oldest peer publish age inside it) is the
+        # staleness admission compares against max_row_age_s.
+        self._peer_view: PeerView | None = None  # ktpu: guarded-by(cluster.lock)
+        self._view_at = float("-inf")  # ktpu: guarded-by(cluster.lock)
+        # hub writes that failed while partitioned: rows must republish
+        # wholesale at the next reachable resync
+        self._exchange_dirty = False  # ktpu: guarded-by(cluster.lock)
+        # conservative-admission rejections under stale rows (the sim's
+        # hub_partition invariant asserts the path engaged)
+        self.stale_rejections = 0  # ktpu: guarded-by(cluster.lock)
         with cluster.lock:
             self._recompute(cluster.list_nodes())
         metrics.fleet_replicas.set(len(self.membership.alive()))
@@ -169,25 +203,54 @@ class FleetRuntime:
         """Membership transition (the sim's replica_loss driver; the
         production path calls refresh_membership below). Flags a
         resync; the scheduler applies it before its next solve."""
+        before = set(self.membership.alive())
         changed = self.membership.set_alive(replicas)
         if changed:
-            with self.cluster.lock:
-                self._recompute(self.cluster.list_nodes())
-                self._needs_resync = True
-            metrics.fleet_replicas.set(len(self.membership.alive()))
+            self._membership_changed(before)
         return changed
 
     def refresh_membership(self) -> bool:
         """Poll peers' per-shard leases (production liveness)."""
+        before = set(self.membership.alive())
         changed = self.membership.refresh_from_leases(
             self.cluster, self.config.lease, self.clock.now()
         )
         if changed:
-            with self.cluster.lock:
-                self._recompute(self.cluster.list_nodes())
-                self._needs_resync = True
-            metrics.fleet_replicas.set(len(self.membership.alive()))
+            self._membership_changed(before)
         return changed
+
+    def _membership_changed(self, before: set) -> None:
+        """Shared membership-transition tail: recompute the partition,
+        flag a resync, and REVOKE the commit fence of every peer that
+        just went dead — the commit-path half of the ownership fence.
+        The survivors are about to re-own the dead peer's shard; if it
+        is actually a zombie (lease stalled, process alive), its next
+        bind finds its token revoked at the state service and gets
+        Conflict, so it can never double-bind what a survivor re-owns.
+        The revocation is committed at the AUTHORITY (the state
+        service), which is what makes it partition-safe: the zombie's
+        own stale view is irrelevant."""
+        with self.cluster.lock:
+            self._recompute(self.cluster.list_nodes())
+            self._needs_resync = True
+            for dead in sorted(before - set(self.membership.alive())):
+                i = shard_index(self.membership.universe, dead)
+                self.cluster.revoke_fence(
+                    f"{self.config.lease}-shard-{i}"
+                )
+                # retire the dead peer's exchange state too: its
+                # committed placements become visible to the adopting
+                # replicas through their own resync re-list (keeping
+                # the rows would double-count), its pending rows can
+                # never commit (fenced), and its frozen publish stamp
+                # must not age the survivors' staleness bound forever —
+                # a detected-dead peer is handled by membership, not by
+                # conservative admission. (A SILENT hub-partitioned
+                # peer that is still lease-alive keeps its rows, and
+                # their growing age is exactly what turns peers
+                # conservative.)
+                self.exchange.retire(dead)
+        metrics.fleet_replicas.set(len(self.membership.alive()))
 
     # -- the shard-filtered watch predicate --
 
@@ -233,10 +296,25 @@ class FleetRuntime:
                 self._last_lease_poll = now
                 self.refresh_membership()
         with self.cluster.lock:
+            if self._exchange_dirty:
+                # hub writes failed while partitioned: once the hub is
+                # reachable again, force a full resync so rows and
+                # inventory republish wholesale from truth
+                try:
+                    self.exchange.peers_version(self.replica)
+                except ExchangeUnreachable:
+                    pass
+                else:
+                    self._exchange_dirty = False
+                    self._needs_resync = True
+            try:
+                handoffs = self.exchange.claim_handoffs(self.replica)
+            except ExchangeUnreachable:
+                handoffs = []  # claims wait out the partition
             # adopt pods peers handed off to this replica (sorted,
             # deterministic): the claim makes this replica the pod's
             # route owner, so its watch events flow here from now on
-            for key, hops in self.exchange.claim_handoffs(self.replica):
+            for key, hops in handoffs:
                 try:
                     ns, name = key.split("/", 1)
                     pod = self.cluster.get_pod(ns, name)
@@ -254,7 +332,10 @@ class FleetRuntime:
                 ):
                     scheduler.queue.add(pod)
             if self._conflicts_since_wake:
-                version = self.exchange.version
+                try:
+                    version = self.exchange.peers_version(self.replica)
+                except ExchangeUnreachable:
+                    version = self._wake_version  # no news while cut off
                 if version != self._wake_version:
                     # peers' occupancy moved since this replica parked
                     # pods on reconcile conflicts: give them another
@@ -286,7 +367,8 @@ class FleetRuntime:
             cache.remove_node(name)
         # adopt nodes that joined the shard, with their bound pods
         pods = self.cluster.list_pods()
-        for node in self.cluster.list_nodes():
+        nodes = self.cluster.list_nodes()
+        for node in nodes:
             if node.name in owned and node.name not in cache.nodes:
                 cache.add_node(node)
         known_nodes = {
@@ -322,30 +404,7 @@ class FleetRuntime:
         # (review-caught). Committed rows = labeled pods bound on
         # currently-owned nodes; pending rows survive only while this
         # replica still assumes the pod.
-        fresh_rows = []
-        node_zone = {
-            n.name: n.labels.get(ZONE_KEY, "")
-            for n in self.cluster.list_nodes()
-            if self._assignment.get(n.name) == self.replica
-        }
-        for pod in pods:
-            if pod.labels and pod.node_name in node_zone:
-                fresh_rows.append(
-                    PodRow.for_pod(
-                        pod, pod.node_name,
-                        node_zone[pod.node_name], COMMITTED,
-                    )
-                )
-        for pod_key in list(cache._assumed):
-            node = cache.pod_node(pod_key)
-            if node in node_zone:
-                info = cache.nodes.get(node)
-                q = info.pods.get(pod_key) if info is not None else None
-                if q is not None and q.labels:
-                    fresh_rows.append(
-                        PodRow.for_pod(q, node, node_zone[node], PENDING)
-                    )
-        self.exchange.replace_pod_rows(self.replica, fresh_rows)
+        self.rebuild_pod_rows(cache, pods=pods, nodes=nodes)
         # sweep routing overrides and reject counts against cluster
         # truth (bound/deleted pods need no routing state)
         live_unbound = {p.key for p in pods if not p.node_name}
@@ -374,7 +433,80 @@ class FleetRuntime:
             for n in self.cluster.list_nodes()
             if self._assignment.get(n.name) == self.replica
         ]
-        self.exchange.publish_nodes(self.replica, rows)
+        try:
+            self.exchange.publish_nodes(self.replica, rows)
+        except ExchangeUnreachable:
+            self._exchange_dirty = True
+
+    # called under cluster.lock (resync, the scheduler's recovery
+    # pass): ktpu: holds(cluster.lock)
+    def rebuild_pod_rows(self, cache, pods=None, nodes=None) -> None:
+        """Replace this replica's exchange pod rows wholesale from
+        cluster truth + the live cache: committed rows = labeled pods
+        bound on currently-owned nodes, pending rows = placements this
+        replica currently ASSUMES. Used at every resync and by the
+        restart-recovery pass — a dead incarnation's stale PENDING rows
+        (assumed but never bound) roll back here, because the fresh
+        incarnation's cache assumes nothing yet. ``pods``/``nodes``
+        let a caller that already listed the cluster (the resync)
+        avoid paying the O(pods)+O(nodes) listing twice under the
+        lock."""
+        if pods is None:
+            pods = self.cluster.list_pods()
+        if nodes is None:
+            nodes = self.cluster.list_nodes()
+        fresh_rows = []
+        node_zone = {
+            n.name: n.labels.get(ZONE_KEY, "")
+            for n in nodes
+            if self._assignment.get(n.name) == self.replica
+        }
+        for pod in pods:
+            if pod.labels and pod.node_name in node_zone:
+                fresh_rows.append(
+                    PodRow.for_pod(
+                        pod, pod.node_name,
+                        node_zone[pod.node_name], COMMITTED,
+                    )
+                )
+        for pod_key in list(cache._assumed):
+            node = cache.pod_node(pod_key)
+            if node in node_zone:
+                info = cache.nodes.get(node)
+                q = info.pods.get(pod_key) if info is not None else None
+                if q is not None and q.labels:
+                    fresh_rows.append(
+                        PodRow.for_pod(q, node, node_zone[node], PENDING)
+                    )
+        try:
+            self.exchange.replace_pod_rows(self.replica, fresh_rows)
+        except ExchangeUnreachable:
+            self._exchange_dirty = True
+
+    # called under cluster.lock (admit runs in the apply phase): ktpu: holds(cluster.lock)
+    def _peers_view_with_age(self) -> "tuple[PeerView | None, float]":
+        """The freshest peer view this replica can get, plus its
+        staleness: a fresh hub fetch has the age of its oldest peer
+        publish; when the hub is unreachable the cached view serves,
+        aging from its fetch time. ``(None, inf)`` before any
+        successful fetch — maximally conservative."""
+        now = self.clock.now()
+        try:
+            view = self.exchange.peers_view(self.replica)
+        except ExchangeUnreachable:
+            view = self._peer_view
+        else:
+            self._peer_view = view
+            self._view_at = now
+        if view is None:
+            return None, float("inf")
+        # a peer's true publish age = its age at fetch time + however
+        # long ago the fetch was (zero for a fresh fetch)
+        fetch_age = max(now - self._view_at, 0.0)
+        oldest_peer = max(
+            (peer_age for _r, peer_age in view.peer_ages), default=0.0
+        )
+        return view, fetch_age + oldest_peer
 
     def _zone_of(self, cache, node_name: str) -> str:
         info = cache.nodes.get(node_name)
@@ -419,7 +551,34 @@ class FleetRuntime:
             # otherwise pay it per pod)
             self._reject_counts.pop(pod.key, None)
             return None
-        peers = self.exchange.peers_view(self.replica)
+        peers, age = self._peers_view_with_age()
+        metrics.fleet_occupancy_row_age_seconds.set(
+            age if age != float("inf") else -1.0
+        )
+        if age > self.config.max_row_age_s:
+            # occupancy-staleness bound: the view may hide peers'
+            # placements (hub unreachable, or a peer stopped
+            # publishing). Admitting a cross-shard-constrained
+            # placement against it risks exactly the overcommit the
+            # exchange exists to prevent — turn CONSERVATIVE and
+            # reject; the pod parks and retries when the exchange
+            # version moves (the heal republish bumps it) or via the
+            # unschedulable flush.
+            metrics.fleet_reconcile_conflicts_total.labels("stale").inc()
+            self.stale_rejections += 1
+            self._conflicts_since_wake += 1
+            if peers is not None:
+                self._wake_version = peers.version
+            self._reject_counts[pod.key] = (
+                self._reject_counts.get(pod.key, 0) + 1
+            )
+            shown = "inf" if age == float("inf") else f"{age:.0f}s"
+            return (
+                f"fleet occupancy view is {shown} stale (bound "
+                f"{self.config.max_row_age_s:.0f}s): conservative "
+                "admission rejects cross-shard-constrained placements "
+                "until the occupancy exchange heals"
+            )
         why = self.reconciler.admit(
             pod, node_name, self._zone_of(cache, node_name), cache, peers
         )
@@ -467,7 +626,12 @@ class FleetRuntime:
         target = chain[(chain.index(self.replica) + 1) % len(chain)]
         if target == self.replica:
             return None
-        self.exchange.hand_off(target, key, hops + 1)
+        try:
+            self.exchange.hand_off(
+                target, key, hops + 1, from_replica=self.replica
+            )
+        except ExchangeUnreachable:
+            return None  # can't release through a hub we can't reach
         self._routed_here.pop(key, None)
         self._routed_away.add(key)
         self._reject_counts.pop(key, None)
@@ -479,21 +643,43 @@ class FleetRuntime:
         peers prefer it last in handoff chains. The replica keeps
         serving its shard — the fallback ladder guarantees forward
         progress — it just stops attracting refugees while sick."""
-        self.exchange.set_degraded(self.replica, degraded)
+        try:
+            self.exchange.set_degraded(self.replica, degraded)
+        except ExchangeUnreachable:
+            # breaker hooks fire outside the cluster lock (the solve
+            # loop holds no lock around dispatch): take it for the
+            # dirty flag
+            with self.cluster.lock:
+                self._exchange_dirty = True
 
     # called from _apply_group's locked apply phase: ktpu: holds(cluster.lock)
     def stage(self, pod: Pod, node_name: str, cache) -> None:
         if not pod.labels:
             return  # label-free pods can never match a selector/term
-        self.exchange.stage(
-            self.replica,
-            PodRow.for_pod(
-                pod, node_name, self._zone_of(cache, node_name), PENDING
-            ),
-        )
+        try:
+            self.exchange.stage(
+                self.replica,
+                PodRow.for_pod(
+                    pod, node_name, self._zone_of(cache, node_name), PENDING
+                ),
+            )
+        except ExchangeUnreachable:
+            # the row republishes wholesale at the first reachable
+            # resync (rebuild_pod_rows) — the placement itself is
+            # legitimate, the hub just hasn't heard about it yet
+            self._exchange_dirty = True
 
+    # called from _commit_binding's locked confirmation phase: ktpu: holds(cluster.lock)
     def commit(self, pod_key: str) -> None:
-        self.exchange.commit(self.replica, pod_key)
+        try:
+            self.exchange.commit(self.replica, pod_key)
+        except ExchangeUnreachable:
+            self._exchange_dirty = True
 
+    # every caller (unreserve/ingest/reap paths) holds the cluster
+    # lock: ktpu: holds(cluster.lock)
     def withdraw(self, pod_key: str) -> None:
-        self.exchange.withdraw(self.replica, pod_key)
+        try:
+            self.exchange.withdraw(self.replica, pod_key)
+        except ExchangeUnreachable:
+            self._exchange_dirty = True

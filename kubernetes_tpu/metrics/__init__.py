@@ -222,7 +222,47 @@ d2h_bytes_total = Counter(
     registry=REGISTRY,
 )
 
+# -- crash-restart recovery + commit fencing --
+
+restart_recovery_seconds = Histogram(
+    "scheduler_restart_recovery_seconds",
+    "Wall time of the cold-start recovery pass: rebuilding cache/queue "
+    "from cluster truth, re-adopting pods a prior incarnation orphaned, "
+    "rolling back half-committed occupancy (claim reservations, fleet "
+    "pending rows), and journaling terminal 'recovered' records.",
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+commit_fenced_total = Counter(
+    "scheduler_commit_fenced_total",
+    "Bind commits rejected by the state service's fencing-token check: "
+    "this incarnation's fence token was revoked (lease lost, partition, "
+    "or a newer incarnation took over) — the zombie's commit never "
+    "lands, extending the fleet admit-time ownership fence to bind "
+    "time.",
+    registry=REGISTRY,
+)
+watch_delivery_error_total = Counter(
+    "scheduler_watch_delivery_error_total",
+    "Exceptions raised by ClusterState watch subscribers during event "
+    "delivery: caught and counted so one bad callback cannot prevent "
+    "delivery to the remaining subscribers or corrupt the event "
+    "sequence.",
+    registry=REGISTRY,
+)
+
 # -- fleet tier (kubernetes_tpu/fleet) --
+
+fleet_occupancy_row_age_seconds = Gauge(
+    "scheduler_fleet_occupancy_row_age_seconds",
+    "Staleness of the cross-shard occupancy view this replica admits "
+    "against: age of the last successful hub fetch PLUS the oldest "
+    "peer's liveness age inside it. Beyond FleetConfig.max_row_age_s "
+    "admission "
+    "turns conservative — cross-shard-constrained placements are "
+    "rejected rather than risking overcommit on stale rows.",
+    registry=REGISTRY,
+)
 
 fleet_replicas = Gauge(
     "scheduler_fleet_replicas",
@@ -253,8 +293,9 @@ fleet_occupancy_rows_total = Counter(
 fleet_reconcile_conflicts_total = Counter(
     "scheduler_fleet_reconcile_conflicts_total",
     "Placements the cross-shard reconciliation rejected pre-assume, "
-    "by constraint family (ownership|spread|anti); the pods retried "
-    "through the ordinary requeue machinery.",
+    "by constraint family (ownership|spread|anti|stale — stale = "
+    "conservative admission under an aged-out occupancy view); the "
+    "pods retried through the ordinary requeue machinery.",
     ["constraint"],
     registry=REGISTRY,
 )
@@ -280,7 +321,7 @@ journal_records_total = Counter(
     "scheduler_tpu_trace_journal_records_total",
     "Per-pod decision-journal records written, by outcome "
     "(bound|unschedulable|bind_failure|permit_wait|permit_rejected|"
-    "permit_timeout|discarded|solver_error|quarantined).",
+    "permit_timeout|discarded|solver_error|quarantined|recovered).",
     ["outcome"],
     registry=REGISTRY,
 )
@@ -306,7 +347,8 @@ sim_faults_injected_total = Counter(
     "scheduler_sim_faults_injected_total",
     "Faults the simulator injected at real boundaries, by fault kind "
     "(bind_conflict|watch_delay|watch_duplicate|extender_timeout|"
-    "extender_5xx|permit_stall|solver_fault|poison_pod).",
+    "extender_5xx|permit_stall|solver_fault|poison_pod|crash|"
+    "hub_partition|lease_fence).",
     ["fault"],
     registry=REGISTRY,
 )
@@ -314,7 +356,8 @@ sim_invariant_violations_total = Counter(
     "scheduler_sim_invariant_violations_total",
     "Invariant violations the simulator's checkers flagged, by "
     "invariant (double_bind|capacity|lost_pod|progress|monotonic|"
-    "constraint|journal|global_overcommit|resilience).",
+    "constraint|journal|global_overcommit|resilience|recovery|"
+    "fencing).",
     ["invariant"],
     registry=REGISTRY,
 )

@@ -62,16 +62,25 @@ OUTCOMES = frozenset(
         # poison-batch bisection isolated the solve failure to this
         # pod: it sits out a TTL'd backoff before re-admission
         "quarantined",
+        # a fresh scheduler incarnation's cold-start recovery pass
+        # re-adopted this pod from cluster truth after a crash orphaned
+        # it mid-flight (assumed/parked/queued state evaporated with
+        # the dead process)
+        "recovered",
     }
 )
 # a pod whose LAST journal record is one of these has a settled fate for
 # the run; permit_wait, discarded, and solver_error always lead to
 # another attempt. quarantined IS terminal: the pod's fate is settled
 # and attributable (the re-admit after the TTL starts a new history).
+# recovered IS terminal for the same cross-incarnation reason: it closes
+# a history the crash left dangling (permit_wait/discarded/solver_error
+# with no process left to continue it) — the adopting incarnation's own
+# records then form the pod's next history.
 TERMINAL_OUTCOMES = frozenset(
     {
         "bound", "unschedulable", "bind_failure", "permit_rejected",
-        "permit_timeout", "quarantined",
+        "permit_timeout", "quarantined", "recovered",
     }
 )
 

@@ -139,6 +139,27 @@ class SchedulerConfig:
     # label-bearing placements are published to the fleet's occupancy
     # exchange. None = the classic sole-owner scheduler.
     fleet: object = None
+    # process-lifecycle identity: which incarnation of this scheduler
+    # role this process is. 1 = a first start; > 1 = a RESTART after a
+    # crash — the cold-start recovery pass then treats cluster truth as
+    # the wreck of a predecessor: unbound pods are re-adopted AND
+    # terminally journaled `recovered` (so journal completeness holds
+    # across incarnations), half-committed occupancy (claim
+    # reservations for unbound pods, stale fleet pending rows) is
+    # rolled back, and quarantine/breaker state deliberately RESETS
+    # (the restart may be on healed hardware; a genuinely poison pod
+    # re-quarantines through the ordinary bisection path within one
+    # batch — tested).
+    incarnation: int = 1
+    # commit fencing (state/cluster.py fencing tokens): the lease role
+    # this scheduler's binds are fenced under. The incarnation acquires
+    # a fresh token at startup — superseding any predecessor — and
+    # every bind carries it; a revoked/superseded token means the state
+    # service rejects the commit with Conflict (scheduler_commit_fenced
+    # _total) so a zombie can never double-bind. None = no fencing
+    # (single-owner deployments that never restart in place); fleet
+    # replicas default to their per-shard lease name.
+    fence_role: str | None = None
 
 
 class _Rejected(Exception):
@@ -333,6 +354,14 @@ class Scheduler:
             self._span_tags = {"replica": self.fleet.replica}
             if self.journal is not None:
                 self.journal.tags["replica"] = self.fleet.replica
+        if self.config.incarnation > 1:
+            # restarted incarnations tag every record/span so a merged
+            # cross-incarnation journal attributes each record to the
+            # process that wrote it (first starts stay tag-free: their
+            # journal bytes must not change under a config default)
+            self._span_tags["incarnation"] = self.config.incarnation
+            if self.journal is not None:
+                self.journal.tags["incarnation"] = self.config.incarnation
         import logging
 
         self._log = logging.getLogger("kubernetes_tpu.scheduler")
@@ -504,12 +533,77 @@ class Scheduler:
         # the per-batch lookup is O(nominated), not O(all pods)
         self.nominated_pods: dict[str, Pod] = {}
 
-        # initial informer sync (WaitForCacheSync equivalent) — atomic with
-        # the subscription so a concurrent writer can't slip an object
-        # between the list and the watch start. Fleet replicas sync (and
-        # subscribe) shard-scoped: owned nodes, pods bound on them, and
-        # the pending pods the ring routes here.
-        with cluster.lock:
+        # commit fencing: the bind-path fence token for this incarnation
+        # (state/cluster.py fencing tokens). Fleet replicas fence under
+        # their per-shard lease identity by default, so a replica whose
+        # lease a peer observed stale is fenced the moment the peer
+        # commits the membership change at the state service.
+        self._fence_role = self.config.fence_role
+        if self._fence_role is None and self.fleet is not None:
+            self._fence_role = self.fleet.lease_name
+        self._fence_token = 0
+        self._fenced_commits = 0  # ktpu: guarded-by(cluster.lock)
+        # sim seam: called with the approved pending list right before
+        # the binding cycle of a batch commits — the "after assume,
+        # before bind" point a crash-restart drive kills the process at
+        self._pre_commit_hook = None
+        # the cold-start recovery pass: initial informer sync
+        # (WaitForCacheSync equivalent) — atomic with the subscription
+        # so a concurrent writer can't slip an object between the list
+        # and the watch start — plus, on a RESTART (incarnation > 1),
+        # orphan re-adoption, half-committed occupancy rollback, and
+        # terminal `recovered` journaling. One root span + one
+        # structured log line + scheduler_restart_recovery_seconds.
+        self._recover()
+
+    def _recover(self) -> None:
+        """Cold-start recovery: rebuild every piece of incarnation-local
+        scheduler state from ``ClusterState`` truth.
+
+        All starts: shard-scoped cache/queue/nominator sync + watch
+        subscription + (fleet) inventory/row publication, exactly the
+        WaitForCacheSync contract.
+
+        Restarts (``config.incarnation > 1``) additionally treat truth
+        as a predecessor's wreck:
+
+        - every unbound routed pod is RE-ADOPTED and terminally
+          journaled ``recovered`` — a pod the dead incarnation left
+          mid-flight (assumed, Permit-parked, popped, deferred-solved)
+          has a dangling non-terminal journal history that no process
+          will ever continue; the recovered record closes it so journal
+          completeness holds across incarnations;
+        - half-committed occupancy rolls back: resource-claim
+          reservations naming unbound routed pods (a crash between the
+          PreBind claim write and the bind commit) are released exactly
+          like the deallocating controller would on pod delete, and a
+          fleet replica's exchange rows are rebuilt wholesale from
+          truth (a predecessor's stale PENDING rows would distort
+          peers' admission forever);
+        - quarantine and breaker state deliberately RESET rather than
+          re-derive: both guard against *this process's* observed
+          hardware/data failures, the restart may be on healed hardware
+          or a fixed build, and the cost of being wrong is one cheap
+          re-discovery (a poison pod re-quarantines via bisection in
+          its first batch — tested in tests/test_restart_recovery.py),
+          while persisting them would let a stale breaker pin a healthy
+          scheduler to its degraded ladder rung indefinitely.
+        """
+        cluster = self.cluster
+        restart = self.config.incarnation > 1
+        t_rec = self.clock.perf()
+        adopted = recovered = claims_rolled = 0
+        span_tags = dict(self._span_tags)
+        span_tags.setdefault("incarnation", self.config.incarnation)
+        with cluster.lock, self.obs.span(
+            "recover", trace_id=self._trace_step, restart=restart,
+            **span_tags,
+        ) as rsp:
+            if self._fence_role is not None:
+                self._fence_token = cluster.grant_fence(
+                    self._fence_role,
+                    holder=f"incarnation-{self.config.incarnation}",
+                )
             for node in cluster.list_nodes():
                 if self.fleet is None or self.fleet.owns_node(node.name):
                     self.cache.add_node(node)
@@ -528,6 +622,26 @@ class Scheduler:
                         self.nominated_pods[pod.key] = pod
                     if pod.scheduler_name in self.solvers:
                         self.queue.add(pod)
+                        adopted += 1
+                        if restart:
+                            recovered += 1
+                            if self.journal is not None:
+                                self.journal.record(
+                                    self._trace_step, 0, pod, "recovered",
+                                    reason=(
+                                        "re-adopted by incarnation "
+                                        f"{self.config.incarnation} after "
+                                        "a crash orphaned the pod"
+                                        + (
+                                            "; orphaned nomination on "
+                                            + pod.nominated_node_name
+                                            if pod.nominated_node_name
+                                            else ""
+                                        )
+                                    ),
+                                )
+            if restart and self._dra:
+                claims_rolled = self._rollback_orphan_claims()
             cluster.subscribe(
                 self._on_event,
                 filter=self.fleet.event_filter
@@ -536,7 +650,99 @@ class Scheduler:
             )
             if self.fleet is not None:
                 self.fleet.publish_inventory()
+                # rebuild this replica's exchange rows from truth: a
+                # prior incarnation's stale PENDING rows (assumed but
+                # never bound) roll back here, wholesale
+                self.fleet.rebuild_pod_rows(self.cache)
                 metrics.fleet_owned_nodes.set(len(self.cache.nodes))
+            rsp.set(
+                adopted=adopted, recovered=recovered,
+                claims_rolled_back=claims_rolled,
+            )
+        dt = self.clock.perf() - t_rec
+        metrics.restart_recovery_seconds.observe(dt)
+        self._log.info(
+            "recovery pass complete: incarnation %d %s %d pod(s), "
+            "journaled %d recovered record(s), rolled back %d "
+            "half-committed claim reservation(s) in %.3fs",
+            self.config.incarnation,
+            "re-adopted" if restart else "adopted",
+            adopted, recovered, claims_rolled, dt,
+            extra={"step": self._trace_step},
+        )
+
+    # runs inside _recover's locked region: ktpu: holds(cluster.lock)
+    def _rollback_orphan_claims(self) -> int:
+        """Release resource-claim reservations naming unbound pods this
+        scheduler routes: only a crash between the PreBind claim write
+        (``bind_pod_claims``) and the bind commit can produce one, so
+        the reservation is half-committed occupancy — roll it back the
+        way the deallocating controller would on pod delete. Pods this
+        scheduler does not own are never touched: fleet PEERS' routed
+        pods (a live peer may be mid-bind on them right now) and pods
+        of FOREIGN schedulers (``spec.schedulerName`` outside our
+        profiles — their scheduler may be between its own PreBind
+        claim write and bind this instant)."""
+        rolled = 0
+        for c in list(self.cluster.list_resource_claims()):
+            if not c.reserved_for:
+                continue
+            stale = []
+            for key in c.reserved_for:
+                ns, name = key.split("/", 1)
+                try:
+                    pod = self.cluster.get_pod(ns, name)
+                except ApiError:
+                    stale.append(key)  # reserved for a deleted pod
+                    continue
+                if pod.node_name:
+                    continue  # bound: the reservation is legitimate
+                if pod.scheduler_name not in self.solvers:
+                    continue  # a foreign scheduler's pod: not ours
+                if self.fleet is not None and not self.fleet.routes_pod(
+                    key
+                ):
+                    continue  # a peer's pod: leave it alone
+                stale.append(key)
+            if not stale:
+                continue
+            c.reserved_for = tuple(
+                k for k in c.reserved_for if k not in stale
+            )
+            if not c.reserved_for:
+                c.allocated_node = ""
+                c.results = ()
+            self.cluster.update_resource_claim(c)
+            rolled += 1
+        return rolled
+
+    def reacquire_fence(self) -> None:
+        """Re-acquire this scheduler's commit fence after it was
+        revoked (lease re-acquired after a partition healed / a stall
+        ended). The zombie path back to legitimacy: a fresh token is
+        granted at the state service AND the scheduler forces a full
+        resync first — both in-flight solves (fence bump) and, in fleet
+        mode, the shard view rebuild — so post-refence commits are
+        computed from current truth, never the stale pre-fence view.
+        Production wires this to lease re-acquisition; the sim's
+        hub_partition drive calls it at heal time."""
+        with self.cluster.lock:
+            if self._fence_role is None:
+                return
+            self._fence_token = self.cluster.grant_fence(
+                self._fence_role,
+                holder=f"incarnation-{self.config.incarnation}",
+            )
+            self._conflict_seq += 1
+            self._occupancy_seq += 1
+            if self.fleet is not None:
+                self.fleet._needs_resync = True
+            self._log.info(
+                "commit fence re-acquired for role %r (token %d); full "
+                "resync forced before the next solve",
+                self._fence_role, self._fence_token,
+                extra={"step": self._trace_step},
+            )
 
     # -- eventhandlers.go#addAllEventHandlers routing --
 
@@ -848,6 +1054,8 @@ class Scheduler:
         with self.cluster.lock, self.obs.span("pop") as sp:
             # re-admit quarantined pods whose TTL'd backoff elapsed
             self._release_quarantine()
+            # reap assumes whose bind confirmation never arrived
+            self._reap_expired_assumes()
             # WaitOnPermit analog: settle WaitingPods whose verdict or
             # deadline arrived since the last cycle, before popping new
             # work
@@ -932,6 +1140,12 @@ class Scheduler:
         """The binding-cycle pass for a batch's approved pods, plus
         in-flight bookkeeping teardown for exactly this batch (the
         pipelined loop keeps other batches' in-flight entries live)."""
+        hook = self._pre_commit_hook
+        if hook is not None and pending:
+            # sim seam: the batch has assumed + approved its pods but
+            # committed nothing — the exact point a crash-restart drive
+            # kills the process (sim/harness.py crash_restart)
+            hook(pending)
         first_err = None
         for entry in pending:
             tb = self.clock.perf()
@@ -1290,6 +1504,62 @@ class Scheduler:
             info.pod = cur
             self.queue.requeue_popped(info)
             metrics.quarantine_readmits_total.inc()
+
+    # called from the locked pop regions of both loops: ktpu: holds(cluster.lock)
+    def _reap_expired_assumes(self) -> None:
+        """Expire assumed pods whose bind confirmation never arrived
+        (cache.cleanup_expired — finished assumes past their deadline,
+        plus unfinished assumes a dead binding cycle leaked past the
+        TTL; Permit-parked pods are protected). The release frees
+        occupancy in-flight solves may have counted, so both fences
+        bump; a pod still unbound in truth re-enters the queue, a pod
+        actually bound (confirmation event lost) re-adopts from
+        truth."""
+        expired = self.cache.cleanup_expired(
+            protected=frozenset(self._waiting)
+        )
+        if not expired:
+            return
+        self._conflict_seq += 1
+        self._occupancy_seq += 1
+        for key in expired:
+            self._log.warning(
+                "assumed pod %s expired without a bind confirmation; "
+                "occupancy released", key,
+                extra={"step": self._trace_step},
+            )
+            ns, name = key.split("/", 1)
+            try:
+                cur = self.cluster.get_pod(ns, name)
+            except ApiError:
+                # deleted: drop the leaked host-side reservations too
+                if self.fleet is not None:
+                    self.fleet.withdraw(key)
+                self.volume_binder.unreserve(key)
+                self.claim_allocator.unreserve(key)
+                continue
+            if cur.node_name:
+                # the bind actually landed and only the confirmation
+                # event was lost: re-adopt real occupancy from truth.
+                # The exchange row stays — it was COMMITTED at bind
+                # time and still represents durable occupancy peers
+                # must respect (withdrawing it here would hide a bound
+                # pod from cross-shard admission; review-caught)
+                self.cache.add_pod(cur)
+                continue
+            if self.fleet is not None:
+                self.fleet.withdraw(key)
+            self.volume_binder.unreserve(key)
+            self.claim_allocator.unreserve(key)
+            if (
+                key not in self.queue.entries()
+                and key not in self._in_flight
+                and key not in self._quarantine
+                and cur.scheduler_name in self.solvers
+                and (self.fleet is None or self.fleet.routes_pod(key))
+            ):
+                self.queue.add(cur)
+        self._refresh_pending_gauge()
 
     def _requeue_immediate(self, infos: list[QueuedPodInfo]) -> None:
         """Requeue a batch whose deferred dispatch failed before any
@@ -2372,13 +2642,39 @@ class Scheduler:
             )
             if binder is not None:
                 # extender.go#Bind: the first interested binder extender
-                # owns the binding subresource call
+                # owns the binding subresource call (scope note: the
+                # extender's own apiserver client carries its fence)
                 binder.bind(pod, node_name)
             else:
-                self.cluster.bind(pod.namespace, pod.name, node_name)
+                self.cluster.bind(
+                    pod.namespace, pod.name, node_name,
+                    fence=(
+                        (self._fence_role, self._fence_token)
+                        if self._fence_role is not None
+                        else None
+                    ),
+                )
         except (ApiError, VolumeBindingError, _Rejected, ExtenderError) as e:
             reason = e.reason if isinstance(e, ApiError) else str(e)
+            fenced = isinstance(e, ApiError) and e.fenced
             with self.cluster.lock:
+                if fenced:
+                    # this incarnation's fence token was revoked (lease
+                    # lost / partition / superseded): the state service
+                    # refused the commit — the zombie path the fence
+                    # exists to close. The pod requeues like any bind
+                    # conflict; the operator signal is the counter+log
+                    # (production wires reacquire_fence to lease
+                    # re-acquisition before commits can resume).
+                    metrics.commit_fenced_total.inc()
+                    self._fenced_commits += 1
+                    self._log.warning(
+                        "bind of %s fenced: this incarnation's commit "
+                        "fence (role %r) was revoked — operating as a "
+                        "zombie until the lease is re-acquired",
+                        pod.key, self._fence_role,
+                        extra={"step": step},
+                    )
                 self._unreserve_all(state, pod, node_name)
                 res.bind_failures.append((pod.key, reason))
                 if self.journal is not None:
@@ -3123,6 +3419,7 @@ class Scheduler:
                 t0 = self.clock.perf()
                 with self.cluster.lock:
                     self._release_quarantine()
+                    self._reap_expired_assumes()
                     self.queue.flush_unschedulable_leftover()
                     infos = self.queue.pop_batch(self.config.batch_size)
                     base_cycle = self.queue.scheduling_cycle - len(infos)

@@ -77,6 +77,12 @@ def _print_result(res) -> None:
             f"recloses={resil['recloses']} "
             f"quarantined={len(s['quarantined'])} tier={tiers}"
         )
+    if s.get("crashes") or s.get("incarnations", 1) > 1:
+        print(
+            f"  lifecycle: incarnations={s['incarnations']} "
+            f"crashes={s['crashes']} "
+            f"recovered_records={s['recovered_records']}"
+        )
     print(
         f"  journal: records={s['journal_records']} "
         f"digest={s['journal_digest'][:16]}"
@@ -106,6 +112,14 @@ def _print_fleet_result(res) -> None:
         f"unbound={s['unbound']} settled={s['settled']} "
         f"binds_by_replica={s['binds_by_replica']}"
     )
+    if s.get("zombie"):
+        fenced = s["fenced_commits"].get(s["zombie"], 0)
+        print(
+            f"  partition: zombie={s['zombie']} "
+            f"fenced_commits={fenced} "
+            f"zombie_binds_while_fenced={s['zombie_binds_while_fenced']} "
+            f"stale_rejections={s['stale_rejections']}"
+        )
     for rid in sorted(res.journal_digests):
         print(f"  journal[{rid}]={res.journal_digests[rid]}")
     if res.violations:

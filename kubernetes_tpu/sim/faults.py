@@ -308,6 +308,43 @@ class SolverFaultInjector:
             )
 
 
+class SimulatedCrash(Exception):
+    """The scheduler process died (kill -9, OOM, GC-stall eviction).
+    Raised from the ``_pre_commit_hook`` seam — after a batch's pods
+    are assumed and approved, before any bind commits — and caught by
+    the HARNESS, never the scheduler: from the cluster's point of view
+    the process simply stopped, with every piece of incarnation-local
+    state (assumed pods, Permit waiters, in-flight maps, deferred
+    solves) evaporating. The harness then constructs a fresh
+    incarnation on the same ClusterState (sim/harness.py)."""
+
+
+class CrashInjector:
+    """Installed as ``Scheduler._pre_commit_hook``: once armed, the
+    next batch that reaches its commit point dies mid-batch — the
+    deterministic kill-after-assume-before-bind the crash_restart
+    profile drives. One-shot: the raise disarms it (the restarted
+    incarnation keeps running)."""
+
+    def __init__(self) -> None:
+        self.armed = False
+        self.crashes = 0
+
+    def arm(self) -> None:
+        self.armed = True
+
+    def __call__(self, pending) -> None:
+        if not self.armed:
+            return
+        self.armed = False
+        self.crashes += 1
+        metrics.sim_faults_injected_total.labels("crash").inc()
+        raise SimulatedCrash(
+            f"sim: process crash mid-batch ({len(pending)} pod(s) "
+            "assumed+approved, none committed)"
+        )
+
+
 class StallingPermitPlugin(PermitPlugin):
     """Out-of-tree Permit plugin: WAITs a pod's FIRST attempt with some
     probability; retries (and everything in settling mode) pass. Parked

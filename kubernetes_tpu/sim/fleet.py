@@ -53,6 +53,7 @@ from .invariants import (
     _record,
     check_constraints,
     check_fleet_journal_completeness,
+    check_hub_partition,
     check_no_global_overcommit,
 )
 from .profiles import Profile, get_profile
@@ -124,7 +125,9 @@ class FleetSimHarness:
         for node in self.generator.seed_nodes():
             self.cluster.create_node(node)
 
-        self.exchange = OccupancyExchange()
+        # the hub shares the virtual clock so occupancy-row aging (the
+        # staleness bounds) rides the same timeline as everything else
+        self.exchange = OccupancyExchange(clock=self.clock)
         self.universe = tuple(f"r{i}" for i in range(self.n))
         self.schedulers: dict[str, Scheduler] = {}
         for rid in self.universe:
@@ -142,6 +145,7 @@ class FleetSimHarness:
                         replica=rid,
                         replicas=self.universe,
                         exchange=self.exchange,
+                        max_row_age_s=self.profile.fleet_max_row_age_s,
                     ),
                 ),
                 clock=self.clock,
@@ -156,6 +160,14 @@ class FleetSimHarness:
         }
         self._events_applied = 0
         self._lost_replica: str | None = None
+        # hub-partition / zombie state (the hub_partition profile):
+        # the zombie keeps DRIVING while partitioned — unlike a lost
+        # replica it is alive, just lease-stale and hub-unreachable —
+        # and every bind it attempts must be rejected by its revoked
+        # commit fence
+        self._zombie: str | None = None
+        self._zombie_fenced = False
+        self._zombie_binds_while_fenced = 0
 
     # -- drive --
 
@@ -168,6 +180,9 @@ class FleetSimHarness:
         scheduled = [
             (pod, node) for r in results for pod, node in r.scheduled
         ]
+        if rid == self._zombie and self._zombie_fenced and scheduled:
+            # a fenced zombie's commit LANDED: the fence leaked
+            self._zombie_binds_while_fenced += len(scheduled)
         self.tracker.record_results(scheduled)
         self._sched_bound.update(pod for pod, _ in scheduled)
         self._binds_by_replica[rid] += len(scheduled)
@@ -185,7 +200,16 @@ class FleetSimHarness:
         )
 
     def _drive(self, cycle: int) -> None:
-        for rid in self.universe:
+        order = list(self.universe)
+        if self._zombie_fenced and self._zombie in order:
+            # real replicas run concurrently; the interleaving the
+            # commit fence exists for is the zombie racing AHEAD of the
+            # survivors that re-owned its shard — so while fenced it
+            # drives first each cycle, attempting commits on pods the
+            # survivors haven't taken yet (all must reject)
+            order.remove(self._zombie)
+            order.insert(0, self._zombie)
+        for rid in order:
             if self.alive[rid]:
                 self._drive_replica(rid, cycle)
 
@@ -203,6 +227,41 @@ class FleetSimHarness:
         survivors = [r for r in self.universe if self.alive[r]]
         for r in survivors:
             self.schedulers[r].fleet.set_alive(survivors)
+
+    def _partition_hub(self, cycle: int) -> None:
+        """The hub_partition fault: the last replica loses its network
+        path to the occupancy hub AND its lease renewals stall (the
+        classic GC-pause zombie). The survivors observe the stale
+        lease, mark it dead, and — through the membership transition —
+        REVOKE its commit fence at the state service. The zombie keeps
+        driving with its stale view; every bind it attempts must now
+        reject with Conflict."""
+        zombie = self.universe[-1]
+        self._zombie = zombie
+        self._zombie_fenced = True
+        metrics.sim_faults_injected_total.labels("hub_partition").inc()
+        metrics.sim_faults_injected_total.labels("lease_fence").inc()
+        self.exchange.set_partitioned(zombie, True)
+        survivors = [r for r in self.universe if r != zombie]
+        for r in survivors:
+            # each survivor's poll observes the stale lease: the
+            # membership flip re-owns the zombie's shard and revokes
+            # its fence (FleetRuntime._membership_changed)
+            self.schedulers[r].fleet.set_alive(survivors)
+
+    def _heal_hub(self, cycle: int) -> None:
+        """Partition heals: the zombie reaches the hub again,
+        re-acquires its lease — a fresh fence token plus a forced full
+        resync BEFORE any commit (Scheduler.reacquire_fence) — and
+        republishes its rows; the survivors' polls see the lease fresh
+        and re-admit it."""
+        zombie = self._zombie
+        self._zombie_fenced = False
+        self.exchange.set_partitioned(zombie, False)
+        for r in self.universe:
+            if r != zombie:
+                self.schedulers[r].fleet.set_alive(self.universe)
+        self.schedulers[zombie].reacquire_fence()
 
     def _check(self, cycle: int) -> None:
         self.tracker.drain(cycle, self.violations)
@@ -255,6 +314,13 @@ class FleetSimHarness:
             metrics.sim_cycles_total.inc()
             if cycle == self.profile.replica_loss_at and self.n > 1:
                 self._kill_replica(self.universe[-1], cycle)
+            if cycle == self.profile.hub_partition_at and self.n > 1:
+                self._partition_hub(cycle)
+            if (
+                self._zombie is not None
+                and cycle == self.profile.hub_partition_heal
+            ):
+                self._heal_hub(cycle)
             for ev in self.generator.generate(cycle):
                 apply_event(self.cluster, ev)
                 self._events_applied += 1
@@ -303,6 +369,26 @@ class FleetSimHarness:
             self.violations,
             self._sched_bound,
         )
+        if self.profile.hub_partition_at >= 0 and self.n > 1:
+            zombie_sched = (
+                self.schedulers[self._zombie]
+                if self._zombie is not None
+                else None
+            )
+            check_hub_partition(
+                self.cycles + self.max_settle_rounds,
+                self.violations,
+                fenced_commits=(
+                    zombie_sched._fenced_commits
+                    if zombie_sched is not None
+                    else 0
+                ),
+                zombie_binds_while_fenced=self._zombie_binds_while_fenced,
+                stale_rejections=sum(
+                    s.fleet.stale_rejections
+                    for s in self.schedulers.values()
+                ),
+            )
         bindings = {
             p.key: p.node_name
             for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
@@ -328,6 +414,20 @@ class FleetSimHarness:
             "violations": len(self.violations),
             "binds_by_replica": dict(
                 sorted(self._binds_by_replica.items())
+            ),
+            # partition-safety counters (hub_partition): who the zombie
+            # was, per-replica fence rejections at the state service,
+            # zombie binds that LANDED while fenced (must be 0), and
+            # conservative-admission rejections under stale rows
+            "zombie": self._zombie,
+            "fenced_commits": {
+                rid: s._fenced_commits
+                for rid, s in sorted(self.schedulers.items())
+            },
+            "zombie_binds_while_fenced": self._zombie_binds_while_fenced,
+            "stale_rejections": sum(
+                s.fleet.stale_rejections
+                for s in self.schedulers.values()
             ),
             "journal_digests": digests,
         }

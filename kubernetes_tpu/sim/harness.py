@@ -36,9 +36,11 @@ from ..state.cluster import ClusterState
 from ..utils.clock import FakeClock
 from .faults import (
     BindFaultInjector,
+    CrashInjector,
     DecisionJournal,
     DelayedWatchBus,
     FlakyExtenderTransport,
+    SimulatedCrash,
     SolverFaultInjector,
     StallingPermitPlugin,
 )
@@ -53,7 +55,9 @@ from .invariants import (
     check_constraints,
     check_journal_completeness,
     check_lost_pods,
+    check_recovery,
     check_resilience,
+    merged_last_outcomes,
 )
 from .profiles import Profile, get_profile
 from .trace import TraceReader, TraceWriter
@@ -172,37 +176,52 @@ class SimHarness:
         self.flight_dump_path = flight_dump
         from ..resilience import ResilienceConfig
 
-        self.scheduler = Scheduler(
-            self.cluster,
-            SchedulerConfig(
-                batch_size=self.profile.batch_size,
-                # short breaker fault window so probes and re-closes
-                # land inside the run's virtual timeline (the
-                # resilience invariant asserts the re-close)
-                resilience=ResilienceConfig(
-                    open_seconds=self.profile.resilience_open_s
-                ),
-                # node-axis solve mesh: results are bit-exactly device-
-                # count invariant, so a mesh_devices=N run's trace and
-                # journal must be byte-identical to the single-device run
-                # with the same seed (the multichip CI smoke leans on
-                # this). Default 1: sim runs are usually single-device.
-                mesh_devices=mesh_devices,
-                solver=ExactSolverConfig(
-                    tie_break="first", group_size=self.profile.group_size
-                ),
-                extenders=extenders,
-                out_of_tree_plugins=plugins,
-                # the decision journal is always on in the sim: the
-                # trace-completeness invariant and the byte-identical-
-                # journal determinism contract both ride on it. Spans
-                # are opt-in (they multiply recorder traffic).
-                obs=ObsConfig(
-                    spans=spans, journal=True, dump_path=flight_dump
-                ),
+        self._base_config = SchedulerConfig(
+            batch_size=self.profile.batch_size,
+            # short breaker fault window so probes and re-closes
+            # land inside the run's virtual timeline (the
+            # resilience invariant asserts the re-close)
+            resilience=ResilienceConfig(
+                open_seconds=self.profile.resilience_open_s
             ),
-            clock=self.clock,
+            # node-axis solve mesh: results are bit-exactly device-
+            # count invariant, so a mesh_devices=N run's trace and
+            # journal must be byte-identical to the single-device run
+            # with the same seed (the multichip CI smoke leans on
+            # this). Default 1: sim runs are usually single-device.
+            mesh_devices=mesh_devices,
+            solver=ExactSolverConfig(
+                tie_break="first", group_size=self.profile.group_size
+            ),
+            extenders=extenders,
+            out_of_tree_plugins=plugins,
+            # every sim scheduler binds under a fence token so a
+            # crash-restarted incarnation structurally supersedes its
+            # predecessor (the commit-fencing layer rides every
+            # profile; it only acts when a token goes stale)
+            fence_role="sim-scheduler",
+            # the decision journal is always on in the sim: the
+            # trace-completeness invariant and the byte-identical-
+            # journal determinism contract both ride on it. Spans
+            # are opt-in (they multiply recorder traffic).
+            obs=ObsConfig(
+                spans=spans, journal=True, dump_path=flight_dump
+            ),
         )
+        # process lifecycle (crash_restart): incarnations share one
+        # virtual timeline; a crash retires the live scheduler's
+        # journal here and a fresh incarnation takes over
+        self.incarnations = 1
+        self._dead_journals: list[list[str]] = []
+        self._orphans_at_restart = 0
+        self.crash_injector: CrashInjector | None = None
+        if self.profile.crash_at >= 0:
+            self.crash_injector = CrashInjector()
+        self.scheduler = Scheduler(
+            self.cluster, self._base_config, clock=self.clock
+        )
+        if self.crash_injector is not None:
+            self.scheduler._pre_commit_hook = self.crash_injector
         self.ext_transport: FlakyExtenderTransport | None = None
         if self.profile.extender:
             self.ext_transport = FlakyExtenderTransport(
@@ -279,6 +298,60 @@ class SimHarness:
     # -- drive + invariants --
 
     def _drive(self, cycle: int) -> None:
+        try:
+            self._drive_once(cycle)
+        except SimulatedCrash:
+            # the scheduler process died mid-batch (after assume,
+            # before bind): every piece of incarnation-local state —
+            # assumed pods, Permit waiters, in-flight maps, deferred
+            # solves — evaporates with the object, and a fresh
+            # incarnation recovers from cluster truth. Batches the
+            # dying drive had already completed lose their result
+            # accounting (acceptable: the ground-truth tracker still
+            # watches the state service directly).
+            self._restart(cycle)
+
+    def _restart(self, cycle: int) -> None:
+        """Construct the successor incarnation on the same ClusterState
+        and re-wire the harness seams to it. The dead incarnation's
+        journal is retained — the completeness invariant merges it with
+        its successors' (its dangling non-terminal histories must be
+        closed by the recovery pass's terminal ``recovered``
+        records)."""
+        import dataclasses
+
+        dead = self.scheduler
+        self._dead_journals.append(list(dead.journal.lines))
+        self.incarnations += 1
+        self._orphans_at_restart = sum(
+            1 for p in self.cluster.list_pods() if not p.node_name
+        )
+        cfg = dataclasses.replace(
+            self._base_config, incarnation=self.incarnations
+        )
+        new = Scheduler(self.cluster, cfg, clock=self.clock)
+        # mirror the init wiring: the new incarnation's watch stream
+        # routes through the (shared) delivery bus, not directly
+        self.cluster.unsubscribe(new._on_event)
+        self.bus._deliver = new._on_event
+        new._post_dispatch_hook = self._on_dispatch
+        if self.crash_injector is not None:
+            new._pre_commit_hook = self.crash_injector
+        if self.solver_injector is not None:
+            new._solve_fault = self.solver_injector
+        if self.ext_transport is not None:
+            for cl in new.extender_clients:
+                cl.transport = self.ext_transport
+        self.scheduler = new
+        # bounded recovery: the fresh incarnation must account for
+        # EVERY unbound pod the moment its recovery pass finishes —
+        # before any drive — or the crash lost work
+        check_lost_pods(
+            self.cluster, new, cycle, self.violations,
+            undelivered=self.bus.pending_pod_adds,
+        )
+
+    def _drive_once(self, cycle: int) -> None:
         if self.pipelined:
             try:
                 results = self.scheduler.run_pipelined(max_batches=200)
@@ -362,6 +435,13 @@ class SimHarness:
                         lambda: self._fault_rng.randint(0, pending),
                     )
                 )
+            if (
+                self.crash_injector is not None
+                and cycle == self.profile.crash_at
+            ):
+                # kill the scheduler at this cycle's first commit
+                # point: pods assumed + approved, nothing bound
+                self.crash_injector.arm()
             self._drive(cycle)
             self._permit_verdicts()
             self._check(cycle)
@@ -434,17 +514,42 @@ class SimHarness:
     def _finish(self, settled: bool) -> SimResult:
         # trace completeness (the obs tentpole's sim contract): every
         # pod this scheduler owned has a journal history ending in a
-        # terminal outcome
+        # terminal outcome — merged ACROSS incarnations when a crash
+        # retired one mid-run (the recovery pass's terminal 'recovered'
+        # records must close every history the crash left dangling)
         journal = self.scheduler.journal
+        journal_sets = self._dead_journals + [list(journal.lines)]
         check_journal_completeness(
             self.cluster,
             self.scheduler,
             self.cycles + self.max_settle_rounds,
             self.violations,
-            journal.last_outcomes(),
+            merged_last_outcomes(journal_sets),
             self._sched_bound,
             undelivered=self.bus.pending_pod_adds(),
         )
+        import json as _json
+
+        recovered_records = sum(
+            1
+            for lines in journal_sets
+            for line in lines
+            if _json.loads(line)["outcome"] == "recovered"
+        )
+        if self.profile.crash_at >= 0:
+            check_recovery(
+                self.cycles + self.max_settle_rounds,
+                self.violations,
+                crash_expected=True,
+                crashes=(
+                    self.crash_injector.crashes
+                    if self.crash_injector is not None
+                    else 0
+                ),
+                incarnations=self.incarnations,
+                orphans_at_restart=self._orphans_at_restart,
+                recovered_records=recovered_records,
+            )
         if self.solver_injector is not None:
             # solver-boundary chaos acceptance: fallback engaged,
             # breaker back at the top tier, poison isolated
@@ -469,8 +574,9 @@ class SimHarness:
         }
         import hashlib
 
+        all_lines = [line for lines in journal_sets for line in lines]
         journal_digest = hashlib.sha256(
-            ("\n".join(journal.lines) + "\n").encode()
+            ("\n".join(all_lines) + "\n").encode()
         ).hexdigest()
         summary = {
             "pipelined": self.pipelined,
@@ -504,9 +610,20 @@ class SimHarness:
             "quarantined": sorted(
                 self.scheduler._quarantine_counts
             ),
+            # process lifecycle (crash_restart): incarnations that ran,
+            # crashes injected, terminal 'recovered' records the fresh
+            # incarnation journaled for crash-orphaned pods
+            "incarnations": self.incarnations,
+            "crashes": (
+                self.crash_injector.crashes
+                if self.crash_injector is not None
+                else 0
+            ),
+            "recovered_records": recovered_records,
             # the journal digest rides in the footer, so the trace
             # selfcheck also proves journal byte-identity across runs
-            "journal_records": len(journal.lines),
+            # (all incarnations' lines, in incarnation order)
+            "journal_records": len(all_lines),
             "journal_digest": journal_digest,
             **deltas,
         }
@@ -537,7 +654,7 @@ class SimHarness:
             summary=summary,
             trace=self.trace,
             replay_divergence=divergence,
-            journal_lines=list(journal.lines),
+            journal_lines=all_lines,
             flight_dump=flight_dump,
         )
 

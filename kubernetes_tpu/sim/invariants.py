@@ -43,7 +43,8 @@ from ..state.cluster import ClusterState, Event
 @dataclass(frozen=True)
 class Violation:
     invariant: str  # double_bind | capacity | lost_pod | progress |
-    # monotonic | constraint | journal | global_overcommit | resilience
+    # monotonic | constraint | journal | global_overcommit |
+    # resilience | recovery | fencing
     cycle: int
     detail: str
 
@@ -343,6 +344,20 @@ def check_fleet_journal_completeness(
     from ..obs.journal import TERMINAL_OUTCOMES
     import json
 
+    # merge key: latest virtual time wins; on a t-tie prefer terminal,
+    # then 'bound' (a bind is irrevocable, so no same-instant record
+    # from another replica can supersede it — e.g. a fenced zombie's
+    # bind_failure racing the survivor's successful bind in the same
+    # cycle), then the within-replica step (steps are NOT comparable
+    # across replicas, so it only breaks same-replica ties)
+    def _key(rec: dict) -> tuple:
+        return (
+            rec["t"],
+            1 if rec["outcome"] in TERMINAL_OUTCOMES else 0,
+            1 if rec["outcome"] == "bound" else 0,
+            rec["step"],
+        )
+
     merged: dict[str, dict] = {}
     for sched in schedulers:
         if sched.journal is None:
@@ -350,20 +365,7 @@ def check_fleet_journal_completeness(
         for line in sched.journal.lines:
             rec = json.loads(line)
             cur = merged.get(rec["pod"])
-            key = (
-                rec["t"], 1 if rec["outcome"] in TERMINAL_OUTCOMES else 0,
-                rec["step"],
-            )
-            cur_key = (
-                (
-                    cur["t"],
-                    1 if cur["outcome"] in TERMINAL_OUTCOMES else 0,
-                    cur["step"],
-                )
-                if cur is not None
-                else None
-            )
-            if cur_key is None or key >= cur_key:
+            if cur is None or _key(rec) >= _key(cur):
                 merged[rec["pod"]] = rec
     solver_names = set()
     for sched in schedulers:
@@ -463,6 +465,119 @@ def check_resilience(
             f"{poison_hits} poison-batch failures were injected but "
             "no pod was ever quarantined — the bisection never "
             "isolated the poison",
+        )
+
+
+def merged_last_outcomes(journal_line_sets) -> dict[str, dict]:
+    """Last-record-wins merge of decision journals across scheduler
+    INCARNATIONS (the process-lifecycle analog of the fleet merge):
+    within one incarnation records append in virtual-time order, and a
+    successor incarnation's records all follow its predecessor's on the
+    shared timeline, so feeding the line sets in incarnation order and
+    letting the last record win yields each pod's true final outcome.
+    The journal-completeness invariant then holds ACROSS a crash: the
+    recovery pass's terminal ``recovered`` records close every history
+    the dead incarnation left dangling."""
+    import json
+
+    out: dict[str, dict] = {}
+    for lines in journal_line_sets:
+        for line in lines:
+            rec = json.loads(line)
+            out[rec["pod"]] = rec
+    return out
+
+
+def check_recovery(
+    cycle: int,
+    violations: list[Violation],
+    *,
+    crash_expected: bool,
+    crashes: int,
+    incarnations: int,
+    orphans_at_restart: int,
+    recovered_records: int,
+) -> None:
+    """Crash-restart recovery invariants (the crash_restart profile):
+
+    - **crash engaged** — the profile demanded a mid-batch kill and
+      one actually fired (zero crashes would make every other
+      assertion vacuous);
+    - **fresh incarnation** — a crash was followed by a restarted
+      Scheduler (incarnations advanced);
+    - **orphans re-adopted and journaled** — the pods the crash
+      orphaned (unbound at restart) each got a terminal ``recovered``
+      record from the fresh incarnation, so the merged
+      cross-incarnation journal stays complete. Bounded recovery —
+      every orphan accounted for immediately after the restart — is
+      asserted by the lost-pod check the harness runs right after
+      constructing the new incarnation.
+    """
+    if not crash_expected:
+        return
+    if crashes < 1:
+        _record(
+            violations, "recovery", cycle,
+            "the profile demanded a mid-batch crash but none fired — "
+            "the process-lifecycle fault never engaged",
+        )
+        return
+    if incarnations < 2:
+        _record(
+            violations, "recovery", cycle,
+            f"{crashes} crash(es) fired but only {incarnations} "
+            "incarnation(s) ever ran — the restart never happened",
+        )
+    if orphans_at_restart > 0 and recovered_records < 1:
+        _record(
+            violations, "recovery", cycle,
+            f"the crash orphaned {orphans_at_restart} unbound pod(s) "
+            "but the fresh incarnation journaled zero terminal "
+            "'recovered' records — cross-incarnation journal "
+            "completeness cannot hold",
+        )
+
+
+def check_hub_partition(
+    cycle: int,
+    violations: list[Violation],
+    *,
+    fenced_commits: int,
+    zombie_binds_while_fenced: int,
+    stale_rejections: int,
+) -> None:
+    """Partition-safety invariants (the hub_partition profile):
+
+    - **all-zombie-commits-fenced** — every bind the fenced replica
+      attempted was rejected with Conflict: zero of its commits landed
+      while its fence was revoked, and at least one attempt actually
+      happened (zero attempts would make the fence assertion vacuous);
+    - **conservative admission engaged** — while peer occupancy rows
+      were aged out past the staleness bound, at least one cross-shard-
+      constrained placement was rejected as stale rather than admitted
+      against rows that may hide peers' placements. (That no violating
+      placement ever landed is asserted by the constraint/overcommit
+      checks that run every cycle.)
+    """
+    if fenced_commits < 1:
+        _record(
+            violations, "fencing", cycle,
+            "the zombie replica never had a commit rejected by the "
+            "fence — the zombie-writes-after-lease-loss fault never "
+            "engaged",
+        )
+    if zombie_binds_while_fenced > 0:
+        _record(
+            violations, "fencing", cycle,
+            f"{zombie_binds_while_fenced} bind(s) by the fenced "
+            "replica LANDED — the commit fence leaked a zombie write",
+        )
+    if stale_rejections < 1:
+        _record(
+            violations, "fencing", cycle,
+            "no placement was ever rejected by the occupancy-staleness "
+            "bound — conservative admission never engaged during the "
+            "partition",
         )
 
 

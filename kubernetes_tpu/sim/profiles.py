@@ -86,6 +86,27 @@ class Profile:
     # re-owned by the survivors and every orphaned pod must still reach
     # a terminal journal outcome fleet-wide. -1 = never.
     replica_loss_at: int = -1
+    # -- process lifecycle (crash_restart / hub_partition) --
+    # crash the scheduler at this cycle, mid-batch: the first batch of
+    # that cycle dies AFTER its pods are assumed/approved and BEFORE
+    # any bind commits (the _pre_commit_hook seam), and a FRESH
+    # Scheduler incarnation is constructed on the same ClusterState —
+    # the cold-start recovery pass re-adopts everything the crash
+    # orphaned. -1 = never.
+    crash_at: int = -1
+    # fleet drive: partition the last replica from the occupancy hub
+    # over virtual cycles [hub_partition_at, hub_partition_heal). Its
+    # lease is observed stale at partition start (survivors mark it
+    # dead and REVOKE its commit fence — it keeps driving as a zombie
+    # whose binds must all reject with Conflict), and at heal it
+    # re-acquires the fence + resyncs while the survivors re-admit it.
+    hub_partition_at: int = -1
+    hub_partition_heal: int = -1
+    # occupancy-staleness bound the fleet drive passes to FleetConfig
+    # (max_row_age_s): hub_partition shrinks it so peer-row aging
+    # crosses the bound inside the window and conservative admission
+    # actually engages.
+    fleet_max_row_age_s: float = 30.0
 
     def validate(self) -> None:
         if self.watch_delay and (
@@ -236,6 +257,63 @@ PROFILES: dict[str, Profile] = {
             pod_ports_rate=0.15,
             delete_pod_rate=0.4,
             fleet_replicas=2,
+        ),
+        # crash_restart: the scheduler process dies mid-batch — after
+        # its pods are assumed and approved, before any bind commits —
+        # and a FRESH incarnation is constructed on the same
+        # ClusterState. Every piece of incarnation-local state (assumed
+        # pods, Permit-parked waiters, the nominated index, in-flight
+        # maps) evaporates; the recovery pass must rebuild from truth,
+        # re-adopt every orphan (terminal `recovered` journal records),
+        # and the merged cross-incarnation journal must stay complete
+        # with zero double-binds. Permit stalls guarantee parked
+        # waiters exist at the crash; priority arrivals drive orphaned
+        # nominations; delete churn exercises orphans vanishing before
+        # re-adoption.
+        Profile(
+            name="crash_restart",
+            nodes=5,
+            arrivals=(2, 6),
+            pod_spread_rate=0.2,
+            pod_ports_rate=0.15,
+            pod_cpu_choices=("1", "2"),
+            pod_priorities=(0, 0, 0, 1000),
+            delete_pod_rate=0.3,
+            permit=True,
+            permit_stall_rate=0.4,
+            permit_timeout=5.0,
+            crash_at=4,
+        ),
+        # hub_partition: fleet_mixed plus the last replica partitioned
+        # from the occupancy hub, its lease observed stale (survivors
+        # revoke its commit fence AND retire its exchange state — 100%
+        # of the zombie's bind attempts must reject with Conflict),
+        # while the ZOMBIE's own cached peer view ages past the
+        # staleness bound so its admission turns conservative for
+        # cross-shard-constrained shapes (the survivors handle the
+        # detected-dead peer via membership + retire, not staleness —
+        # the silent-peer aging path is unit-tested in
+        # tests/test_fencing.py). Heals mid-run: the zombie
+        # re-acquires its fence, resyncs, republishes — the fleet must
+        # settle clean.
+        Profile(
+            name="hub_partition",
+            nodes=9,
+            zones=3,
+            arrivals=(3, 6),
+            # enough PLAIN arrivals that the zombie's fenced bind path
+            # actually fires during the window (spread/anti arrivals
+            # are stale-rejected by conservative admission BEFORE the
+            # bind — both paths must engage, and the invariant asserts
+            # each did)
+            pod_spread_rate=0.2,
+            pod_anti_rate=0.1,
+            pod_ports_rate=0.1,
+            delete_pod_rate=0.3,
+            fleet_replicas=2,
+            hub_partition_at=2,
+            hub_partition_heal=6,
+            fleet_max_row_age_s=2.0,
         ),
         # replica_loss: fleet_mixed plus one replica killed mid-drive.
         # The survivors must re-own its shard (ring orphan

@@ -73,6 +73,7 @@ class _AssumedInfo:
     node_name: str
     binding_finished: bool = False
     deadline: float | None = None  # set by FinishBinding
+    assumed_at: float = 0.0  # when the assume landed (unfinished reap)
 
 
 class SchedulerCache:
@@ -106,7 +107,9 @@ class SchedulerCache:
         info.add_pod(pod)
         self._bump(info)
         self._pod_node[pod.key] = node_name
-        self._assumed[pod.key] = _AssumedInfo(node_name)
+        self._assumed[pod.key] = _AssumedInfo(
+            node_name, assumed_at=self._clock.now()
+        )
 
     def forget_pod(self, pod_key: str) -> None:
         """Bind failed: release the optimistic placement."""
@@ -124,14 +127,37 @@ class SchedulerCache:
     def is_assumed(self, pod_key: str) -> bool:
         return pod_key in self._assumed
 
-    def cleanup_expired(self) -> list[str]:
+    def cleanup_expired(self, protected: frozenset = frozenset()) -> list[str]:
         """Expire assumed pods whose bind confirmation never arrived
-        (cache.go#cleanupAssumedPods). Returns expired pod keys."""
+        (cache.go#cleanupAssumedPods). Returns expired pod keys.
+
+        Two populations expire:
+
+        - **finished** assumes (FinishBinding ran) past their deadline —
+          the bind landed but the confirming watch event never arrived;
+        - **unfinished** assumes older than the TTL — the binding cycle
+          died between assume and finish (a crashed commit thread, an
+          unwound exception path): without this arm the leaked assume
+          holds phantom occupancy forever (pre-PR-8 gap: this reap both
+          didn't cover them and was never even called by the
+          scheduler). ``protected`` exempts pods legitimately parked
+          assumed-unfinished across cycles — the Permit WaitingPods map
+          — whose rollback deadline is the permit timeout, not the
+          assume TTL."""
         now = self._clock.now()
         expired = [
             k
             for k, a in self._assumed.items()
-            if a.binding_finished and a.deadline is not None and a.deadline <= now
+            if (
+                a.binding_finished
+                and a.deadline is not None
+                and a.deadline <= now
+            )
+            or (
+                not a.binding_finished
+                and k not in protected
+                and now - a.assumed_at > self._ttl
+            )
         ]
         for k in expired:
             self._assumed.pop(k)

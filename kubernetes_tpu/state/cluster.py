@@ -38,8 +38,13 @@ EventType = Literal["ADDED", "MODIFIED", "DELETED"]
 
 
 class ApiError(Exception):
-    def __init__(self, reason: str, message: str = ""):
+    def __init__(self, reason: str, message: str = "", fenced: bool = False):
         self.reason = reason  # Conflict | NotFound | AlreadyExists | Invalid
+        # True when a Conflict came from the fencing-token check: the
+        # caller's fence token is revoked/superseded (it is a zombie).
+        # A typed flag, not a message-prefix contract, so rewording the
+        # message cannot silently break the scheduler's classification.
+        self.fenced = fenced
         super().__init__(f"{reason}: {message}")
 
 
@@ -154,6 +159,18 @@ class ClusterState:
         # fault injection: called with (pod, node_name) before a bind commits;
         # raise ApiError to simulate apiserver-side rejection
         self.bind_fault: Callable[[Pod, str], None] | None = None
+        # fencing tokens (the classic lease-epoch pattern, server-side):
+        # role -> the currently valid token. grant_fence bumps and hands
+        # out a fresh token; revoke_fence bumps WITHOUT handing it out,
+        # so every outstanding token for the role goes stale. A bind
+        # carrying a stale token is rejected with Conflict — the commit
+        # path's zombie fence (a scheduler incarnation that lost its
+        # lease or was superseded can never land a bind).
+        self._fences: dict[str, int] = {}
+        self._fence_holders: dict[str, str] = {}
+        # role -> rejected-commit count (the sim's zombie invariant
+        # asserts 100% of a fenced incarnation's commits land here)
+        self.fence_rejections: dict[str, int] = {}
 
     # -- watch plumbing --
 
@@ -180,10 +197,30 @@ class ClusterState:
         raise ApiError("NotFound", "watcher not subscribed")
 
     def _emit(self, etype: EventType, kind: str, obj: Pod | Node) -> None:
+        """Deliver one event to every subscriber. Delivery is ISOLATED:
+        an exception in one subscriber's filter or callback is caught
+        and counted (scheduler_watch_delivery_error_total) so it can
+        neither prevent delivery to the remaining subscribers nor
+        corrupt the event sequence (the rv was committed before any
+        delivery started). The mutation that emitted the event has
+        already landed — swallowing a subscriber's crash here is the
+        informer-relay contract, not data loss."""
+        from .. import metrics
+
         ev = Event(etype, kind, obj, self._rv)
         for w, flt in list(self._watchers):
-            if flt is None or flt(ev):
-                w(ev)
+            try:
+                if flt is None or flt(ev):
+                    w(ev)
+            except Exception:
+                metrics.watch_delivery_error_total.inc()
+                import logging
+
+                logging.getLogger("kubernetes_tpu.cluster").exception(
+                    "watch subscriber raised during %s %s delivery "
+                    "(rv %d); remaining subscribers still served",
+                    etype, kind, self._rv,
+                )
 
     def _next_rv(self) -> int:
         self._rv += 1
@@ -261,8 +298,60 @@ class ClusterState:
     def list_pods(self) -> list[Pod]:
         return list(self._pods.values())
 
-    def bind(self, namespace: str, name: str, node_name: str) -> None:
-        """POST pods/{name}/binding — the commit point of a scheduling cycle."""
+    # -- fencing tokens (commit-path zombie fence) --
+
+    def grant_fence(self, role: str, holder: str = "") -> int:
+        """Issue a fresh fencing token for ``role`` (a lease identity:
+        the scheduler's leader lease, a fleet replica's per-shard
+        lease). Granting invalidates every previously issued token for
+        the role — a new incarnation taking over automatically fences
+        its predecessor. Models the lease epoch committed at the
+        apiserver; callers pass the token back on bind()."""
+        token = self._fences.get(role, 0) + 1
+        self._fences[role] = token
+        self._fence_holders[role] = holder
+        return token
+
+    def revoke_fence(self, role: str) -> None:
+        """Invalidate the role's current token WITHOUT granting a new
+        one: every outstanding holder is fenced until someone re-grants
+        (re-acquires the lease). The fleet calls this when a peer's
+        lease goes stale — the membership change is committed HERE, at
+        the authority, so a partitioned zombie that can still reach the
+        state service finds its commits rejected."""
+        self._fences[role] = self._fences.get(role, 0) + 1
+        self._fence_holders[role] = ""
+
+    def fence_valid(self, role: str, token: int) -> bool:
+        return self._fences.get(role) == token
+
+    def bind(
+        self,
+        namespace: str,
+        name: str,
+        node_name: str,
+        fence: "tuple[str, int] | None" = None,
+    ) -> None:
+        """POST pods/{name}/binding — the commit point of a scheduling
+        cycle. ``fence`` = (role, token) from grant_fence: a stale
+        token is rejected with Conflict before anything else is
+        examined — a fenced (lease-lost, partitioned, or superseded)
+        incarnation can never land a commit, no matter what its stale
+        cache believes about ownership."""
+        if fence is not None:
+            role, token = fence
+            if not self.fence_valid(role, token):
+                self.fence_rejections[role] = (
+                    self.fence_rejections.get(role, 0) + 1
+                )
+                raise ApiError(
+                    "Conflict",
+                    f"fenced: token {token} for role {role!r} is no "
+                    f"longer valid (current "
+                    f"{self._fences.get(role)}); the incarnation lost "
+                    "its lease or was superseded",
+                    fenced=True,
+                )
         pod = self.get_pod(namespace, name)
         if pod.node_name:
             raise ApiError("Conflict", f"{pod.key} already bound to {pod.node_name}")

@@ -62,6 +62,37 @@ python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile solver_flaky \
 python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile poison_pods \
     --selfcheck
 
+echo "== crash smoke: restart recovery + partition-safe fencing =="
+# crash_restart: the scheduler is killed mid-batch (pods assumed +
+# approved, nothing bound) and a fresh incarnation recovers on the
+# same ClusterState. The run's invariants assert zero lost pods
+# (bounded recovery runs the lost-pod check the moment the new
+# incarnation constructs), cross-incarnation journal completeness
+# (terminal `recovered` records close the dead incarnation's dangling
+# histories), and zero double-binds; --selfcheck proves the whole
+# crash/restart boundary byte-deterministic. The greps pin the faults
+# actually engaging — a run that never crashed or never recovered
+# would pass the invariants vacuously.
+crash_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 8 \
+    --profile crash_restart --selfcheck)
+echo "$crash_out"
+echo "$crash_out" | grep -q "incarnations=2 crashes=1" \
+    || { echo "CRASH SMOKE: the mid-batch kill never fired"; exit 1; }
+echo "$crash_out" | grep -qE "recovered_records=[1-9]" \
+    || { echo "CRASH SMOKE: recovery journaled no recovered records"; exit 1; }
+# hub_partition: the last replica is partitioned from the occupancy
+# hub with its lease observed stale — survivors revoke its commit
+# fence and 100% of the zombie's bind attempts must reject with
+# Conflict (the all-zombie-commits-fenced invariant), while
+# conservative admission under aged-out rows rejects cross-shard-risky
+# placements instead of risking overcommit. The grep pins >= 1 fenced
+# zombie commit (and zero landed).
+part_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 8 \
+    --profile hub_partition --fleet 2 --selfcheck)
+echo "$part_out"
+echo "$part_out" | grep -qE "fenced_commits=[1-9][0-9]* zombie_binds_while_fenced=0" \
+    || { echo "CRASH SMOKE: no fenced zombie commit (or one landed)"; exit 1; }
+
 echo "== fleet smoke: 2-replica sharded drive =="
 # two active replicas sharding one cluster (shard-filtered watches,
 # cross-shard occupancy exchange, handoff protocol) under the

@@ -272,3 +272,121 @@ def test_progress_violation_on_unsettled_harness():
     res = h.run()
     assert not res.settled
     assert any(v.invariant == "progress" for v in res.violations)
+
+
+# -- recovery (crash_restart) -----------------------------------------------
+
+
+def test_recovery_flags_crash_that_never_fired():
+    from kubernetes_tpu.sim.invariants import check_recovery
+
+    violations = []
+    check_recovery(
+        0, violations, crash_expected=True, crashes=0, incarnations=1,
+        orphans_at_restart=0, recovered_records=0,
+    )
+    assert [v.invariant for v in violations] == ["recovery"]
+    assert "never engaged" in violations[0].detail
+
+
+def test_recovery_flags_missing_restart():
+    from kubernetes_tpu.sim.invariants import check_recovery
+
+    violations = []
+    check_recovery(
+        0, violations, crash_expected=True, crashes=1, incarnations=1,
+        orphans_at_restart=0, recovered_records=0,
+    )
+    assert [v.invariant for v in violations] == ["recovery"]
+    assert "restart never happened" in violations[0].detail
+
+
+def test_recovery_flags_unjournaled_orphans():
+    from kubernetes_tpu.sim.invariants import check_recovery
+
+    violations = []
+    check_recovery(
+        0, violations, crash_expected=True, crashes=1, incarnations=2,
+        orphans_at_restart=3, recovered_records=0,
+    )
+    assert [v.invariant for v in violations] == ["recovery"]
+    assert "recovered" in violations[0].detail
+
+
+def test_recovery_clean_on_good_run():
+    from kubernetes_tpu.sim.invariants import check_recovery
+
+    violations = []
+    check_recovery(
+        0, violations, crash_expected=True, crashes=1, incarnations=2,
+        orphans_at_restart=3, recovered_records=3,
+    )
+    assert violations == []
+
+
+# -- fencing (hub_partition / zombie) ---------------------------------------
+
+
+def test_fencing_flags_vacuous_zombie():
+    from kubernetes_tpu.sim.invariants import check_hub_partition
+
+    violations = []
+    check_hub_partition(
+        0, violations, fenced_commits=0, zombie_binds_while_fenced=0,
+        stale_rejections=2,
+    )
+    assert [v.invariant for v in violations] == ["fencing"]
+    assert "never engaged" in violations[0].detail
+
+
+def test_fencing_flags_leaked_zombie_bind():
+    from kubernetes_tpu.sim.invariants import check_hub_partition
+
+    violations = []
+    check_hub_partition(
+        0, violations, fenced_commits=2, zombie_binds_while_fenced=1,
+        stale_rejections=2,
+    )
+    assert [v.invariant for v in violations] == ["fencing"]
+    assert "LANDED" in violations[0].detail
+
+
+def test_fencing_flags_missing_conservative_admission():
+    from kubernetes_tpu.sim.invariants import check_hub_partition
+
+    violations = []
+    check_hub_partition(
+        0, violations, fenced_commits=2, zombie_binds_while_fenced=0,
+        stale_rejections=0,
+    )
+    assert [v.invariant for v in violations] == ["fencing"]
+    assert "conservative" in violations[0].detail
+
+
+def test_fencing_clean_on_good_partition_run():
+    from kubernetes_tpu.sim.invariants import check_hub_partition
+
+    violations = []
+    check_hub_partition(
+        0, violations, fenced_commits=2, zombie_binds_while_fenced=0,
+        stale_rejections=3,
+    )
+    assert violations == []
+
+
+# -- cross-incarnation journal merge ----------------------------------------
+
+
+def test_merged_last_outcomes_last_incarnation_wins():
+    from kubernetes_tpu.sim.invariants import merged_last_outcomes
+
+    inc1 = [
+        '{"outcome":"permit_wait","pod":"default/a","step":1,"t":1.0}',
+        '{"outcome":"bound","pod":"default/b","step":1,"t":1.0}',
+    ]
+    inc2 = [
+        '{"outcome":"recovered","pod":"default/a","step":0,"t":4.0}',
+    ]
+    merged = merged_last_outcomes([inc1, inc2])
+    assert merged["default/a"]["outcome"] == "recovered"
+    assert merged["default/b"]["outcome"] == "bound"

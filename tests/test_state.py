@@ -120,13 +120,34 @@ class TestSchedulerCache:
         assert expired == ["default/p1"]
         assert c.nodes["n1"].used.get("cpu", 0) == 0
 
-    def test_no_expiry_before_finish_binding(self):
+    def test_unfinished_assume_expires_after_ttl(self):
+        # pre-PR-8 discrepancy: an assume whose binding cycle died
+        # before finish_binding was NEVER reaped, leaking phantom
+        # occupancy forever. It now expires after the assume TTL and
+        # releases its occupancy (the restart-recovery pass leans on
+        # the same release semantics).
+        clock = FakeClock()
+        c = SchedulerCache(clock, assume_ttl=30)
+        c.add_node(node("n1"))
+        c.assume_pod(pod("p1"), "n1")
+        clock.advance(29)
+        assert c.cleanup_expired() == []  # binding still in flight
+        clock.advance(2)
+        assert c.cleanup_expired() == ["default/p1"]
+        assert c.nodes["n1"].used.get("cpu", 0) == 0  # occupancy released
+        assert not c.is_assumed("default/p1")
+
+    def test_protected_unfinished_assume_survives_ttl(self):
+        # Permit-parked pods legitimately sit assumed-unfinished across
+        # cycles: the WaitingPods map protects them from the unfinished
+        # reap (their rollback deadline is the permit timeout)
         clock = FakeClock()
         c = SchedulerCache(clock, assume_ttl=30)
         c.add_node(node("n1"))
         c.assume_pod(pod("p1"), "n1")
         clock.advance(300)
-        assert c.cleanup_expired() == []  # binding still in flight
+        assert c.cleanup_expired(protected=frozenset({"default/p1"})) == []
+        assert c.is_assumed("default/p1")
 
     def test_double_assume_rejected(self):
         c = SchedulerCache(FakeClock())
