@@ -1043,6 +1043,183 @@ def _north_star_exact() -> dict:
     }
 
 
+def ladder10_rebalance_loop() -> dict:
+    """#10: the continuous rebalancer (kubernetes_tpu/rebalance) closing
+    a seeded fragmented cluster at north-star scale — the A/B is packed
+    utilization before vs after the loop runs to convergence.
+
+    The cluster: the 51.2k uniform pods (1 cpu / 2Gi) scattered over the
+    10.24k nodes with per-node loads drawn 1..10 (aggregate ~34% packed
+    utilization on the cpu-dominant axis against the 70% packing bar,
+    bin-packing lower bound ~3.2k nodes). Each cycle runs the REAL
+    production pieces — ``detector.detect``, the runtime's drain-source
+    gather discipline (emptiest in-use nodes first; the fullest node and
+    nodes at the bar are never drained), ``planner.plan_moves`` (the
+    pack-objective auction against live load with the drain sources
+    masked) and ``planner.select_moves`` (churn budget / strict-gain /
+    joint-feasibility bounding) — then applies the selected moves to the
+    node tensors, standing in for the evict -> requeue -> re-bind
+    migration path that the ``fragmentation`` sim profile and the CI
+    smoke prove end to end (PDB gate included) at full fidelity."""
+    import numpy as np
+
+    from kubernetes_tpu.api.wrappers import MakePod
+    from kubernetes_tpu.rebalance.detector import detect
+    from kubernetes_tpu.rebalance.planner import plan_moves, select_moves
+    from kubernetes_tpu.tensorize.schema import ResourceVocab, pad_to
+
+    BUDGET = 2_048  # churn budget: evictions per cycle
+    BAR = 0.7  # min_packing — the detector's fragmentation threshold
+    MAX_CYCLES = 24  # "bounded number of cycles" gate
+
+    vocab = ResourceVocab(("cpu", "memory", "ephemeral-storage"))
+    npad = pad_to(NS_NODES)
+    names = [f"n{i}" for i in range(NS_NODES)]
+    alloc = np.zeros((3, npad), np.int64)
+    alloc[0, :NS_NODES] = 16_000
+    alloc[1, :NS_NODES] = 64 << 30
+
+    rng = np.random.default_rng(10)
+    loads = rng.integers(1, 11, NS_NODES)
+    assert int(loads.sum()) >= NS_PODS
+    pod_node = np.repeat(np.arange(NS_NODES), loads)[:NS_PODS].copy()
+    prio = rng.integers(0, 10, NS_PODS)
+    tmpl = MakePod().name("t").req({"cpu": "1", "memory": "2Gi"}).obj()
+    req = np.asarray(vocab.vectorize(tmpl.resource_request()), np.int64)
+
+    used = np.zeros((3, npad), np.int64)
+    cnt = np.zeros(npad, np.int32)
+    node_counts = np.bincount(pod_node, minlength=NS_NODES)
+    cnt[:NS_NODES] = node_counts
+    used[:, :NS_NODES] = req[:, None] * node_counts[None, :]
+    node_pods: list[list[int]] = [[] for _ in range(NS_NODES)]
+    for i, nslot in enumerate(pod_node):
+        node_pods[nslot].append(int(i))
+
+    pod_cache: dict[int, object] = {}
+    key2idx: dict[str, int] = {}
+
+    def pod_obj(i: int):
+        p = pod_cache.get(i)
+        if p is None:
+            p = (
+                MakePod()
+                .name(f"pod-{i:06}")
+                .priority(int(prio[i]))
+                .start_time(float(i))
+                .req({"cpu": "1", "memory": "2Gi"})
+                .obj()
+            )
+            pod_cache[i] = p
+            key2idx[p.key] = i
+        return p
+
+    def fill_pct() -> np.ndarray:
+        # detector.packing_score, vectorized: integer dominant-resource
+        # fill in percent points
+        cpu_f = np.where(alloc[0] > 0, used[0] / np.maximum(alloc[0], 1), 0)
+        mem_f = np.where(alloc[1] > 0, used[1] / np.maximum(alloc[1], 1), 0)
+        return (100.0 * np.maximum(np.minimum(cpu_f, 1.0), np.minimum(mem_f, 1.0))).astype(np.int64)
+
+    def gather():
+        """The runtime's ``_gather`` discipline over the tensors."""
+        fill = fill_pct()
+        in_use = np.flatnonzero(cnt[:NS_NODES] > 0)
+        order = in_use[np.lexsort((in_use, fill[in_use]))]
+        bar_pts = int(BAR * 100)
+        movable: list[tuple[object, int]] = []
+        drains: set[int] = set()
+        fixed_used = used.copy()
+        fixed_cnt = cnt.copy()
+        for slot in order[:-1]:  # never drain the fullest in-use node
+            slot = int(slot)
+            if len(movable) >= BUDGET or fill[slot] >= bar_pts:
+                break
+            take = sorted(node_pods[slot], key=lambda i: (prio[i], -i))
+            take = take[: BUDGET - len(movable)]
+            drains.add(slot)
+            for i in take:
+                movable.append((pod_obj(i), slot))
+                fixed_used[:, slot] = np.maximum(fixed_used[:, slot] - req, 0)
+                fixed_cnt[slot] = max(int(fixed_cnt[slot]) - 1, 0)
+        return movable, fixed_used, fixed_cnt, frozenset(drains)
+
+    def batch_now():
+        return _synthetic_node_batch(vocab, NS_NODES, alloc, used, cnt)
+
+    before = detect(batch_now(), min_packing=BAR)
+    plan_walls: list[float] = []
+    cycle_evictions: list[int] = []
+    for cycle in range(MAX_CYCLES):
+        batch = batch_now()
+        report = detect(batch, min_packing=BAR)
+        if not report.fragmented:
+            break
+        movable, fixed_used, fixed_cnt, drains = gather()
+        if not movable:
+            break
+        if cycle == 0:
+            # compile warm-up: the auction is deterministic, so the
+            # discarded result equals the measured one
+            plan_moves(batch, movable, fixed_used, fixed_cnt, drains)
+        t0 = time.perf_counter()
+        raw = plan_moves(batch, movable, fixed_used, fixed_cnt, drains)
+        plan_walls.append(time.perf_counter() - t0)
+        plan = select_moves(batch, names, raw, [], budget=BUDGET, min_gain=1)
+        if not plan.moves:
+            break
+        assert len(plan.moves) <= BUDGET, "churn budget exceeded"
+        cycle_evictions.append(len(plan.moves))
+        for mv in plan.moves:
+            i = key2idx[mv.pod.key]
+            src, dst = mv.source_slot, mv.target_slot
+            used[:, src] -= req
+            used[:, dst] += req
+            cnt[src] -= 1
+            cnt[dst] += 1
+            node_pods[src].remove(i)
+            node_pods[dst].append(i)
+            pod_node[i] = dst
+    after = detect(batch_now(), min_packing=BAR)
+
+    # validity gates: the A/B only counts if the end state is real —
+    # every pod still placed exactly once and no node over capacity
+    assert int(cnt[:NS_NODES].sum()) == NS_PODS
+    assert np.all(used[0, :NS_NODES] <= alloc[0, :NS_NODES])
+    assert np.all(used[1, :NS_NODES] <= alloc[1, :NS_NODES])
+    assert not after.fragmented, (
+        f"rebalance loop did not converge within {MAX_CYCLES} cycles "
+        f"(packed {after.packed_utilization:.3f})"
+    )
+    gain = after.packed_utilization - before.packed_utilization
+    assert gain > 0, "rebalance loop did not improve packed utilization"
+    # median over the (post-warm-up) cycles: the steady-state figure —
+    # min would let one lucky cycle satisfy the <1 s gate
+    solve_s = float(np.median(plan_walls))
+    return {
+        "pods": NS_PODS,
+        "nodes": NS_NODES,
+        "churn_budget": BUDGET,
+        "min_packing": BAR,
+        "packed_utilization_before": round(before.packed_utilization, 4),
+        "packed_utilization_after": round(after.packed_utilization, 4),
+        "rebalance_utilization_gain": round(gain, 4),
+        "nodes_in_use_before": before.nodes_in_use,
+        "nodes_in_use_after": after.nodes_in_use,
+        "ideal_nodes": before.ideal_nodes,
+        "stranded_fraction_before": round(before.stranded_fraction, 4),
+        "stranded_fraction_after": round(after.stranded_fraction, 4),
+        "cycles": len(cycle_evictions),
+        "max_cycles": MAX_CYCLES,
+        "evictions_total": sum(cycle_evictions),
+        "max_cycle_evictions": max(cycle_evictions, default=0),
+        "over_budget_cycles": 0,  # asserted above, every cycle
+        "rebalance_plan_solve_s": round(solve_s, 4),
+        "plan_solve_max_s": round(max(plan_walls), 4),
+        "vs_1s_target": round(NS_TARGET_S / solve_s, 2),
+    }
+
+
 def ladder7_multichip() -> dict:
     """#7: multichip A/B — the exact-parity grouped SESSION solve at the
     north-star shape (51,200 x 10,240) on 1 device vs the full node-axis
@@ -1248,6 +1425,16 @@ def main() -> None:
     ladders["8_fleet"] = fleet
     degraded = ladder9_degraded()
     ladders["9_degraded"] = degraded
+    rebalance = ladder10_rebalance_loop()
+    ladders["10_rebalance_loop"] = {
+        "config": (
+            "continuous rebalancer A/B on a seeded fragmented "
+            "51.2k x 10.24k cluster: detector + drain gather + "
+            "pack-auction plan + budget/gain/PDB-bounded selection "
+            "per cycle, loop run to detector convergence"
+        ),
+        **rebalance,
+    }
     ladders["served_grpc_5kx1k"] = served_grpc()
     ladders["tunnel"] = {
         "pre_first_read_dispatch_ms": round(pre_read_ms, 3),
@@ -1297,6 +1484,15 @@ def main() -> None:
                 # ladder's pure-host floor — what degraded mode costs
                 "degraded_pods_per_sec": degraded[
                     "degraded_pods_per_sec"
+                ],
+                # ladder #10 hoist: packed-utilization gain the
+                # rebalance loop recovered on the seeded fragmented
+                # north-star cluster, and its steady-state plan solve
+                "rebalance_utilization_gain": rebalance[
+                    "rebalance_utilization_gain"
+                ],
+                "rebalance_plan_solve_s": rebalance[
+                    "rebalance_plan_solve_s"
                 ],
                 "vs_baseline": round(headline / BAND_TOP_PODS_PER_SEC, 2),
                 "baseline_note": (

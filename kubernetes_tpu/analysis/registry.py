@@ -42,6 +42,7 @@ METRIC_SCAN_PATHS = (
     "kubernetes_tpu/sim/",
     "kubernetes_tpu/obs/",
     "kubernetes_tpu/fleet/",
+    "kubernetes_tpu/rebalance/",
 )
 
 

@@ -66,6 +66,14 @@ def cmd_config(args) -> int:
             "groupSize": cfg.tpu_solver.group_size,
             "meshDevices": cfg.tpu_solver.mesh_devices,
         },
+        "rebalance": {
+            "enabled": cfg.rebalance.enabled,
+            "intervalSeconds": cfg.rebalance.interval_seconds,
+            "maxMovesPerCycle": cfg.rebalance.max_moves_per_cycle,
+            "minPackingUtilization": cfg.rebalance.min_packing_utilization,
+            "minGainPoints": cfg.rebalance.min_gain_points,
+            "nominate": cfg.rebalance.nominate,
+        },
         "warnings": cfg.warnings,
     }
     print(json.dumps(out, indent=2))

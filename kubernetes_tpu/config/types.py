@@ -15,8 +15,11 @@ Covered surface:
 - extenders[]: urlPrefix, filterVerb/prioritizeVerb/preemptVerb/bindVerb,
   weight, nodeCacheCapable, ignorable, managedResources
 - tpuSolver (ours): batchSize, tieBreak, seed, balancedFdtype, singleShot
-  {maxRounds, priceStep, topT}, enablePreemption, groupSize, meshDevices
-  (node-axis solve mesh: 0 = all visible devices)
+  {maxRounds, priceStep, topT, repairRounds}, enablePreemption, groupSize,
+  meshDevices (node-axis solve mesh: 0 = all visible devices)
+- rebalance (ours): enabled, intervalSeconds, maxMovesPerCycle,
+  minPackingUtilization, minGainPoints, nominate — the continuous
+  defragmentation loop (kubernetes_tpu/rebalance)
 
 Unknown plugin names and unsupported pluginConfig args are collected into
 `warnings` rather than rejected — the validation posture of a scheduler that
@@ -102,6 +105,27 @@ class SingleShotSection:
     max_rounds: int = 32
     price_step: int = 8
     top_t: int = 1024
+    # full-width repair rounds closing the scarcity gap (0 = off)
+    repair_rounds: int = 16
+
+
+@dataclass
+class RebalanceSection:
+    """``rebalance:`` — the continuous defragmentation loop
+    (kubernetes_tpu/rebalance). Ours, like tpuSolver: no reference
+    analog (upstream delegates to the out-of-tree descheduler)."""
+
+    enabled: bool = False
+    interval_seconds: float = 60.0
+    # max-churn budget: evictions per rebalance cycle
+    max_moves_per_cycle: int = 512
+    # dominant-resource packed-utilization threshold below which the
+    # in-use nodes count as fragmented
+    min_packing_utilization: float = 0.7
+    # minimum strict packing-score gain (percent points) per move
+    min_gain_points: int = 1
+    # carry the auction target as a nominated-node hint on eviction
+    nominate: bool = True
 
 
 @dataclass
@@ -129,6 +153,7 @@ class KubeSchedulerConfiguration:
     profiles: list[Profile] = field(default_factory=lambda: [Profile()])
     extenders: list[Extender] = field(default_factory=list)
     tpu_solver: TpuSolverSection = field(default_factory=TpuSolverSection)
+    rebalance: RebalanceSection = field(default_factory=RebalanceSection)
     warnings: list[str] = field(default_factory=list)
 
     def profile_for(self, scheduler_name: str) -> Profile | None:
@@ -201,6 +226,14 @@ def _parse_profile(d: Mapping, warnings: list[str]) -> Profile:
     return profile
 
 
+def _nn(value, default):
+    """``value`` unless it is None — the null-tolerant default for
+    keys where falsy values (0, False) are meaningful, so neither
+    ``get(k, d)`` (misses explicit YAML nulls) nor ``get(k) or d``
+    (swallows 0/False) is right."""
+    return default if value is None else value
+
+
 def load(data: Mapping | str) -> KubeSchedulerConfiguration:
     """Parse a KubeSchedulerConfiguration YAML document (string or mapping)."""
     if isinstance(data, str):
@@ -261,10 +294,57 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
             max_rounds=int(ss.get("maxRounds") or 32),
             price_step=int(ss.get("priceStep") or 8),
             top_t=int(ss.get("topT") or 1024),
+            # .get-with-default + explicit None check: 0 is meaningful
+            # (repair off), so the usual `or`-default shape is wrong,
+            # and an explicit YAML null must still default, not
+            # TypeError out of int()
+            repair_rounds=int(_nn(ss.get("repairRounds"), 16)),
         ),
     )
     if cfg.tpu_solver.tie_break not in ("random", "first"):
         raise ValueError(f"tpuSolver.tieBreak: {cfg.tpu_solver.tie_break!r}")
+    if cfg.tpu_solver.single_shot.repair_rounds < 0:
+        # a negative would silently disable the repair phase (the
+        # solver gates on > 0) — reject like the rebalance knobs do
+        raise ValueError(
+            "tpuSolver.singleShot.repairRounds must be >= 0 "
+            f"(got {cfg.tpu_solver.single_shot.repair_rounds})"
+        )
+
+    rb = data.get("rebalance") or {}
+    cfg.rebalance = RebalanceSection(
+        enabled=bool(_nn(rb.get("enabled"), False)),
+        interval_seconds=float(_nn(rb.get("intervalSeconds"), 60.0)),
+        max_moves_per_cycle=int(_nn(rb.get("maxMovesPerCycle"), 512)),
+        min_packing_utilization=float(
+            _nn(rb.get("minPackingUtilization"), 0.7)
+        ),
+        min_gain_points=int(_nn(rb.get("minGainPoints"), 1)),
+        nominate=bool(_nn(rb.get("nominate"), True)),
+    )
+    if cfg.rebalance.max_moves_per_cycle < 0:
+        raise ValueError(
+            "rebalance.maxMovesPerCycle must be >= 0 "
+            f"(got {cfg.rebalance.max_moves_per_cycle})"
+        )
+    if not 0.0 < cfg.rebalance.min_packing_utilization <= 1.0:
+        raise ValueError(
+            "rebalance.minPackingUtilization must be in (0, 1] "
+            f"(got {cfg.rebalance.min_packing_utilization})"
+        )
+    if cfg.rebalance.interval_seconds <= 0:
+        raise ValueError(
+            "rebalance.intervalSeconds must be > 0 "
+            f"(got {cfg.rebalance.interval_seconds})"
+        )
+    if cfg.rebalance.min_gain_points < 1:
+        # > 0 is what guarantees each move strictly increases packing
+        # potential, the termination argument that keeps repeated
+        # cycles from thrashing (rebalance/runtime.py)
+        raise ValueError(
+            "rebalance.minGainPoints must be >= 1 "
+            f"(got {cfg.rebalance.min_gain_points})"
+        )
     return cfg
 
 
@@ -378,6 +458,17 @@ def scheduler_config(cfg: KubeSchedulerConfiguration):
     profiles = {
         p.scheduler_name: _solver_config(cfg, p) for p in cfg.profiles
     }
+    rebalance = None
+    if cfg.rebalance.enabled:
+        from ..rebalance.runtime import RebalanceConfig
+
+        rebalance = RebalanceConfig(
+            interval_s=cfg.rebalance.interval_seconds,
+            max_moves_per_cycle=cfg.rebalance.max_moves_per_cycle,
+            min_packing=cfg.rebalance.min_packing_utilization,
+            min_gain=cfg.rebalance.min_gain_points,
+            nominate=cfg.rebalance.nominate,
+        )
     return SchedulerConfig(
         batch_size=cfg.tpu_solver.batch_size,
         enable_preemption=cfg.tpu_solver.enable_preemption,
@@ -387,4 +478,5 @@ def scheduler_config(cfg: KubeSchedulerConfiguration):
         # honored, not just parsed: the scheduler consults these via the
         # outbound HTTP client during every solve
         extenders=tuple(cfg.extenders),
+        rebalance=rebalance,
     )

@@ -321,7 +321,8 @@ journal_records_total = Counter(
     "scheduler_tpu_trace_journal_records_total",
     "Per-pod decision-journal records written, by outcome "
     "(bound|unschedulable|bind_failure|permit_wait|permit_rejected|"
-    "permit_timeout|discarded|solver_error|quarantined|recovered).",
+    "permit_timeout|discarded|solver_error|quarantined|recovered|"
+    "evicted_for_rebalance).",
     ["outcome"],
     registry=REGISTRY,
 )
@@ -330,6 +331,70 @@ flight_recorder_dumps_total = Counter(
     "Flight-recorder ring dumps, by trigger "
     "(crash|invariant|manual|breaker).",
     ["trigger"],
+    registry=REGISTRY,
+)
+
+# -- continuous rebalancer (kubernetes_tpu/rebalance) --
+
+rebalance_runs_total = Counter(
+    "scheduler_rebalance_runs_total",
+    "Rebalance passes by outcome: planned (evictions executed), "
+    "empty_plan (fragmented but no strictly-improving executable "
+    "move survived bounding), not_fragmented (detector below "
+    "threshold or nothing movable), fenced (the incarnation lost "
+    "its commit fence — a zombie rebalancer moves nothing).",
+    ["outcome"],
+    registry=REGISTRY,
+)
+rebalance_evictions_total = Counter(
+    "scheduler_rebalance_evictions_total",
+    "Pods evicted by the rebalancer through the eviction "
+    "subresource (each carries a nominated-node hint toward its "
+    "auction target and re-enters the scheduling queue).",
+    registry=REGISTRY,
+)
+rebalance_migrations_total = Counter(
+    "scheduler_rebalance_migrations_total",
+    "Completed migrations — an evicted pod re-bound — by where it "
+    "landed (target = the auction's nominated node, elsewhere = the "
+    "solver placed it differently; the hint is advisory).",
+    ["result"],
+    registry=REGISTRY,
+)
+rebalance_pdb_blocked_total = Counter(
+    "scheduler_rebalance_pdb_blocked_total",
+    "Planned moves dropped by the PDB gate "
+    "(classify_pdb_violations over the selected stream): the pod's "
+    "PodDisruptionBudget had no disruptions left.",
+    registry=REGISTRY,
+)
+rebalance_plan_seconds = Histogram(
+    "scheduler_rebalance_plan_seconds",
+    "Wall time of the rebalance plan solve: the single-shot auction "
+    "(pack objective) re-placing every movable pod against the "
+    "cluster's fixed load.",
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+rebalance_packing_utilization = Gauge(
+    "scheduler_rebalance_packing_utilization",
+    "Dominant-resource packed utilization of the in-use nodes at "
+    "the last rebalance pass (detector.py): max(cpu, mem) of "
+    "used/allocatable over schedulable nodes hosting pods.",
+    registry=REGISTRY,
+)
+rebalance_stranded_fraction = Gauge(
+    "scheduler_rebalance_stranded_fraction",
+    "Fraction of total free capacity stranded on partly-used nodes "
+    "(free slivers between resident pods) at the last rebalance "
+    "pass.",
+    registry=REGISTRY,
+)
+rebalance_priority_inversions = Gauge(
+    "scheduler_rebalance_priority_inversions",
+    "Pending pods more important than the least important bound pod "
+    "at the last fragmented rebalance pass — re-packing could seat "
+    "them (advisory: the planner itself only consolidates).",
     registry=REGISTRY,
 )
 
@@ -357,7 +422,7 @@ sim_invariant_violations_total = Counter(
     "Invariant violations the simulator's checkers flagged, by "
     "invariant (double_bind|capacity|lost_pod|progress|monotonic|"
     "constraint|journal|global_overcommit|resilience|recovery|"
-    "fencing).",
+    "fencing|rebalance).",
     ["invariant"],
     registry=REGISTRY,
 )

@@ -67,6 +67,12 @@ OUTCOMES = frozenset(
         # it mid-flight (assumed/parked/queued state evaporated with
         # the dead process)
         "recovered",
+        # the continuous rebalancer evicted this bound pod to
+        # defragment (kubernetes_tpu/rebalance): node= the source,
+        # nominated= the auction's target hint. Non-terminal — the pod
+        # re-enters the queue and its next attempt journals the
+        # migration's outcome.
+        "evicted_for_rebalance",
     }
 )
 # a pod whose LAST journal record is one of these has a settled fate for

@@ -151,6 +151,16 @@ class SchedulerConfig:
     # re-quarantines through the ordinary bisection path within one
     # batch — tested).
     incarnation: int = 1
+    # continuous rebalancer (kubernetes_tpu/rebalance): a
+    # RebalanceConfig enabling the background defragmentation loop —
+    # when the queues go idle and the interval elapses, detect
+    # fragmentation from the snapshot, plan a consolidation target with
+    # the pack-objective auction, and execute a bounded (churn-budget,
+    # PDB-gated, fenced) migration plan through the eviction
+    # subresource. None = off. Fleet replicas rebalance shard-scoped
+    # (their cache IS their shard); a fence-revoked zombie incarnation
+    # skips every pass.
+    rebalance: object = None
     # commit fencing (state/cluster.py fencing tokens): the lease role
     # this scheduler's binds are fenced under. The incarnation acquires
     # a fresh token at startup — superseding any predecessor — and
@@ -188,6 +198,12 @@ class BatchResult:
     # solve failure is isolated and terminal-journaled; they re-admit
     # after a TTL'd backoff (kubernetes_tpu/resilience)
     quarantined: list[str] = field(default_factory=list)
+    # (pod, source node, target node) per rebalancer eviction this
+    # cycle (kubernetes_tpu/rebalance): the pod re-entered the queue
+    # with a nominated hint — the migration completes in later cycles
+    rebalance_evictions: list[tuple[str, str, str]] = field(
+        default_factory=list
+    )
     # (pod, nominated node, victim keys) per successful preemption
     preemptions: list[tuple[str, str, list[str]]] = field(default_factory=list)
     solve_seconds: float = 0.0
@@ -211,6 +227,7 @@ class BatchResult:
             or self.unschedulable
             or self.bind_failures
             or self.quarantined
+            or self.rebalance_evictions
         )
 
 
@@ -503,6 +520,13 @@ class Scheduler:
         # tier — dispatch, probe, bisection sub-solve, host rung. May
         # raise to inject a solver-boundary fault deterministically.
         self._solve_fault = None
+        # continuous rebalancer (kubernetes_tpu/rebalance): ticked by
+        # both loops at idle cycle boundaries; None = off
+        self.rebalancer = None
+        if self.config.rebalance is not None:
+            from .rebalance.runtime import Rebalancer
+
+            self.rebalancer = Rebalancer(self.config.rebalance, self.clock)
         self.snapshot = Snapshot()
         self.snapshot.pad_multiple = self._mesh_devices
         from .state.volume_binder import VolumeBinder
@@ -1050,6 +1074,13 @@ class Scheduler:
             # ring move) before popping, so this cycle solves against
             # the current shard
             self.fleet.maybe_resync(self)
+        if self.rebalancer is not None:
+            # background defragmentation: a no-op unless the interval
+            # elapsed AND the queues are idle. Evictions re-enter the
+            # queue synchronously (the eviction's watch events land
+            # under the cluster lock), so the pop below picks the
+            # migrating pods up in this same cycle.
+            self.rebalancer.maybe_run(self, res)
         t0 = self.clock.perf()
         with self.cluster.lock, self.obs.span("pop") as sp:
             # re-admit quarantined pods whose TTL'd backoff elapsed
@@ -3433,6 +3464,17 @@ class Scheduler:
                     if flights:
                         drain()
                         continue  # discards/failures may requeue work
+                    if self.rebalancer is not None:
+                        # idle + pipeline drained: the one safe point
+                        # for a rebalance pass in this loop (no
+                        # in-flight solve can go stale on the eviction
+                        # events). Evictions re-populate the queue, so
+                        # loop back and schedule the migrations.
+                        r = BatchResult()
+                        if self.rebalancer.maybe_run(self, r) > 0:
+                            r.completed_at = self.clock.perf()
+                            out.append(r)
+                            continue
                     break
                 batches += 1
                 # batch id for this pop's spans/journal (the sync branch

@@ -77,6 +77,18 @@ def _print_result(res) -> None:
             f"recloses={resil['recloses']} "
             f"quarantined={len(s['quarantined'])} tier={tiers}"
         )
+    reb = s.get("rebalance")
+    if reb:
+        print(
+            f"  rebalance: runs={reb['runs']} "
+            f"evicted={reb['evicted']} "
+            f"migrations_completed={reb['migrations_completed']} "
+            f"max_cycle_evictions={reb['max_cycle_evictions']} "
+            f"budget={reb['budget']} over_budget={reb['over_budget']} "
+            f"pdb_blocked={reb['pdb_blocked']} "
+            f"pdb_overruns={reb['pdb_overruns']} "
+            f"final_packing={reb['final_packing']}"
+        )
     if s.get("crashes") or s.get("incarnations", 1) > 1:
         print(
             f"  lifecycle: incarnations={s['incarnations']} "

@@ -45,17 +45,22 @@ SIM_PORTS = (8080, 8081)  # small pool: conflicts actually happen
 # the SolverFaultInjector breaks any solve whose batch contains a pod
 # carrying this label, at every ladder tier
 POISON_LABEL = "sim.kubernetes.io/poison"
+# PDB-guarded cohort (kubernetes_tpu/rebalance): the harness seeds a
+# PodDisruptionBudget with disruptionsAllowed=0 over this label, so
+# the rebalancer must never evict a pod carrying it
+PDB_GUARD_LABEL = "sim.kubernetes.io/pdb-guard"
 
 
 def make_pod(
     name: str, cpu: str, priority: int = 0, shape: str = "plain",
-    port: int = 0, poison: bool = False,
+    port: int = 0, poison: bool = False, pdb_guard: bool = False,
 ) -> Pod:
     """``shape``: plain | spread (hard maxSkew=1 zone spread over the
     app=spread cohort) | anti (required hostname anti-affinity over
     app=anti) | ports (hostPort ``port``). ``poison`` marks the pod
     with POISON_LABEL (its presence breaks the solve — the bisection
-    quarantine's food)."""
+    quarantine's food). ``pdb_guard`` joins the PDB-guarded cohort the
+    rebalancer must never evict."""
     from ..api.wrappers import MakePod
 
     b = MakePod().name(name).req({"cpu": cpu, "memory": "1Gi"})
@@ -73,6 +78,8 @@ def make_pod(
         b = b.host_port(port or SIM_PORTS[0])
     if poison:
         b = b.label(POISON_LABEL, "1")
+    if pdb_guard:
+        b = b.label(PDB_GUARD_LABEL, "1")
     return b.obj()
 
 
@@ -144,10 +151,14 @@ class ChurnGenerator:
             elif p.pod_ports_rate and rng.random() < p.pod_ports_rate:
                 shape = "ports"
                 port = rng.choice(SIM_PORTS)
-            # poison draw guarded on the rate so profiles without it
-            # consume no RNG here (existing traces stay byte-identical)
+            # poison/pdb-guard draws guarded on the rate so profiles
+            # without them consume no RNG here (existing traces stay
+            # byte-identical)
             poison = bool(
                 p.poison_rate and rng.random() < p.poison_rate
+            )
+            pdb_guard = bool(
+                p.pdb_guard_rate and rng.random() < p.pdb_guard_rate
             )
             pod = make_pod(
                 self._next_pod_name(),
@@ -156,6 +167,7 @@ class ChurnGenerator:
                 shape=shape,
                 port=port,
                 poison=poison,
+                pdb_guard=pdb_guard,
             )
             events.append({"op": "create_pod", "pod": pod.to_dict()})
 

@@ -49,15 +49,18 @@ from ..obs import ObsConfig
 from .invariants import (
     BindTransitionTracker,
     MonotonicCounters,
+    RebalanceTracker,
     Violation,
     _record,
     check_capacity,
     check_constraints,
     check_journal_completeness,
     check_lost_pods,
+    check_rebalance,
     check_recovery,
     check_resilience,
     merged_last_outcomes,
+    packed_utilization,
 )
 from .profiles import Profile, get_profile
 from .trace import TraceReader, TraceWriter
@@ -174,6 +177,42 @@ class SimHarness:
                 ),
             )
         self.flight_dump_path = flight_dump
+        # continuous rebalancer (kubernetes_tpu/rebalance): the
+        # fragmentation profile's defragmentation loop, plus a seeded
+        # PDB-guarded cohort the rebalancer must never move
+        rebalance_cfg = None
+        self.rebalance_tracker: RebalanceTracker | None = None
+        if self.profile.rebalance:
+            from ..rebalance.runtime import RebalanceConfig
+
+            rebalance_cfg = RebalanceConfig(
+                interval_s=self.profile.rebalance_interval_s,
+                max_moves_per_cycle=self.profile.rebalance_budget,
+                min_packing=self.profile.rebalance_min_packing,
+            )
+            if self.profile.pdb_guard_rate > 0:
+                from ..api.labels import (
+                    Selector,
+                    requirements_from_match_labels,
+                )
+                from ..api.objects import PodDisruptionBudget
+                from .generators import PDB_GUARD_LABEL
+
+                self.cluster.create_pdb(
+                    PodDisruptionBudget(
+                        name="sim-pdb-guard",
+                        namespace="default",
+                        selector=Selector(
+                            requirements=requirements_from_match_labels(
+                                {PDB_GUARD_LABEL: "1"}
+                            )
+                        ),
+                        disruptions_allowed=0,
+                    )
+                )
+            # constructed AFTER the PDB so its allowance mirror seeds
+            # from the original budgets
+            self.rebalance_tracker = RebalanceTracker(self.cluster)
         from ..resilience import ResilienceConfig
 
         self._base_config = SchedulerConfig(
@@ -195,6 +234,7 @@ class SimHarness:
             ),
             extenders=extenders,
             out_of_tree_plugins=plugins,
+            rebalance=rebalance_cfg,
             # every sim scheduler binds under a fence token so a
             # crash-restarted incarnation structurally supersedes its
             # predecessor (the commit-fencing layer rides every
@@ -560,6 +600,42 @@ class SimHarness:
                 device_faults=self.solver_injector.injected,
                 poison_hits=self.solver_injector.poison_hits,
             )
+        rebalance_summary = None
+        if self.profile.rebalance:
+            reb = self.scheduler.rebalancer
+            if reb is not None:
+                reb.reconcile(self.cluster)
+            overruns = (
+                self.rebalance_tracker.pdb_overruns
+                if self.rebalance_tracker is not None
+                else 0
+            )
+            final_packing = packed_utilization(self.cluster)
+            check_rebalance(
+                self.cycles + self.max_settle_rounds,
+                self.violations,
+                history=reb.history if reb is not None else [],
+                budget=self.profile.rebalance_budget,
+                pdb_overruns=overruns,
+                migrations_completed=(
+                    reb.migrations_completed if reb is not None else 0
+                ),
+                # the last churn cycle drives at t == cycles; only
+                # passes strictly after it are churn-free, so the
+                # monotonicity window opens at cycles + 1
+                churn_end_t=float(self.cycles) + 1.0,
+                final_packing=final_packing,
+            )
+            rebalance_summary = {
+                **(reb.stats() if reb is not None else {}),
+                "tracker_evictions": (
+                    self.rebalance_tracker.evictions
+                    if self.rebalance_tracker is not None
+                    else 0
+                ),
+                "pdb_overruns": overruns,
+                "final_packing": round(final_packing, 4),
+            }
         bindings = {
             p.key: p.node_name
             for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
@@ -620,6 +696,10 @@ class SimHarness:
                 else 0
             ),
             "recovered_records": recovered_records,
+            # continuous rebalancer (the fragmentation profile): pass
+            # history, eviction counts from the independent tracker,
+            # PDB overruns (must be 0), final packed utilization
+            "rebalance": rebalance_summary,
             # the journal digest rides in the footer, so the trace
             # selfcheck also proves journal byte-identity across runs
             # (all incarnations' lines, in incarnation order)

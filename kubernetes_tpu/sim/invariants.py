@@ -44,7 +44,7 @@ from ..state.cluster import ClusterState, Event
 class Violation:
     invariant: str  # double_bind | capacity | lost_pod | progress |
     # monotonic | constraint | journal | global_overcommit |
-    # resilience | recovery | fencing
+    # resilience | recovery | fencing | rebalance
     cycle: int
     detail: str
 
@@ -72,15 +72,48 @@ class BindTransitionTracker:
         }
         self._pending: list[str] = []
         self._sched_bound: set[str] = set()
+        # EVICTED-pod deletes observed BEFORE the scheduler's bind
+        # report for that pod drained (record_results runs once per
+        # drive, so an evict-and-rebind inside one drive delivers its
+        # DELETED while _sched_bound is still empty): each credit
+        # legitimizes exactly one re-bind of the key. Only evictions
+        # bank — the subresource emits an Events-API `Evicted` record
+        # immediately before its DELETED, and keying on it keeps the
+        # double-bind check strict for every OTHER bound-pod delete
+        # (a churn-deleted pod's key must never legally re-bind).
+        self._delete_credits: dict[str, int] = {}
+        self._evict_marks: dict[str, int] = {}
         cluster.subscribe(self._on_event)
 
     def _on_event(self, ev: Event) -> None:
+        if ev.kind == "Event":
+            if getattr(ev.obj, "reason", "") == "Evicted":
+                key = (
+                    f"{ev.obj.regarding_namespace}/"
+                    f"{ev.obj.regarding_name}"
+                )
+                self._evict_marks[key] = self._evict_marks.get(key, 0) + 1
+            return
         if ev.kind != "Pod":
             return
         pod = ev.obj
         if ev.type == "DELETED":
             self._node_of.pop(pod.key, None)
-            self._sched_bound.discard(pod.key)
+            evicted = self._evict_marks.get(pod.key, 0) > 0
+            if evicted:
+                self._evict_marks[pod.key] -= 1
+                if not self._evict_marks[pod.key]:
+                    del self._evict_marks[pod.key]
+            if pod.key in self._sched_bound:
+                self._sched_bound.discard(pod.key)
+            elif pod.node_name and evicted:
+                # an EVICTED bound pod deleted before its bind report
+                # drained: bank the delete (see _delete_credits).
+                # Plain deletes and pending-pod deletes bank nothing —
+                # they can't legitimize a re-bind.
+                self._delete_credits[pod.key] = (
+                    self._delete_credits.get(pod.key, 0) + 1
+                )
             return
         if not pod.node_name:
             return
@@ -93,13 +126,19 @@ class BindTransitionTracker:
 
     def record_results(self, scheduled: Iterable[tuple[str, str]]) -> None:
         """Feed one drive's BatchResult.scheduled entries: a pod bound
-        twice by the scheduler (no delete in between) is a double-bind
-        even if the state service masked it."""
+        twice by the scheduler — with neither an observed delete nor a
+        banked bound-delete credit in between — is a double-bind even
+        if the state service masked it."""
         for key, node in scheduled:
             if key in self._sched_bound:
-                self._pending.append(
-                    f"scheduler bound pod {key} twice (latest to {node})"
-                )
+                if self._delete_credits.get(key, 0) > 0:
+                    self._delete_credits[key] -= 1
+                    if not self._delete_credits[key]:
+                        del self._delete_credits[key]
+                else:
+                    self._pending.append(
+                        f"scheduler bound pod {key} twice (latest to {node})"
+                    )
             self._sched_bound.add(key)
 
     def drain(self, cycle: int, violations: list[Violation]) -> None:
@@ -579,6 +618,164 @@ def check_hub_partition(
             "bound — conservative admission never engaged during the "
             "partition",
         )
+
+
+class RebalanceTracker:
+    """Independent witness for the rebalancer's eviction activity:
+    subscribes straight to the state service and counts the Events-API
+    ``Evicted`` records the eviction subresource emits, re-checking PDB
+    allowances against its OWN mirror (seeded from the PDBs' original
+    ``disruptionsAllowed``, decremented per observed eviction) — so a
+    bug in the enforcement code cannot vouch for itself."""
+
+    def __init__(self, cluster: ClusterState) -> None:
+        import dataclasses
+
+        self._cluster = cluster
+        # snapshot the PDBs at construction: selector + the ORIGINAL
+        # allowance (the live objects decrement as evictions land)
+        self._pdbs = [
+            dataclasses.replace(pdb) for pdb in cluster.list_pdbs()
+        ]
+        self._allow = [pdb.disruptions_allowed for pdb in self._pdbs]
+        self.evictions = 0
+        self.evicted_keys: list[str] = []
+        self.pdb_overruns = 0
+        cluster.subscribe(self._on_event)
+
+    def _on_event(self, ev: Event) -> None:
+        if ev.kind != "Event":
+            return
+        rec = ev.obj
+        if getattr(rec, "reason", "") != "Evicted":
+            return
+        self.evictions += 1
+        key = f"{rec.regarding_namespace}/{rec.regarding_name}"
+        self.evicted_keys.append(key)
+        try:
+            pod = self._cluster.get_pod(
+                rec.regarding_namespace, rec.regarding_name
+            )
+        except Exception:
+            return  # vanished before delivery: nothing to match
+        for i, pdb in enumerate(self._pdbs):
+            if pdb.matches(pod):
+                self._allow[i] -= 1
+                if self._allow[i] < 0:
+                    self.pdb_overruns += 1
+
+
+def check_rebalance(
+    cycle: int,
+    violations: list[Violation],
+    *,
+    history,
+    budget: int,
+    pdb_overruns: int,
+    migrations_completed: int,
+    churn_end_t: float,
+    final_packing: float,
+    expect_runs: bool = True,
+    tol: float = 0.02,
+) -> None:
+    """Continuous-rebalancer invariants (the fragmentation profile),
+    checked after quiescence:
+
+    - **engaged** — the profile demanded rebalancing and at least one
+      pass actually ran (zero passes would make everything else
+      vacuous);
+    - **churn budget** — no pass evicted more than the configured
+      budget;
+    - **PDB never violated** — the independent tracker's allowance
+      mirror never went negative (a PDB-guarded pod moving at 0
+      disruptions allowed is exactly the bug the eviction subresource
+      must make impossible);
+    - **migrations complete** — evictions are only half a migration:
+      when anything was evicted, at least one evicted pod must have
+      re-bound (an evict-and-strand rebalancer destroys capacity);
+    - **utilization monotonic** — across the SETTLE-phase passes
+      (``t >= churn_end_t``: churn has stopped, so packing changes are
+      the rebalancer's alone) the packed utilization each pass observes
+      must be non-decreasing (within ``tol``), and the final packed
+      utilization must not regress below the first settle-phase pass's.
+      During-churn passes are exempt: arrivals and deletes legitimately
+      move packing both ways under the rebalancer's feet.
+    """
+    if not history:
+        if expect_runs:
+            _record(
+                violations, "rebalance", cycle,
+                "the profile demanded rebalancing but no pass ever "
+                "ran — the defragmentation loop never engaged",
+            )
+        return
+    for r in history:
+        if r.evicted > budget:
+            _record(
+                violations, "rebalance", cycle,
+                f"rebalance pass at t={r.t} evicted {r.evicted} pods "
+                f"> churn budget {budget}",
+            )
+    if pdb_overruns > 0:
+        _record(
+            violations, "rebalance", cycle,
+            f"{pdb_overruns} eviction(s) landed on pods whose "
+            "PodDisruptionBudget had no disruptions left — the PDB "
+            "gate leaked",
+        )
+    total_evicted = sum(r.evicted for r in history)
+    if total_evicted > 0 and migrations_completed < 1:
+        _record(
+            violations, "rebalance", cycle,
+            f"{total_evicted} eviction(s) but zero completed "
+            "migrations — the rebalancer evicts and strands",
+        )
+    settle = [r for r in history if r.t >= churn_end_t]
+    for prev, cur in zip(settle, settle[1:]):
+        if cur.packing_before < prev.packing_before - tol:
+            _record(
+                violations, "rebalance", cycle,
+                "packed utilization regressed across settle-phase "
+                f"rebalance passes: {prev.packing_before:.4f} -> "
+                f"{cur.packing_before:.4f}",
+            )
+    if settle and migrations_completed >= 1 and (
+        final_packing < settle[0].packing_before - tol
+    ):
+        _record(
+            violations, "rebalance", cycle,
+            f"final packed utilization {final_packing:.4f} regressed "
+            f"below the first settle-phase pass's "
+            f"{settle[0].packing_before:.4f}",
+        )
+
+
+def packed_utilization(cluster: ClusterState) -> float:
+    """Dominant-resource fill of the in-use nodes, from cluster TRUTH
+    (pod objects, not the scheduler's snapshot) — the invariant-side
+    mirror of rebalance/detector.py's packed_utilization, computed
+    through an independent path so the two can disagree when one is
+    buggy."""
+    nodes = {n.name: n for n in cluster.list_nodes()}
+    used: dict[str, dict[str, int]] = {}
+    for pod in cluster.list_pods():
+        if pod.node_name and pod.node_name in nodes:
+            u = used.setdefault(pod.node_name, {})
+            for r, v in pod.resource_request().items():
+                u[r] = u.get(r, 0) + v
+    if not used:
+        return 1.0
+    tot_u = {"cpu": 0, "memory": 0}
+    tot_a = {"cpu": 0, "memory": 0}
+    for name, u in used.items():
+        alloc = nodes[name].allocatable
+        for r in ("cpu", "memory"):
+            tot_u[r] += u.get(r, 0)
+            tot_a[r] += alloc.get(r, 0)
+    fracs = [
+        tot_u[r] / tot_a[r] for r in ("cpu", "memory") if tot_a[r] > 0
+    ]
+    return max(fracs) if fracs else 1.0
 
 
 class MonotonicCounters:

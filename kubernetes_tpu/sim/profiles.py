@@ -107,6 +107,19 @@ class Profile:
     # crosses the bound inside the window and conservative admission
     # actually engages.
     fleet_max_row_age_s: float = 30.0
+    # -- continuous rebalancer (kubernetes_tpu/rebalance) --
+    # enable the background defragmentation loop on the sim scheduler
+    rebalance: bool = False
+    rebalance_interval_s: float = 4.0  # virtual seconds between passes
+    rebalance_budget: int = 4  # max-churn: evictions per pass
+    # dominant-resource packed-utilization threshold (detector.py)
+    rebalance_min_packing: float = 0.6
+    # P(an arrival joins the PDB-guarded cohort): labeled pods matched
+    # by a seeded PodDisruptionBudget with disruptionsAllowed=0, so the
+    # rebalancer's PDB gate (and the eviction subresource's 429) are
+    # exercised non-vacuously — the rebalance invariant asserts none
+    # of them ever moved
+    pdb_guard_rate: float = 0.0
 
     def validate(self) -> None:
         if self.watch_delay and (
@@ -314,6 +327,33 @@ PROFILES: dict[str, Profile] = {
             hub_partition_at=2,
             hub_partition_heal=6,
             fleet_max_row_age_s=2.0,
+        ),
+        # fragmentation: heavy plain arrivals + heavy deletes carve the
+        # cluster into Swiss cheese (every node partly used, packed
+        # utilization low), and the continuous rebalancer must
+        # consolidate: detect fragmentation from the snapshot, plan
+        # with the pack-objective auction, evict under the churn
+        # budget with nominated hints, and the migrations complete
+        # through the ordinary scheduling path. A PDB-guarded cohort
+        # (disruptionsAllowed=0) rides along — those pods must NEVER
+        # move. The rebalance invariant asserts: evictions <= budget
+        # every pass, zero PDB overruns, packed utilization
+        # non-decreasing across settle-phase passes, and >= 1
+        # completed migration when anything was evicted. Byte-
+        # deterministic under --selfcheck like every profile.
+        Profile(
+            name="fragmentation",
+            nodes=8,
+            node_cpu="8",
+            node_mem="32Gi",
+            arrivals=(3, 7),
+            pod_cpu_choices=("500m", "1"),
+            delete_pod_rate=2.5,
+            rebalance=True,
+            rebalance_interval_s=4.0,
+            rebalance_budget=4,
+            rebalance_min_packing=0.6,
+            pdb_guard_rate=0.25,
         ),
         # replica_loss: fleet_mixed plus one replica killed mid-drive.
         # The survivors must re-own its shard (ring orphan

@@ -23,6 +23,24 @@ assignment where every round is dense work over ALL pods at once:
 Rounds run inside one jitted lax.scan (fixed max_rounds; converged rounds
 are no-ops): sort + segment reductions + gathers, no host round-trips.
 
+After the top-T loop a FULL-WIDTH REPAIR phase closes the scarcity gap
+(SURVEY §8.4 / VERDICT missing #6): under contention the fullest nodes
+carry low headroom scores, fall outside every class's top-T window, and
+their prices never escalate — so small remaining gaps on them stay
+invisible and capacity strands (measured: scarce_rc8 placed_ratio
+0.9854). The repair reruns the same auction round with the bid window
+widened to ALL nodes, and keeps going while anyone still *bids* (placed
+OR rejected > 0 — a rejected bid escalated a price, so the next round
+explores a different node), bounded by ``repair_rounds``. Work
+conservation then holds up to the round budget: a pod is left unplaced
+only when no feasible node remains anywhere. Solves that already placed
+everything skip the phase in one condition check.
+
+``objective`` flips the score sense: ``"spread"`` (default) prefers
+high-headroom nodes — the serving posture; ``"pack"`` prefers FULL
+nodes — the bin-packing posture the continuous rebalancer
+(kubernetes_tpu/rebalance) plans consolidation targets with.
+
 Scope: NodeResourcesFit + the static per-class plugin mask (taints,
 affinity, nodeName, unschedulable) + headroom scoring vs the snapshot.
 Ports/spread/interpod route through the exact scan path instead.
@@ -79,6 +97,13 @@ class SingleShotConfig:
     # wider = fewer rounds: 1024 measured 189ms vs 320ms at 256 for the
     # 51.2k x 10.24k north-star config on v5e
     top_t: int = 1024
+    # full-width repair rounds after the top-T loop (the scarcity
+    # closer: nodes outside every top-T window become biddable). 0
+    # disables — restoring the pre-repair early-exit behavior.
+    repair_rounds: int = 16
+    # "spread" = prefer high-headroom nodes (serving default);
+    # "pack" = prefer full nodes (the rebalancer's consolidation plan)
+    objective: str = "spread"
 
 
 def _segmented_prefix(x, seg_start, seg_id, num_segments):
@@ -110,6 +135,8 @@ def _single_shot(
     max_rounds: int,
     price_step: int,
     top_t: int,
+    repair_rounds: int = 16,
+    pack: bool = False,
 ):
     p = rc_of.shape[0]
     n = alloc.shape[1]
@@ -122,140 +149,185 @@ def _single_shot(
     free_frac = jnp.where(
         alloc2 > 0, (alloc2 - used2) / jnp.maximum(alloc2, 1.0), 0.0
     )
-    base_score = (
+    headroom = (
         100.0 * (free_frac[CPU_IDX] + free_frac[MEM_IDX]) / 2.0
     ).astype(jnp.int32)  # [N] headroom at snapshot
+    # pack objective inverts the preference: full nodes score high, so
+    # the auction consolidates instead of spreading (the rebalancer's
+    # planning posture). Same integer arithmetic — still deterministic.
+    base_score = (jnp.int32(100) - headroom) if pack else headroom
 
     pod_idx = jnp.arange(p, dtype=jnp.int32)
 
-    def round_step(carry, _):
-        used, pod_count, price, assigned_to = carry
-        unassigned = (assigned_to < 0) & pod_valid
+    def make_round(t_r: int):
+        """One auction round bidding over each class's top ``t_r``
+        feasible nodes. The main loop uses t_r = top_t; the repair phase
+        re-instantiates with t_r = n (every node biddable)."""
 
-        # 1. class-level feasibility on REMAINING capacity: [RC, N]
-        free = alloc - used
-        fit = jnp.all(
-            rc_req[:, :, None] <= free[None, :, :], axis=1
-        )  # [RC, K, N] -> [RC, N]; RC is small by construction
-        ok = (
-            fit
-            & static_mask[rc_static]
-            & node_valid[None, :]
-            & (pod_count + 1 <= max_pods)[None, :]
-        )
-        score = jnp.where(ok, base_score[None, :] - price[None, :], NEG)
+        def round_step(carry):
+            used, pod_count, price, assigned_to = carry
+            unassigned = (assigned_to < 0) & pod_valid
 
-        # 2. top-T nodes per class + round-robin fan-out of the class's
-        # unassigned pods across them
-        top_scores, top_nodes = jax.lax.top_k(score, t)  # [RC, T]
-        top_ok = top_scores > NEG
-        # feasible entries sort to the front; fan out only across them so a
-        # class with few feasible nodes still bids every round
-        n_ok = jnp.sum(top_ok.astype(jnp.int32), axis=1)  # [RC]
-
-        # rank of each unassigned pod within its class (stable)
-        key = jnp.where(
-            unassigned, rc_of.astype(jnp.int64) * p + pod_idx, (1 << 62)
-        )
-        order_rc = jnp.argsort(key)
-        rc_sorted = rc_of[order_rc]
-        seg_start_rc = jnp.concatenate(
-            [jnp.array([True], dtype=jnp.bool_), rc_sorted[1:] != rc_sorted[:-1]]
-        )
-        seg_id_rc = _cumsum0(seg_start_rc.astype(jnp.int32)) - 1
-        rank_sorted = (
-            _segmented_prefix(
-                jnp.ones(p, dtype=jnp.int32), seg_start_rc, seg_id_rc, p
+            # 1. class-level feasibility on REMAINING capacity: [RC, N]
+            free = alloc - used
+            fit = jnp.all(
+                rc_req[:, :, None] <= free[None, :, :], axis=1
+            )  # [RC, K, N] -> [RC, N]; RC is small by construction
+            ok = (
+                fit
+                & static_mask[rc_static]
+                & node_valid[None, :]
+                & (pod_count + 1 <= max_pods)[None, :]
             )
-            - 1
-        )
-        rank = jnp.zeros(p, dtype=jnp.int32).at[order_rc].set(rank_sorted)
+            score = jnp.where(ok, base_score[None, :] - price[None, :], NEG)
 
-        slot = rank % jnp.maximum(n_ok[rc_of], 1)
-        target = top_nodes[rc_of, slot].astype(jnp.int32)
-        has_node = n_ok[rc_of] > 0
-        bidding = unassigned & has_node
-        target = jnp.where(bidding, target, n)  # park at virtual node n
+            # 2. top-T nodes per class + round-robin fan-out of the
+            # class's unassigned pods across them
+            top_scores, top_nodes = jax.lax.top_k(score, t_r)  # [RC, T]
+            top_ok = top_scores > NEG
+            # feasible entries sort to the front; fan out only across them
+            # so a class with few feasible nodes still bids every round
+            n_ok = jnp.sum(top_ok.astype(jnp.int32), axis=1)  # [RC]
 
-        # 3. admission: sort claimants by (node, -priority), segmented
-        # prefix sums against the node's remaining resources. The inverted
-        # priority is biased into [0, 2^32) so the full legal int32 priority
-        # range (system-critical 2e9 down to very negative user values)
-        # packs below the node id without interleaving adjacent nodes.
-        inv_prio = jnp.int64((1 << 31) - 1) - priority.astype(jnp.int64)
-        sort_key = target.astype(jnp.int64) * (1 << 32) + inv_prio
-        order = jnp.argsort(sort_key)
-        t_sorted = target[order]
-        bidding_sorted = bidding[order]
-        req_sorted = jnp.where(
-            bidding_sorted[:, None], rc_req[rc_of[order]], 0
-        )  # [P, K]
+            # rank of each unassigned pod within its class (stable)
+            key = jnp.where(
+                unassigned, rc_of.astype(jnp.int64) * p + pod_idx, (1 << 62)
+            )
+            order_rc = jnp.argsort(key)
+            rc_sorted = rc_of[order_rc]
+            seg_start_rc = jnp.concatenate(
+                [jnp.array([True], dtype=jnp.bool_), rc_sorted[1:] != rc_sorted[:-1]]
+            )
+            seg_id_rc = _cumsum0(seg_start_rc.astype(jnp.int32)) - 1
+            rank_sorted = (
+                _segmented_prefix(
+                    jnp.ones(p, dtype=jnp.int32), seg_start_rc, seg_id_rc, p
+                )
+                - 1
+            )
+            rank = jnp.zeros(p, dtype=jnp.int32).at[order_rc].set(rank_sorted)
 
-        seg_start = jnp.concatenate(
-            [jnp.array([True], dtype=jnp.bool_), t_sorted[1:] != t_sorted[:-1]]
-        )
-        seg_id = _cumsum0(seg_start.astype(jnp.int32)) - 1
-        prefix = _segmented_prefix(req_sorted, seg_start, seg_id, p)
-        cnt_prefix = _segmented_prefix(
-            bidding_sorted.astype(jnp.int32), seg_start, seg_id, p
-        )
+            slot = rank % jnp.maximum(n_ok[rc_of], 1)
+            target = top_nodes[rc_of, slot].astype(jnp.int32)
+            has_node = n_ok[rc_of] > 0
+            bidding = unassigned & has_node
+            target = jnp.where(bidding, target, n)  # park at virtual node n
 
-        free_t = jnp.concatenate([free, jnp.zeros((k, 1), free.dtype)], axis=1)
-        cnt_free = jnp.concatenate(
-            [(max_pods - pod_count).astype(jnp.int32), jnp.zeros(1, jnp.int32)]
-        )
-        fits_res = jnp.all(prefix <= free_t[:, t_sorted].T, axis=1)
-        fits_cnt = cnt_prefix <= cnt_free[t_sorted]
-        admit_sorted = bidding_sorted & fits_res & fits_cnt
-        admit = jnp.zeros(p, dtype=bool).at[order].set(admit_sorted)
+            # 3. admission: sort claimants by (node, -priority), segmented
+            # prefix sums against the node's remaining resources. The
+            # inverted priority is biased into [0, 2^32) so the full legal
+            # int32 priority range (system-critical 2e9 down to very
+            # negative user values) packs below the node id without
+            # interleaving adjacent nodes.
+            inv_prio = jnp.int64((1 << 31) - 1) - priority.astype(jnp.int64)
+            sort_key = target.astype(jnp.int64) * (1 << 32) + inv_prio
+            order = jnp.argsort(sort_key)
+            t_sorted = target[order]
+            bidding_sorted = bidding[order]
+            req_sorted = jnp.where(
+                bidding_sorted[:, None], rc_req[rc_of[order]], 0
+            )  # [P, K]
 
-        # 4. commit + price escalation on rejection
-        assigned_to = jnp.where(admit, target, assigned_to)
-        tgt_or_park = jnp.where(admit, target, n)
-        used = used + jax.ops.segment_sum(
-            jnp.where(admit[:, None], rc_req[rc_of], 0),
-            tgt_or_park,
-            num_segments=n + 1,
-        )[:n].T
-        pod_count = pod_count + jax.ops.segment_sum(
-            admit.astype(jnp.int32), tgt_or_park, num_segments=n + 1
-        )[:n]
-        rejected = bidding & ~admit
-        rej_per_node = jax.ops.segment_sum(
-            rejected.astype(jnp.int32), jnp.where(rejected, target, n),
-            num_segments=n + 1,
-        )[:n]
-        price = price + jnp.where(rej_per_node > 0, price_step, 0)
+            seg_start = jnp.concatenate(
+                [jnp.array([True], dtype=jnp.bool_), t_sorted[1:] != t_sorted[:-1]]
+            )
+            seg_id = _cumsum0(seg_start.astype(jnp.int32)) - 1
+            prefix = _segmented_prefix(req_sorted, seg_start, seg_id, p)
+            cnt_prefix = _segmented_prefix(
+                bidding_sorted.astype(jnp.int32), seg_start, seg_id, p
+            )
 
-        return (used, pod_count, price, assigned_to), admit.sum()
+            free_t = jnp.concatenate([free, jnp.zeros((k, 1), free.dtype)], axis=1)
+            cnt_free = jnp.concatenate(
+                [(max_pods - pod_count).astype(jnp.int32), jnp.zeros(1, jnp.int32)]
+            )
+            fits_res = jnp.all(prefix <= free_t[:, t_sorted].T, axis=1)
+            fits_cnt = cnt_prefix <= cnt_free[t_sorted]
+            admit_sorted = bidding_sorted & fits_res & fits_cnt
+            admit = jnp.zeros(p, dtype=bool).at[order].set(admit_sorted)
 
+            # 4. commit + price escalation on rejection
+            assigned_to = jnp.where(admit, target, assigned_to)
+            tgt_or_park = jnp.where(admit, target, n)
+            used = used + jax.ops.segment_sum(
+                jnp.where(admit[:, None], rc_req[rc_of], 0),
+                tgt_or_park,
+                num_segments=n + 1,
+            )[:n].T
+            pod_count = pod_count + jax.ops.segment_sum(
+                admit.astype(jnp.int32), tgt_or_park, num_segments=n + 1
+            )[:n]
+            rejected = bidding & ~admit
+            rej_per_node = jax.ops.segment_sum(
+                rejected.astype(jnp.int32), jnp.where(rejected, target, n),
+                num_segments=n + 1,
+            )[:n]
+            price = price + jnp.where(rej_per_node > 0, price_step, 0)
+
+            return (
+                (used, pod_count, price, assigned_to),
+                admit.sum().astype(jnp.int32),
+                rejected.sum().astype(jnp.int32),
+            )
+
+        return round_step
+
+    main_round = make_round(t)
     assigned0 = jnp.full(p, -1, dtype=jnp.int32)
     price0 = jnp.zeros(n, dtype=jnp.int32)
 
     # while_loop with early exit: converged solves stop paying for the
     # remaining round budget (placed==0 means no further progress possible
-    # this configuration — every still-unassigned pod found no feasible
-    # node or lost admission AND prices already escalated)
+    # at this bid width — every still-unassigned pod found no feasible
+    # top-T node or lost admission AND prices already escalated; the
+    # repair phase below re-examines with the window fully open)
     def cond(state):
         rounds, last_placed, _ = state
         return (rounds < max_rounds) & (last_placed > 0)
 
     def body(state):
         rounds, _, carry = state
-        carry, placed = round_step(carry, None)
-        return rounds + 1, placed.astype(jnp.int32), carry
+        carry, placed, _rejected = main_round(carry)
+        return rounds + 1, placed, carry
 
     init_placed = jnp.int32(1)
-    _, _, (used, pod_count, _, assigned_to) = jax.lax.while_loop(
+    _, _, carry = jax.lax.while_loop(
         cond, body, (jnp.int32(0), init_placed, (used0, pod_count0, price0, assigned0))
     )
+
+    if repair_rounds > 0 and p > 0:
+        # full-width repair: every feasible node is biddable, and the
+        # loop keeps going while anyone still BIDS — a round that placed
+        # nothing but rejected someone escalated that node's price, so
+        # the next round explores a different node. Terminates when no
+        # unassigned pod has any feasible node left (nobody bids).
+        repair_round = make_round(n)
+
+        def cond_rep(state):
+            rounds, bid_activity, carry_r = state
+            _, _, _, assigned_to = carry_r
+            remaining = jnp.any((assigned_to < 0) & pod_valid)
+            return (rounds < repair_rounds) & bid_activity & remaining
+
+        def body_rep(state):
+            rounds, _, carry_r = state
+            carry_r, placed, rejected = repair_round(carry_r)
+            return rounds + 1, (placed + rejected) > 0, carry_r
+
+        _, _, carry = jax.lax.while_loop(
+            cond_rep, body_rep, (jnp.int32(0), jnp.bool_(True), carry)
+        )
+
+    used, pod_count, _, assigned_to = carry
     placed_total = jnp.sum((assigned_to >= 0).astype(jnp.int32))
     return assigned_to, used, pod_count, placed_total
 
 
 _single_shot_jit = jax.jit(
     _single_shot,
-    static_argnames=("max_rounds", "price_step", "top_t"),
+    static_argnames=(
+        "max_rounds", "price_step", "top_t", "repair_rounds", "pack",
+    ),
     donate_argnums=(1, 2),
 )
 
@@ -333,6 +405,8 @@ class SingleShotSolver:
             max_rounds=self.config.max_rounds,
             price_step=self.config.price_step,
             top_t=self.config.top_t,
+            repair_rounds=self.config.repair_rounds,
+            pack=self.config.objective == "pack",
         )
         nodes.used = np.array(used)
         nodes.pod_count = np.array(pod_count)

@@ -39,7 +39,9 @@ EventType = Literal["ADDED", "MODIFIED", "DELETED"]
 
 class ApiError(Exception):
     def __init__(self, reason: str, message: str = "", fenced: bool = False):
-        self.reason = reason  # Conflict | NotFound | AlreadyExists | Invalid
+        # Conflict | NotFound | AlreadyExists | Invalid | TooManyRequests
+        # (429: the eviction subresource's PDB-exhausted rejection)
+        self.reason = reason
         # True when a Conflict came from the fencing-token check: the
         # caller's fence token is revoked/superseded (it is a zombie).
         # A typed flag, not a message-prefix contract, so rewording the
@@ -362,6 +364,128 @@ class ClusterState:
         pod.node_name = node_name
         pod.resource_version = self._next_rv()
         self._emit("MODIFIED", "Pod", pod)
+
+    def evict(
+        self,
+        namespace: str,
+        name: str,
+        *,
+        expect_rv: int | None = None,
+        fence: "tuple[str, int] | None" = None,
+        nominated_node: str = "",
+    ) -> Pod:
+        """POST pods/{name}/eviction — the policy/v1 Eviction
+        subresource analog, the API the continuous rebalancer
+        (kubernetes_tpu/rebalance) moves pods through.
+
+        Order of checks mirrors the reference registry
+        (pkg/registry/core/pod/storage/eviction.go): the fencing token
+        first (a zombie rebalancer incarnation can never move
+        anything), then existence, then optimistic concurrency
+        (``expect_rv`` → Conflict, like an eviction carrying a
+        preconditions.resourceVersion), then the PodDisruptionBudget
+        gate — a matching PDB with ``disruptionsAllowed == 0`` rejects
+        with 429 TooManyRequests and the eviction does NOT happen.
+        A granted eviction decrements every matching PDB's allowance
+        immediately (the reference's registry does the same; the
+        disruption controller replenishing it is out of scope) and
+        emits an events.k8s.io record.
+
+        [BOUNDARY] divergence, deliberate: the reference eviction
+        DELETES the pod and a workload controller recreates a
+        replacement that then schedules fresh. This store has no
+        controllers, so delete + recreate collapse into one step — the
+        pod returns to Pending (nodeName cleared) under its own
+        identity, optionally carrying ``nominated_node`` as the
+        status.nominatedNodeName hint the recreated pod would get from
+        the rebalancer's target assignment. On the watch bus the
+        collapse is visible as the SAME pair every subscriber already
+        handles: a DELETED event (nodeName still set — assigned-pod
+        delete: caches release occupancy, shard filters route it to the
+        node's owner) followed by an ADDED event (unbound — queues
+        re-admit it, routed to the pod's owner). Pod identity surviving
+        the eviction is what keeps the decision journal's per-pod
+        history continuous across a migration."""
+        if fence is not None:
+            role, token = fence
+            if not self.fence_valid(role, token):
+                self.fence_rejections[role] = (
+                    self.fence_rejections.get(role, 0) + 1
+                )
+                raise ApiError(
+                    "Conflict",
+                    f"fenced: token {token} for role {role!r} is no "
+                    f"longer valid (current {self._fences.get(role)}); "
+                    "the incarnation lost its lease or was superseded",
+                    fenced=True,
+                )
+        pod = self.get_pod(namespace, name)
+        if not pod.node_name:
+            raise ApiError(
+                "Invalid", f"{pod.key} is not bound; nothing to evict"
+            )
+        if expect_rv is not None and pod.resource_version != expect_rv:
+            raise ApiError(
+                "Conflict",
+                f"{pod.key} rv {pod.resource_version} != {expect_rv}",
+            )
+        matching = [
+            pdb for pdb in self._pdbs.values() if pdb.matches(pod)
+        ]
+        for pdb in matching:
+            if pdb.disruptions_allowed <= 0:
+                raise ApiError(
+                    "TooManyRequests",
+                    f"cannot evict {pod.key}: PDB {pdb.key} has "
+                    "disruptionsAllowed == 0",
+                )
+        for pdb in matching:
+            pdb.disruptions_allowed -= 1
+        source = pod.node_name
+        self.record_event(
+            pod, "Evicted",
+            f"evicted from {source} by the rebalancer"
+            + (f"; nominated toward {nominated_node}" if nominated_node else ""),
+            action="Eviction",
+        )
+        # the delete half: nodeName still set, so every subscriber's
+        # assigned-pod-delete path (cache release, occupancy fences,
+        # fleet row withdraw, waking parked pods) runs unchanged. The
+        # DELETED carries a SNAPSHOT of the pod — events hold their
+        # object by reference, and a buffered consumer (the sim's
+        # delayed watch bus) must still read the bound state at pump
+        # time, after the recreate half below has mutated the live pod
+        import dataclasses
+
+        self._next_rv()
+        self._emit("DELETED", "Pod", dataclasses.replace(pod))
+        pod.node_name = ""
+        pod.phase = "Pending"
+        if nominated_node:
+            pod.nominated_node_name = nominated_node
+        pod.resource_version = self._next_rv()
+        # the recreate half: an unbound ADDED re-admits the pod through
+        # the ordinary queue-add routing (with the nomination indexed)
+        self._emit("ADDED", "Pod", pod)
+        # DRA deallocating-controller stand-in, same as delete_pod: an
+        # evicted pod leaves every claim's reservedFor; a claim nobody
+        # reserves loses its allocation, freeing the devices (the
+        # recreated pod re-allocates at its next scheduling)
+        if pod.resource_claim_names:
+            for cname in pod.resource_claim_names:
+                c = self._resource_claims.get(f"{namespace}/{cname}")
+                if c is None or pod.key not in c.reserved_for:
+                    continue
+                c.reserved_for = tuple(
+                    k for k in c.reserved_for if k != pod.key
+                )
+                if not c.reserved_for:
+                    c.allocated_node = ""
+                    c.results = ()
+                c.resource_version = self._next_rv()
+                self.dra_generation += 1
+                self._emit("MODIFIED", "ResourceClaim", c)
+        return pod
 
     # -- nodes --
 

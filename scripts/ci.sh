@@ -93,6 +93,26 @@ echo "$part_out"
 echo "$part_out" | grep -qE "fenced_commits=[1-9][0-9]* zombie_binds_while_fenced=0" \
     || { echo "CRASH SMOKE: no fenced zombie commit (or one landed)"; exit 1; }
 
+echo "== rebalance smoke: fragmentation profile =="
+# fragmentation: heavy plain arrivals + heavy deletes carve the cluster
+# into a sparse scatter; the idle-cycle rebalancer must detect it, plan
+# through the pack-objective auction, and migrate pods through the REAL
+# evict -> requeue -> re-bind path under the churn budget and the PDB
+# gate. The run's rebalance invariant asserts budget-never-exceeded,
+# zero PDB overruns, and packing-non-regressing across passes;
+# --selfcheck proves the whole loop byte-deterministic. The greps pin
+# the loop actually engaging — a run with no migrations would pass the
+# invariants vacuously.
+reb_out=$(python -m kubernetes_tpu.sim --seed 1234 --profile fragmentation \
+    --selfcheck)
+echo "$reb_out"
+echo "$reb_out" | grep -qE "migrations_completed=[1-9]" \
+    || { echo "REBALANCE SMOKE: no completed migration"; exit 1; }
+echo "$reb_out" | grep -qE "over_budget=0" \
+    || { echo "REBALANCE SMOKE: a cycle exceeded the churn budget"; exit 1; }
+echo "$reb_out" | grep -qE "pdb_overruns=0" \
+    || { echo "REBALANCE SMOKE: an eviction violated a PDB"; exit 1; }
+
 echo "== fleet smoke: 2-replica sharded drive =="
 # two active replicas sharding one cluster (shard-filtered watches,
 # cross-shard occupancy exchange, handoff protocol) under the

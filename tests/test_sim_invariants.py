@@ -374,6 +374,189 @@ def test_fencing_clean_on_good_partition_run():
     assert violations == []
 
 
+# -- rebalance (fragmentation profile) --------------------------------------
+
+
+def _run_record(t=10.0, packing=0.4, evicted=2, **kw):
+    from kubernetes_tpu.rebalance.runtime import RunRecord
+
+    return RunRecord(
+        t=t, packing_before=packing, stranded_before=0.5,
+        planned=kw.get("planned", evicted),
+        selected=kw.get("selected", evicted),
+        evicted=evicted, pdb_blocked=kw.get("pdb_blocked", 0),
+        plan_solve_s=0.01,
+    )
+
+
+def _check_rebalance(violations, history, **kw):
+    from kubernetes_tpu.sim.invariants import check_rebalance
+
+    defaults = dict(
+        budget=4, pdb_overruns=0, migrations_completed=1,
+        churn_end_t=9.0, final_packing=0.5,
+    )
+    defaults.update(kw)
+    check_rebalance(0, violations, history=history, **defaults)
+
+
+def test_rebalance_flags_budget_exceeded_plan():
+    violations = []
+    _check_rebalance(violations, [_run_record(evicted=9)], budget=4)
+    assert [v.invariant for v in violations] == ["rebalance"]
+    assert "churn budget" in violations[0].detail
+
+
+def test_rebalance_flags_pdb_violating_eviction():
+    violations = []
+    _check_rebalance(violations, [_run_record()], pdb_overruns=1)
+    assert [v.invariant for v in violations] == ["rebalance"]
+    assert "PDB" in violations[0].detail
+
+
+def test_rebalance_flags_utilization_regression():
+    violations = []
+    _check_rebalance(
+        violations,
+        [
+            _run_record(t=10.0, packing=0.5),
+            _run_record(t=21.0, packing=0.3),  # settle-phase regression
+        ],
+    )
+    assert any(
+        v.invariant == "rebalance" and "regressed" in v.detail
+        for v in violations
+    )
+
+
+def test_rebalance_flags_final_packing_regression():
+    violations = []
+    _check_rebalance(
+        violations, [_run_record(t=10.0, packing=0.5)], final_packing=0.2,
+    )
+    assert [v.invariant for v in violations] == ["rebalance"]
+    assert "final packed utilization" in violations[0].detail
+
+
+def test_rebalance_flags_stranded_evictions():
+    violations = []
+    _check_rebalance(
+        violations, [_run_record(evicted=3)], migrations_completed=0,
+    )
+    assert [v.invariant for v in violations] == ["rebalance"]
+    assert "strands" in violations[0].detail
+
+
+def test_rebalance_flags_never_engaged():
+    violations = []
+    _check_rebalance(violations, [])
+    assert [v.invariant for v in violations] == ["rebalance"]
+    assert "never engaged" in violations[0].detail
+
+
+def test_rebalance_churn_phase_regression_exempt():
+    # packing moving both ways DURING churn is legitimate: only
+    # settle-phase passes are held to monotonicity
+    violations = []
+    _check_rebalance(
+        violations,
+        [
+            _run_record(t=3.0, packing=0.6),  # churn phase
+            _run_record(t=5.0, packing=0.3),  # churn phase
+            _run_record(t=10.0, packing=0.4),
+            _run_record(t=21.0, packing=0.45),
+        ],
+    )
+    assert violations == []
+
+
+def test_rebalance_clean_on_good_run():
+    violations = []
+    _check_rebalance(
+        violations,
+        [_run_record(t=10.0, packing=0.4), _run_record(t=21.0, packing=0.55)],
+        final_packing=0.6,
+    )
+    assert violations == []
+
+
+def test_rebalance_tracker_counts_evictions_and_pdb_overruns():
+    """The tracker's independent allowance mirror must flag an eviction
+    that the enforcement code (hypothetically buggy) let through."""
+    from kubernetes_tpu.api.labels import (
+        Selector,
+        requirements_from_match_labels,
+    )
+    from kubernetes_tpu.api.objects import PodDisruptionBudget
+    from kubernetes_tpu.sim.invariants import RebalanceTracker
+
+    cs = _cluster()
+    cs.create_pdb(
+        PodDisruptionBudget(
+            name="guard", namespace="default",
+            selector=Selector(
+                requirements=requirements_from_match_labels({"app": "g"})
+            ),
+            disruptions_allowed=1,
+        )
+    )
+    tracker = RebalanceTracker(cs)
+    for name in ("a", "b"):
+        pod = MakePod().name(name).label("app", "g").req(
+            {"cpu": "1", "memory": "1Gi"}
+        ).obj()
+        cs.create_pod(pod)
+        cs.bind("default", name, "n0")
+    # first eviction consumes the allowance; force the second past the
+    # subresource's own gate by resetting the LIVE allowance — the
+    # tracker's mirror (seeded at construction) must still flag it
+    cs.evict("default", "a")
+    assert tracker.evictions == 1 and tracker.pdb_overruns == 0
+    cs.list_pdbs()[0].disruptions_allowed = 1
+    cs.evict("default", "b")
+    assert tracker.evictions == 2
+    assert tracker.pdb_overruns == 1
+    assert tracker.evicted_keys == ["default/a", "default/b"]
+
+
+def test_double_bind_evict_then_rebind_is_legitimate():
+    """An evict-and-rebind inside one drive delivers its DELETED before
+    the bind report drains: the banked bound-delete credit keeps the
+    tracker from misreading the migration as a double-bind — while a
+    genuine double-report still flags."""
+    cs = _cluster()
+    tracker = BindTransitionTracker(cs)
+    cs.create_pod(_pod("a"))
+    cs.bind("default", "a", "n0")
+    cs.evict("default", "a")
+    cs.bind("default", "a", "n1")
+    violations = []
+    # both binds report at drive end, after the eviction's DELETED
+    tracker.record_results([("default/a", "n0"), ("default/a", "n1")])
+    tracker.drain(0, violations)
+    assert violations == []
+    # a THIRD report with no delete in between is still a double-bind
+    tracker.record_results([("default/a", "n1")])
+    tracker.drain(1, violations)
+    assert [v.invariant for v in violations] == ["double_bind"]
+
+
+def test_double_bind_plain_delete_banks_no_credit():
+    """Only EVICTIONS bank re-bind credits (keyed on the subresource's
+    Evicted event): a plain bound-pod delete racing the bind report
+    must NOT absorb a masked double-report of the dead pod's key —
+    that is exactly the scheduler bug the check exists to catch."""
+    cs = _cluster()
+    tracker = BindTransitionTracker(cs)
+    cs.create_pod(_pod("a"))
+    cs.bind("default", "a", "n0")
+    cs.delete_pod("default", "a")  # churn delete, no Evicted record
+    violations = []
+    tracker.record_results([("default/a", "n0"), ("default/a", "n0")])
+    tracker.drain(0, violations)
+    assert [v.invariant for v in violations] == ["double_bind"]
+
+
 # -- cross-incarnation journal merge ----------------------------------------
 
 

@@ -196,6 +196,128 @@ def test_quality_vs_exact():
     assert score[1] <= max(2.0 * score[0], 1e-4), score
 
 
+def _preloaded_scarce(seed=3, n_nodes=256, n_pods=1200, rc=8):
+    """Miniature of the bench quality table's scarce_rc8 shape: unevenly
+    preloaded nodes (heterogeneous base scores), big request classes,
+    demand > capacity — the regime where a narrow top-T window strands
+    capacity on the fullest (lowest-scored) nodes."""
+    from kubernetes_tpu.server.bulk import columnar_pod_batch
+    from kubernetes_tpu.tensorize.schema import NodeBatch, pad_to
+
+    rng = np.random.default_rng(seed)
+    vocab = ResourceVocab(("cpu", "memory", "ephemeral-storage"))
+    npad = pad_to(n_nodes)
+    live = np.arange(npad) < n_nodes
+    alloc = np.zeros((3, npad), np.int64)
+    alloc[0, :n_nodes] = 16_000
+    alloc[1, :n_nodes] = 64 << 30
+    load = rng.integers(0, 9, n_nodes)
+    used = np.zeros((3, npad), np.int64)
+    used[0, :n_nodes] = load * 1_000
+    used[1, :n_nodes] = load * (2 << 30)
+    cnt = np.zeros(npad, np.int32)
+    cnt[:n_nodes] = load
+    rc_cpu = rng.integers(24, 33, rc) * 125
+    rc_mem = rng.choice([8 << 30], rc)
+    rc_of = np.sort(rng.integers(0, rc, n_pods))
+    prio = rng.integers(0, 10, n_pods).astype(np.int32)
+    order = np.lexsort((rc_of, -prio))
+    rc_of, prio = rc_of[order], prio[order]
+    rc_req = np.zeros((rc, 3), np.int64)
+    rc_req[:, 0], rc_req[:, 1] = rc_cpu, rc_mem
+
+    def node_batch():
+        return NodeBatch(
+            vocab=vocab, names=[f"n{i}" for i in range(n_nodes)],
+            num_nodes=n_nodes, padded=npad,
+            allocatable=alloc.copy(), used=used.copy(),
+            nonzero_used=used[:2].copy(), pod_count=cnt.copy(),
+            max_pods=np.where(live, 110, 0).astype(np.int32),
+            valid=live.copy(), schedulable=live.copy(),
+        )
+
+    def pod_batch():
+        return columnar_pod_batch(
+            rc_req[rc_of, 0].copy(), rc_req[rc_of, 1].copy(),
+            prio.copy(), vocab,
+        )
+
+    base = (
+        100.0
+        * (
+            (alloc[0] - used[0]) / np.maximum(alloc[0], 1)
+            + (alloc[1] - used[1]) / np.maximum(alloc[1], 1)
+        )
+        / 2.0
+    ).astype(np.int64)
+    return node_batch, pod_batch, base
+
+
+def test_scarcity_repair_closes_the_gap():
+    """SURVEY §8.4 / VERDICT missing #6: under demand > capacity with a
+    narrow top-T window, the fullest nodes score lowest, fall outside
+    every class's bid window, their prices never escalate, and capacity
+    strands (scarce_rc8 placed_ratio was 0.9854 without repair). The
+    full-width repair phase must close it: placed_ratio >= 0.995 and
+    objective_ratio >= 0.99 against the exact sequential anchor, on the
+    same preloaded cluster through both PUBLIC solver entry points."""
+    from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+
+    node_batch, pod_batch, base = _preloaded_scarce()
+    # top_t=16 of 256 nodes with a tight round budget: the pre-repair
+    # stranding regime, scaled down (without repair this config places
+    # ~60% — price rotation alone can't explore the window in time)
+    cfg = dict(top_t=16, max_rounds=8)
+    a_repair = SingleShotSolver(SingleShotConfig(**cfg)).solve(
+        node_batch(), pod_batch()
+    )
+    a_exact = ExactSolver(
+        ExactSolverConfig(tie_break="first", group_size=256)
+    ).solve(node_batch(), pod_batch())
+
+    def stats(a):
+        a = np.asarray(a)
+        placed = a >= 0
+        return int(placed.sum()), int(base[a[placed]].sum())
+
+    placed_s, obj_s = stats(a_repair)
+    placed_e, obj_e = stats(a_exact)
+    assert placed_s >= 0.995 * placed_e, (placed_s, placed_e)
+    assert obj_s >= 0.99 * obj_e, (obj_s, obj_e)
+
+    # repair OFF reproduces the stranding gap this test guards against —
+    # proving the gate above is non-vacuous for this workload
+    a_off = SingleShotSolver(
+        SingleShotConfig(repair_rounds=0, **cfg)
+    ).solve(node_batch(), pod_batch())
+    assert int((np.asarray(a_off) >= 0).sum()) < placed_s
+
+
+def test_pack_objective_consolidates():
+    """objective="pack" (the rebalancer's planning posture) with a
+    narrow bid window prefers the FULLEST feasible node instead of the
+    emptiest — the consolidation force the defragmentation plan needs.
+    top_t=1 makes every pod of a class bid the single best node per
+    round (wider windows deliberately fan a class out across the
+    window — the serving posture)."""
+    nodes = [
+        MakeNode().name("full").capacity({"cpu": "8", "memory": "32Gi", "pods": "20"}).obj(),
+        MakeNode().name("empty").capacity({"cpu": "8", "memory": "32Gi", "pods": "20"}).obj(),
+    ]
+    vocab = ResourceVocab.build([], nodes)
+    nbatch = build_node_batch(nodes, vocab=vocab)
+    # preload "full" to 50% cpu
+    nbatch.used[0, 0] = 4000
+    pods = [MakePod().name(f"p{i}").req({"cpu": "1"}).obj() for i in range(2)]
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - len(nodes))
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded)
+    a = SingleShotSolver(
+        SingleShotConfig(objective="pack", top_t=1)
+    ).solve(nbatch, pbatch, static)
+    assert all(int(x) == 0 for x in a)  # both landed on the fuller node
+
+
 def test_moderate_scale_host():
     # 2k pods x 512 nodes on CPU: still fast, exercises fan-out + rounds
     nodes = [

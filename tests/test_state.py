@@ -85,6 +85,195 @@ class TestClusterState:
         assert cs.get_pod("default", "p1").node_name == ""
 
 
+class TestEviction:
+    """The pods/{name}/eviction subresource analog (ClusterState.evict):
+    fencing first, then existence, optimistic concurrency, the PDB gate
+    (429 TooManyRequests at disruptionsAllowed == 0), and the collapsed
+    delete+recreate that returns the pod to Pending under its own
+    identity — the API the continuous rebalancer moves pods through."""
+
+    def _bound(self, labels=None, claim=None):
+        cs = ClusterState()
+        cs.create_node(node("n1"))
+        cs.create_node(node("n2"))
+        mp = MakePod().name("p1").req({"cpu": "100m"})
+        for k, v in (labels or {}).items():
+            mp = mp.label(k, v)
+        if claim:
+            mp = mp.resource_claim(claim)
+        cs.create_pod(mp.obj())
+        cs.bind("default", "p1", "n1")
+        return cs
+
+    def test_evict_returns_pod_to_pending_with_nomination(self):
+        cs = self._bound()
+        seen = []
+        cs.subscribe(
+            lambda ev: seen.append((ev.type, bool(ev.obj.node_name)))
+            if ev.kind == "Pod"
+            else None
+        )
+        rv_before = cs.get_pod("default", "p1").resource_version
+        p = cs.evict("default", "p1", nominated_node="n2")
+        assert p.node_name == ""
+        assert p.phase == "Pending"
+        assert p.nominated_node_name == "n2"
+        assert p.resource_version > rv_before
+        # the watch collapse every subscriber already handles: an
+        # assigned-pod DELETED (nodeName still set) then an unbound
+        # ADDED re-admitting the same identity
+        assert seen == [("DELETED", True), ("ADDED", False)]
+        evs = [e for e in cs.list_events() if e.reason == "Evicted"]
+        assert len(evs) == 1
+        assert "n2" in evs[0].note  # the nomination is recorded
+
+    def test_evict_deleted_event_snapshot_survives_recreate(self):
+        # events carry their object by reference and a delayed watch
+        # bus delivers them AFTER evict() has mutated the live pod for
+        # the recreate half: the DELETED must be a snapshot that still
+        # reads as bound at pump time, or every buffered consumer
+        # takes the unbound-delete branch and leaks source occupancy
+        cs = self._bound()
+        buffered = []
+        cs.subscribe(
+            lambda ev: buffered.append(ev)
+            if ev.kind == "Pod"
+            else None
+        )
+        cs.evict("default", "p1", nominated_node="n2")
+        deleted = [e for e in buffered if e.type == "DELETED"]
+        assert len(deleted) == 1
+        assert deleted[0].obj.node_name == "n1"  # deferred read
+        assert cs.get_pod("default", "p1").node_name == ""
+
+    def test_evict_unbound_pod_invalid(self):
+        cs = ClusterState()
+        cs.create_pod(pod("p1"))
+        with pytest.raises(ApiError) as e:
+            cs.evict("default", "p1")
+        assert e.value.reason == "Invalid"
+
+    def test_evict_missing_pod_not_found(self):
+        cs = ClusterState()
+        with pytest.raises(ApiError) as e:
+            cs.evict("default", "ghost")
+        assert e.value.reason == "NotFound"
+
+    def test_evict_stale_rv_conflict(self):
+        cs = self._bound()
+        stale = cs.get_pod("default", "p1").resource_version
+        cs.patch_pod_status("default", "p1", nominated_node_name="n2")
+        with pytest.raises(ApiError) as e:
+            cs.evict("default", "p1", expect_rv=stale)
+        assert e.value.reason == "Conflict"
+        assert cs.get_pod("default", "p1").node_name == "n1"  # untouched
+
+    def test_evict_pdb_exhausted_rejects_with_429(self):
+        from kubernetes_tpu.api.labels import (
+            Selector,
+            requirements_from_match_labels,
+        )
+        from kubernetes_tpu.api.objects import PodDisruptionBudget
+
+        cs = self._bound(labels={"app": "db"})
+        cs.create_pdb(
+            PodDisruptionBudget(
+                name="db-pdb",
+                selector=Selector(
+                    requirements=requirements_from_match_labels(
+                        {"app": "db"}
+                    )
+                ),
+                disruptions_allowed=0,
+            )
+        )
+        with pytest.raises(ApiError) as e:
+            cs.evict("default", "p1")
+        assert e.value.reason == "TooManyRequests"
+        # the eviction did NOT happen and the allowance did not go
+        # further negative
+        assert cs.get_pod("default", "p1").node_name == "n1"
+        (pdb,) = cs.list_pdbs()
+        assert pdb.disruptions_allowed == 0
+
+    def test_evict_decrements_pdb_allowance(self):
+        from kubernetes_tpu.api.labels import (
+            Selector,
+            requirements_from_match_labels,
+        )
+        from kubernetes_tpu.api.objects import PodDisruptionBudget
+
+        cs = self._bound(labels={"app": "db"})
+        mp2 = MakePod().name("p2").req({"cpu": "100m"}).label("app", "db")
+        cs.create_pod(mp2.obj())
+        cs.bind("default", "p2", "n2")
+        cs.create_pdb(
+            PodDisruptionBudget(
+                name="db-pdb",
+                selector=Selector(
+                    requirements=requirements_from_match_labels(
+                        {"app": "db"}
+                    )
+                ),
+                disruptions_allowed=1,
+            )
+        )
+        cs.evict("default", "p1")  # spends the one allowance
+        (pdb,) = cs.list_pdbs()
+        assert pdb.disruptions_allowed == 0
+        with pytest.raises(ApiError) as e:
+            cs.evict("default", "p2")
+        assert e.value.reason == "TooManyRequests"
+        assert cs.get_pod("default", "p2").node_name == "n2"
+
+    def test_evict_fenced_zombie_rejected_before_anything(self):
+        cs = self._bound()
+        old = cs.grant_fence("leader")
+        fresh = cs.grant_fence("leader")  # supersedes: old is a zombie
+        with pytest.raises(ApiError) as e:
+            cs.evict("default", "p1", fence=("leader", old))
+        assert e.value.reason == "Conflict"
+        assert e.value.fenced  # typed flag, not a message contract
+        assert cs.fence_rejections["leader"] == 1
+        assert cs.get_pod("default", "p1").node_name == "n1"
+        # the current holder moves pods fine
+        p = cs.evict("default", "p1", fence=("leader", fresh))
+        assert p.node_name == ""
+
+    def test_evict_fence_checked_before_existence(self):
+        # order mirrors the registry: a zombie probing a deleted pod
+        # learns it is fenced, not that the pod is gone
+        cs = ClusterState()
+        old = cs.grant_fence("leader")
+        cs.grant_fence("leader")
+        with pytest.raises(ApiError) as e:
+            cs.evict("default", "ghost", fence=("leader", old))
+        assert e.value.fenced
+
+    def test_evict_releases_resource_claims(self):
+        from kubernetes_tpu.api.dra import DeviceRequest, ResourceClaim
+
+        cs = self._bound(claim="train")
+        c = cs.create_resource_claim(
+            ResourceClaim(
+                name="train",
+                requests=(
+                    DeviceRequest(name="g", device_class_name="gpu"),
+                ),
+            )
+        )
+        c.reserved_for = ("default/p1",)
+        c.allocated_node = "n1"
+        gen = cs.dra_generation
+        cs.evict("default", "p1")
+        claim = cs.get_resource_claim("default", "train")
+        # the deallocating-controller stand-in ran: nobody reserves the
+        # claim, so its allocation is released for the re-bind
+        assert claim.reserved_for == ()
+        assert claim.allocated_node == ""
+        assert cs.dra_generation > gen
+
+
 class TestSchedulerCache:
     def test_assume_confirm_flow(self):
         clock = FakeClock()
