@@ -248,24 +248,29 @@ def _sustained_shape(
     n_nodes: int,
     n_pods: int,
     rate: float,
-    pipelined: bool,
+    mode: str = "pipelined",  # "sync" | "pipelined" | "streaming"
     batch: int = 2_048,
     group: int = 256,
     split: int = 4,
+    stream_depth: int = 4,
     resilience=None,  # ResilienceConfig override (ladder #9's forced
     # host-greedy arm); None = defaults (top tier)
 ) -> dict:
     """One open-loop sustained-arrival run: pods arrive at ``rate``/s
-    while the scheduler drains concurrently — pipelined
-    (Scheduler.run_pipelined, hard shapes included via the
-    occupancy-carrying sub-batch split) or synchronous
-    (schedule_batch), same workload either way for the A/B.
+    while the scheduler drains concurrently — streaming
+    (Scheduler.run_streaming, the device-resident solve loop with
+    cross-batch occupancy chaining), pipelined (Scheduler.run_pipelined,
+    hard shapes via the occupancy-carrying sub-batch split), or
+    synchronous (schedule_batch); same workload for every arm.
 
     Reports POST-WARMUP steady-state throughput (the first measured
     batch, which absorbs residual warmup, is dropped; time-weighted
-    over the rest) and the per-pod e2e p99 (first queue entry -> bind
-    commit) — BASELINE.md's sustained metric pair — plus the pipeline
-    mode/sub-batch counters proving which path ran."""
+    over the rest), the per-pod e2e p99 (first queue entry -> bind
+    commit) — BASELINE.md's sustained metric pair — the pipeline
+    mode/sub-batch counters proving which path ran, and the RTT
+    attribution row: hidden-vs-paid deferred reads (a read that blocked
+    the driver > 1 ms paid an un-hidden tunnel round trip),
+    unhidden_reads_per_batch, and the h2d/d2h transfer-byte deltas."""
     from kubernetes_tpu import metrics
     from kubernetes_tpu.perf.runner import WorkloadResult
     from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
@@ -280,7 +285,11 @@ def _sustained_shape(
             cs,
             SchedulerConfig(
                 batch_size=batch,
-                pipeline_split=split if pipelined else 1,
+                # the streaming arm splits too (run_streaming threads
+                # _choose_split through _dispatch_stream) — only the
+                # sync arm pins 1 so the A/B isolates the dispatcher
+                pipeline_split=split if mode != "sync" else 1,
+                stream_depth=stream_depth,
                 solver=ExactSolverConfig(
                     tie_break="random", group_size=group
                 ),
@@ -289,23 +298,33 @@ def _sustained_shape(
         )
         return cs, sched
 
+    def drive(sched, max_batches=None):
+        if mode == "streaming":
+            return sched.run_streaming(
+                max_batches=max_batches or 10_000
+            )
+        if mode == "pipelined":
+            return sched.run_pipelined(max_batches=max_batches or 10_000)
+        if max_batches is not None:
+            return [sched.schedule_batch()]
+        return sched.run_until_settled()
+
     # warmup: compile this shape's executables (incl. the chained
     # sub-batch variants) on a throwaway cluster
     cs, sched = build()
     for i in range(min(n_pods, batch)):
         cs.create_pod(_mk_pod(i, kind))
-    if pipelined:
-        sched.run_pipelined()
-    else:
-        sched.run_until_settled()
+    drive(sched)
 
     cs, sched = build()
     mode_counters = {
         m: metrics.pipeline_mode_total.labels(m)
-        for m in ("overlap", "carry", "sync")
+        for m in ("overlap", "carry", "stream", "sync")
     }
     modes0 = {m: c._value.get() for m, c in mode_counters.items()}
     sub0 = metrics.pipeline_subbatches_total._value.get()
+    h2d0 = metrics.h2d_bytes_total._value.get()
+    d2h0 = metrics.d2h_bytes_total._value.get()
     # stats ride the perf runner's WorkloadResult so the steady-state
     # definition (drop the first measured batch, time-weighted) and the
     # e2e p99 are ONE formula shared with the SteadyStateArrival
@@ -320,10 +339,8 @@ def _sustained_shape(
             cs.create_pod(_mk_pod(created, kind))
             created += 1
         made_progress = False
-        results = (
-            sched.run_pipelined(max_batches=2)
-            if pipelined
-            else [sched.schedule_batch()]
+        results = drive(
+            sched, max_batches=8 if mode == "streaming" else 2
         )
         for r in results:
             n = len(r.scheduled)
@@ -341,6 +358,7 @@ def _sustained_shape(
         if created >= n_pods and not made_progress:
             break  # drained (or only stuck pods remain)
     res.measure_seconds = time.perf_counter() - t0
+    batches = max(sched._trace_step, 1)
     return {
         "pods": n_pods,
         "nodes": n_nodes,
@@ -359,16 +377,37 @@ def _sustained_shape(
         "pipeline_subbatches": int(
             metrics.pipeline_subbatches_total._value.get() - sub0
         ),
+        # RTT attribution (ISSUE 10): a deferred read that blocked the
+        # driver > 1 ms paid an un-hidden host<->device round trip; the
+        # rest were hidden behind overlapped host work / the streaming
+        # completion thread. unhidden_reads_per_batch is the number the
+        # device-resident loop drives toward one per event-fence.
+        "rtt_attribution": {
+            "reads_hidden": sched._reads_hidden,
+            "reads_paid": sched._reads_paid,
+            "unhidden_reads_per_batch": round(
+                sched._reads_paid / batches, 4
+            ),
+            "batches": batches,
+            "stream_chained_batches": int(
+                sched.solver.dispatch_counts.get("stream_chained", 0)
+            ),
+            "h2d_bytes": int(metrics.h2d_bytes_total._value.get() - h2d0),
+            "d2h_bytes": int(metrics.d2h_bytes_total._value.get() - d2h0),
+        },
         "dispatch": _dispatch_label(sched),
     }
 
 
 def ladder_sustained() -> dict:
-    """#6: the sustained-arrival pipelined ladder with a per-shape
-    sync-vs-pipelined A/B. The hard shapes (ports/spread/anti) run
-    through run_pipelined's occupancy-carrying path — the flagship
-    feature measured on the workloads that used to drain to the
-    synchronous loop, with the RTT-hiding sub-batch split engaged."""
+    """#6: the sustained-arrival ladder with a per-shape
+    sync-vs-pipelined-vs-STREAMING A/B/C. The hard shapes
+    (ports/spread/anti) run through run_pipelined's occupancy-carrying
+    path and through run_streaming's cross-batch occupancy chain — the
+    streaming dispatcher (ISSUE 10) is gated on its sustained p99
+    against the PR 4 pipelined arm, with the RTT attribution row
+    (unhidden_reads_per_batch) proving the per-batch round-trip floor
+    actually fell."""
     shapes = (
         # (kind, pods, arrival rate): rates oversupply the scheduler so
         # the measured number is scheduler capacity, not arrival cap
@@ -379,11 +418,17 @@ def ladder_sustained() -> dict:
     )
     out: dict = {}
     for kind, n_pods, rate in shapes:
-        sync = _sustained_shape(kind, 500, n_pods, rate, pipelined=False)
-        pipe = _sustained_shape(kind, 500, n_pods, rate, pipelined=True)
+        sync = _sustained_shape(kind, 500, n_pods, rate, mode="sync")
+        pipe = _sustained_shape(kind, 500, n_pods, rate, mode="pipelined")
+        stream = _sustained_shape(
+            kind, 500, n_pods, rate, mode="streaming"
+        )
+        pipe_p99 = pipe["sustained_p99_pod_latency_s"]
+        stream_p99 = stream["sustained_p99_pod_latency_s"]
         out[kind] = {
             "sync": sync,
             "pipelined": pipe,
+            "streaming": stream,
             "pipelined_vs_sync": round(
                 pipe["sustained_pods_per_sec"]
                 / max(sync["sustained_pods_per_sec"], 1e-9),
@@ -393,6 +438,18 @@ def ladder_sustained() -> dict:
                 pipe["sustained_pods_per_sec"]
                 >= sync["sustained_pods_per_sec"]
             ),
+            # the streaming gate pair: p99 speedup over the pipelined
+            # arm (>= 2x target on plain) and no-regression marker
+            "streaming_p99_speedup_vs_pipelined": round(
+                pipe_p99 / max(stream_p99, 1e-9), 3
+            ),
+            "streaming_ge_pipelined": bool(
+                stream["sustained_pods_per_sec"]
+                >= pipe["sustained_pods_per_sec"]
+            ),
+            "streaming_unhidden_reads_per_batch": stream[
+                "rtt_attribution"
+            ]["unhidden_reads_per_batch"],
         }
     return out
 
@@ -643,9 +700,9 @@ def ladder9_degraded() -> dict:
         kind="plain", n_nodes=200, n_pods=1_000, rate=8_000.0,
         batch=256, group=64, split=1,
     )
-    top = _sustained_shape(pipelined=True, **shape)
+    top = _sustained_shape(mode="pipelined", **shape)
     host = _sustained_shape(
-        pipelined=True,  # force_tier routes every batch through the
+        mode="pipelined",  # force_tier routes every batch through the
         # synchronous resilient cycle either way; keeping the flag
         # equal keeps the arrival/drive loop identical for the A/B
         resilience=ResilienceConfig(force_tier="host"),
@@ -1413,9 +1470,12 @@ def main() -> None:
     sustained = ladder_sustained()
     ladders["6_sustained_arrival"] = {
         "config": (
-            "open-loop sustained arrival, sync-vs-pipelined A/B per "
-            "shape; hard shapes (ports/spread/anti) run through "
-            "run_pipelined's occupancy-carrying sub-batch split"
+            "open-loop sustained arrival, sync-vs-pipelined-vs-"
+            "streaming A/B/C per shape; hard shapes (ports/spread/"
+            "anti) run through run_pipelined's occupancy-carrying "
+            "sub-batch split AND run_streaming's device-resident "
+            "cross-batch chain; rtt_attribution rows break deferred "
+            "reads into hidden vs paid"
         ),
         **sustained,
     }
@@ -1467,6 +1527,21 @@ def main() -> None:
                 "sustained_p99_pod_latency_s": sus_head[
                     "sustained_p99_pod_latency_s"
                 ],
+                # ladder #6 streaming hoist (ISSUE 10): the streaming
+                # dispatcher's plain-shape sustained p99 and its p99
+                # speedup over the PR 4 pipelined arm (the >= 2x gate),
+                # plus the amortized un-hidden reads per batch (the
+                # per-event-fence RTT floor; < 1.0 means the per-batch
+                # floor fell)
+                "streaming_p99_pod_latency_s": sustained["plain"][
+                    "streaming"
+                ]["sustained_p99_pod_latency_s"],
+                "streaming_speedup": sustained["plain"][
+                    "streaming_p99_speedup_vs_pipelined"
+                ],
+                "streaming_unhidden_reads_per_batch": sustained[
+                    "plain"
+                ]["streaming_unhidden_reads_per_batch"],
                 # ladder #7 hoist: real numbers when a mesh ran, the skip
                 # reason string when only one device is visible
                 "multichip_pods_per_sec": multichip.get(

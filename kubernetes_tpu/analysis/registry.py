@@ -3,16 +3,21 @@ scopes for the kubernetes_tpu package.
 
 SANCTIONED_SYNC_POINTS is the contract at the heart of the pipelined
 solver (BENCH_r05: ~104 ms per host<->device sync post-first-read): the
-hot path may read device values through EXACTLY these two points —
+hot path may read device values through EXACTLY these three points —
 
 - ``DeferredAssignments.get`` (solver/exact.py): the deferred
   assignment download whose async D2H copy was started at dispatch, so
   the blocking read lands after the tunnel RTT has been overlapped.
+- ``DeferredAssignments.wait`` (solver/exact.py): the streaming
+  dispatcher's completion thread parks here so the tunnel RTT is paid
+  OFF the driver thread — it only waits for the async D2H started at
+  dispatch to land and never converts the value; the driver's ``get``
+  stays the one read.
 - ``_InFlightSolve.assignments`` (scheduler.py): the scheduler-side
   wrapper the apply path calls once per batch.
 
-Adding a third entry is a design decision, not a lint tweak: it must
-come with the same overlap analysis those two carry.
+Adding an entry is a design decision, not a lint tweak: it must come
+with the same overlap analysis these carry.
 """
 
 from __future__ import annotations
@@ -22,6 +27,7 @@ from .core import AnalysisContext
 SANCTIONED_SYNC_POINTS = frozenset(
     {
         ("kubernetes_tpu/solver/exact.py", "DeferredAssignments.get"),
+        ("kubernetes_tpu/solver/exact.py", "DeferredAssignments.wait"),
         ("kubernetes_tpu/scheduler.py", "_InFlightSolve.assignments"),
     }
 )

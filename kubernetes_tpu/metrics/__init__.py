@@ -133,12 +133,44 @@ pipeline_fallback_total = Counter(
 )
 pipeline_mode_total = Counter(
     "scheduler_pipeline_mode_total",
-    "Popped batches by pipelined-loop mode: overlap (plain fit shapes "
+    "Popped batches by dispatch mode: overlap (plain fit shapes "
     "dispatched before the previous solve's read lands), carry (hard "
     "shapes — ports/spread/interpod/volumes/DRA/nominated/multi-"
     "profile — drained-then-chained through the occupancy-carrying "
-    "sub-batch split), sync (livelock-backstop synchronous cycle).",
+    "sub-batch split), stream (the streaming dispatcher's unified "
+    "device-resident solve loop, run_streaming), sync (livelock-"
+    "backstop / degraded-mode synchronous cycle).",
     ["mode"],
+    registry=REGISTRY,
+)
+stream_depth = Gauge(
+    "scheduler_stream_depth",
+    "Dispatched-but-unapplied stream slots in the streaming "
+    "dispatcher's bounded work ring (run_streaming); bounded by "
+    "SchedulerConfig.stream_depth.",
+    registry=REGISTRY,
+)
+stream_inflight_reads = Gauge(
+    "scheduler_stream_inflight_reads",
+    "Deferred assignment reads handed to the streaming dispatcher's "
+    "completion thread and not yet landed (the async D2H transfers "
+    "currently hiding tunnel RTT off the driver thread).",
+    registry=REGISTRY,
+)
+stream_unhidden_reads_total = Counter(
+    "scheduler_stream_unhidden_reads_total",
+    "Streaming-dispatcher assignment reads that actually BLOCKED the "
+    "driver thread (> 1 ms) — the un-hidden tunnel round trips the "
+    "device-resident solve loop exists to eliminate. Steady state "
+    "should trend toward one per event-fence, not one per batch.",
+    registry=REGISTRY,
+)
+stream_slot_discard_total = Counter(
+    "scheduler_stream_slot_discard_total",
+    "Stream slots discarded by the per-slot fence epochs (a "
+    "conflicting/occupancy event landed between a slot's dispatch and "
+    "its apply): only the affected slot and its chained successors "
+    "die; unrelated slots apply normally.",
     registry=REGISTRY,
 )
 pipeline_subbatches_total = Counter(

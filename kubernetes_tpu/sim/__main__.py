@@ -45,9 +45,14 @@ def _configure_jax(mesh_devices: int = 1) -> None:
 
 def _print_result(res) -> None:
     s = res.summary
+    dispatcher = (
+        "streaming"
+        if s.get("streaming")
+        else ("pipelined" if s["pipelined"] else "sync")
+    )
     print(
         f"profile={res.profile} seed={res.seed} cycles={res.cycles} "
-        f"pipelined={s['pipelined']}"
+        f"pipelined={s['pipelined']} dispatcher={dispatcher}"
     )
     print(
         f"  events={s['events']} bound={s['bound']} unbound={s['unbound']} "
@@ -62,6 +67,7 @@ def _print_result(res) -> None:
     print(
         f"  pipeline: discards={s['discards']:.0f} "
         f"fallbacks={s['pipeline_fallbacks']:.0f} "
+        f"stream_discards={s.get('stream_discards', 0):.0f} "
         f"preemptions={s['preemptions']:.0f}"
     )
     resil = s.get("resilience")
@@ -145,10 +151,15 @@ def _print_fleet_result(res) -> None:
 def _run_fleet(args) -> int:
     from .fleet import run_fleet_sim
 
+    pipelined = streaming = None
+    if args.dispatcher is not None:
+        pipelined = args.dispatcher == "pipelined"
+        streaming = args.dispatcher == "streaming"
     try:
         res = run_fleet_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
-            replicas=args.fleet,
+            replicas=args.fleet, pipelined=pipelined,
+            streaming=streaming,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -164,7 +175,8 @@ def _run_fleet(args) -> int:
     if args.selfcheck:
         res2 = run_fleet_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
-            replicas=args.fleet,
+            replicas=args.fleet, pipelined=pipelined,
+            streaming=streaming,
         )
         if res.journal_digests != res2.journal_digests:
             print(
@@ -200,6 +212,13 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--sync", action="store_true",
         help="drive run_until_settled instead of the profile's default",
+    )
+    parser.add_argument(
+        "--dispatcher", choices=("sync", "pipelined", "streaming"),
+        default=None,
+        help="override the profile's dispatch loop: sync "
+        "(schedule_batch), pipelined (run_pipelined), streaming "
+        "(run_streaming — the device-resident solve loop)",
     )
     parser.add_argument(
         "--trace", metavar="PATH", help="write the replayable trace here"
@@ -264,11 +283,18 @@ def main(argv=None) -> int:
         _print_result(res)
         return 0 if res.ok else 1
 
+    # --sync must override BOTH profile defaults: a streaming profile
+    # (sustained_stream) would otherwise still drive run_streaming
     pipelined = False if args.sync else None
+    streaming = False if args.sync else None
+    if args.dispatcher is not None:
+        pipelined = args.dispatcher == "pipelined"
+        streaming = args.dispatcher == "streaming"
     try:
         res = run_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
-            pipelined=pipelined, flight_dump=args.flight_dump,
+            pipelined=pipelined, streaming=streaming,
+            flight_dump=args.flight_dump,
             mesh_devices=args.mesh_devices,
         )
     except ValueError as e:
@@ -288,7 +314,8 @@ def main(argv=None) -> int:
     if args.selfcheck:
         res2 = run_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
-            pipelined=pipelined, mesh_devices=args.mesh_devices,
+            pipelined=pipelined, streaming=streaming,
+            mesh_devices=args.mesh_devices,
         )
         if res.journal_lines != res2.journal_lines:
             print(

@@ -92,6 +92,7 @@ class FleetSimHarness:
         replicas: int | None = None,
         *,
         pipelined: bool | None = None,
+        streaming: bool | None = None,
         max_settle_rounds: int = 12,
     ) -> None:
         self.profile = (
@@ -110,6 +111,10 @@ class FleetSimHarness:
         self.n = replicas or self.profile.fleet_replicas or 2
         self.pipelined = (
             self.profile.pipelined if pipelined is None else pipelined
+        )
+        # streaming dispatcher drive per replica (run_streaming)
+        self.streaming = (
+            self.profile.streaming if streaming is None else streaming
         )
         self.max_settle_rounds = max_settle_rounds
         # the same "{seed}/gen" stream as the single-scheduler harness:
@@ -173,7 +178,9 @@ class FleetSimHarness:
 
     def _drive_replica(self, rid: str, cycle: int) -> None:
         sched = self.schedulers[rid]
-        if self.pipelined:
+        if self.streaming:
+            results = sched.run_streaming(max_batches=200)
+        elif self.pipelined:
             results = sched.run_pipelined(max_batches=200)
         else:
             results = sched.run_until_settled(max_batches=200)
@@ -453,9 +460,10 @@ def run_fleet_sim(
     replicas: int | None = None,
     *,
     pipelined: bool | None = None,
+    streaming: bool | None = None,
 ) -> FleetSimResult:
     """One fresh seeded fleet run (library entry; CLI and tests)."""
     return FleetSimHarness(
         profile, seed=seed, cycles=cycles, replicas=replicas,
-        pipelined=pipelined,
+        pipelined=pipelined, streaming=streaming,
     ).run()

@@ -98,6 +98,9 @@ _DELTA_COUNTERS = {
     "discards": metrics.solves_discarded_total,
     "pipeline_fallbacks": metrics.pipeline_fallback_total,
     "preemptions": metrics.preemption_attempts_total,
+    # streaming dispatcher: slots killed by per-slot fence epochs —
+    # driver-thread logic, so same-seed runs stay byte-identical
+    "stream_discards": metrics.stream_slot_discard_total,
 }
 
 
@@ -113,6 +116,7 @@ class SimHarness:
         cycles: int = 10,
         *,
         pipelined: bool | None = None,
+        streaming: bool | None = None,
         replay: TraceReader | None = None,
         max_settle_rounds: int = 12,
         spans: bool = False,
@@ -128,6 +132,12 @@ class SimHarness:
         self.pipelined = (
             self.profile.pipelined if pipelined is None else pipelined
         )
+        # streaming dispatcher drive (Scheduler.run_streaming): profile
+        # default, overridable per run (the CI smokes re-drive the
+        # chaos/crash profiles through it)
+        self.streaming = (
+            self.profile.streaming if streaming is None else streaming
+        )
         self.max_settle_rounds = max_settle_rounds
         self._reader = replay
 
@@ -137,6 +147,7 @@ class SimHarness:
             profile=self.profile.name,
             cycles=cycles,
             pipelined=self.pipelined,
+            streaming=self.streaming,
         )
         self.journal = DecisionJournal(
             None if replay is not None else self.trace,
@@ -392,6 +403,16 @@ class SimHarness:
         )
 
     def _drive_once(self, cycle: int) -> None:
+        if self.streaming:
+            try:
+                results = self.scheduler.run_streaming(max_batches=200)
+            except ExtenderError:
+                self._extender_aborts += 1
+                return
+            for r in results:
+                self.tracker.record_results(r.scheduled)
+                self._sched_bound.update(k for k, _ in r.scheduled)
+            return
         if self.pipelined:
             try:
                 results = self.scheduler.run_pipelined(max_batches=200)
@@ -656,6 +677,7 @@ class SimHarness:
         ).hexdigest()
         summary = {
             "pipelined": self.pipelined,
+            "streaming": self.streaming,
             "events": self._events_applied,
             "bound": len(bindings),
             "unbound": len(unbound),
@@ -770,6 +792,7 @@ def run_sim(
     cycles: int = 10,
     *,
     pipelined: bool | None = None,
+    streaming: bool | None = None,
     spans: bool = False,
     flight_dump: str | None = None,
     mesh_devices: int = 1,
@@ -777,7 +800,8 @@ def run_sim(
     """One fresh seeded run (library entry; the CLI and tests use this)."""
     return SimHarness(
         profile, seed=seed, cycles=cycles, pipelined=pipelined,
-        spans=spans, flight_dump=flight_dump, mesh_devices=mesh_devices,
+        streaming=streaming, spans=spans, flight_dump=flight_dump,
+        mesh_devices=mesh_devices,
     ).run()
 
 
@@ -792,5 +816,6 @@ def replay_trace(path) -> SimResult:
         seed=int(h["seed"]),
         cycles=int(h["cycles"]),
         pipelined=bool(h["pipelined"]),
+        streaming=bool(h.get("streaming", False)),
         replay=reader,
     ).run()

@@ -28,6 +28,11 @@ class Profile:
     name: str
     # -- cluster shape / scheduler config --
     pipelined: bool = True
+    # drive Scheduler.run_streaming (the streaming dispatcher's
+    # device-resident solve loop) instead of run_pipelined. Orthogonal
+    # to ``pipelined`` (which picks pipelined-vs-sync when streaming is
+    # off); the CLI's --dispatcher flag overrides either.
+    streaming: bool = False
     nodes: int = 6
     node_cpu: str = "8"
     node_mem: str = "32Gi"
@@ -354,6 +359,30 @@ PROFILES: dict[str, Profile] = {
             rebalance_budget=4,
             rebalance_min_packing=0.6,
             pdb_guard_rate=0.25,
+        ),
+        # sustained_stream: the streaming dispatcher's high-arrival
+        # profile — enough arrivals per cycle that several batches pop
+        # back-to-back and the bounded work ring actually fills, with a
+        # hard-shape mix (spread/anti/ports) so cross-batch occupancy
+        # chaining and the drain-then-retensorize fallback both
+        # engage, plus delete churn and delayed/duplicated watch
+        # delivery so per-slot fence epochs discard stream slots
+        # mid-ring. Byte-deterministic under --selfcheck like every
+        # profile (the completion thread only warms transfers — apply
+        # order stays driver-side).
+        Profile(
+            name="sustained_stream",
+            streaming=True,
+            nodes=8,
+            arrivals=(6, 12),
+            batch_size=6,
+            pod_spread_rate=0.2,
+            pod_anti_rate=0.1,
+            pod_ports_rate=0.15,
+            delete_pod_rate=0.4,
+            bind_fault_rate=0.1,
+            watch_delay=True,
+            watch_dup_rate=0.1,
         ),
         # replica_loss: fleet_mixed plus one replica killed mid-drive.
         # The survivors must re-own its shard (ring orphan

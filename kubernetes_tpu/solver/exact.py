@@ -1259,6 +1259,17 @@ class DeferredAssignments:
     def get(self) -> np.ndarray:
         return np.asarray(self._dev)[: self._num_pods]
 
+    # sanctioned deferred-read point (analysis/registry.py): the
+    # streaming dispatcher's COMPLETION THREAD parks here so the tunnel
+    # RTT is paid off the driver thread — it only waits for the async
+    # D2H started in __init__ to land, it never converts the value (the
+    # driver's get() stays the one read): ktpu: hot
+    def wait(self) -> None:
+        try:
+            self._dev.block_until_ready()
+        except Exception:
+            pass  # get() surfaces any real transfer death to the driver
+
 
 class BatchCarriedUsage:
     """Device-resident occupancy carry between chained sub-batch solves
@@ -1292,6 +1303,22 @@ def _class_table_arrays(static, spread, interpod) -> list:
     if static.extra_score is not None:
         arrays.append(static.extra_score)
     return arrays
+
+
+def _class_table_digest(static, spread, interpod) -> bytes:
+    """Content hash of the class-table arrays — the one digest both the
+    session's class-table cache key AND the streaming dispatcher's
+    stream_chain_key are built from, so a streaming dispatch hashes the
+    tables once (stream_chain_key computes it, solve hands it to
+    class_tables via the chain key) instead of twice per batch."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for a in _class_table_arrays(static, spread, interpod):
+        arr = np.ascontiguousarray(a)
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.digest()
 
 
 def _place_class_tables(static, spread, interpod, mesh, node_pad: int):
@@ -1367,6 +1394,22 @@ class _DeviceSession:
         # buffers' shardings no longer match the dispatch's expectations.
         self.mesh = None
         self.mesh_key: tuple | None = None
+        # cross-BATCH occupancy carry (the streaming dispatcher): the
+        # FULL carried state of the last stream solve — fit rows plus
+        # the port/spread/interpod occupancy rows — kept device-resident
+        # so the next batch with an identical occupancy vocabulary
+        # (stream_key) chains on it instead of re-uploading host bstate.
+        # Its fit buffers are the SAME objects as ``persist``'s, so any
+        # donation of persist (ordinary solves, heals) invalidates it —
+        # every such path must null it out. ``stream_versions`` is the
+        # carry's own host-column baseline: the scheduler advances it
+        # after each CLEAN ring-slot apply (the device assumed those
+        # placements at solve time, so host truth catching up is not
+        # drift), which is what keeps chaining alive past the first
+        # ring fill — ``seen_versions`` stays the heal baseline.
+        self.stream_carry: dict | None = None
+        self.stream_key: tuple | None = None
+        self.stream_versions: np.ndarray | None = None
 
     def sync(
         self,
@@ -1405,6 +1448,11 @@ class _DeviceSession:
             self.k = nodes.allocatable.shape[0]
             self.mesh = mesh
             self.mesh_key = mesh_key
+            # a full re-upload replaces the resident state wholesale:
+            # any cross-batch occupancy carry is gone with it
+            self.stream_carry = None
+            self.stream_key = None
+            self.stream_versions = None
             _, put = placers(mesh, nodes.padded)
             self.nt = {
                 "alloc": put(nodes.allocatable),
@@ -1450,6 +1498,14 @@ class _DeviceSession:
             # only the owning shard's columns — the others are out of its
             # index range)
             put_r, _ = placers(self.mesh)
+            # the heal donates persist's fit buffers, which the stream
+            # carry shares — a dirty-column heal therefore breaks any
+            # cross-batch chain (the streaming dispatcher refuses to
+            # chain over dirty columns for exactly this reason:
+            # ExactSolver.can_chain checks seen_versions first)
+            self.stream_carry = None
+            self.stream_key = None
+            self.stream_versions = None
             self.nt, self.persist = _heal_jit(
                 self.nt,
                 self.persist,
@@ -1465,20 +1521,18 @@ class _DeviceSession:
             else 0
         )
 
-    def class_tables(self, static, spread, interpod, mesh=None):
+    def class_tables(self, static, spread, interpod, mesh=None, digest=None):
         """Content-addressed device cache of the per-batch class tables.
         Returns (tables, bytes_uploaded) — 0 bytes on a cache hit. The
         cache key includes the mesh fingerprint: the same content placed
-        for a different topology is a different device resident."""
-        import hashlib
-
-        h = hashlib.blake2b(digest_size=16)
+        for a different topology is a different device resident.
+        ``digest`` short-circuits the content hash with a precomputed
+        _class_table_digest (the streaming path already computed it for
+        the chain key)."""
         arrays = _class_table_arrays(static, spread, interpod)
-        for a in arrays:
-            arr = np.ascontiguousarray(a)
-            h.update(str(arr.shape).encode())
-            h.update(arr.tobytes())
-        key = (h.digest(), mesh_fingerprint(mesh))
+        if digest is None:
+            digest = _class_table_digest(static, spread, interpod)
+        key = (digest, mesh_fingerprint(mesh))
         ct = self.class_cache.pop(key, None)
         if ct is not None:
             self.class_cache[key] = ct  # re-insert: LRU refresh on hit
@@ -1536,6 +1590,119 @@ class ExactSolver:
         fresh.class_cache = self._session.class_cache
         self._session = fresh
 
+    # -- cross-batch occupancy chaining (the streaming dispatcher) --
+
+    def stream_chain_key(
+        self,
+        nodes: NodeBatch,
+        pods: PodBatch,
+        static: StaticPluginTensors,
+        ports: PortTensors | None = None,
+        spread: SpreadTensors | None = None,
+        interpod: InterpodTensors | None = None,
+        mesh=None,
+    ) -> tuple:
+        """Fingerprint of everything that makes one batch's device-
+        resident occupancy carry (BatchCarriedUsage) semantically AND
+        shape-compatible with the next batch's dispatch: the class-table
+        content (spread instance/domain tables, interpod term tables,
+        static masks — the index spaces the carried rows are keyed by),
+        the ordered port vocabulary, the bstate row layout, the node
+        padding/resource-vocab width, the domain paddings, and the mesh
+        topology. Two consecutive batches with equal keys may chain: the
+        occupancy rows the earlier batch's placements advanced stay
+        device-resident instead of round-tripping through host
+        tensorize. Conservative by construction — any difference falls
+        back to the drain-then-retensorize path, never to a wrong
+        chain. ``spread``/``interpod``/``ports`` may be None — the same
+        trivial tensors ``solve`` would build are keyed then, so a
+        plain batch's key matches the dispatch it fingerprints."""
+        if mesh is None:
+            mesh = self.mesh
+        if ports is None:
+            ports = trivial_port_tensors(pods, nodes.padded)
+        if spread is None:
+            spread = trivial_spread_tensors(pods, nodes.padded, static.c_pad)
+        if interpod is None:
+            interpod = trivial_interpod_tensors(
+                pods, nodes.padded, static.c_pad
+            )
+        import hashlib
+
+        # component 0 is exactly the class-table cache digest, so the
+        # dispatch can hand it to _DeviceSession.class_tables instead of
+        # hashing the same arrays a second time in the hot loop
+        return (
+            _class_table_digest(static, spread, interpod),
+            hashlib.blake2b(
+                repr(ports.vocab).encode(), digest_size=16
+            ).digest(),
+            mesh_fingerprint(mesh),
+            nodes.padded,
+            nodes.allocatable.shape[0],
+            ports.used.shape[0],
+            spread.cnt0.shape[0],
+            interpod.in_cnt0.shape[0],
+            interpod.ex_cnt0.shape[0],
+            spread.d_pad,
+            interpod.d_pad,
+        )
+
+    def can_chain(self, key: tuple, col_versions: np.ndarray) -> bool:
+        """True when the next solve may consume the resident stream
+        carry: a carry exists, its key matches, and NO snapshot column
+        went dirty past the carry's OWN baseline (``stream_versions``) —
+        unexplained dirt means host truth moved under the carry (node
+        table change, assume-failure touch), and healing it would
+        donate the carry's fit buffers, so the chain refuses instead
+        (the caller drains and re-tensorizes, which is always correct).
+        The baseline starts at the carry's dispatch and is advanced by
+        ``note_stream_applied`` after each clean ring-slot apply: the
+        scheduler's own applies only write usage the device already
+        assumed at solve time, so they must not kill the chain —
+        without the advance, chaining would die permanently the moment
+        the stream ring first fills (every apply dirties columns, and
+        in-flight dispatches defer heals, so ``seen_versions`` never
+        catches up)."""
+        s = self._session
+        if s.stream_carry is None or s.stream_key != key:
+            return False
+        if s.padded == -1 or s.stream_versions is None:
+            return False
+        if col_versions is None or s.padded > len(col_versions):
+            return False
+        return not bool(
+            np.any(col_versions[: s.padded] > s.stream_versions)
+        )
+
+    def note_stream_applied(self, col_versions: np.ndarray) -> None:
+        """Advance the stream carry's column baseline after the
+        scheduler applied a ring slot CLEANLY (no fence discard, no
+        assume/bind failure): the apply wrote exactly the usage the
+        device session assumed at that slot's solve, so host truth
+        catching up is not drift — the carry stays chainable. Any
+        UNCLEAN apply skips this call; its assume-failure ``touch``
+        then trips ``can_chain`` and the next dispatch drains + heals
+        the phantom placement."""
+        s = self._session
+        if s.stream_carry is None or s.padded == -1:
+            return
+        if col_versions is None or s.padded > len(col_versions):
+            return
+        s.stream_versions = col_versions[: s.padded].copy()
+
+    def invalidate_stream_carry(self) -> None:
+        """Drop the resident stream carry. Called by the scheduler when
+        a ring-slot apply was UNCLEAN (fence discard, assume/bind
+        failure): the session persist may hold a phantom placement, and
+        a later clean apply must not advance the baseline past the
+        failure's ``touch`` — with the carry gone, the next dispatch
+        takes the drain-then-heal path, which clears the phantom."""
+        s = self._session
+        s.stream_carry = None
+        s.stream_key = None
+        s.stream_versions = None
+
     def solve(
         self,
         nodes: NodeBatch,
@@ -1551,6 +1718,9 @@ class ExactSolver:
         allow_heal: bool = True,
         split: int = 1,
         mesh=None,
+        chain_occupancy: bool = False,
+        stream_carry_out: bool = False,
+        chain_key: tuple | None = None,
     ) -> np.ndarray | DeferredAssignments | list[DeferredAssignments]:
         """Returns assignments [num_pods] of node indices (-1 = unschedulable).
 
@@ -1589,6 +1759,21 @@ class ExactSolver:
         correction carry is per-solve). When ``split > 1`` the return
         value is ALWAYS a list, even if the clamp lands on one
         sub-batch.
+
+        ``stream_carry_out`` (session + defer_read only): after the
+        solve, keep the FULL carried state — fit rows AND the batch
+        occupancy rows — device-resident as the session's stream carry,
+        tagged with ``chain_key`` (stream_chain_key). The next solve
+        whose key matches may pass ``chain_occupancy=True`` to consume
+        it: its first dispatch chains on the resident carry instead of
+        uploading host bstate, so the occupancy the earlier batch's
+        placements advanced never round-trips. This is the streaming
+        dispatcher's cross-BATCH extension of the within-batch
+        ``split`` chain; the caller is responsible for only chaining
+        when its fences prove no conflicting event landed in between
+        (``can_chain`` re-checks the vocabulary + dirty columns).
+        Nominated batches never stream (their correction carry is
+        per-solve).
 
         ``mesh`` (default: the constructor's mesh): a jax.sharding.Mesh
         with a "nodes" axis — every node-resident table/state array
@@ -1630,7 +1815,8 @@ class ExactSolver:
             nt = self._session.nt
             persist = self._session.persist
             ct, ct_bytes = self._session.class_tables(
-                static, spread, interpod, mesh=mesh
+                static, spread, interpod, mesh=mesh,
+                digest=chain_key[0] if chain_key is not None else None,
             )
             h2d_bytes += ct_bytes
         else:
@@ -1841,11 +2027,32 @@ class ExactSolver:
             kinds_host = None
             self.dispatch_counts["scan"] += 1
 
+        # streaming chain eligibility: session + deferred + un-nominated
+        stream = (
+            session
+            and defer_read
+            and not use_nominated
+            and (chain_occupancy or stream_carry_out)
+        )
+        chain_occupancy = chain_occupancy and stream
+        if chain_occupancy and not self.can_chain(
+            chain_key, col_versions
+        ):
+            # the caller's pre-dispatch check and this one race nothing
+            # (single driver thread); a mismatch here is a logic error
+            # upstream — refuse loudly rather than chain wrongly
+            raise ValueError(
+                "chain_occupancy requested but the session carry does "
+                "not match (stale key or dirty columns)"
+            )
+
         # per-solve transfer accounting + mesh placement: per-pod packed
         # arrays and scalars replicate; node-axis rows (bstate, nominated
-        # load) shard over the mesh's node axis
+        # load) shard over the mesh's node axis. A chained dispatch
+        # consumes the resident carry instead of uploading bstate.
         h2d_bytes += (
-            bstate.nbytes + xi64.nbytes + xi32.nbytes + xbool.nbytes
+            (0 if chain_occupancy else bstate.nbytes)
+            + xi64.nbytes + xi32.nbytes + xbool.nbytes
             + vcnt_host.nbytes + np.asarray(nom_used).nbytes
             + np.asarray(nom_ports).nbytes
         )
@@ -1867,19 +2074,45 @@ class ExactSolver:
             kinds = jax.device_put(kinds, _repl)
 
         want_chain = split > 1 and session and defer_read
-        if want_chain and not use_nominated:
+        if (want_chain or stream) and not use_nominated:
             k_split = self._feasible_split(
-                split, pods.padded, grouped, group
+                max(split, 1), pods.padded, grouped, group
             )
-            if k_split > 1:
-                return self._solve_chain(
+            if k_split > 1 or stream:
+                # stream solves route through the chain dispatcher even
+                # unsplit (k_split == 1): it is the one path that can
+                # consume/produce the cross-batch occupancy carry
+                handles = self._solve_chain(
                     k_split, nt, ct, bstate, xi64, xi32, xbool,
                     kinds_host if grouped else None, vcnt_host, compact,
                     nom_used, nom_ports, key, pods, mesh,
                     bspec=tuple(bspec), xspec=xspec, grouped=grouped,
-                    group=group, **kw,
+                    group=group,
+                    chain_start=(
+                        self._session.stream_carry
+                        if chain_occupancy
+                        else None
+                    ),
+                    carry_out=stream_carry_out,
+                    chain_key=chain_key,
+                    **kw,
                 )
+                if self._session.stream_carry is not None:
+                    # the kept carry's chain baseline: host columns as
+                    # of this dispatch (note_stream_applied advances it
+                    # as ring-slot applies land cleanly)
+                    self._session.stream_versions = col_versions[
+                        : self._session.padded
+                    ].copy()
+                return handles
 
+        if session:
+            # this dispatch donates the session persist, whose fit
+            # buffers any saved stream carry shares: the carry cannot
+            # survive a non-streaming solve
+            self._session.stream_carry = None
+            self._session.stream_key = None
+            self._session.stream_versions = None
         run = _run_packed_jit if session else _run_packed_jit_nodonate
         out = run(
             nt,
@@ -1982,6 +2215,9 @@ class ExactSolver:
         xspec,
         grouped: bool,
         group: int,
+        chain_start: dict | None = None,
+        carry_out: bool = False,
+        chain_key: tuple | None = None,
         **kw,
     ) -> list[DeferredAssignments]:
         """Dispatch one tensorized batch as ``k_split`` chained
@@ -1990,11 +2226,30 @@ class ExactSolver:
         ``state0`` is sub-solve i's full carried state
         (BatchCarriedUsage) donated straight through — no host sync
         anywhere in the chain. Trailing all-padding sub-batches are
-        never dispatched."""
+        never dispatched.
+
+        ``chain_start`` (the streaming dispatcher's cross-batch chain):
+        the PREVIOUS batch's full carried state — the first sub-solve
+        chains on it exactly like a mid-chain sub-solve would, so the
+        occupancy rows the previous batch's placements advanced never
+        re-upload from host. ``carry_out`` keeps the final carried
+        state resident as the session's stream carry under
+        ``chain_key`` for the next batch to consume."""
         sub = pods.padded // k_split
         cpk = sub // group  # chunks per sub-batch (grouped/compact axes)
         handles: list[DeferredAssignments] = []
-        carry: BatchCarriedUsage | None = None
+        carry: BatchCarriedUsage | None = (
+            BatchCarriedUsage(chain_start)
+            if chain_start is not None
+            else None
+        )
+        if chain_start is not None:
+            self.dispatch_counts["stream_chained"] += 1
+            # the carry is consumed (donated) by the first dispatch —
+            # it can no longer be offered to anyone else
+            self._session.stream_carry = None
+            self._session.stream_key = None
+            self._session.stream_versions = None
         dummy_b = np.zeros((1, 1), dtype=np.int32)
         # node pad = bstate's trailing axis (chained solves are
         # session-mode only; nominated dummies replicate)
@@ -2053,6 +2308,16 @@ class ExactSolver:
             name: carry.state[name]
             for name in ("used", "nonzero_used", "pod_count")
         }
+        if carry_out and chain_key is not None:
+            # keep the FULL carried state resident for the next batch's
+            # chain (its fit entries are the same buffers as persist's;
+            # every donating path nulls this out before reusing them)
+            self._session.stream_carry = carry.state
+            self._session.stream_key = chain_key
+        else:
+            self._session.stream_carry = None
+            self._session.stream_key = None
+            self._session.stream_versions = None
         self.dispatch_counts["chained_subbatches"] += len(handles)
         return handles
 

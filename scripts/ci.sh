@@ -45,6 +45,51 @@ python -m kubernetes_tpu.sim --seed 1 --cycles 8 --profile churn_heavy \
 python -m kubernetes_tpu.sim --seed 1 --cycles 8 \
     --profile preemption_pressure --selfcheck
 
+echo "== streaming dispatcher smoke =="
+# sustained_stream: the high-arrival profile driving run_streaming —
+# the device-resident solve loop with cross-batch occupancy chaining,
+# per-slot fence epochs, and the completion thread; --selfcheck proves
+# the whole loop byte-deterministic (the completion thread only warms
+# transfers). churn_heavy re-driven through --dispatcher streaming
+# covers slot discards + the livelock backstop under delete/label
+# churn, and its trace digest is byte-compared at --mesh-devices 8 vs
+# 1 (the PR 5 device-count-invariance convention, now through the
+# chained stream dispatch). Greps pin the discard machinery within
+# bounds: sustained_stream must never engage the livelock backstop
+# (fallbacks=0 — the backstop is a last resort, not the steady state),
+# and the churn run must actually exercise per-slot discards
+# (stream_discards >= 1) while staying fallback-bounded (single
+# digit). solver_flaky / crash_restart / fleet_mixed re-drive through
+# the streaming dispatcher so degraded mode, restart recovery, and the
+# fleet tier are proven to survive the refactor.
+stream_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 8 \
+    --profile sustained_stream --selfcheck)
+echo "$stream_out"
+echo "$stream_out" | grep -qE "fallbacks=0 " \
+    || { echo "STREAM SMOKE: sustained_stream engaged the livelock backstop"; exit 1; }
+churn_stream=$(python -m kubernetes_tpu.sim --seed 1 --cycles 8 \
+    --profile churn_heavy --dispatcher streaming --selfcheck)
+echo "$churn_stream"
+echo "$churn_stream" | grep -qE "stream_discards=[1-9][0-9]* " \
+    || { echo "STREAM SMOKE: churn never discarded a stream slot (vacuous fences)"; exit 1; }
+echo "$churn_stream" | grep -qE "fallbacks=[0-9] " \
+    || { echo "STREAM SMOKE: churn backstop out of bounds"; exit 1; }
+stream_mesh_digest=$(python -m kubernetes_tpu.sim --seed 0 --cycles 6 \
+    --profile sustained_stream --mesh-devices 8 | grep -o 'trace_digest=[0-9a-f]*')
+stream_one_digest=$(python -m kubernetes_tpu.sim --seed 0 --cycles 6 \
+    --profile sustained_stream | grep -o 'trace_digest=[0-9a-f]*')
+if [ "$stream_mesh_digest" != "$stream_one_digest" ] || [ -z "$stream_mesh_digest" ]; then
+    echo "STREAM MULTICHIP DIVERGENCE: mesh=$stream_mesh_digest vs 1-device=$stream_one_digest"
+    exit 1
+fi
+echo "-- streaming mesh-vs-1-device trace digests identical: $stream_mesh_digest --"
+python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile solver_flaky \
+    --dispatcher streaming --selfcheck
+python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile crash_restart \
+    --dispatcher streaming --selfcheck
+python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile fleet_mixed \
+    --fleet 2 --dispatcher streaming --selfcheck
+
 echo "== chaos smoke: solver fallback ladder + poison quarantine =="
 # solver_flaky: every device-tier solve fails during the fault window
 # (virtual t in [2,5)), then heals. The run's resilience invariant

@@ -1,0 +1,501 @@
+"""The streaming dispatcher (ISSUE 10 tentpole): run_streaming replaces
+run_pipelined's three modes with one persistent device-resident solve
+loop — popped batches chain on the previous batch's device-resident
+occupancy carry, deferred reads drain through a completion thread, and
+fence discards invalidate individual stream slots. These tests pin:
+
+1. streaming ≡ sync binding AND journal equivalence per hard shape
+   (plain/ports/spread/anti/DRA), with cross-batch chaining actually
+   engaging (ExactSolver.dispatch_counts["stream_chained"]) on
+   uniform-shape traffic;
+2. per-slot fence epochs — a conflicting/occupancy event kills exactly
+   the affected stream slot (scheduler_stream_slot_discard_total), a
+   plain slot rides out occupancy events, and the retry schedules
+   against post-event truth;
+3. the tensorize staging micro-opt — the port-occupancy vocab/used
+   staging reuses across consecutive unchanged-cache batches and
+   invalidates on any cache mutation;
+4. the sustained_stream sim profile is byte-deterministic and actually
+   drives the streaming loop.
+"""
+
+import numpy as np
+
+from kubernetes_tpu import metrics
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.obs import ObsConfig
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+
+
+def mk_cluster(n_nodes=6, cpu="8"):
+    cs = ClusterState()
+    for i in range(n_nodes):
+        cs.create_node(
+            MakeNode()
+            .name(f"n{i}")
+            .capacity({"cpu": cpu, "memory": "32Gi", "pods": "110"})
+            .label(ZONE, f"z{i % 3}")
+            .label(HOST, f"n{i}")
+            .obj()
+        )
+    return cs
+
+
+def mk_sched(cs, batch=8, group=4, depth=4, journal=False, **cfg):
+    return Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=batch,
+            stream_depth=depth,
+            solver=ExactSolverConfig(tie_break="first", group_size=group),
+            obs=ObsConfig(journal=True) if journal else None,
+            **cfg,
+        ),
+    )
+
+
+def shape_pod(i: int, kind: str):
+    b = MakePod().name(f"{kind}{i:03}").req({"cpu": "100m", "memory": "256Mi"})
+    if kind == "spread":
+        b = b.label("app", "spread").spread_constraint(
+            1, ZONE, "DoNotSchedule", {"app": "spread"}
+        )
+    elif kind == "anti":
+        b = b.label("app", "anti").pod_anti_affinity(HOST, {"app": "anti"})
+    elif kind == "ports":
+        b = b.host_port(8000 + i % 3)
+    return b.obj()
+
+
+def bindings(cs):
+    return sorted((p.name, p.node_name) for p in cs.list_pods())
+
+
+# -- 1. streaming ≡ sync equivalence, with chaining engaged ------------------
+
+
+def _equivalence(kind, n_pods=24, n_nodes=6, batch=8):
+    cs1 = mk_cluster(n_nodes)
+    s1 = mk_sched(cs1, batch=batch, journal=True)
+    for i in range(n_pods):
+        cs1.create_pod(shape_pod(i, kind))
+    s1.run_until_settled()
+
+    cs2 = mk_cluster(n_nodes)
+    s2 = mk_sched(cs2, batch=batch, journal=True)
+    for i in range(n_pods):
+        cs2.create_pod(shape_pod(i, kind))
+    before = metrics.pipeline_mode_total.labels("stream")._value.get()
+    s2.run_streaming()
+    assert (
+        metrics.pipeline_mode_total.labels("stream")._value.get() > before
+    ), kind
+    assert bindings(cs1) == bindings(cs2), kind
+    # journal equivalence: every pod's terminal outcome + node match
+    o1 = {
+        pod: (rec.get("outcome"), rec.get("node"))
+        for pod, rec in s1.journal.last_outcomes().items()
+    }
+    o2 = {
+        pod: (rec.get("outcome"), rec.get("node"))
+        for pod, rec in s2.journal.last_outcomes().items()
+    }
+    assert o1 == o2, kind
+    return cs2, s2
+
+
+def test_plain_streaming_matches_sync_and_chains():
+    _, s = _equivalence("plain")
+    # uniform plain batches chain across pops (the trivial occupancy
+    # vocabulary fingerprints identically)
+    assert s.solver.dispatch_counts.get("stream_chained", 0) > 0
+
+
+def test_ports_streaming_matches_sync():
+    cs, s = _equivalence("ports")
+    assert s.solver.dispatch_counts.get("stream_chained", 0) > 0
+    per = {}
+    for p in cs.list_pods():
+        if p.node_name:
+            for port in p.host_ports():
+                key = (p.node_name, port)
+                assert key not in per, f"hostPort clash on {key}"
+                per[key] = p.name
+
+
+def test_spread_streaming_matches_sync():
+    cs, s = _equivalence("spread")
+    assert s.solver.dispatch_counts.get("stream_chained", 0) > 0
+    from collections import Counter
+
+    zones = Counter()
+    node_zone = {n.name: n.labels[ZONE] for n in cs.list_nodes()}
+    for p in cs.list_pods():
+        if p.node_name and p.name.startswith("spread"):
+            zones[node_zone[p.node_name]] += 1
+    assert max(zones.values()) - min(zones.values()) <= 1
+
+
+def test_anti_streaming_matches_sync():
+    """Required hostname anti-affinity across chained batches: batch
+    k+1's pods must see batch k's DEVICE-side placements through the
+    carried interpod term counts (host tensorize never saw them)."""
+    cs, s = _equivalence("anti", n_pods=12, n_nodes=12, batch=4)
+    assert s.solver.dispatch_counts.get("stream_chained", 0) > 0
+    anti_nodes = [p.node_name for p in cs.list_pods() if p.node_name]
+    assert len(set(anti_nodes)) == len(anti_nodes) == 12
+
+
+def test_dra_streaming_matches_sync():
+    from kubernetes_tpu.api.dra import (
+        Device,
+        DeviceClass,
+        DeviceRequest,
+        ResourceClaim,
+        ResourceSlice,
+    )
+    from kubernetes_tpu.utils.featuregate import FeatureGates
+
+    def mk():
+        cs = ClusterState()
+        for i in range(3):
+            cs.create_node(
+                MakeNode()
+                .name(f"n{i}")
+                .capacity({"cpu": "8", "memory": "32Gi", "pods": "20"})
+                .obj()
+            )
+            cs.create_resource_slice(
+                ResourceSlice(
+                    name=f"slice-n{i}",
+                    node_name=f"n{i}",
+                    driver="gpu.example.com",
+                    devices=(Device(name="gpu-0"), Device(name="gpu-1")),
+                )
+            )
+        cs.create_device_class(
+            DeviceClass(name="gpu", driver="gpu.example.com")
+        )
+        for i in range(4):
+            cs.create_resource_claim(
+                ResourceClaim(
+                    name=f"c{i}",
+                    namespace="default",
+                    requests=(
+                        DeviceRequest(name="r0", device_class_name="gpu"),
+                    ),
+                )
+            )
+        s = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=2,
+                solver=ExactSolverConfig(tie_break="first", group_size=1),
+                feature_gates=FeatureGates.parse(
+                    "DynamicResourceAllocation=true"
+                ),
+            ),
+        )
+        for i in range(4):
+            cs.create_pod(
+                MakePod()
+                .name(f"p{i}")
+                .req({"cpu": "1"})
+                .resource_claim(f"c{i}")
+                .obj()
+            )
+        return cs, s
+
+    cs1, s1 = mk()
+    s1.run_until_settled()
+    cs2, s2 = mk()
+    s2.run_streaming()
+    assert bindings(cs1) == bindings(cs2)
+    assert all(p.node_name for p in cs2.list_pods())
+
+
+def test_multi_profile_streaming_matches_sync():
+    from kubernetes_tpu.api.objects import DEFAULT_SCHEDULER_NAME
+
+    def mk():
+        cs = mk_cluster(4)
+        s = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=8,
+                profiles={
+                    DEFAULT_SCHEDULER_NAME: ExactSolverConfig(
+                        tie_break="first", group_size=4
+                    ),
+                    "alt": ExactSolverConfig(
+                        tie_break="first", group_size=4
+                    ),
+                },
+            ),
+        )
+        for i in range(6):
+            cs.create_pod(
+                MakePod().name(f"a{i}").req({"cpu": "500m"}).obj()
+            )
+            cs.create_pod(
+                MakePod()
+                .name(f"b{i}")
+                .scheduler_name("alt")
+                .req({"cpu": "500m"})
+                .obj()
+            )
+        return cs, s
+
+    cs1, s1 = mk()
+    s1.run_until_settled()
+    cs2, s2 = mk()
+    s2.run_streaming()
+    assert bindings(cs1) == bindings(cs2)
+
+
+def test_chain_survives_ring_fill():
+    """Cross-batch chaining must stay ALIVE once the stream ring fills:
+    from then on every dispatch interleaves with a ring-slot apply,
+    whose host-side assume dirties snapshot columns — but the device
+    already assumed exactly those placements at solve time, so the
+    carry's own baseline (note_stream_applied) keeps can_chain true. A
+    regression here silently degrades steady-state streaming to
+    carry-mode drain-per-batch (the exact regime the dispatcher exists
+    for) while every shallow drive still passes."""
+    n_pods, batch, depth = 40, 4, 2
+    cs1 = mk_cluster(6)
+    s1 = mk_sched(cs1, batch=batch, journal=True)
+    for i in range(n_pods):
+        cs1.create_pod(shape_pod(i, "spread"))
+    s1.run_until_settled()
+
+    cs2 = mk_cluster(6)
+    s2 = mk_sched(cs2, batch=batch, depth=depth, journal=True)
+    for i in range(n_pods):
+        cs2.create_pod(shape_pod(i, "spread"))
+    s2.run_streaming()
+    # 10 popped batches against a depth-2 ring: batches 4..10 dispatch
+    # with a clean apply in between each — all but the first pop must
+    # chain through them
+    assert s2.solver.dispatch_counts.get("stream_chained", 0) >= 8
+    assert bindings(cs1) == bindings(cs2)
+
+
+# -- 2. per-slot fence epochs ------------------------------------------------
+
+
+def _event_mid_stream(s, fire):
+    """Install a one-shot post-dispatch hook that lands ``fire`` while
+    the FIRST dispatched slot is in flight (the one real window where a
+    concurrent actor's events race a deferred solve)."""
+    state = {"fired": False}
+
+    def hook(_flight):
+        if not state["fired"]:
+            state["fired"] = True
+            fire()
+
+    s._post_dispatch_hook = hook
+    return state
+
+
+def test_occupancy_event_kills_exactly_one_stream_slot():
+    """A spread slot in flight when an assigned-pod label re-key lands
+    must discard — and ONLY that slot: the follow-up batch re-tensorizes
+    against post-event truth and applies cleanly, so the run converges
+    with exactly one slot discard."""
+    cs = mk_cluster()
+    s = mk_sched(cs, batch=4)
+    cs.create_pod(
+        MakePod().name("old").label("app", "spread").req({"cpu": "1"}).obj()
+    )
+    cs.bind("default", "old", "n0")
+    for i in range(8):
+        cs.create_pod(shape_pod(i, "spread"))
+
+    import dataclasses
+
+    def fire():
+        old = cs.get_pod("default", "old")
+        cs.update_pod(dataclasses.replace(old, labels={"app": "other"}))
+
+    _event_mid_stream(s, fire)
+    slot0 = metrics.stream_slot_discard_total._value.get()
+    disc0 = metrics.solves_discarded_total._value.get()
+    s.run_streaming()
+    assert metrics.stream_slot_discard_total._value.get() - slot0 == 1
+    # the slot had one sub-flight: sub-flight discards match slot count
+    assert metrics.solves_discarded_total._value.get() - disc0 >= 1
+    assert all(p.node_name for p in cs.list_pods())
+
+
+def test_plain_slot_survives_occupancy_events():
+    """Selectivity: plain fit slots carry no occupancy vocabulary, so
+    an assigned-pod delete/label flap mid-flight must NOT discard them
+    (the fit carry absorbs frees conservatively) — zero slot discards,
+    everything binds in the first attempt."""
+    cs = mk_cluster(3)
+    s = mk_sched(cs, batch=4)
+    cs.create_pod(
+        MakePod().name("old").label("app", "x").req({"cpu": "1"}).obj()
+    )
+    cs.bind("default", "old", "n0")
+    for i in range(8):
+        cs.create_pod(shape_pod(i, "plain"))
+
+    def fire():
+        cs.delete_pod("default", "old")
+
+    _event_mid_stream(s, fire)
+    slot0 = metrics.stream_slot_discard_total._value.get()
+    results = s.run_streaming()
+    assert metrics.stream_slot_discard_total._value.get() - slot0 == 0
+    assert sum(len(r.scheduled) for r in results) == 8
+
+
+def test_conflict_event_discards_chained_successors_together():
+    """Chained slots share one fence epoch by construction (the chain
+    only extends inside an unchanged fence window): a node-capacity
+    event landing after two chained dispatches kills both slots, and
+    every pod still reaches a terminal outcome on the retry."""
+    cs = mk_cluster(4)
+    s = mk_sched(cs, batch=4, depth=4)
+    for i in range(8):
+        cs.create_pod(shape_pod(i, "plain"))
+
+    fired = {"n": 0}
+
+    def hook(_flight):
+        fired["n"] += 1
+        if fired["n"] == 2:
+            # both slots dispatched, neither applied: shrink a node
+            import dataclasses
+
+            node = cs.get_node("n3")
+            alloc = dict(node.allocatable)
+            alloc["cpu"] = max(alloc.get("cpu", 0) - 1000, 1000)
+            cs.update_node(
+                dataclasses.replace(node, allocatable=alloc)
+            )
+
+    s._post_dispatch_hook = hook
+    slot0 = metrics.stream_slot_discard_total._value.get()
+    s.run_streaming()
+    assert metrics.stream_slot_discard_total._value.get() - slot0 == 2
+    assert all(p.node_name for p in cs.list_pods())
+
+
+# -- 3. tensorize staging reuse ----------------------------------------------
+
+
+def test_port_staging_reuses_across_unchanged_batches():
+    from kubernetes_tpu.tensorize.plugins import (
+        PortStaging,
+        build_port_tensors,
+    )
+    from kubernetes_tpu.tensorize.schema import build_pod_batch
+    from kubernetes_tpu.state.snapshot import Snapshot
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.utils.clock import Clock
+
+    cs = mk_cluster(2)
+    cache = SchedulerCache(Clock())
+    for n in cs.list_nodes():
+        cache.add_node(n)
+    placed = MakePod().name("old").req({"cpu": "1"}).host_port(9000).obj()
+    placed.node_name = "n0"
+    cache.add_pod(placed)
+    snap = Snapshot()
+    batch = snap.update(cache)
+    slot_nodes = [
+        cache.nodes[name].node if name else None for name in snap.names
+    ]
+    placed_by_slot = {
+        slot: list(cache.nodes[name].pods.values())
+        for slot, name in enumerate(snap.names)
+        if name and cache.nodes[name].pods
+    }
+    staging = PortStaging()
+    pods1 = [shape_pod(i, "ports") for i in range(3)]
+    pb1 = build_pod_batch(pods1, batch.vocab)
+    key = (cache.generation, batch.padded)
+    t1 = build_port_tensors(
+        pods1, pb1, slot_nodes, placed_by_slot, batch.padded,
+        staging=staging, staging_key=key,
+    )
+    assert staging.misses == 1 and staging.hits == 0
+    # identical cache, next batch: the placed scan is skipped
+    pods2 = [shape_pod(i + 3, "ports") for i in range(3)]
+    pb2 = build_pod_batch(pods2, batch.vocab)
+    t2 = build_port_tensors(
+        pods2, pb2, slot_nodes, placed_by_slot, batch.padded,
+        staging=staging, staging_key=key,
+    )
+    assert staging.hits == 1
+    # the staged occupancy is identical to a fresh build
+    fresh = build_port_tensors(
+        pods2, pb2, slot_nodes, placed_by_slot, batch.padded
+    )
+    assert t2.vocab[: len(fresh.vocab)] == fresh.vocab or set(
+        fresh.vocab
+    ) <= set(t2.vocab)
+    for entry in fresh.vocab:
+        fi = fresh.vocab.index(entry)
+        ti = t2.vocab.index(entry)
+        np.testing.assert_array_equal(fresh.used[fi], t2.used[ti])
+    # t1's vocab was not retroactively grown by t2's interning
+    assert len(t1.vocab) <= t1.pod_conflict.shape[1]
+    # a cache mutation invalidates
+    cache.add_pod(
+        MakePod().name("new").req({"cpu": "1"}).host_port(9100).obj()
+    )
+    t3 = build_port_tensors(
+        pods2, pb2, slot_nodes, placed_by_slot, batch.padded,
+        staging=staging, staging_key=(cache.generation, batch.padded),
+    )
+    assert staging.misses == 2
+    assert t3 is not None
+
+
+def test_streaming_uses_port_staging():
+    """End to end: consecutive ports batches in one streaming burst hit
+    the staging (the cache is unchanged between tensorizes)."""
+    cs = mk_cluster()
+    s = mk_sched(cs, batch=4)
+    for i in range(12):
+        cs.create_pod(shape_pod(i, "ports"))
+    s.run_streaming()
+    assert s._port_staging.hits > 0
+
+
+# -- 4. sustained_stream profile ---------------------------------------------
+
+
+def test_sustained_stream_profile_deterministic():
+    from kubernetes_tpu.sim import run_sim
+
+    r1 = run_sim("sustained_stream", seed=3, cycles=4)
+    r2 = run_sim("sustained_stream", seed=3, cycles=4)
+    assert r1.summary["streaming"] is True
+    assert not r1.violations, r1.violations
+    assert r1.journal_lines == r2.journal_lines
+    assert r1.trace.lines == r2.trace.lines
+
+
+def test_streaming_dispatcher_override_drives_existing_profiles():
+    """--dispatcher streaming re-drives an existing profile through
+    run_streaming (the CI chaos/crash smokes lean on this)."""
+    from kubernetes_tpu.sim import run_sim
+
+    before = metrics.pipeline_mode_total.labels("stream")._value.get()
+    res = run_sim("preemption_pressure", seed=0, cycles=3, streaming=True)
+    assert res.summary["streaming"] is True
+    assert not res.violations, res.violations
+    assert (
+        metrics.pipeline_mode_total.labels("stream")._value.get() > before
+    )
