@@ -24,13 +24,16 @@ Measures the FULL BASELINE.md target ladder (VERDICT r2 #3):
      nodes) on the full mesh. Emits multichip_pods_per_sec +
      multichip_speedup (hoisted to the top level); skips with a reason
      string when only one device is visible.
-  #8 Fleet A/B: 1 scheduler process vs N active fleet replicas (each
-     its own OS process, shard-scoped by the consistent-hash ring in
-     kubernetes_tpu/fleet) draining the same open-loop arrival stream.
-     Both arms solve on CPU — this ladder measures the HOST tier's
-     horizontal scaling (ladder #7 owns device scaling, and N
-     processes cannot share one TPU). Emits fleet_pods_per_sec +
-     fleet_speedup (hoisted to the top level).
+  #8 Fleet A/B, DEVICE tier: 1 scheduler process (full device set) vs
+     N active fleet replicas (each its own OS process, shard-scoped by
+     the consistent-hash ring, pinned to an EXCLUSIVE 1/N mesh slice
+     of the shared virtual device set, stream-dispatching) draining
+     the same open-loop arrival stream at ladder #6 rates, with ONE
+     occupancy hub served over localhost gRPC (fenced CAS admits +
+     row traffic on the real wire). The backend is XLA CPU on every
+     box (N children cannot share one libtpu) — the multiplier is the
+     fleet tier scaling the whole device-path pipeline. Emits
+     fleet_pods_per_sec + fleet_speedup (hoisted to the top level).
   #9 Degraded-mode A/B (kubernetes_tpu/resilience): the same sustained
      open-loop workload at the top fallback-ladder tier vs pinned to
      the pure-host serial-greedy rung (force_tier="host") — the floor
@@ -465,6 +468,8 @@ def _fleet_replica_worker(
     start_at: float,
     out_q,
     kind: str = "plain",
+    hub_addr: str = "",
+    total_devices: int = 8,
 ) -> None:
     """One fleet replica as its own OS process (spawn target): builds
     its replica of the state service (every replica of a real fleet
@@ -474,16 +479,31 @@ def _fleet_replica_worker(
     completion timeline on ``out_q``. Pod arrivals follow one shared
     wall-clock schedule anchored at ``start_at`` (epoch time), so the
     fleet's replicas face the same open-loop arrival process
-    concurrently."""
+    concurrently.
+
+    DEVICE-TIER arms (ISSUE 11): every replica owns an EXCLUSIVE mesh
+    slice of one shared virtual device set (mesh_slice = (rank, N)
+    over ``total_devices`` forced host-platform devices) and drives
+    the STREAMING dispatcher (PR 10) against it — the solve is the
+    sharded resident-session device path end to end, N processes never
+    sharing a device. The backend is XLA CPU on every box (N spawned
+    children still cannot share one libtpu), so the measured multiplier
+    is the fleet tier scaling the whole device-path pipeline — shard-
+    scoped caches, per-slice sharded sessions, per-replica stream
+    rings — under a fair hardware split (disjoint core slices). Multi-
+    replica arms share ONE occupancy hub over a localhost gRPC server
+    (``hub_addr`` -> RemoteOccupancyExchange): fenced CAS admits pay a
+    synchronous round trip, plain row traffic rides the write-behind
+    apply_ops batches — the wire discipline production would use."""
     import os
 
-    # BOTH arms solve on CPU: ladder #8 measures the fleet tier's
-    # horizontal HOST scaling (N scheduler processes sharding the
-    # cluster); device-tier scaling is ladder #7's story, and N
-    # spawned children cannot share one TPU device anyway (libtpu is
-    # single-process) — forcing cpu keeps the A/B apples-to-apples on
-    # every box
     os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={total_devices}"
+        ).strip()
     if len(universe) > 1:
         # disjoint core slices per replica: two XLA CPU runtimes
         # otherwise both size their intra-op pools to the whole box
@@ -501,18 +521,24 @@ def _fleet_replica_worker(
             pass  # non-Linux: let the OS schedule
     import jax
 
+    jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
     from kubernetes_tpu.fleet import FleetConfig
     from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
     from kubernetes_tpu.solver.exact import ExactSolverConfig
     from kubernetes_tpu.state.cluster import ClusterState
 
+    rank = universe.index(rid)
+    mesh_slice = (rank, len(universe))
+
     def build():
         cs = ClusterState()
         for i in range(n_nodes):
             cs.create_node(_mk_node(i, zones=8))
         fleet = (
-            FleetConfig(replica=rid, replicas=universe)
+            FleetConfig(
+                replica=rid, replicas=universe, hub_address=hub_addr
+            )
             if len(universe) > 1
             else None
         )
@@ -520,6 +546,7 @@ def _fleet_replica_worker(
             cs,
             SchedulerConfig(
                 batch_size=batch,
+                mesh_slice=mesh_slice,
                 solver=ExactSolverConfig(
                     tie_break="random", group_size=group
                 ),
@@ -528,11 +555,15 @@ def _fleet_replica_worker(
         )
         return cs, sched
 
-    # warmup compile on a throwaway cluster (shard-sized shapes)
+    # warmup compile on a throwaway cluster. The shard filter routes
+    # only ~1/N of created pods to this replica, so seed batch*N pods:
+    # each replica must warm the FULL batch-size pod bucket it will
+    # solve in the measured window (a half-shard warmup leaves the
+    # measured run paying a fresh XLA compile per replica)
     cs, sched = build()
-    for i in range(min(n_pods, batch)):
+    for i in range(min(n_pods, batch * max(len(universe), 1) * 2)):
         cs.create_pod(_mk_pod(i, kind))
-    sched.run_pipelined()
+    sched.run_streaming()
 
     cs, sched = build()
     # prebuild the arrival stream: the pod OBJECTS are the synthetic
@@ -552,7 +583,7 @@ def _fleet_replica_worker(
             cs.create_pod(pods[created])
             created += 1
         progressed = False
-        for r in sched.run_pipelined(max_batches=2):
+        for r in sched.run_streaming(max_batches=2):
             n = len(r.scheduled)
             if n:
                 completions.append((time.time(), n))
@@ -579,25 +610,35 @@ def _fleet_sustained(
     batch: int = 2_048,
     group: int = 256,
     kind: str = "plain",
+    total_devices: int = 8,
 ) -> dict:
     """One open-loop sustained run driven by ``n_replicas`` active
     fleet replicas, each its OWN OS process (1 = the classic
-    sole-owner scheduler, the A arm). This is the deployment shape the
-    fleet tier exists for: N scheduler processes, each shard-scoped by
-    the ring, draining the same arrival stream concurrently — the
-    speedup is horizontal process scale-out (independent hosts/GILs)
-    on sub-problems 1/N the size."""
+    sole-owner scheduler, the A arm — one process, the WHOLE device
+    set). This is the deployment shape the fleet tier exists for: N
+    scheduler processes, each shard-scoped by the ring and pinned to
+    an exclusive 1/N mesh slice of the same device set, all
+    stream-dispatching concurrently against ONE occupancy hub served
+    over localhost gRPC — the speedup is the fleet tier multiplying
+    the device-path streaming dispatcher, wire costs included."""
     import multiprocessing
 
-    if n_replicas > 1 and kind in ("spread", "anti"):
-        # each worker process gets a PRIVATE exchange hub (no
-        # cross-process hub adapter yet — fleet/occupancy.py), so
-        # cross-shard spread/anti reconciliation would pass vacuously
-        # and handoffs would vanish: refuse rather than mis-measure
-        raise ValueError(
-            "ladder #8 multi-replica arms support reconcile-free "
-            f"shapes only (plain/ports), not {kind!r}"
-        )
+    server = None
+    hub_addr = ""
+    if n_replicas > 1:
+        # one REAL occupancy hub for the whole fleet, served behind
+        # the bulk gRPC boundary: stage/commit rows and fenced CAS
+        # admits all cross a real socket (RemoteOccupancyExchange),
+        # so reconcile-bearing shapes (spread/anti) measure honestly
+        # too — the PR 6 private-hub refusal is gone
+        from kubernetes_tpu.fleet import OccupancyExchange
+        from kubernetes_tpu.server.bulk import BulkCore, make_grpc_server
+        from kubernetes_tpu.state.cluster import ClusterState
+
+        core = BulkCore(ClusterState(), exchange=OccupancyExchange())
+        server, hub_port = make_grpc_server(core, port=0)
+        server.start()
+        hub_addr = f"127.0.0.1:{hub_port}"
     ctx = multiprocessing.get_context("spawn")
     universe = tuple(f"r{i}" for i in range(n_replicas))
     out_q = ctx.Queue()
@@ -609,16 +650,20 @@ def _fleet_sustained(
             target=_fleet_replica_worker,
             args=(
                 rid, universe, n_nodes, n_pods, rate, batch, group,
-                start_at, out_q, kind,
+                start_at, out_q, kind, hub_addr, total_devices,
             ),
         )
         for rid in universe
     ]
     for p in procs:
         p.start()
-    results = [out_q.get(timeout=600.0) for _ in procs]
-    for p in procs:
-        p.join(timeout=30.0)
+    try:
+        results = [out_q.get(timeout=600.0) for _ in procs]
+    finally:
+        for p in procs:
+            p.join(timeout=30.0)
+        if server is not None:
+            server.stop(grace=None)
     merged = sorted(x for r in results for x in r["completions"])
     scheduled = sum(n for _, n in merged)
     # steady-state: one formula for both arms — drop the first
@@ -640,6 +685,9 @@ def _fleet_sustained(
     return {
         "replicas": n_replicas,
         "kind": kind,
+        "tier": "device",
+        "mesh_slice_devices": total_devices // max(n_replicas, 1),
+        "hub": "grpc" if n_replicas > 1 else "none",
         "pods": n_pods,
         "nodes": n_nodes,
         "arrival_rate_pods_per_sec": rate,
@@ -656,14 +704,20 @@ def _fleet_sustained(
 def ladder8_fleet(n_replicas: int = 4) -> dict:
     """#8: fleet A/B — 1-replica vs N-replica sustained throughput at
     the same arrival rate on the same cluster, every replica its own
-    OS process shard-scoped by the ring (fleet/). This is the
-    horizontal pods/s story: each replica ingests the shared arrival
-    stream but pops, solves, and commits only its partition, so the
-    per-pod host work — the sustained path's real bottleneck — scales
-    with process count while each solve also shrinks to a shard. The
-    acceptance bar (ISSUE 6) is fleet_pods_per_sec >= 1.5x the
-    1-replica row at the same arrival rate."""
-    shape = dict(n_nodes=1_024, n_pods=16_000, rate=60_000.0)
+    OS process. DEVICE-TIER arms (ISSUE 11): the A arm is one process
+    streaming against the whole (virtual) device set; the B arm is N
+    processes, each ring-shard-scoped, pinned to an EXCLUSIVE 1/N
+    mesh slice, stream-dispatching (PR 10) and sharing one occupancy
+    hub over localhost gRPC — fenced CAS admits, stage/commit rows,
+    and handoff polls all pay the real wire. Arrival rate = ladder
+    #6's plain sustained rate, so the two ladders' numbers compose:
+    the fleet multiplier applies to the same arrival regime the
+    streaming dispatcher is gated on. The acceptance bar (ISSUE 11)
+    is fleet_pods_per_sec >= 1.5x the 1-replica device arm."""
+    # ladder #6 plain-shape arrival rate (ladder_sustained's shapes
+    # table); nodes sized so each replica's shard still outweighs its
+    # batch
+    shape = dict(n_nodes=1_024, n_pods=16_000, rate=20_000.0)
     single = _fleet_sustained(1, **shape)
     fleet = _fleet_sustained(n_replicas, **shape)
     speedup = round(
@@ -673,10 +727,11 @@ def ladder8_fleet(n_replicas: int = 4) -> dict:
     )
     return {
         "config": (
-            f"open-loop sustained arrival, 1 vs {n_replicas} active "
-            "replicas sharding one cluster (round-robin on one "
-            "thread: the speedup is sub-problem granularity, not "
-            "parallel hardware)"
+            f"open-loop sustained arrival at ladder #6 rates, 1 "
+            f"process x full device set vs {n_replicas} processes x "
+            "exclusive 1/N mesh slices, every replica streaming "
+            "(run_streaming) against its shard with ONE gRPC "
+            "occupancy hub on localhost"
         ),
         "single": single,
         "fleet": fleet,

@@ -74,6 +74,17 @@ def cmd_config(args) -> int:
             "minGainPoints": cfg.rebalance.min_gain_points,
             "nominate": cfg.rebalance.nominate,
         },
+        "fleet": {
+            "replica": cfg.fleet.replica,
+            "replicas": cfg.fleet.replicas,
+            "hubAddress": cfg.fleet.hub_address,
+            "meshSlice": (
+                f"{cfg.fleet.mesh_slice[0]}/{cfg.fleet.mesh_slice[1]}"
+                if cfg.fleet.mesh_slice is not None
+                else None
+            ),
+            "maxRowAgeSeconds": cfg.fleet.max_row_age_seconds,
+        },
         "warnings": cfg.warnings,
     }
     print(json.dumps(out, indent=2))

@@ -20,6 +20,11 @@ Covered surface:
 - rebalance (ours): enabled, intervalSeconds, maxMovesPerCycle,
   minPackingUtilization, minGainPoints, nominate — the continuous
   defragmentation loop (kubernetes_tpu/rebalance)
+- fleet (ours): replica, replicas, hubAddress (a bulk gRPC server whose
+  HubOp method serves the shared occupancy hub), meshSlice ("rank/count"
+  — this replica's EXCLUSIVE contiguous slice of the visible device
+  set), maxRowAgeSeconds — the active-active scale-out tier
+  (kubernetes_tpu/fleet)
 
 Unknown plugin names and unsupported pluginConfig args are collected into
 `warnings` rather than rejected — the validation posture of a scheduler that
@@ -129,6 +134,28 @@ class RebalanceSection:
 
 
 @dataclass
+class FleetSection:
+    """``fleet:`` — the active-active fleet tier (kubernetes_tpu/fleet).
+    Ours, like tpuSolver: the reference's only HA is active/passive
+    leader election."""
+
+    # this replica's identity; empty = fleet mode off
+    replica: str = ""
+    # the configured universe (the replica itself is always included)
+    replicas: list[str] = field(default_factory=list)
+    # "host:port" of a bulk gRPC server serving the shared occupancy
+    # hub over its HubOp method (fleet/runtime.RemoteOccupancyExchange);
+    # empty = an in-process private hub (single-replica degenerate)
+    hub_address: str = ""
+    # "rank/count": this replica's EXCLUSIVE mesh slice — contiguous
+    # first-N partition of the visible device set, so N replicas on one
+    # host solve against disjoint devices. None = no slice.
+    mesh_slice: "tuple[int, int] | None" = None
+    # occupancy-staleness bound (FleetConfig.max_row_age_s)
+    max_row_age_seconds: float = 30.0
+
+
+@dataclass
 class TpuSolverSection:
     batch_size: int = 1024
     tie_break: str = "random"  # random | first
@@ -154,6 +181,7 @@ class KubeSchedulerConfiguration:
     extenders: list[Extender] = field(default_factory=list)
     tpu_solver: TpuSolverSection = field(default_factory=TpuSolverSection)
     rebalance: RebalanceSection = field(default_factory=RebalanceSection)
+    fleet: FleetSection = field(default_factory=FleetSection)
     warnings: list[str] = field(default_factory=list)
 
     def profile_for(self, scheduler_name: str) -> Profile | None:
@@ -345,7 +373,61 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
             "rebalance.minGainPoints must be >= 1 "
             f"(got {cfg.rebalance.min_gain_points})"
         )
+
+    fl = data.get("fleet") or {}
+    cfg.fleet = FleetSection(
+        replica=str(_nn(fl.get("replica"), "")),
+        replicas=[str(r) for r in _nn(fl.get("replicas"), []) or []],
+        hub_address=str(_nn(fl.get("hubAddress"), "")),
+        mesh_slice=_parse_mesh_slice(fl.get("meshSlice")),
+        max_row_age_seconds=float(_nn(fl.get("maxRowAgeSeconds"), 30.0)),
+    )
+    if cfg.fleet.hub_address and ":" not in cfg.fleet.hub_address:
+        raise ValueError(
+            'fleet.hubAddress must be "host:port" '
+            f"(got {cfg.fleet.hub_address!r})"
+        )
+    if cfg.fleet.max_row_age_seconds <= 0:
+        raise ValueError(
+            "fleet.maxRowAgeSeconds must be > 0 "
+            f"(got {cfg.fleet.max_row_age_seconds})"
+        )
+    if (
+        cfg.fleet.replicas
+        or cfg.fleet.hub_address
+        or cfg.fleet.mesh_slice is not None
+    ) and not cfg.fleet.replica:
+        # meshSlice especially: honoring a slice with fleet mode off
+        # would silently pin the sole scheduler to a fraction of the
+        # devices — exactly the quiet capacity loss this section's
+        # hard validation exists to prevent
+        raise ValueError(
+            "fleet.replica is required when any other fleet key is set "
+            "(a replica must know its own identity)"
+        )
     return cfg
+
+
+def _parse_mesh_slice(value) -> "tuple[int, int] | None":
+    """fleet.meshSlice "rank/count" -> (rank, count). Null/empty = no
+    slice; anything malformed is a hard error (a typo silently sharing
+    devices between replicas is the failure mode this key exists to
+    prevent)."""
+    if value is None or value == "":
+        return None
+    try:
+        rank_s, count_s = str(value).split("/", 1)
+        rank, count = int(rank_s), int(count_s)
+    except ValueError:
+        raise ValueError(
+            'fleet.meshSlice must be "rank/count" (e.g. "0/4"); '
+            f"got {value!r}"
+        ) from None
+    if count < 1 or not 0 <= rank < count:
+        raise ValueError(
+            f"fleet.meshSlice needs 0 <= rank < count; got {value!r}"
+        )
+    return (rank, count)
 
 
 def load_file(path: str) -> KubeSchedulerConfiguration:
@@ -469,14 +551,29 @@ def scheduler_config(cfg: KubeSchedulerConfiguration):
             min_gain=cfg.rebalance.min_gain_points,
             nominate=cfg.rebalance.nominate,
         )
+    fleet = None
+    if cfg.fleet.replica:
+        from ..fleet.runtime import FleetConfig
+
+        # hub_address (not an exchange object) so nothing network-
+        # shaped is constructed at config-build time: FleetRuntime
+        # builds the RemoteOccupancyExchange when the Scheduler starts
+        fleet = FleetConfig(
+            replica=cfg.fleet.replica,
+            replicas=tuple(cfg.fleet.replicas),
+            hub_address=cfg.fleet.hub_address,
+            max_row_age_s=cfg.fleet.max_row_age_seconds,
+        )
     return SchedulerConfig(
         batch_size=cfg.tpu_solver.batch_size,
         enable_preemption=cfg.tpu_solver.enable_preemption,
         mesh_devices=cfg.tpu_solver.mesh_devices,
+        mesh_slice=cfg.fleet.mesh_slice,
         solver=profiles[cfg.profiles[0].scheduler_name],
         profiles=profiles,
         # honored, not just parsed: the scheduler consults these via the
         # outbound HTTP client during every solve
         extenders=tuple(cfg.extenders),
         rebalance=rebalance,
+        fleet=fleet,
     )

@@ -16,14 +16,19 @@ conflicts through the scheduler's existing requeue machinery.
 
 Wiring: set ``SchedulerConfig.fleet = FleetConfig(replica=...,
 replicas=(...))``; replicas sharing a process (sim, tests, bench)
-share one ``OccupancyExchange``; cross-process replicas exchange rows
-over the bulk gRPC service's ``ExchangeOccupancy`` method.
+share one ``OccupancyExchange``; cross-process replicas share the same
+hub over the bulk gRPC service's ``HubOp`` method
+(``RemoteOccupancyExchange``, config key ``fleet.hubAddress``) with
+admission kept atomic hub-side by the fenced compare-and-stage, and
+each replica owns an exclusive device slice via ``fleet.meshSlice``.
 """
 
 from .membership import FleetMembership, shard_index
 from .occupancy import (
+    AdmitConflict,
     COMMITTED,
     PENDING,
+    ExchangeUnreachable,
     NodeRow,
     OccupancyExchange,
     PeerView,
@@ -33,12 +38,15 @@ from .occupancy import (
 )
 from .reconciler import CrossShardReconciler
 from .ring import HashRing, RingNode, ring_nodes_from
-from .runtime import FleetConfig, FleetRuntime
+from .runtime import FleetConfig, FleetRuntime, RemoteOccupancyExchange
 
 __all__ = [
+    "AdmitConflict",
     "COMMITTED",
     "PENDING",
     "CrossShardReconciler",
+    "ExchangeUnreachable",
+    "RemoteOccupancyExchange",
     "FleetConfig",
     "FleetMembership",
     "FleetRuntime",

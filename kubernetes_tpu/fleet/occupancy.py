@@ -25,16 +25,36 @@ they ride the same tensorcodec wire framing over the bulk gRPC
 boundary (server/bulk.py ``ExchangeOccupancy``).
 
 Concurrency contract: the hub serializes every mutation under one lock
-and bumps a monotonically increasing ``version``. Admission soundness
-for IN-PROCESS fleets (the sim, tests, the bench, thread-per-replica
-serving) comes from the shared ClusterState lock: every replica's
-``admit`` + ``stage`` run inside it, so two replicas can never both
-admit against the same stale view. Cross-process replicas get the row
-TRANSPORT here (the ``ExchangeOccupancy`` RPC below) but not yet an
-atomic admit — a hub-side compare-and-stage keyed on ``version`` is
-the designed extension point; until it lands, multi-process fleets
-should partition constraint cohorts by zone (the ring's zone affinity
-makes cross-shard spread domains rare by construction).
+and bumps a monotonically increasing ``version``, and admission is
+atomic AT THE HUB for every fleet shape — in-process or cross-process.
+``compare_and_stage`` is a fenced compare-and-swap on pending rows:
+the replica re-checks its cross-shard constraints host-side against a
+peer view taken at version V, then lands the pending row only if the
+hub is STILL at V (any interleaved stage/commit/withdraw by a peer
+moved it). Two replicas racing a hard-spread placement therefore can
+never both land it: the hub serializes the two CAS calls, the first
+wins, the second gets a typed ``AdmitConflict`` and re-admits against
+the fresh rows (which now include the winner's pending row). The CAS
+is *fenced* with the PR 8 token discipline: ``retire`` (a membership
+transition declaring the replica dead) revokes its hub write
+privilege, so a zombie's CAS — or any other row mutation — rejects
+with ``AdmitConflict(fenced=True)`` until the replica re-registers by
+wholesale republish (``publish_nodes`` / ``replace_pod_rows``, the
+resync path every heal already takes). Cross-process replicas reach
+all of this over the bulk service's ``HubOp`` RPC via
+``fleet.runtime.RemoteOccupancyExchange``; version conflicts map to
+gRPC ABORTED and fenced conflicts to FAILED_PRECONDITION — semantic
+rejections the BulkClient never retries (unlike UNAVAILABLE).
+
+Granularity scope note: the CAS compares against the ONE hub-wide
+version, so any interleaved write — even a row that cannot touch the
+admitted pod's spread domain — costs the admit a re-fetch/re-check
+round (bounded by FleetRuntime._CAS_ATTEMPTS, then an ordinary
+requeue; ``scheduler_fleet_admit_cas_conflict_total`` is the
+observability). Safe by construction, and the write-behind batching in
+RemoteOccupancyExchange collapses most benign churn into one bump per
+flush; per-domain versioning is the refinement if constrained-cohort
+contention ever shows up in that counter (ROADMAP fleet depth note).
 """
 
 from __future__ import annotations
@@ -57,6 +77,34 @@ class ExchangeUnreachable(Exception):
     replica is partitioned; FleetRuntime degrades to its cached peer
     view, whose growing age drives admission conservative
     (fleet/runtime.py occupancy-staleness bounds)."""
+
+
+class AdmitConflict(Exception):
+    """Typed hub-side rejection of a row mutation — the cross-process
+    analog of the state service's fenced ``ApiError`` (a flag, not a
+    message-prefix contract).
+
+    ``fenced=False``: a ``compare_and_stage`` lost its compare — the
+    hub version moved past ``expected_version`` between the caller's
+    peer-view fetch and its CAS (a peer landed a row first). The caller
+    re-fetches and re-admits; ``version`` carries the hub version at
+    rejection time. ``fenced=True``: the caller's hub write privilege
+    was revoked by ``retire`` (its membership was declared dead) — no
+    mutation lands until it re-registers wholesale via resync.
+
+    This is a SEMANTIC rejection, never a transport failure: over the
+    wire it maps to gRPC ABORTED / FAILED_PRECONDITION, which the
+    BulkClient deliberately does not retry (a blind retry of a lost
+    race would re-land the very write the CAS exists to reject —
+    the committing-Solve never-retries rule)."""
+
+    def __init__(
+        self, message: str, *, fenced: bool = False,
+        version: int | None = None,
+    ) -> None:
+        self.fenced = fenced
+        self.version = version
+        super().__init__(message)
 
 
 @dataclass(frozen=True)
@@ -130,6 +178,15 @@ class OccupancyExchange:
         # and its published_at freezes, which is what peers' staleness
         # bounds key off.
         self._partitioned: set[str] = set()
+        # replicas whose hub write privilege is revoked (retire()): the
+        # PR 8 fencing-token discipline extended to the hub — a peer
+        # observed this replica's lease stale and retired it, so its
+        # row mutations must not land until it re-registers by
+        # wholesale republish (publish_nodes / replace_pod_rows — the
+        # path every heal's forced resync already takes). Reads stay
+        # open: a zombie reading rows is harmless, a zombie WRITING
+        # rows would distort every peer's admission.
+        self._revoked: set[str] = set()
         # metric children resolved once: stage/commit run per placed
         # pod on the scheduler's apply path, and the label lookup is
         # measurable there (ops mirror the metric help string)
@@ -177,6 +234,17 @@ class OccupancyExchange:
                 f"replica {replica} is partitioned from the occupancy hub"
             )
 
+    def _check_write_fence(self, replica: str) -> None:
+        # callers hold self._lock
+        if replica in self._revoked:
+            raise AdmitConflict(
+                f"replica {replica} was retired at the hub (membership "
+                "declared it dead): row mutations are fenced until it "
+                "re-registers by wholesale republish",
+                fenced=True,
+                version=self._version,
+            )
+
     def _touch(self, replica: str) -> None:
         """Refresh ``replica``'s liveness stamp. Rows are maintained
         incrementally (every change stages/commits/withdraws
@@ -202,9 +270,13 @@ class OccupancyExchange:
     def publish_nodes(self, replica: str, rows: Iterable[NodeRow]) -> None:
         """Replace ``replica``'s domain inventory (called at startup
         and on every resync — the owned set is replaced wholesale, not
-        diffed, so a missed event can never leave a stale row)."""
+        diffed, so a missed event can never leave a stale row). A
+        wholesale republish is the replica re-asserting itself from
+        cluster truth, so it also clears a hub write fence (the healed
+        zombie's forced resync routes here)."""
         with self._lock:
             self._check_reachable(replica)
+            self._revoked.discard(replica)
             self._version += 1
             self._node_rows[replica] = {r.node: r for r in rows}
             self._touch(replica)
@@ -212,18 +284,50 @@ class OccupancyExchange:
     def stage(self, replica: str, row: PodRow) -> None:
         with self._lock:
             self._check_reachable(replica)
+            self._check_write_fence(replica)
             self._version += 1
             self._pod_rows.setdefault(replica, {})[row.pod] = row
             self._touch(replica)
         self._m["staged"].inc()
 
+    def compare_and_stage(
+        self, replica: str, row: PodRow, expected_version: int
+    ) -> int:
+        """Cross-process atomic admit: land ``row`` as pending ONLY if
+        the hub is still at ``expected_version`` — the version the
+        caller's host-side constraint recheck ran against. Any
+        interleaved mutation (a peer's stage/commit/withdraw, a
+        handoff, a membership retire) moved the version, so the
+        caller's view may hide a racing placement: reject with a typed
+        ``AdmitConflict`` and let the caller re-fetch + re-admit.
+        Returns the new hub version on success. Fenced (retired)
+        replicas reject regardless of version."""
+        with self._lock:
+            self._check_reachable(replica)
+            self._check_write_fence(replica)
+            if self._version != expected_version:
+                raise AdmitConflict(
+                    f"hub version moved to {self._version} past the "
+                    f"admitted view at {expected_version}: a peer's row "
+                    "landed first — re-fetch and re-admit",
+                    version=self._version,
+                )
+            self._version += 1
+            self._pod_rows.setdefault(replica, {})[row.pod] = row
+            self._touch(replica)
+            version = self._version
+        self._m["staged"].inc()
+        return version
+
     def replace_pod_rows(self, replica: str, rows: Iterable[PodRow]) -> None:
         """Replace ``replica``'s pod rows wholesale (resync): rows are
         rebuilt from cluster truth whenever the partition moves, so a
         pod whose DELETE the shard filter later hides from this
-        replica can never leave a ghost row behind."""
+        replica can never leave a ghost row behind. Clears a hub write
+        fence like publish_nodes (same re-registration argument)."""
         with self._lock:
             self._check_reachable(replica)
+            self._revoked.discard(replica)
             self._version += 1
             self._pod_rows[replica] = {r.pod: r for r in rows}
             self._touch(replica)
@@ -231,6 +335,7 @@ class OccupancyExchange:
     def commit(self, replica: str, pod_key: str) -> None:
         with self._lock:
             self._check_reachable(replica)
+            self._check_write_fence(replica)
             row = self._pod_rows.get(replica, {}).get(pod_key)
             if row is None or row.state == COMMITTED:
                 return
@@ -242,6 +347,11 @@ class OccupancyExchange:
     def withdraw(self, replica: str, pod_key: str) -> None:
         with self._lock:
             self._check_reachable(replica)
+            # fenced like every other mutation: today a retired
+            # replica's rows are already dropped (nil data effect),
+            # but an asymmetric escape hatch is one refactor away from
+            # a zombie deleting a live row (review-caught)
+            self._check_write_fence(replica)
             if self._pod_rows.get(replica, {}).pop(pod_key, None) is None:
                 return
             self._version += 1
@@ -253,8 +363,14 @@ class OccupancyExchange:
         visible to the adopting replica through its own resync re-list,
         so keeping them here would double-count. Unclaimed handoffs
         addressed to it revert to plain hash routing — the new route
-        owner adopts the pod at its membership-change resync."""
+        owner adopts the pod at its membership-change resync. Also
+        REVOKES the replica's hub write privilege (the fencing-token
+        discipline): if it is actually a zombie, its next row mutation
+        (stage / CAS / commit / withdraw / handoff / degraded-flag)
+        rejects with a typed fenced AdmitConflict until its healed
+        incarnation re-registers wholesale."""
         with self._lock:
+            self._revoked.add(replica)
             had = (
                 bool(self._node_rows.pop(replica, None))
                 | bool(self._pod_rows.pop(replica, None))
@@ -276,6 +392,7 @@ class OccupancyExchange:
         conflict-parked pods re-evaluate their handoff chains."""
         with self._lock:
             self._check_reachable(replica)
+            self._check_write_fence(replica)
             if degraded == (replica in self._degraded):
                 return
             if degraded:
@@ -298,6 +415,7 @@ class OccupancyExchange:
         with self._lock:
             if from_replica is not None:
                 self._check_reachable(from_replica)
+                self._check_write_fence(from_replica)
                 self._touch(from_replica)
             self._version += 1
             self._handoffs.setdefault(to_replica, {})[pod_key] = hops
@@ -364,6 +482,24 @@ class OccupancyExchange:
 
 
 # -- wire framing (server/tensorcodec.py, the BatchCarriedUsage wire) --
+
+
+def pod_row_to_list(r: PodRow) -> list:
+    """JSON-meta shape of one pod row for the HubOp RPC (state rides
+    inline — single-row ops don't need the columnar committed array
+    the bulk ExchangeOccupancy payload uses)."""
+    return [
+        r.pod, r.node, r.zone, r.namespace,
+        [list(kv) for kv in r.labels], r.state,
+    ]
+
+
+def pod_row_from_list(v) -> PodRow:
+    pod, node, zone, ns, labels, state = v
+    return PodRow(
+        pod=pod, node=node, zone=zone, namespace=ns,
+        labels=tuple((k, val) for k, val in labels), state=state,
+    )
 
 
 def encode_rows(

@@ -37,6 +37,7 @@ from ..api.objects import Pod
 from ..state.cluster import ClusterState, Event
 from .membership import FleetMembership, shard_index
 from .occupancy import (
+    AdmitConflict,
     COMMITTED,
     ExchangeUnreachable,
     NodeRow,
@@ -61,10 +62,16 @@ class FleetConfig:
     # (<lease>-shard-<i>, i = rank of the replica in the sorted universe)
     lease: str = "kubernetes-tpu-scheduler"
     # the occupancy exchange hub. In-process fleets (the sim, tests, the
-    # bench A/B) share one OccupancyExchange; cross-process replicas use
-    # a client for the bulk service's ExchangeOccupancy RPC. None =
-    # private hub (single-replica fleet degenerates gracefully).
+    # bench A/B) share one OccupancyExchange; cross-process replicas
+    # reach a shared hub over the bulk gRPC boundary — pass a
+    # RemoteOccupancyExchange here, or just set hub_address below and
+    # let FleetRuntime construct one. None + no hub_address = private
+    # hub (single-replica fleet degenerates gracefully).
     exchange: object = None
+    # "host:port" of a bulk gRPC server whose HubOp method serves the
+    # shared hub (config key fleet.hubAddress). Ignored when an
+    # exchange object is passed explicitly.
+    hub_address: str = ""
     # production liveness: poll peers' per-shard leases every
     # lease_poll_s seconds and flip membership when one goes stale
     # (utils/leaderelection.py shard= + membership.refresh_from_leases).
@@ -93,6 +100,270 @@ class FleetConfig:
         self.replicas = tuple(sorted(set(self.replicas) | {self.replica}))
 
 
+class RemoteOccupancyExchange:
+    """Client half of the cross-process occupancy hub: the full
+    OccupancyExchange surface, each operation one ``HubOp`` RPC on the
+    bulk gRPC boundary (server/bulk.py — the same tensorcodec-framed
+    wire the 17–37k pods/s bulk solve path uses).
+
+    Semantics mirror the in-process hub exactly — that is the contract
+    FleetRuntime leans on:
+
+    - a hub-side ``ExchangeUnreachable`` (the partition seam) arrives
+      as gRPC UNAVAILABLE and is re-raised as ``ExchangeUnreachable``,
+      so the PR 8 machinery (dirty flag, cached-view aging, the
+      occupancy-staleness bound turning admission conservative) runs
+      unchanged over the real wire; any other transport failure
+      (server down, deadline, broken connection) degrades the same way;
+    - typed ``AdmitConflict`` rejections arrive as ABORTED (version
+      race) / FAILED_PRECONDITION (hub write fence) and are re-raised
+      typed. The underlying BulkClient never retries them — a CAS
+      conflict is a semantic answer, not a flake.
+
+    The client is built with ``retries=0``: hub ops have their OWN
+    retry story at the fleet layer (requeue, resync republish, the
+    staleness bound), and transparent transport retries underneath it
+    would stretch the partition-detection latency the staleness bound
+    is calibrated against.
+
+    WRITE-BEHIND ROW TRAFFIC: plain ``stage`` / ``commit`` /
+    ``withdraw`` calls buffer client-side and flush as ONE
+    ``apply_ops`` RPC — before every read (so any view this replica
+    admits against reflects its own prior writes), at the buffer cap,
+    and at every resync poll. Per-row unary RPCs would otherwise put
+    a wire round trip inside the per-pod apply loop (measured ~4x
+    throughput loss on the ladder #8 fleet arm). This is sound
+    because the admission-critical row landings don't ride the
+    buffer: a cross-shard-CONSTRAINED placement lands synchronously
+    via ``compare_and_stage`` (the atomic admit), commit is a
+    state-only transition the reconciler ignores (pending and
+    committed rows count alike), and a lagging withdraw only makes
+    peers OVER-count — conservative. The one scope note: an
+    UNconstrained label-bearing pod's stage row (a potential selector
+    target for someone else's constraint) may lag peers' views by up
+    to one flush window (bounded by the buffer cap and the per-cycle
+    resync poll), the cross-process analog of the PR 6 scope notes.
+    A buffer that cannot flush (hub unreachable) is retained and
+    retried; the wholesale resync republish supersedes it either way.
+    """
+
+    _BUFFER_CAP = 256
+
+    def __init__(
+        self, target: str, replica: str = "", *, client=None, clock=None
+    ) -> None:
+        from ..server.bulk import BulkClient
+
+        self._client = (
+            client
+            if client is not None
+            else BulkClient(target, retries=0, clock=clock)
+        )
+        self._replica = replica
+        # buffered [kind, arg] mutations awaiting one apply_ops RPC;
+        # callers are single-threaded per replica (the scheduler's
+        # locked apply phase / driver loop)
+        self._buffer: list = []
+        # a flush observed the hub write fence (this replica was
+        # retired): sticky until re-registration, surfaced as a typed
+        # AdmitConflict at the NEXT row mutation so FleetRuntime's
+        # handlers set _needs_resync exactly like the in-process path
+        # (a read-path flush has no caller prepared for the typed
+        # conflict, so it cannot raise there — review-caught)
+        self._fenced_seen = False
+
+    def _op(self, op: str, **meta) -> dict:
+        import grpc
+        import time
+
+        from .occupancy import AdmitConflict, ExchangeUnreachable
+
+        t0 = time.perf_counter()
+        try:
+            return self._client.hub_op(op, **meta)
+        except grpc.RpcError as e:
+            code = getattr(e, "code", lambda: None)()
+            name = code.name if code is not None else ""
+            details = getattr(e, "details", lambda: "")() or name
+            if name == "ABORTED":
+                raise AdmitConflict(details) from None
+            if name == "FAILED_PRECONDITION":
+                raise AdmitConflict(details, fenced=True) from None
+            raise ExchangeUnreachable(details) from None
+        except ConnectionError as e:
+            raise ExchangeUnreachable(str(e)) from None
+        finally:
+            metrics.fleet_hub_rpc_seconds.labels(op).observe(
+                time.perf_counter() - t0
+            )
+
+    def flush(self) -> None:
+        """Drain the write-behind buffer as one apply_ops RPC. On a
+        transport failure the buffer is RETAINED (idempotent upserts —
+        a retry replays safely; the wholesale resync republish
+        supersedes it regardless). A fenced rejection DROPS it: a
+        retired replica's rows must not land, and its healed
+        incarnation re-registers from truth."""
+        from .occupancy import AdmitConflict
+
+        if not self._buffer:
+            return
+        ops, self._buffer = self._buffer, []
+        try:
+            self._op("apply_ops", replica=self._replica, ops=ops)
+        except AdmitConflict:
+            # fenced: the rows must not land — drop, and remember so
+            # the next mutation surfaces the typed conflict (the
+            # in-process hub raises it inline; silently succeeding
+            # here would leave every later row discarded without the
+            # replica ever learning to resync)
+            self._fenced_seen = True
+        except Exception:
+            self._buffer = ops + self._buffer  # retained for retry
+            if len(self._buffer) > 4 * self._BUFFER_CAP:
+                # a long partition must not grow the buffer without
+                # bound: drop it — the raise below sets the caller's
+                # dirty flag, and the first reachable resync
+                # republishes every row wholesale from truth
+                self._buffer.clear()
+            raise
+
+    def _buffered(self, kind: str, arg) -> None:
+        if self._fenced_seen:
+            from .occupancy import AdmitConflict
+
+            # sticky until re-registration: rows of a retired replica
+            # must not even buffer, and the caller (FleetRuntime's
+            # stage/commit/withdraw handlers) flags the resync that
+            # re-registers
+            raise AdmitConflict(
+                f"replica {self._replica} observed the hub write fence "
+                "at a prior flush: no row mutation may land until a "
+                "wholesale republish re-registers it",
+                fenced=True,
+            )
+        self._buffer.append([kind, arg])
+        if len(self._buffer) >= self._BUFFER_CAP:
+            self.flush()
+
+    # -- the OccupancyExchange surface --
+
+    @property
+    def version(self) -> int:
+        self.flush()
+        return int(self._op("version")["version"])
+
+    def peers_version(self, replica: str) -> int:
+        self.flush()
+        return int(self._op("peers_version", replica=replica)["version"])
+
+    def publish_nodes(self, replica: str, rows) -> None:
+        self.flush()
+        self._op(
+            "publish_nodes", replica=replica,
+            nodes=[[r.node, r.zone] for r in rows],
+        )
+        self._fenced_seen = False  # wholesale republish re-registers
+
+    def stage(self, replica: str, row: PodRow) -> None:
+        from .occupancy import pod_row_to_list
+
+        self._buffered("stage", pod_row_to_list(row))
+
+    def compare_and_stage(
+        self, replica: str, row: PodRow, expected_version: int
+    ) -> int:
+        from .occupancy import pod_row_to_list
+
+        # the CAS never buffers — it IS the atomic admit. Flush first
+        # so expected_version (from the flushed-before read) stays
+        # consistent with this replica's own write stream.
+        self.flush()
+        return int(
+            self._op(
+                "cas_stage", replica=replica, row=pod_row_to_list(row),
+                expect=int(expected_version),
+            )["version"]
+        )
+
+    def replace_pod_rows(self, replica: str, rows) -> None:
+        from .occupancy import pod_row_to_list
+
+        # wholesale from truth supersedes anything buffered
+        self._buffer.clear()
+        self._op(
+            "replace_pod_rows", replica=replica,
+            rows=[pod_row_to_list(r) for r in rows],
+        )
+        self._fenced_seen = False  # wholesale republish re-registers
+
+    def commit(self, replica: str, pod_key: str) -> None:
+        self._buffered("commit", pod_key)
+
+    def withdraw(self, replica: str, pod_key: str) -> None:
+        self._buffered("withdraw", pod_key)
+
+    def retire(self, replica: str) -> None:
+        self.flush()
+        self._op("retire", replica=replica)
+
+    def set_degraded(self, replica: str, degraded: bool) -> None:
+        self.flush()
+        self._op("set_degraded", replica=replica, degraded=bool(degraded))
+
+    def degraded_replicas(self) -> frozenset:
+        return frozenset(self._op("degraded_replicas")["replicas"] or ())
+
+    def hand_off(
+        self, to_replica: str, pod_key: str, hops: int,
+        from_replica: str | None = None,
+    ) -> None:
+        self.flush()
+        self._op(
+            "hand_off", to=to_replica, pod=pod_key, hops=int(hops),
+            **({"from": from_replica} if from_replica is not None else {}),
+        )
+
+    def claim_handoffs(self, replica: str) -> list:
+        self.flush()
+        return [
+            (k, int(h))
+            for k, h in self._op("claim_handoffs", replica=replica)[
+                "handoffs"
+            ]
+            or []
+        ]
+
+    def pending_handoff_keys(self) -> set:
+        self.flush()
+        return set(self._op("pending_handoff_keys")["keys"] or ())
+
+    def peers_view(self, replica: str) -> PeerView:
+        from .occupancy import pod_row_from_list
+
+        self.flush()
+        out = self._op("peers_view", replica=replica)
+        return PeerView(
+            version=int(out["version"]),
+            node_rows=tuple(
+                NodeRow(node=n, zone=z) for n, z in out.get("nodes") or []
+            ),
+            pod_rows=tuple(
+                pod_row_from_list(r) for r in out.get("pods") or []
+            ),
+            peer_ages=tuple(
+                (r, float(a)) for r, a in out.get("peerAges") or []
+            ),
+        )
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        except Exception:
+            pass  # teardown is best-effort; resync owns recovery
+        self._client.close()
+
+
 class FleetRuntime:
     def __init__(
         self, config: FleetConfig, cluster: ClusterState, clock
@@ -101,11 +372,14 @@ class FleetRuntime:
         self.cluster = cluster
         self.clock = clock
         self.replica = config.replica
-        self.exchange: OccupancyExchange = (
-            config.exchange
-            if config.exchange is not None
-            else OccupancyExchange()
-        )
+        if config.exchange is not None:
+            self.exchange: OccupancyExchange = config.exchange
+        elif config.hub_address:
+            self.exchange = RemoteOccupancyExchange(
+                config.hub_address, config.replica, clock=clock
+            )
+        else:
+            self.exchange = OccupancyExchange()
         self.membership = FleetMembership(config.replicas, config.replica)
         self.ring = HashRing(self.membership.universe)
         # alive-subset ring, cached per membership version: routes_pod
@@ -155,11 +429,23 @@ class FleetRuntime:
         # conservative-admission rejections under stale rows (the sim's
         # hub_partition invariant asserts the path engaged)
         self.stale_rejections = 0  # ktpu: guarded-by(cluster.lock)
+        # cross-process atomic admit bookkeeping: pods whose pending
+        # row already landed at the hub via compare_and_stage during
+        # admit (the apply phase's stage() must not re-send it), and
+        # how many CAS rejections this replica has absorbed (typed
+        # AdmitConflict — version races and fenced writes)
+        self._cas_staged: set[str] = set()  # ktpu: guarded-by(cluster.lock)
+        self.cas_conflicts = 0  # ktpu: guarded-by(cluster.lock)
         with cluster.lock:
             self._recompute(cluster.list_nodes())
         metrics.fleet_replicas.set(len(self.membership.alive()))
 
     _HANDOFF_AFTER = 2
+    # bounded re-admission rounds when compare_and_stage loses its
+    # version race: each round re-fetches the peer view and re-runs the
+    # host-side recheck against the rows that beat it. Exhaustion is an
+    # ordinary reconcile rejection (requeue + retry), never a stall.
+    _CAS_ATTEMPTS = 3
 
     # -- partition maintenance --
 
@@ -405,6 +691,9 @@ class FleetRuntime:
         # currently-owned nodes; pending rows survive only while this
         # replica still assumes the pod.
         self.rebuild_pod_rows(cache, pods=pods, nodes=nodes)
+        # CAS-staged markers are only meaningful between one admit and
+        # its stage; the wholesale row rebuild supersedes any leftovers
+        self._cas_staged.clear()
         # sweep routing overrides and reject counts against cluster
         # truth (bound/deleted pods need no routing state)
         live_unbound = {p.key for p in pods if not p.node_name}
@@ -535,7 +824,15 @@ class FleetRuntime:
     def admit(self, pod: Pod, node_name: str, cache) -> str | None:
         """Pre-assume fleet admission: ownership fence first (the
         no-global-overcommit guarantee), then the cross-shard
-        constraint recheck against peers' occupancy rows."""
+        constraint recheck against peers' occupancy rows, then —
+        for label-bearing cross-shard-constrained pods — the fenced
+        compare-and-stage that lands the pending row at the hub
+        ATOMICALLY with the recheck's view version. Two replicas
+        racing the same hard-spread slot both pass their host-side
+        recheck against the same view; the hub serializes their CAS
+        calls, exactly one lands, the loser re-fetches (now seeing the
+        winner's pending row) and re-admits — or rejects and requeues
+        after _CAS_ATTEMPTS rounds of contention."""
         if not self.owns_node(node_name):
             metrics.fleet_reconcile_conflicts_total.labels(
                 "ownership"
@@ -551,48 +848,109 @@ class FleetRuntime:
             # otherwise pay it per pod)
             self._reject_counts.pop(pod.key, None)
             return None
-        peers, age = self._peers_view_with_age()
-        metrics.fleet_occupancy_row_age_seconds.set(
-            age if age != float("inf") else -1.0
-        )
-        if age > self.config.max_row_age_s:
-            # occupancy-staleness bound: the view may hide peers'
-            # placements (hub unreachable, or a peer stopped
-            # publishing). Admitting a cross-shard-constrained
-            # placement against it risks exactly the overcommit the
-            # exchange exists to prevent — turn CONSERVATIVE and
-            # reject; the pod parks and retries when the exchange
-            # version moves (the heal republish bumps it) or via the
-            # unschedulable flush.
-            metrics.fleet_reconcile_conflicts_total.labels("stale").inc()
-            self.stale_rejections += 1
-            self._conflicts_since_wake += 1
-            if peers is not None:
-                self._wake_version = peers.version
-            self._reject_counts[pod.key] = (
-                self._reject_counts.get(pod.key, 0) + 1
+        why = None
+        for _attempt in range(self._CAS_ATTEMPTS):
+            peers, age = self._peers_view_with_age()
+            metrics.fleet_occupancy_row_age_seconds.set(
+                age if age != float("inf") else -1.0
             )
-            shown = "inf" if age == float("inf") else f"{age:.0f}s"
-            return (
-                f"fleet occupancy view is {shown} stale (bound "
-                f"{self.config.max_row_age_s:.0f}s): conservative "
-                "admission rejects cross-shard-constrained placements "
-                "until the occupancy exchange heals"
+            if age > self.config.max_row_age_s:
+                # occupancy-staleness bound: the view may hide peers'
+                # placements (hub unreachable, or a peer stopped
+                # publishing). Admitting a cross-shard-constrained
+                # placement against it risks exactly the overcommit the
+                # exchange exists to prevent — turn CONSERVATIVE and
+                # reject; the pod parks and retries when the exchange
+                # version moves (the heal republish bumps it) or via
+                # the unschedulable flush.
+                metrics.fleet_reconcile_conflicts_total.labels(
+                    "stale"
+                ).inc()
+                self.stale_rejections += 1
+                self._conflicts_since_wake += 1
+                if peers is not None:
+                    self._wake_version = peers.version
+                self._reject_counts[pod.key] = (
+                    self._reject_counts.get(pod.key, 0) + 1
+                )
+                shown = "inf" if age == float("inf") else f"{age:.0f}s"
+                return (
+                    f"fleet occupancy view is {shown} stale (bound "
+                    f"{self.config.max_row_age_s:.0f}s): conservative "
+                    "admission rejects cross-shard-constrained "
+                    "placements until the occupancy exchange heals"
+                )
+            why = self.reconciler.admit(
+                pod, node_name, self._zone_of(cache, node_name), cache,
+                peers,
             )
-        why = self.reconciler.admit(
-            pod, node_name, self._zone_of(cache, node_name), cache, peers
-        )
-        if why is not None:
-            metrics.fleet_reconcile_conflicts_total.labels(
-                "spread" if "spread" in why else "anti"
-            ).inc()
-            self._conflicts_since_wake += 1
-            self._wake_version = peers.version
-            self._reject_counts[pod.key] = (
-                self._reject_counts.get(pod.key, 0) + 1
-            )
+            if why is not None:
+                break  # a real constraint conflict, not CAS contention
+            if not pod.labels:
+                # label-free pods publish no row (they can never match
+                # a peer's selector/term), so there is nothing for a
+                # racing peer to CAS against either way
+                self._reject_counts.pop(pod.key, None)
+                return None
+            try:
+                self.exchange.compare_and_stage(
+                    self.replica,
+                    PodRow.for_pod(
+                        pod, node_name,
+                        self._zone_of(cache, node_name), PENDING,
+                    ),
+                    peers.version,
+                )
+            except AdmitConflict as e:
+                metrics.fleet_admit_cas_conflict_total.labels(
+                    "fenced" if e.fenced else "version"
+                ).inc()
+                self.cas_conflicts += 1
+                if e.fenced:
+                    # the hub retired this replica (a peer observed its
+                    # lease stale): no row may land until the forced
+                    # resync re-registers wholesale — reject and let
+                    # the bind-time fence / reacquire path sort out
+                    # whether this incarnation still owns anything
+                    self._needs_resync = True
+                    why = (
+                        "fleet occupancy hub fenced this replica "
+                        "(membership declared it dead): no placement "
+                        "row may land until resync re-registers it"
+                    )
+                    break
+                continue  # version moved: re-fetch and re-admit
+            except ExchangeUnreachable:
+                # the hub vanished between the view fetch and the CAS:
+                # the view already passed the staleness bound, so admit
+                # against it (PR 8 partition semantics — the bound is
+                # the risk window) and republish wholesale at the first
+                # reachable resync
+                self._exchange_dirty = True
+                self._reject_counts.pop(pod.key, None)
+                return None
+            else:
+                # the pending row is already at the hub: the apply
+                # phase's stage() must not re-send it
+                self._cas_staged.add(pod.key)
+                self._reject_counts.pop(pod.key, None)
+                return None
         else:
-            self._reject_counts.pop(pod.key, None)
+            why = (
+                f"fleet occupancy CAS contention: the hub version moved "
+                f"{self._CAS_ATTEMPTS} times during admission — requeue "
+                "and retry against quieter rows"
+            )
+        metrics.fleet_reconcile_conflicts_total.labels(
+            "spread" if "spread" in why
+            else ("anti" if "anti" in why else "cas")
+        ).inc()
+        self._conflicts_since_wake += 1
+        if peers is not None:
+            self._wake_version = peers.version
+        self._reject_counts[pod.key] = (
+            self._reject_counts.get(pod.key, 0) + 1
+        )
         return why
 
     # called from the scheduler's admit-reject branch under
@@ -632,6 +990,11 @@ class FleetRuntime:
             )
         except ExchangeUnreachable:
             return None  # can't release through a hub we can't reach
+        except AdmitConflict:
+            # fenced at the hub: keep the pod local until the forced
+            # resync re-registers this replica
+            self._needs_resync = True
+            return None
         self._routed_here.pop(key, None)
         self._routed_away.add(key)
         self._reject_counts.pop(key, None)
@@ -645,15 +1008,20 @@ class FleetRuntime:
         progress — it just stops attracting refugees while sick."""
         try:
             self.exchange.set_degraded(self.replica, degraded)
-        except ExchangeUnreachable:
+        except (AdmitConflict, ExchangeUnreachable):
             # breaker hooks fire outside the cluster lock (the solve
             # loop holds no lock around dispatch): take it for the
-            # dirty flag
+            # dirty flag (a fenced write re-registers at resync too)
             with self.cluster.lock:
                 self._exchange_dirty = True
 
     # called from _apply_group's locked apply phase: ktpu: holds(cluster.lock)
     def stage(self, pod: Pod, node_name: str, cache) -> None:
+        if pod.key in self._cas_staged:
+            # admit()'s compare_and_stage already landed this pending
+            # row atomically with the constraint recheck
+            self._cas_staged.discard(pod.key)
+            return
         if not pod.labels:
             return  # label-free pods can never match a selector/term
         try:
@@ -668,6 +1036,11 @@ class FleetRuntime:
             # resync (rebuild_pod_rows) — the placement itself is
             # legitimate, the hub just hasn't heard about it yet
             self._exchange_dirty = True
+        except AdmitConflict:
+            # hub write fence (this replica was retired): the forced
+            # resync re-registers from truth; until then the row stays
+            # off the hub, which is conservative for peers
+            self._needs_resync = True
 
     # called from _commit_binding's locked confirmation phase: ktpu: holds(cluster.lock)
     def commit(self, pod_key: str) -> None:
@@ -675,11 +1048,16 @@ class FleetRuntime:
             self.exchange.commit(self.replica, pod_key)
         except ExchangeUnreachable:
             self._exchange_dirty = True
+        except AdmitConflict:
+            self._needs_resync = True
 
     # every caller (unreserve/ingest/reap paths) holds the cluster
     # lock: ktpu: holds(cluster.lock)
     def withdraw(self, pod_key: str) -> None:
+        self._cas_staged.discard(pod_key)
         try:
             self.exchange.withdraw(self.replica, pod_key)
         except ExchangeUnreachable:
             self._exchange_dirty = True
+        except AdmitConflict:
+            self._needs_resync = True

@@ -325,10 +325,41 @@ fleet_occupancy_rows_total = Counter(
 fleet_reconcile_conflicts_total = Counter(
     "scheduler_fleet_reconcile_conflicts_total",
     "Placements the cross-shard reconciliation rejected pre-assume, "
-    "by constraint family (ownership|spread|anti|stale — stale = "
-    "conservative admission under an aged-out occupancy view); the "
-    "pods retried through the ordinary requeue machinery.",
+    "by constraint family (ownership|spread|anti|stale|cas — stale = "
+    "conservative admission under an aged-out occupancy view, cas = "
+    "sustained hub compare-and-stage contention or a fenced write); "
+    "the pods retried through the ordinary requeue machinery.",
     ["constraint"],
+    registry=REGISTRY,
+)
+fleet_admit_cas_conflict_total = Counter(
+    "scheduler_fleet_admit_cas_conflict_total",
+    "Cross-process atomic admits rejected by the hub's fenced "
+    "compare-and-stage, by kind (version = the hub moved past the "
+    "admitted view — a peer's row landed first, the replica re-fetches "
+    "and re-admits; fenced = the replica's hub write privilege was "
+    "revoked by a membership retire — no row lands until its forced "
+    "resync re-registers it wholesale).",
+    ["kind"],
+    registry=REGISTRY,
+)
+fleet_hub_rpc_seconds = Histogram(
+    "scheduler_fleet_hub_rpc_seconds",
+    "Wall time of one occupancy-hub RPC from RemoteOccupancyExchange "
+    "(the HubOp method on the bulk gRPC boundary), by hub op — the "
+    "wire cost a cross-process fleet pays per stage/commit/view that "
+    "an in-process fleet gets for a lock acquire.",
+    ["op"],
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+fleet_mesh_slice_devices = Gauge(
+    "scheduler_fleet_mesh_slice_devices",
+    "Devices in this replica's EXCLUSIVE mesh slice "
+    "(SchedulerConfig.mesh_slice = (rank, count): contiguous first-N "
+    "partitioning of the visible device set, so N fleet replicas "
+    "stream-dispatch against disjoint device sets). 0 = no slice "
+    "configured (the sole-owner scheduler uses mesh_devices alone).",
     registry=REGISTRY,
 )
 bulk_retry_total = Counter(

@@ -76,22 +76,57 @@ def node_mesh(n_devices: int | None = None):
     return Mesh(np.array(devices), axis_names=(NODE_AXIS,))
 
 
-def resolve_mesh(mesh_devices: int):
-    """SchedulerConfig.mesh_devices -> Mesh | None.
+def resolve_mesh(mesh_devices: int, mesh_slice: tuple | None = None):
+    """SchedulerConfig.mesh_devices (+ optional mesh_slice) -> Mesh | None.
 
-    0 = all visible devices; 1 = single-device (no mesh, the unsharded
-    fast path); N > 1 = the first min(N, visible) devices. A resolved
-    count of 1 returns None — a 1-way mesh would pay GSPMD lowering for
-    nothing."""
-    if mesh_devices == 1:
-        return None
+    Without a slice: 0 = all visible devices; 1 = single-device (no
+    mesh, the unsharded fast path); N > 1 = the first min(N, visible)
+    devices. A resolved count of 1 returns None — a 1-way mesh would
+    pay GSPMD lowering for nothing.
+
+    ``mesh_slice=(rank, count)`` is the fleet's device-tier partition
+    (config key fleet.meshSlice = "rank/count"): the visible device
+    list is cut into ``count`` contiguous first-N slices of equal size
+    and this process owns slice ``rank`` EXCLUSIVELY — N replicas on
+    one host therefore dispatch against disjoint device sets, which is
+    what lets the fleet tier multiply the streaming dispatcher instead
+    of fighting over one accelerator. ``mesh_devices`` then applies
+    WITHIN the slice (0 = the whole slice). Unlike the no-slice path, a
+    1-device slice still returns a 1-way Mesh: the mesh is what pins
+    the solve to THIS replica's device — falling back to the default
+    device would silently stack every replica on device 0, the exact
+    sharing violation the slice exists to prevent."""
+    if mesh_slice is None:
+        if mesh_devices == 1:
+            return None
+        import jax
+
+        visible = len(jax.devices())
+        n = visible if mesh_devices <= 0 else min(mesh_devices, visible)
+        if n < 2:
+            return None
+        return node_mesh(n)
+
     import jax
+    from jax.sharding import Mesh
 
-    visible = len(jax.devices())
-    n = visible if mesh_devices <= 0 else min(mesh_devices, visible)
-    if n < 2:
-        return None
-    return node_mesh(n)
+    rank, count = int(mesh_slice[0]), int(mesh_slice[1])
+    if count < 1 or not 0 <= rank < count:
+        raise ValueError(
+            f"mesh_slice must be (rank, count) with 0 <= rank < count; "
+            f"got {mesh_slice!r}"
+        )
+    devices = jax.devices()
+    share = len(devices) // count
+    if share < 1:
+        raise ValueError(
+            f"mesh_slice {rank}/{count} needs at least {count} visible "
+            f"devices for disjoint per-replica slices; only "
+            f"{len(devices)} are visible"
+        )
+    mine = devices[rank * share : (rank + 1) * share]
+    n = len(mine) if mesh_devices <= 0 else min(mesh_devices, len(mine))
+    return Mesh(np.array(mine[:n]), axis_names=(NODE_AXIS,))
 
 
 def mesh_fingerprint(mesh) -> tuple | None:

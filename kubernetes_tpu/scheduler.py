@@ -105,6 +105,15 @@ class SchedulerConfig:
     # parity tests (ExactSolver defaults to mesh=None), and the
     # mesh_devices=1 arms of the equivalence tests.
     mesh_devices: int = 0
+    # per-replica EXCLUSIVE mesh slice (fleet device-tier scale-out;
+    # config key fleet.meshSlice = "rank/count"): (rank, count) cuts
+    # the visible device list into count contiguous equal slices and
+    # this scheduler dispatches ONLY against slice rank, so N fleet
+    # replicas on one host own disjoint device sets (a 1-device slice
+    # still builds a 1-way mesh — the mesh is what pins the device).
+    # mesh_devices applies within the slice. None = no slice (the
+    # sole-owner scheduler).
+    mesh_slice: tuple | None = None
     # multi-profile (profile.NewMap): schedulerName -> solver config for
     # that profile; pods whose schedulerName matches no profile are ignored
     # at queue-add, like the reference's frameworkForPod miss. None = the
@@ -532,11 +541,18 @@ class Scheduler:
         # always shards evenly; padded rows stay masked unschedulable.
         from .parallel.sharding import resolve_mesh
 
-        self.mesh = resolve_mesh(self.config.mesh_devices)
+        self.mesh = resolve_mesh(
+            self.config.mesh_devices, self.config.mesh_slice
+        )
         self._mesh_devices = (
             int(self.mesh.size) if self.mesh is not None else 1
         )
         metrics.mesh_devices.set(self._mesh_devices)
+        # fleet device-tier scale-out: the devices this replica's
+        # EXCLUSIVE slice owns (0 = no slice configured)
+        metrics.fleet_mesh_slice_devices.set(
+            self._mesh_devices if self.config.mesh_slice is not None else 0
+        )
         # degraded-mode solve resilience (kubernetes_tpu/resilience):
         # the fallback ladder + per-profile circuit breaker both
         # scheduling loops dispatch through, pre-apply output
@@ -2475,6 +2491,12 @@ class Scheduler:
                     # the device-resident solve DID place the pod; mark the
                     # column dirty so the session re-heals it from cache truth
                     self.snapshot.touch(int(a))
+                    if self.fleet is not None:
+                        # admit() may have CAS-staged the pending row at
+                        # the hub already; a placement that never gets
+                        # assumed must not keep distorting peers'
+                        # admission until the next resync
+                        self.fleet.withdraw(pod.key)
                     res.bind_failures.append((pod.key, str(e)))
                     self._requeue(info, cycle)
                     if self.journal is not None:

@@ -245,13 +245,140 @@ class BulkCore:
         OTHER replica, framed the same way. One unary call per
         reconcile refresh — compact by construction (label-bearing
         placements only)."""
-        from ..fleet.occupancy import OccupancyExchange, ingest_payload
+        from ..fleet.occupancy import ingest_payload
+
+        return ingest_payload(self._hub(), data)
+
+    def _hub(self):
+        from ..fleet.occupancy import OccupancyExchange
 
         with self._lock:
             if self.exchange is None:
                 self.exchange = OccupancyExchange()
-            exchange = self.exchange
-        return ingest_payload(exchange, data)
+            return self.exchange
+
+    def hub_op(self, data: bytes, ctx=None) -> bytes:
+        """Occupancy-hub operation dispatch: the full OccupancyExchange
+        surface (stage / fenced compare-and-stage / commit / withdraw /
+        retire / handoff / degraded flags / views) as one unary RPC, so
+        N cross-process replicas share ONE hub with the in-process
+        semantics intact. meta.op selects the operation; rows ride the
+        JSON meta (they are compact by construction). Error mapping —
+        the wire half of the typed-conflict contract:
+
+        - ``ExchangeUnreachable`` (the sim's partition seam) ->
+          UNAVAILABLE: a transport-class failure the client surfaces as
+          ExchangeUnreachable again;
+        - ``AdmitConflict`` (CAS lost its version race) -> ABORTED;
+          ``AdmitConflict(fenced=True)`` (hub write fence) ->
+          FAILED_PRECONDITION. Both are SEMANTIC rejections: BulkClient
+          never retries them (retrying a lost race would re-land the
+          write the CAS exists to reject)."""
+        import grpc
+
+        from ..fleet.occupancy import (
+            AdmitConflict,
+            ExchangeUnreachable,
+            NodeRow,
+            pod_row_from_list,
+            pod_row_to_list,
+        )
+
+        meta, _arrays = tensorcodec.decode(data)
+        op = meta.get("op") or ""
+        replica = meta.get("replica") or ""
+        hub = self._hub()
+        try:
+            out: dict = {}
+            if op == "version":
+                out["version"] = hub.version
+            elif op == "peers_version":
+                out["version"] = hub.peers_version(replica)
+            elif op == "publish_nodes":
+                hub.publish_nodes(
+                    replica,
+                    [NodeRow(node=n, zone=z) for n, z in meta.get("nodes") or []],
+                )
+            elif op == "stage":
+                hub.stage(replica, pod_row_from_list(meta["row"]))
+            elif op == "cas_stage":
+                out["version"] = hub.compare_and_stage(
+                    replica,
+                    pod_row_from_list(meta["row"]),
+                    int(meta["expect"]),
+                )
+            elif op == "replace_pod_rows":
+                hub.replace_pod_rows(
+                    replica,
+                    [pod_row_from_list(r) for r in meta.get("rows") or []],
+                )
+            elif op == "commit":
+                hub.commit(replica, meta["pod"])
+            elif op == "withdraw":
+                hub.withdraw(replica, meta["pod"])
+            elif op == "apply_ops":
+                # write-behind flush (RemoteOccupancyExchange): a batch
+                # of buffered stage/commit/withdraw mutations applied in
+                # order — ONE wire round trip instead of one per row.
+                # Idempotent upserts keyed by pod, so a client retrying
+                # a buffer after a transient failure is safe.
+                for kind, arg in meta.get("ops") or []:
+                    if kind == "stage":
+                        hub.stage(replica, pod_row_from_list(arg))
+                    elif kind == "commit":
+                        hub.commit(replica, arg)
+                    elif kind == "withdraw":
+                        hub.withdraw(replica, arg)
+                    else:
+                        raise ValueError(
+                            f"unknown apply_ops kind {kind!r}"
+                        )
+            elif op == "retire":
+                hub.retire(replica)
+            elif op == "set_degraded":
+                hub.set_degraded(replica, bool(meta.get("degraded")))
+            elif op == "degraded_replicas":
+                out["replicas"] = sorted(hub.degraded_replicas())
+            elif op == "hand_off":
+                hub.hand_off(
+                    meta["to"], meta["pod"], int(meta.get("hops") or 0),
+                    from_replica=meta.get("from") or None,
+                )
+            elif op == "claim_handoffs":
+                out["handoffs"] = [
+                    [k, h] for k, h in hub.claim_handoffs(replica)
+                ]
+            elif op == "pending_handoff_keys":
+                out["keys"] = sorted(hub.pending_handoff_keys())
+            elif op == "peers_view":
+                view = hub.peers_view(replica)
+                out = {
+                    "version": view.version,
+                    "nodes": [[r.node, r.zone] for r in view.node_rows],
+                    "pods": [pod_row_to_list(r) for r in view.pod_rows],
+                    "peerAges": [[r, a] for r, a in view.peer_ages],
+                }
+            else:
+                if ctx is not None:
+                    ctx.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        f"unknown hub op {op!r}",
+                    )
+                raise ValueError(f"unknown hub op {op!r}")
+        except ExchangeUnreachable as e:
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            raise
+        except AdmitConflict as e:
+            if ctx is not None:
+                ctx.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION
+                    if e.fenced
+                    else grpc.StatusCode.ABORTED,
+                    str(e),
+                )
+            raise
+        return tensorcodec.encode(out)
 
     def evaluate(self, data: bytes) -> bytes:
         meta, arrays = tensorcodec.decode(data)
@@ -305,6 +432,15 @@ def make_grpc_server(core: BulkCore, port: int = 0, host: str = "127.0.0.1"):
             response_serializer=ident,
         )
 
+    def unary_ctx(fn):
+        # the handler needs the ServicerContext to abort with typed
+        # status codes (the HubOp conflict mapping)
+        return grpc.unary_unary_rpc_method_handler(
+            lambda req, ctx: fn(req, ctx),
+            request_deserializer=ident,
+            response_serializer=ident,
+        )
+
     handler = grpc.method_handlers_generic_handler(
         SERVICE,
         {
@@ -312,6 +448,7 @@ def make_grpc_server(core: BulkCore, port: int = 0, host: str = "127.0.0.1"):
             "Solve": unary(core.solve),
             "Evaluate": unary(core.evaluate),
             "ExchangeOccupancy": unary(core.exchange_occupancy),
+            "HubOp": unary_ctx(core.hub_op),
         },
     )
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
@@ -394,6 +531,11 @@ class BulkClient:
             request_serializer=ident,
             response_deserializer=ident,
         )
+        self._hub_op = self._channel.unary_unary(
+            f"/{SERVICE}/HubOp",
+            request_serializer=ident,
+            response_deserializer=ident,
+        )
 
     def _retryable(self, err: Exception) -> bool:
         if isinstance(err, ConnectionError):
@@ -473,6 +615,20 @@ class BulkClient:
             "Evaluate", self._eval, tensorcodec.encode({}, arrays)
         )
         return tensorcodec.decode(reply)
+
+    def hub_op(self, op: str, **meta) -> dict:
+        """One occupancy-hub operation (the HubOp method): meta in,
+        reply meta out. Transient transport failures retry like every
+        other bulk RPC; ABORTED / FAILED_PRECONDITION — the hub's typed
+        CAS-conflict and fence rejections — are SEMANTIC and surface
+        immediately (never retried: a blind retry of a lost admit race
+        would re-land the write the compare-and-stage rejected,
+        mirroring the committing-Solve never-retries rule)."""
+        meta["op"] = op
+        reply = self._call(
+            "HubOp", self._hub_op, tensorcodec.encode(meta)
+        )
+        return tensorcodec.decode(reply)[0]
 
     def exchange_occupancy(self, replica, version, node_rows, pod_rows):
         """Fleet occupancy exchange round trip: publish this replica's

@@ -123,7 +123,9 @@ def _print_fleet_result(res) -> None:
     print(
         f"profile={res.profile} seed={res.seed} cycles={res.cycles} "
         f"fleet={res.replicas} alive={s['alive']} "
-        f"lost={s['lost_replica'] or '-'}"
+        f"lost={s['lost_replica'] or '-'} "
+        f"hub={s.get('hub', 'in-process')} "
+        f"cas_conflicts={s.get('cas_conflicts', 0)}"
     )
     print(
         f"  events={s['events']} bound={s['bound']} "
@@ -159,7 +161,7 @@ def _run_fleet(args) -> int:
         res = run_fleet_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
             replicas=args.fleet, pipelined=pipelined,
-            streaming=streaming,
+            streaming=streaming, grpc_hub=args.hub_grpc,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -176,7 +178,7 @@ def _run_fleet(args) -> int:
         res2 = run_fleet_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
             replicas=args.fleet, pipelined=pipelined,
-            streaming=streaming,
+            streaming=streaming, grpc_hub=args.hub_grpc,
         )
         if res.journal_digests != res2.journal_digests:
             print(
@@ -256,6 +258,13 @@ def main(argv=None) -> int:
         "single-scheduler drive; use with the fleet_mixed / "
         "replica_loss profiles. --selfcheck byte-compares per-replica "
         "journal digests across two runs.",
+    )
+    parser.add_argument(
+        "--hub-grpc", action="store_true",
+        help="fleet drives only: serve the occupancy hub behind a "
+        "localhost bulk gRPC server (real wire framing, typed "
+        "CAS-conflict status mapping) instead of the shared in-process "
+        "object — the cross-process deployment shape on one box",
     )
     parser.add_argument("--list-profiles", action="store_true")
     args = parser.parse_args(argv)

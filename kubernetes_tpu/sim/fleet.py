@@ -94,6 +94,7 @@ class FleetSimHarness:
         pipelined: bool | None = None,
         streaming: bool | None = None,
         max_settle_rounds: int = 12,
+        grpc_hub: bool = False,
     ) -> None:
         self.profile = (
             get_profile(profile) if isinstance(profile, str) else profile
@@ -133,7 +134,39 @@ class FleetSimHarness:
         # the hub shares the virtual clock so occupancy-row aging (the
         # staleness bounds) rides the same timeline as everything else
         self.exchange = OccupancyExchange(clock=self.clock)
+        # gRPC-backed hub: the SAME hub object served behind the bulk
+        # boundary's HubOp method on localhost — every replica talks to
+        # it through a RemoteOccupancyExchange over a real socket (real
+        # tensorcodec wire framing, real status-code conflict mapping),
+        # while the harness keeps direct access for its fault seams
+        # (set_partitioned / retire) and invariants. Virtual time is
+        # untouched (RPC wall time never enters the FakeClock) and the
+        # drive stays single-threaded round-robin, so same seed + flags
+        # reproduce byte-identical journals ACROSS RUNS (--selfcheck).
+        # Journals are not byte-identical to the in-process-hub drive:
+        # the client's write-behind row buffer legitimately shifts WHEN
+        # commit/withdraw bumps land on the hub version counter, which
+        # re-times conflict-parked wakeups — every invariant still
+        # holds, which is the actual contract.
+        self.grpc_hub = grpc_hub
+        self._hub_server = None
+        self._hub_clients: list = []
         self.universe = tuple(f"r{i}" for i in range(self.n))
+        replica_exchange = {rid: self.exchange for rid in self.universe}
+        if grpc_hub:
+            from ..fleet.runtime import RemoteOccupancyExchange
+            from ..server.bulk import BulkCore, make_grpc_server
+
+            core = BulkCore(self.cluster, exchange=self.exchange)
+            self._hub_server, port = make_grpc_server(core, port=0)
+            self._hub_server.start()
+            replica_exchange = {}
+            for rid in self.universe:
+                remote = RemoteOccupancyExchange(
+                    f"127.0.0.1:{port}", rid, clock=self.clock
+                )
+                self._hub_clients.append(remote)
+                replica_exchange[rid] = remote
         self.schedulers: dict[str, Scheduler] = {}
         for rid in self.universe:
             self.schedulers[rid] = Scheduler(
@@ -149,7 +182,7 @@ class FleetSimHarness:
                     fleet=FleetConfig(
                         replica=rid,
                         replicas=self.universe,
-                        exchange=self.exchange,
+                        exchange=replica_exchange[rid],
                         max_row_age_s=self.profile.fleet_max_row_age_s,
                     ),
                 ),
@@ -317,6 +350,15 @@ class FleetSimHarness:
         return True
 
     def run(self) -> FleetSimResult:
+        try:
+            return self._run()
+        finally:
+            for client in self._hub_clients:
+                client.close()
+            if self._hub_server is not None:
+                self._hub_server.stop(grace=None)
+
+    def _run(self) -> FleetSimResult:
         for cycle in range(self.cycles):
             metrics.sim_cycles_total.inc()
             if cycle == self.profile.replica_loss_at and self.n > 1:
@@ -413,6 +455,10 @@ class FleetSimHarness:
             "replicas": self.n,
             "alive": sum(self.alive.values()),
             "lost_replica": self._lost_replica,
+            "hub": "grpc" if self.grpc_hub else "in-process",
+            "cas_conflicts": sum(
+                s.fleet.cas_conflicts for s in self.schedulers.values()
+            ),
             "pipelined": self.pipelined,
             "events": self._events_applied,
             "bound": len(bindings),
@@ -461,9 +507,15 @@ def run_fleet_sim(
     *,
     pipelined: bool | None = None,
     streaming: bool | None = None,
+    grpc_hub: bool = False,
 ) -> FleetSimResult:
-    """One fresh seeded fleet run (library entry; CLI and tests)."""
+    """One fresh seeded fleet run (library entry; CLI and tests).
+    ``grpc_hub=True`` serves the occupancy hub behind a localhost bulk
+    gRPC server (real wire framing + typed status mapping) instead of
+    the shared in-process object — same invariants; byte-determinism
+    holds run-to-run (--selfcheck), NOT across transports (the
+    write-behind row buffer re-times hub version bumps)."""
     return FleetSimHarness(
         profile, seed=seed, cycles=cycles, replicas=replicas,
-        pipelined=pipelined, streaming=streaming,
+        pipelined=pipelined, streaming=streaming, grpc_hub=grpc_hub,
     ).run()

@@ -171,6 +171,30 @@ python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile fleet_mixed \
 python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile replica_loss \
     --fleet 2
 
+echo "== fleet smoke: gRPC-backed occupancy hub =="
+# the same fault profiles re-driven with the hub served behind a
+# localhost bulk gRPC server (--hub-grpc): every stage / fenced
+# compare-and-stage / view crosses a real socket with the tensorcodec
+# wire framing and the typed status-code conflict mapping
+# (ABORTED/FAILED_PRECONDITION never retried). replica_loss proves
+# shard re-owning + orphan adoption survive the wire; hub_partition
+# re-pins the PR 8 contract over it — 100% of the fenced zombie's
+# commits reject (zombie_binds_while_fenced=0) AND conservative
+# admission under aged-out rows engages (stale_rejections >= 1).
+# --selfcheck byte-compares per-replica journals across two runs (RPC
+# wall time never enters the virtual clock; the write-behind row
+# buffer re-times hub version bumps vs the in-process drive, so the
+# cross-transport contract is invariants, not byte equality).
+python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile replica_loss \
+    --fleet 2 --hub-grpc --selfcheck
+part_grpc=$(python -m kubernetes_tpu.sim --seed 0 --cycles 8 \
+    --profile hub_partition --fleet 2 --hub-grpc --selfcheck)
+echo "$part_grpc"
+echo "$part_grpc" | grep -qE "fenced_commits=[1-9][0-9]* zombie_binds_while_fenced=0" \
+    || { echo "GRPC HUB SMOKE: no fenced zombie commit (or one landed)"; exit 1; }
+echo "$part_grpc" | grep -qE "stale_rejections=[1-9]" \
+    || { echo "GRPC HUB SMOKE: conservative admission never engaged"; exit 1; }
+
 echo "== multichip: 8-device forced-host mesh smoke =="
 # sharded-vs-unsharded exact-path equivalence on an 8-way virtual CPU
 # mesh (conftest.py forces the device count before jax initializes):

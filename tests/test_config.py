@@ -551,3 +551,70 @@ def test_score_disable_independent_of_filter_disable():
     assert scfg.solver.interpod_weight == 2  # score stage still enabled
     assert scfg.solver.taint_weight == 0  # score disabled
     assert "TaintToleration" not in scfg.solver.disabled_filters
+
+
+def test_fleet_section_round_trip(tmp_path, capsys):
+    """fleet.hubAddress / fleet.meshSlice (ISSUE 11): parse -> typed
+    section -> runtime SchedulerConfig -> cli config dump, with the
+    null-tolerant convention (explicit YAML nulls default instead of
+    TypeError-ing) and hard validation for the dangerous typos."""
+    import pytest
+
+    yaml_doc = textwrap.dedent(
+        """
+        fleet:
+          replica: r2
+          replicas: [r0, r1, r2, r3]
+          hubAddress: "hub.scheduling.svc:9411"
+          meshSlice: "2/4"
+          maxRowAgeSeconds: 15
+        """
+    )
+    cfg = ct.load(yaml_doc)
+    assert cfg.fleet.replica == "r2"
+    assert cfg.fleet.replicas == ["r0", "r1", "r2", "r3"]
+    assert cfg.fleet.hub_address == "hub.scheduling.svc:9411"
+    assert cfg.fleet.mesh_slice == (2, 4)
+    assert cfg.fleet.max_row_age_seconds == 15.0
+    scfg = ct.scheduler_config(cfg)
+    assert scfg.mesh_slice == (2, 4)
+    assert scfg.fleet.replica == "r2"
+    assert scfg.fleet.replicas == ("r0", "r1", "r2", "r3")
+    assert scfg.fleet.hub_address == "hub.scheduling.svc:9411"
+    assert scfg.fleet.max_row_age_s == 15.0
+    # the cli dump round-trips the section (meshSlice back in its
+    # "rank/count" wire shape)
+    from kubernetes_tpu.cli import main
+
+    p = tmp_path / "fleet.yaml"
+    p.write_text(yaml_doc)
+    assert main(["--config", str(p), "config"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["fleet"] == {
+        "replica": "r2",
+        "replicas": ["r0", "r1", "r2", "r3"],
+        "hubAddress": "hub.scheduling.svc:9411",
+        "meshSlice": "2/4",
+        "maxRowAgeSeconds": 15.0,
+    }
+    # null-tolerant: explicit nulls default, fleet stays off
+    cfg2 = ct.load(
+        "fleet:\n  replica: null\n  meshSlice: null\n  hubAddress: null\n"
+    )
+    assert cfg2.fleet.replica == "" and cfg2.fleet.mesh_slice is None
+    assert ct.scheduler_config(cfg2).fleet is None
+    # validation: the typos that would silently share devices or
+    # misroute the hub are hard errors
+    for bad in (
+        'fleet:\n  replica: r0\n  meshSlice: "4/4"',
+        'fleet:\n  replica: r0\n  meshSlice: "-1/4"',
+        'fleet:\n  replica: r0\n  meshSlice: "x"',
+        'fleet:\n  replica: r0\n  hubAddress: "no-port"',
+        'fleet:\n  replica: r0\n  maxRowAgeSeconds: 0',
+        "fleet:\n  replicas: [a, b]",
+        # meshSlice with fleet mode off would silently pin the sole
+        # scheduler to a fraction of the devices (review-caught)
+        'fleet:\n  meshSlice: "0/4"',
+    ):
+        with pytest.raises(ValueError):
+            ct.load(bad)
