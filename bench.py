@@ -42,6 +42,19 @@ Measures the FULL BASELINE.md target ladder (VERDICT r2 #3):
      + degradation_factor, so the cost of degradation is a measured
      number.
 
+ #10 Rebalance loop A/B: the continuous rebalancer closing a seeded
+     fragmented 51.2k x 10.24k cluster (packed utilization before vs
+     after, median plan solve per the <1 s target).
+ #11 Backlog drain at 10x the proven scale (ISSUE 12): a 512k-pod
+     backlog drained end to end against 102,400 nodes through
+     Scheduler.drain_backlog — HBM-budget-planned chunk-aligned
+     sub-batches through run_streaming's slot ring with cross-batch
+     occupancy chaining on a hard (zone-spread) shape; 1-device vs
+     full-mesh A/B, MEDIAN drain-chunk solve time, end-state validity
+     asserted, plus the single-shot auction (scarcity repair on) at
+     the same shape. Emits backlog_drain_pods_per_sec +
+     backlog_drain_seconds (hoisted to the top level).
+
 Each ladder reports steady-state (warm-start) pods/s, best of 3 full
 passes — compiles happen in a same-shaped warmup pass (persistent compile
 cache makes restarts cheap) — plus per-workload invariant checks (all
@@ -1332,6 +1345,257 @@ def ladder10_rebalance_loop() -> dict:
     }
 
 
+BD_PODS = 512_000
+BD_NODES = 102_400
+
+
+def _backlog_arm(
+    n_nodes: int,
+    n_pods: int,
+    chunk: int,
+    mesh_devices: int,
+    kind: str = "spread",
+    group: int = 512,
+) -> dict:
+    """One backlog-drain arm: a ``n_pods`` backlog queued against
+    ``n_nodes`` nodes, drained end to end through
+    ``Scheduler.drain_backlog`` — the HBM-budget-planned, chunk-aligned
+    streaming path with cross-batch occupancy chaining (ISSUE 12).
+    ``kind='spread'`` keeps a HARD shape in the carry so the chain is
+    measured on the occupancy path, not the plain-fit fast case.
+
+    One warmup drain (chunk-sized backlog, same node/pod buckets)
+    compiles every executable; the measured pass is a single full
+    drain — at 512k pods the drain IS the steady state, so best-of-N
+    would only re-pay the 100k-node cluster build."""
+    import numpy as np
+
+    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.solver.exact import ExactSolverConfig
+    from kubernetes_tpu.state.cluster import ClusterState
+
+    cs = ClusterState()
+    for i in range(n_nodes):
+        cs.create_node(_mk_node(i))
+    sched = Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=chunk,
+            mesh_devices=mesh_devices,
+            solver=ExactSolverConfig(tie_break="random", group_size=group),
+        ),
+    )
+    # warmup: drain a chunk-sized backlog on the SAME cluster (same
+    # node padding bucket — a throwaway small cluster would compile the
+    # wrong shapes), then delete the placed pods so the measured drain
+    # starts from an empty cluster
+    for i in range(chunk):
+        cs.create_pod(_mk_pod(i, kind))
+    sched.drain_backlog(chunk_pods=chunk)
+    for p in list(cs.list_pods()):
+        cs.delete_pod(p.namespace, p.name)
+
+    t0 = time.perf_counter()
+    for i in range(n_pods):
+        cs.create_pod(_mk_pod(i, kind))
+    enqueue_s = time.perf_counter() - t0
+
+    report = sched.drain_backlog(chunk_pods=chunk)
+    assert report.drained == n_pods, (
+        f"backlog drain placed {report.drained}/{n_pods}"
+    )
+    # streaming chain engagement: the drain must measure the resident-
+    # carry path, not a silent per-chunk drain-and-retensorize fallback
+    assert report.chain_fraction >= 0.5, (
+        f"stream chain engaged on only {report.chain_fraction:.0%} of "
+        "chunks — the drain fell back to per-chunk retensorize"
+    )
+    # end-state validity (the ladder-#10 convention): every pod placed
+    # at most once with no node overcommitted — weighted bincounts over
+    # the actual request vectors
+    pods = [p for p in cs.list_pods() if p.name.startswith("pod-")]
+    assert len(pods) == n_pods
+    nodes_list = cs.list_nodes()
+    slot = {n.name: i for i, n in enumerate(nodes_list)}
+    a = np.fromiter(
+        (slot[p.node_name] for p in pods), dtype=np.int64, count=n_pods
+    )
+    cnt = np.bincount(a, minlength=n_nodes)
+    assert int(cnt.max()) <= 110, "pod-count overcommit"
+    assert np.bincount(a, weights=np.full(n_pods, 250.0)).max() <= 16_000
+    assert (
+        np.bincount(a, weights=np.full(n_pods, 512.0 * 1024**2)).max()
+        <= 64 * 1024**3
+    )
+    if kind == "spread":
+        zone_of = np.fromiter(
+            (
+                int(n.labels["topology.kubernetes.io/zone"][1:])
+                for n in nodes_list
+            ),
+            dtype=np.int64,
+            count=len(nodes_list),
+        )
+        zones = np.bincount(zone_of[a], minlength=3)
+        assert int(zones.max() - zones.min()) <= 1, (
+            f"zone skew violated at drain scale: {zones.tolist()}"
+        )
+    return {
+        "mesh_devices": mesh_devices,
+        "pods": n_pods,
+        "nodes": n_nodes,
+        "kind": kind,
+        "chunk_pods": report.chunk_pods,
+        "chunks": report.chunks,
+        "budget_splits": report.budget_splits,
+        "budget_bytes": report.budget_bytes,
+        "estimated_per_device_bytes": report.estimated_per_device_bytes,
+        "estimated_h2d_bytes": report.estimated_h2d_bytes,
+        "measured_h2d_bytes": report.measured_h2d_bytes,
+        "h2d_model_ratio": round(
+            report.measured_h2d_bytes
+            / max(report.estimated_h2d_bytes, 1),
+            3,
+        ),
+        "backlog_drain_seconds": round(report.drain_seconds, 3),
+        "backlog_drain_pods_per_sec": round(report.pods_per_sec, 1),
+        "sustained_p99_pod_latency_s": round(
+            report.p99_e2e_latency_s, 4
+        ),
+        "median_chunk_solve_s": round(report.median_chunk_solve_s, 4),
+        "stream_chained_batches": report.stream_chained_batches,
+        "chain_fraction": round(report.chain_fraction, 4),
+        "enqueue_s": round(enqueue_s, 3),
+        "dispatch": _dispatch_label(sched),
+    }
+
+
+def _backlog_auction(n_nodes: int, n_pods: int) -> dict:
+    """The single-shot auction (scarcity repair included) at the 10x
+    shape — proves the whole-problem-resident quality path holds at
+    512k x 102k, not just the chunked exact drain."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.solver.single_shot import (
+        SingleShotConfig,
+        _single_shot_jit,
+    )
+
+    rng = np.random.default_rng(12)
+    k, c, rc = 3, 8, 8
+    alloc = np.zeros((k, n_nodes), dtype=np.int64)
+    alloc[0] = 16_000
+    alloc[1] = 64 * 1024**3
+    rc_req = np.zeros((rc, k), dtype=np.int64)
+    rc_req[:, 0] = rng.integers(1, 9, rc) * 250
+    rc_req[:, 1] = rng.integers(1, 5, rc) * 1024**3
+    rc_static = (np.arange(rc) % c).astype(np.int32)
+    rc_of = rng.integers(0, rc, n_pods).astype(np.int32)
+    priority = rng.integers(0, 10, n_pods).astype(np.int32)
+    cfg = SingleShotConfig()
+    kw = dict(
+        max_rounds=cfg.max_rounds,
+        price_step=cfg.price_step,
+        top_t=cfg.top_t,
+        repair_rounds=cfg.repair_rounds,  # scarcity repair ON at scale
+    )
+
+    def fresh():
+        return [
+            jnp.asarray(x)
+            for x in (
+                alloc,
+                np.zeros((k, n_nodes), np.int64),
+                np.zeros(n_nodes, np.int32),
+                np.full(n_nodes, 110, np.int32),
+                np.ones(n_nodes, bool),
+                np.ones((c, n_nodes), bool),
+                rc_req,
+                rc_static,
+                rc_of,
+                priority,
+                np.ones(n_pods, bool),
+            )
+        ]
+
+    out = _single_shot_jit(*fresh(), **kw)
+    out[0].block_until_ready()  # compile
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = _single_shot_jit(*fresh(), **kw)
+        out[0].block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    placed = int((np.asarray(out[0]) >= 0).sum())
+    return {
+        "auction_pods": n_pods,
+        "auction_nodes": n_nodes,
+        "auction_solve_s": round(best, 3),
+        "auction_placed": placed,
+        "auction_placed_ratio": round(placed / n_pods, 4),
+        "auction_repair_rounds": cfg.repair_rounds,
+    }
+
+
+def ladder11_backlog_drain(
+    n_nodes: int = BD_NODES,
+    n_pods: int = BD_PODS,
+    chunk: int = 16_384,
+) -> dict:
+    """#11: 10x the proven scale — a 512k-pod backlog drained end to
+    end against 102,400 nodes through ``Scheduler.drain_backlog``
+    (ISSUE 12): the HBM-budget-planned chunked streaming path, with
+    cross-batch occupancy chaining keeping the hard-shape carry
+    device-resident across the whole drain. A/B: the exact same drain
+    on 1 device vs the full node-axis mesh; the auction (scarcity
+    repair on) runs once at the same shape. Reports the MEDIAN
+    drain-chunk solve time (the ladder-#10 convention) and asserts
+    end-state validity + chain engagement in both arms."""
+    import jax
+
+    one = _backlog_arm(n_nodes, n_pods, chunk, mesh_devices=1)
+    n_dev = len(jax.devices())
+    if n_dev >= 2:
+        mesh = _backlog_arm(n_nodes, n_pods, chunk, mesh_devices=0)
+        headline = mesh
+        speedup = round(
+            mesh["backlog_drain_pods_per_sec"]
+            / max(one["backlog_drain_pods_per_sec"], 1e-9),
+            3,
+        )
+    else:
+        mesh = {
+            "skipped": (
+                f"only {n_dev} device visible; the mesh arm needs a "
+                "multi-device node-axis mesh"
+            )
+        }
+        headline = one
+        speedup = None
+    return {
+        "config": (
+            f"{n_pods} queued pods drained against {n_nodes} nodes "
+            "through drain_backlog: HBM-budget-planned chunks through "
+            "the streaming ring, cross-batch occupancy chaining on a "
+            "hard (zone-spread) shape, 1-device vs full-mesh A/B; "
+            "single-shot auction with scarcity repair at the same "
+            "shape"
+        ),
+        "one_device": one,
+        "mesh": mesh,
+        "backlog_drain_pods_per_sec": headline[
+            "backlog_drain_pods_per_sec"
+        ],
+        "backlog_drain_seconds": headline["backlog_drain_seconds"],
+        "backlog_p99_pod_latency_s": headline[
+            "sustained_p99_pod_latency_s"
+        ],
+        "backlog_mesh_speedup": speedup,
+        **_backlog_auction(n_nodes, n_pods),
+    }
+
+
 def ladder7_multichip() -> dict:
     """#7: multichip A/B — the exact-parity grouped SESSION solve at the
     north-star shape (51,200 x 10,240) on 1 device vs the full node-axis
@@ -1540,6 +1804,8 @@ def main() -> None:
     ladders["8_fleet"] = fleet
     degraded = ladder9_degraded()
     ladders["9_degraded"] = degraded
+    backlog = ladder11_backlog_drain()
+    ladders["11_backlog_drain"] = backlog
     rebalance = ladder10_rebalance_loop()
     ladders["10_rebalance_loop"] = {
         "config": (
@@ -1623,6 +1889,17 @@ def main() -> None:
                 ],
                 "rebalance_plan_solve_s": rebalance[
                     "rebalance_plan_solve_s"
+                ],
+                # ladder #11 hoist (ISSUE 12): the 10x-scale backlog
+                # drain — 512k pods against 102,400 nodes through the
+                # HBM-budget-planned chunked streaming path — end-to-
+                # end drain rate and wall time (mesh arm when a mesh
+                # ran, 1-device otherwise)
+                "backlog_drain_pods_per_sec": backlog[
+                    "backlog_drain_pods_per_sec"
+                ],
+                "backlog_drain_seconds": backlog[
+                    "backlog_drain_seconds"
                 ],
                 "vs_baseline": round(headline / BAND_TOP_PODS_PER_SEC, 2),
                 "baseline_note": (

@@ -10,7 +10,16 @@ without one is called out specifically (the classic weak-float leak).
 
 Positional dtypes count (``jnp.zeros(n, jnp.int32)``), as does
 ``dtype=``; ``jnp.zeros_like``/``astype`` are inherently typed and out
-of scope.
+of scope of the constructor check.
+
+A second clause polices NARROW FLATTENED INDICES (the 512k x 102k
+scale audit, ISSUE 12): ``(a * n + b).astype(jnp.int32)`` — a product
+of index-like values narrowed to a sub-64-bit integer in the same
+expression. At pod·node scale (5.2e10) such a product wraps int32
+silently on device; the flattening must happen in int64 (or the
+operands must be provably clamped first, in which case the narrowing
+belongs on a separate named value with the bound in a comment, which
+also moves it out of this purely syntactic check's reach).
 """
 
 from __future__ import annotations
@@ -30,6 +39,38 @@ def _has_float_literal(expr: ast.expr) -> bool:
     )
 
 
+_NARROW_INT_DTYPES = {"int32", "int16", "int8"}
+
+
+def _is_narrow_int_dtype(expr: ast.expr) -> bool:
+    """jnp.int32 / np.int32 (and narrower) attribute references."""
+    return (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id in ("jnp", "np", "numpy")
+        and expr.attr in _NARROW_INT_DTYPES
+    )
+
+
+def _has_mult(expr: ast.expr) -> bool:
+    return any(
+        isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult)
+        for n in ast.walk(expr)
+    )
+
+
+def _looks_float(expr: ast.expr) -> bool:
+    """Float-arithmetic receivers (score normalization narrowed to its
+    documented 0..100 range) are not index flattening: a float literal
+    or a true division anywhere in the expression marks them."""
+    if _has_float_literal(expr):
+        return True
+    return any(
+        isinstance(n, ast.BinOp) and isinstance(n.op, ast.Div)
+        for n in ast.walk(expr)
+    )
+
+
 class DtypeDisciplinePass(Pass):
     rule = "TPU003"
     title = "missing explicit dtype"
@@ -42,6 +83,41 @@ class DtypeDisciplinePass(Pass):
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
+            # narrow flattened index: (a * n + b).astype(jnp.int32) —
+            # the product may exceed 2^31 at pod·node scale and the
+            # narrowing masks the wrap (widen to int64 before
+            # flattening, or clamp into a named value first)
+            astype_dtype = None
+            if isinstance(f, ast.Attribute) and f.attr == "astype":
+                if len(node.args) == 1 and not node.keywords:
+                    astype_dtype = node.args[0]
+                elif not node.args:
+                    astype_dtype = next(
+                        (
+                            kw.value
+                            for kw in node.keywords
+                            if kw.arg == "dtype"
+                        ),
+                        None,
+                    )
+            if (
+                astype_dtype is not None
+                and _is_narrow_int_dtype(astype_dtype)
+                and _has_mult(f.value)
+                and not _looks_float(f.value)
+            ):
+                findings.append(
+                    Finding(
+                        self.rule, module.path, node.lineno,
+                        "flattened-index product narrowed to a "
+                        "sub-64-bit integer in one expression (the "
+                        "product can wrap before the cast)",
+                        hint="flatten in int64 (astype(jnp.int64) on "
+                        "the operands) or clamp into a named value "
+                        "whose bound a comment states, then narrow",
+                    )
+                )
+                continue
             if not (
                 isinstance(f, ast.Attribute)
                 and isinstance(f.value, ast.Name)

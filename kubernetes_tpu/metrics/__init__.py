@@ -254,6 +254,47 @@ d2h_bytes_total = Counter(
     registry=REGISTRY,
 )
 
+# -- backlog drain (Scheduler.drain_backlog, ISSUE 12) --
+
+backlog_chunks_total = Counter(
+    "scheduler_backlog_chunks_total",
+    "Chunk-aligned sub-batches a backlog drain dispatched through the "
+    "streaming ring (Scheduler.drain_backlog): the 512k-pod backlog "
+    "cut into budget-sized chunks chained against the resident "
+    "session.",
+    registry=REGISTRY,
+)
+backlog_budget_splits_total = Counter(
+    "scheduler_backlog_budget_splits_total",
+    "Chunk halvings the HBM budget planner (solver/budget.py "
+    "plan_chunk) took before the drain chunk fit the per-device "
+    "budget — the auto-split that replaces an OOM mid-drain.",
+    registry=REGISTRY,
+)
+backlog_drain_seconds = Histogram(
+    "scheduler_backlog_drain_seconds",
+    "End-to-end wall time of one Scheduler.drain_backlog pass "
+    "(queue full -> backlog drained through the streaming ring).",
+    buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0),
+    registry=REGISTRY,
+)
+backlog_hbm_estimated_bytes = Gauge(
+    "scheduler_backlog_hbm_estimated_bytes",
+    "The HBM budget model's predicted host->device upload bytes for "
+    "the last backlog drain (solver/budget.py ShapeEstimate: fresh "
+    "session + per-chunk uploads). Compare against "
+    "scheduler_backlog_hbm_measured_bytes — the pair is what makes "
+    "the capacity-planning model checkable in production.",
+    registry=REGISTRY,
+)
+backlog_hbm_measured_bytes = Gauge(
+    "scheduler_backlog_hbm_measured_bytes",
+    "Measured scheduler_tpu_host_to_device_bytes_total delta across "
+    "the last backlog drain — the ground truth the HBM budget "
+    "model's estimate is validated against.",
+    registry=REGISTRY,
+)
+
 # -- crash-restart recovery + commit fencing --
 
 restart_recovery_seconds = Histogram(

@@ -77,6 +77,10 @@ class Explanation:
                 bits.append(f"nominated={rec['nominated']}")
             if rec.get("attempts"):
                 bits.append(f"attempt {rec['attempts']}")
+            if rec.get("drain_chunk") is not None:
+                # backlog drains (Scheduler.drain_backlog) tag records
+                # with the chunk that solved them
+                bits.append(f"drain_chunk={rec['drain_chunk']}")
             line = "    " + " ".join(bits)
             if rec.get("plugins"):
                 line += f"  [{summarize_plugins(rec['plugins'])}]"

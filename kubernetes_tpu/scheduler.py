@@ -89,6 +89,16 @@ class SchedulerConfig:
     # both HBM held by in-flight solves and the bind latency a pod can
     # accrue behind later dispatches.
     stream_depth: int = 4
+    # backlog drain (drain_backlog, ISSUE 12): pods per drain chunk fed
+    # through the streaming ring against the resident session. 0 = plan
+    # from the HBM budget model (solver/budget.py) starting at
+    # batch_size; the planner halves group-aligned until the chunk's
+    # per-device estimate fits the budget (auto-split instead of OOM).
+    backlog_chunk_pods: int = 0
+    # per-device HBM budget the drain planner asserts chunk shapes
+    # against. 0 = auto (PJRT bytes_limit, else the conservative
+    # solver/budget.py default floor).
+    hbm_budget_bytes: int = 0
     # defaultpreemption: run the PostFilter dry-run for unschedulable pods
     enable_preemption: bool = True
     # node-axis mesh for the device solve (parallel/sharding.py): number
@@ -248,6 +258,33 @@ class BatchResult:
             or self.quarantined
             or self.rebalance_evictions
         )
+
+
+@dataclass
+class BacklogDrainReport:
+    """What one ``Scheduler.drain_backlog`` pass did, for the bench
+    ladder, the sim footer, and operators (the same numbers back the
+    ``scheduler_backlog_*`` metrics). ``results`` holds the underlying
+    per-chunk BatchResults so callers can fold them into their own
+    accounting (the sim's bind tracker, the bench's latency pool)."""
+
+    pods: int = 0  # backlog size at drain start
+    drained: int = 0  # pods bound by this pass
+    unschedulable: int = 0
+    chunks: int = 0  # streaming batches dispatched
+    chunk_pods: int = 0  # planned chunk size (post budget splits)
+    budget_splits: int = 0  # halvings the HBM planner took
+    budget_bytes: int = 0  # per-device budget asserted against
+    drain_seconds: float = 0.0
+    pods_per_sec: float = 0.0
+    p99_e2e_latency_s: float = 0.0  # first queue entry -> bind commit
+    median_chunk_solve_s: float = 0.0  # per the ladder-#10 convention
+    stream_chained_batches: int = 0  # cross-batch carry chains engaged
+    chain_fraction: float = 0.0  # chained / (chunks - 1)
+    estimated_per_device_bytes: int = 0  # HBM model, resident worst case
+    estimated_h2d_bytes: int = 0  # HBM model's predicted upload total
+    measured_h2d_bytes: int = 0  # h2d counter delta over the drain
+    results: list = field(default_factory=list)
 
 
 @dataclass
@@ -501,6 +538,13 @@ class Scheduler:
         self._streaming_active = False
         self._reads_hidden = 0
         self._reads_paid = 0
+        # backlog drain (drain_backlog): while active, dispatch spans
+        # and journal records carry the drain-chunk id (prep.step -
+        # base) so `obs explain` attributes a pod to the chunk that
+        # placed it. Driver thread only; _note_drain_chunk points the
+        # journal tag at the chunk about to write records.
+        self._backlog_drain_active = False
+        self._drain_chunk_base = 0
         # reusable port-occupancy staging (tensorize/plugins.PortStaging):
         # consecutive tensorizes against an unchanged cache — exactly the
         # streaming burst window — skip the placed-pod port re-scan
@@ -1492,6 +1536,7 @@ class Scheduler:
             "batched solve failed (%s, %d pods): %r",
             reason, len(infos), exc, extra={"step": step},
         )
+        self._note_drain_chunk(step)
         if self.journal is not None:
             for info in infos:
                 self.journal.record(
@@ -1565,6 +1610,7 @@ class Scheduler:
                 f"quarantined: the batched solve fails whenever this "
                 f"pod is included: {exc!r}", type_="Warning",
             )
+            self._note_drain_chunk(self._trace_step)
             if self.journal is not None:
                 self.journal.record(
                     self._trace_step, cycle, pod, "quarantined",
@@ -2122,12 +2168,19 @@ class Scheduler:
             hook(prep.pods, tier_name)
         mesh = self.mesh if tier_name == TIER_MESH else None
         t1 = self.clock.perf()
+        # backlog drains thread the chunk id into the dispatch span so
+        # `obs explain` can attribute a pod to its drain chunk
+        span_extra = (
+            {"drain_chunk": prep.step - self._drain_chunk_base}
+            if self._backlog_drain_active
+            else {}
+        )
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
         with self.obs.span(
             "dispatch", trace_id=prep.step, profile=prep.profile,
             defer=defer, healed=heal_stale, split=split,
-            mesh_devices=self._mesh_devices,
+            mesh_devices=self._mesh_devices, **span_extra,
         ), _tier_device_context(tier_name):
             handle = solver.solve(
                 prep.batch, prep.pbatch, prep.static, prep.ports,
@@ -3309,6 +3362,19 @@ class Scheduler:
                 return False
         return True
 
+    def _note_drain_chunk(self, step: int) -> None:
+        """While a backlog drain is active, point the journal's
+        drain_chunk tag at the chunk (trace step) whose records are
+        about to be written. Derived PER CALL SITE — apply, discard,
+        solver failure, quarantine — so failure-path records attribute
+        to THEIR chunk, not whichever flight last applied (with a full
+        stream ring those differ by up to stream_depth chunks). Driver
+        thread only; drain_backlog pops the tag when the pass ends."""
+        if self._backlog_drain_active and self.journal is not None:
+            self.journal.tags["drain_chunk"] = (
+                step - self._drain_chunk_base
+            )
+
     def _discard_flight(self, flight: _InFlightSolve) -> None:
         """Drop a stale (or salvaged) deferred solve. The pods retry at
         the head of the active queue with no backoff (the failure is the
@@ -3321,6 +3387,7 @@ class Scheduler:
         be chained on it)."""
         metrics.solves_discarded_total.inc()
         prep = flight.prep
+        self._note_drain_chunk(prep.step)
         if prep.step != self._last_discard_step:
             self._discard_streak += 1
             self._last_discard_step = prep.step
@@ -3357,6 +3424,7 @@ class Scheduler:
         pending: list = []
         prep = flight.prep
         infos = flight.infos()
+        self._note_drain_chunk(prep.step)
         # ktpu: ignore[LOCK001]: deliberately unlocked pre-check — a torn read can only misroute to the locked re-check inside _apply_group or to a discard, both safe
         fence_fresh = prep.fence == self._conflict_seq
         # ktpu: ignore[LOCK001]: same deliberately unlocked pre-check; the locked re-check inside _apply_group is authoritative
@@ -4213,6 +4281,207 @@ class Scheduler:
             stream=True, chain=chain, chain_key=chain_key,
         )
         return got if isinstance(got, list) else [got]
+
+    # -- backlog drain (the accelerator-resident mega-backlog path) --
+
+    def drain_shape(self, chunk_pods: int, sample: int = 256):
+        """The HBM budget model's inputs for draining THIS scheduler's
+        queue in ``chunk_pods``-sized chunks (solver/budget.DrainShape):
+        node count and padding discipline from the live cache/snapshot,
+        per-family activity and row widths from a bounded sample of the
+        queued pods (a 512k-pod backlog is never walked in full — the
+        floor pads cover the unsampled tail conservatively, and an
+        underestimate degrades to a budget miss caught by the real
+        counters, never to a wrong solve)."""
+        from .solver.budget import DrainShape, node_padding
+        from .tensorize.plugins import PORT_PAD
+        from .tensorize.schema import bucket_pow2
+
+        with self.cluster.lock:
+            n_nodes = sum(
+                1
+                for info in self.cache.nodes.values()
+                if info.node is not None
+            )
+            keys = list(self.queue.entries().keys())[:sample]
+        vocab_k = (
+            len(self.snapshot.batch.vocab)
+            if self.snapshot.batch is not None
+            else 3
+        )
+        ports: set[int] = set()
+        spread = interpod = False
+        classes: set[tuple] = set()
+        for key in keys:
+            ns, name = key.split("/", 1)
+            try:
+                pod = self.cluster.get_pod(ns, name)
+            except ApiError:
+                continue
+            ports.update(pod.host_ports())
+            if pod.topology_spread_constraints:
+                spread = True
+            if pod.affinity is not None and (
+                pod.affinity.pod_affinity is not None
+                or pod.affinity.pod_anti_affinity is not None
+            ):
+                interpod = True
+            req = pod.resource_request()
+            classes.add(
+                (
+                    req.get("cpu", 0),
+                    req.get("memory", 0),
+                    tuple(sorted(pod.host_ports())),
+                )
+            )
+        pad_mult = self.snapshot.pad_multiple
+        inst = 8  # the tensorizers' INST_PAD floor
+        return DrainShape(
+            nodes=max(n_nodes, 1),
+            chunk_pods=chunk_pods,
+            vocab_k=vocab_k,
+            classes=min(len(classes) or 1, 64),
+            spread=spread,
+            interpod=interpod,
+            port_rows=max(bucket_pow2(len(ports), floor=PORT_PAD), PORT_PAD)
+            if ports
+            else PORT_PAD,
+            spread_rows=inst,
+            ipa_in_rows=inst,
+            ipa_ex_rows=inst,
+            # hostname topologies make every node its own domain: bound
+            # the index audit by the node padding whenever a domain
+            # family is active at all (conservative — d_pad is not in
+            # the byte model, only the overflow clauses)
+            d_pad=node_padding(max(n_nodes, 1), pad_mult)
+            if (spread or interpod)
+            else 8,
+            mesh_devices=self._mesh_devices,
+            group=max(self.solver.config.group_size, 1),
+            stream_depth=max(self.config.stream_depth, 1),
+            pad_multiple=pad_mult,
+        )
+
+    def drain_backlog(
+        self,
+        *,
+        chunk_pods: int = 0,
+        budget_bytes: int = 0,
+        max_batches: int = 1_000_000,
+    ) -> BacklogDrainReport:
+        """Drain the queued backlog through the streaming dispatcher in
+        chunk-aligned sub-batches against the resident session — the
+        512k-pods x 102k-nodes path (ISSUE 12). The pod axis is cut
+        into budget-planned chunks (one popped batch each) that stream
+        down ``run_streaming``'s slot ring; cross-batch occupancy
+        chaining keeps the port/spread/interpod carry device-resident
+        across the whole drain, so hard shapes stop paying a
+        drain-and-retensorize per chunk.
+
+        Before anything dispatches, the HBM budget model
+        (solver/budget.py) computes the chunk shape's per-device
+        footprint from the same pad_multiple/LANE discipline the
+        tensorizers use and asserts it against ``budget_bytes``
+        (default: the PJRT-reported device limit). An over-budget
+        chunk AUTO-SPLITS — the planner halves group-aligned,
+        ``scheduler_backlog_budget_splits_total`` counts it — instead
+        of OOMing mid-drain; a shape that cannot fit at any chunk size
+        raises the typed ``BudgetExceeded`` with nothing dispatched.
+
+        The estimate and the measured h2d counter delta are exported
+        as the ``scheduler_backlog_hbm_*_bytes`` gauge pair so the
+        model stays checkable in production."""
+        from .solver import budget as hbm
+
+        with self.cluster.lock:
+            backlog = len(self.queue)
+        report = BacklogDrainReport(pods=backlog)
+        if backlog == 0:
+            return report
+        base_chunk = (
+            chunk_pods
+            or self.config.backlog_chunk_pods
+            or self.config.batch_size
+        )
+        budget = hbm.device_budget_bytes(
+            budget_bytes or self.config.hbm_budget_bytes
+        )
+        shape = self.drain_shape(base_chunk)
+        est, splits = hbm.plan_chunk(shape, budget)  # BudgetExceeded -> caller
+        chunk = est.chunk_pods
+        compact = self.solver.config.compact_wire
+        per_chunk = (
+            est.chunk_upload_bytes_compact
+            if compact
+            else est.chunk_upload_bytes
+        )
+        n_chunks_est = max((backlog + chunk - 1) // chunk, 1)
+        est_h2d = est.session_upload_bytes + (n_chunks_est - 1) * per_chunk
+        metrics.backlog_budget_splits_total.inc(splits)
+        metrics.backlog_hbm_estimated_bytes.set(est_h2d)
+        self._log.info(
+            "backlog drain: %d pods in %d-pod chunks (%d budget splits, "
+            "%d B/device estimated vs %d B budget)",
+            backlog, chunk, splits, est.per_device_bytes, budget,
+            extra={"step": self._trace_step},
+        )
+
+        old_batch = self.config.batch_size
+        self.config.batch_size = chunk
+        self._backlog_drain_active = True
+        self._drain_chunk_base = self._trace_step
+        steps0 = self._trace_step
+        h2d0 = metrics.h2d_bytes_total._value.get()
+        chained0 = sum(
+            s.dispatch_counts.get("stream_chained", 0)
+            for s in self.solvers.values()
+        )
+        t0 = self.clock.perf()
+        try:
+            results = self.run_streaming(max_batches=max_batches)
+        finally:
+            self.config.batch_size = old_batch
+            self._backlog_drain_active = False
+            if self.journal is not None:
+                self.journal.tags.pop("drain_chunk", None)
+        dt = self.clock.perf() - t0
+
+        report.results = results
+        report.drained = sum(len(r.scheduled) for r in results)
+        report.unschedulable = sum(len(r.unschedulable) for r in results)
+        report.chunks = self._trace_step - steps0
+        report.chunk_pods = chunk
+        report.budget_splits = splits
+        report.budget_bytes = budget
+        report.drain_seconds = dt
+        report.pods_per_sec = report.drained / dt if dt > 0 else 0.0
+        lats = sorted(x for r in results for x in r.e2e_latencies)
+        if lats:
+            report.p99_e2e_latency_s = lats[int(0.99 * (len(lats) - 1))]
+        solves = sorted(
+            r.solve_seconds for r in results if r.solve_seconds > 0
+        )
+        if solves:
+            report.median_chunk_solve_s = solves[len(solves) // 2]
+        report.stream_chained_batches = (
+            sum(
+                s.dispatch_counts.get("stream_chained", 0)
+                for s in self.solvers.values()
+            )
+            - chained0
+        )
+        report.chain_fraction = report.stream_chained_batches / max(
+            report.chunks - 1, 1
+        )
+        report.estimated_per_device_bytes = est.per_device_bytes
+        report.estimated_h2d_bytes = est_h2d
+        report.measured_h2d_bytes = int(
+            metrics.h2d_bytes_total._value.get() - h2d0
+        )
+        metrics.backlog_chunks_total.inc(report.chunks)
+        metrics.backlog_drain_seconds.observe(dt)
+        metrics.backlog_hbm_measured_bytes.set(report.measured_h2d_bytes)
+        return report
 
     @property
     def pending(self) -> int:

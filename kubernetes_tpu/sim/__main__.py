@@ -83,6 +83,14 @@ def _print_result(res) -> None:
             f"recloses={resil['recloses']} "
             f"quarantined={len(s['quarantined'])} tier={tiers}"
         )
+    bl = s.get("backlog")
+    if bl:
+        print(
+            f"  backlog: pods={bl['pods']} drained={bl['drained']} "
+            f"chunks={bl['chunks']} chunk_pods={bl['chunk_pods']} "
+            f"budget_splits={bl['budget_splits']} "
+            f"stream_chained={bl['stream_chained']}"
+        )
     reb = s.get("rebalance")
     if reb:
         print(

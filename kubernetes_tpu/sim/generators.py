@@ -140,9 +140,17 @@ class ChurnGenerator:
         p, rng = self.profile, self.rng
         events: list[dict] = []
 
+        # backlog seeding (backlog_drain profiles): the mega-backlog
+        # lands as ordinary cycle-0 create_pod events — same hard-shape
+        # draw, same trace/replay machinery — BEFORE the cycle's
+        # arrivals, so cycle 0's drive sees the full backlog queued
+        n_arrivals = rng.randint(*p.arrivals)
+        if cycle == 0 and p.backlog:
+            n_arrivals += p.backlog
+
         # pod arrivals (shape drawn per arrival in a fixed order so the
         # stream is a pure function of the gen RNG)
-        for _ in range(rng.randint(*p.arrivals)):
+        for _ in range(n_arrivals):
             shape, port = "plain", 0
             if p.pod_spread_rate and rng.random() < p.pod_spread_rate:
                 shape = "spread"

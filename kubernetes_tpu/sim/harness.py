@@ -323,6 +323,9 @@ class SimHarness:
         self._sched_bound: set[str] = set()
         self._events_applied = 0
         self._extender_aborts = 0
+        # backlog drain (backlog_drain profiles): cycle 0's
+        # drain_backlog report, surfaced in the footer summary
+        self._backlog_report = None
         self._counters0 = {
             k: _counter_value(c) for k, c in _DELTA_COUNTERS.items()
         }
@@ -403,6 +406,28 @@ class SimHarness:
         )
 
     def _drive_once(self, cycle: int) -> None:
+        if self.profile.backlog and cycle == 0 and self.streaming:
+            # the seeded mega-backlog drains through the HBM-budget-
+            # planned chunked streaming path (Scheduler.drain_backlog).
+            # backlog_force_split hands the planner a budget one byte
+            # below the base chunk's own estimate, so the auto-split
+            # path engages deterministically (the CI smoke pins
+            # budget_splits >= 1 off this)
+            from ..solver import budget as hbm
+
+            chunk = self.profile.backlog_chunk or self.profile.batch_size
+            budget_bytes = 0
+            if self.profile.backlog_force_split:
+                shape = self.scheduler.drain_shape(chunk)
+                budget_bytes = hbm.estimate(shape).per_device_bytes - 1
+            report = self.scheduler.drain_backlog(
+                chunk_pods=chunk, budget_bytes=budget_bytes,
+            )
+            self._backlog_report = report
+            for r in report.results:
+                self.tracker.record_results(r.scheduled)
+                self._sched_bound.update(k for k, _ in r.scheduled)
+            return
         if self.streaming:
             try:
                 results = self.scheduler.run_streaming(max_batches=200)
@@ -722,6 +747,23 @@ class SimHarness:
             # history, eviction counts from the independent tracker,
             # PDB overruns (must be 0), final packed utilization
             "rebalance": rebalance_summary,
+            # backlog drain (backlog_drain profiles): counts only —
+            # all driver-side and deterministic, so same-seed runs
+            # stay byte-identical (wall timings deliberately excluded)
+            "backlog": (
+                {
+                    "pods": self._backlog_report.pods,
+                    "drained": self._backlog_report.drained,
+                    "chunks": self._backlog_report.chunks,
+                    "chunk_pods": self._backlog_report.chunk_pods,
+                    "budget_splits": self._backlog_report.budget_splits,
+                    "stream_chained": (
+                        self._backlog_report.stream_chained_batches
+                    ),
+                }
+                if self._backlog_report is not None
+                else None
+            ),
             # the journal digest rides in the footer, so the trace
             # selfcheck also proves journal byte-identity across runs
             # (all incarnations' lines, in incarnation order)

@@ -125,6 +125,22 @@ class Profile:
     # exercised non-vacuously — the rebalance invariant asserts none
     # of them ever moved
     pdb_guard_rate: float = 0.0
+    # -- backlog drain (Scheduler.drain_backlog, ISSUE 12) --
+    # pods seeded at cycle 0 BEFORE any churn (same hard-shape mix as
+    # arrivals, same event/trace machinery so replay works): cycle 0's
+    # drive then drains them through drain_backlog — the HBM-budget-
+    # planned, chunk-aligned streaming path — instead of a plain
+    # run_streaming call. 0 = off.
+    backlog: int = 0
+    # starting chunk size for the drain's budget planner (0 = the
+    # profile batch_size)
+    backlog_chunk: int = 0
+    # force the budget planner to auto-split: the harness computes the
+    # base chunk's per-device estimate and hands the drain a budget one
+    # byte BELOW it, so plan_chunk must halve at least once — the
+    # budget_splits>=1 the CI smoke pins, robust to estimator formula
+    # changes (an absolute byte figure here would not be)
+    backlog_force_split: bool = False
 
     def validate(self) -> None:
         if self.watch_delay and (
@@ -383,6 +399,32 @@ PROFILES: dict[str, Profile] = {
             bind_fault_rate=0.1,
             watch_delay=True,
             watch_dup_rate=0.1,
+        ),
+        # backlog_drain: a seeded mega-backlog (relative to the sim's
+        # scale) with a hard-shape mix, drained at cycle 0 through
+        # Scheduler.drain_backlog — the HBM-budget-planned chunked
+        # streaming path (ISSUE 12) — then delete churn and fresh
+        # arrivals over the drained cluster. backlog_force_split makes
+        # the budget planner halve the chunk at least once, so the CI
+        # smoke pins the auto-split path non-vacuously; the drain's
+        # chunk/split/chain counters ride the footer (byte-
+        # deterministic under --selfcheck like every profile). The
+        # backstop must never engage during the drain (fallbacks=0
+        # pinned by the smoke).
+        Profile(
+            name="backlog_drain",
+            streaming=True,
+            nodes=10,
+            zones=3,
+            batch_size=16,
+            group_size=8,
+            backlog=96,
+            backlog_chunk=16,
+            backlog_force_split=True,
+            arrivals=(1, 3),
+            pod_spread_rate=0.25,
+            pod_ports_rate=0.2,
+            delete_pod_rate=0.6,
         ),
         # replica_loss: fleet_mixed plus one replica killed mid-drive.
         # The survivors must re-own its shard (ring orphan

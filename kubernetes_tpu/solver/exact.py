@@ -846,10 +846,24 @@ def _solve_grouped(
                                 jnp.cumsum(dom_present.astype(jnp.int32))
                                 - 1
                             )
+                            # clamp the scattered rank to `group` before
+                            # the position product: accepted lanes have
+                            # rank < kk <= group (values unchanged), and
+                            # unaccepted lanes' positions are never read
+                            # — without the clamp, rank_n * d_present
+                            # reaches node_pad * d_pad (~1.7e10 at the
+                            # 512k x 102k hostname-domain shape) and
+                            # wraps int32 (solver/budget.py
+                            # assert_index_headroom polices the clamped
+                            # bound host-side)
                             rank_n = (
                                 jnp.zeros(n, dtype=jnp.int32)
                                 .at[si]
-                                .set(rank.astype(jnp.int32))
+                                .set(
+                                    jnp.minimum(rank, group).astype(
+                                        jnp.int32
+                                    )
+                                )
                             )
                             pos = rank_n * d_present + d_rank[dd]
                             return accept, pos.astype(jnp.int32)
@@ -1806,6 +1820,19 @@ class ExactSolver:
         use_interpod = not interpod.empty
         use_nominated = nominated is not None and not nominated.empty
         session = col_versions is not None
+
+        # index-dtype audit (solver/budget.py): the flattened-index
+        # products this dispatch's compiled program forms must fit
+        # their container dtypes — a 2^31-scale shape fails loudly
+        # here instead of silently wrapping on device. Host ints, ~ns.
+        from .budget import assert_index_headroom
+
+        assert_index_headroom(
+            pods.padded,
+            nodes.padded,
+            d_pad=max(spread.d_pad, interpod.d_pad),
+            group=max(cfg.group_size, 1),
+        )
 
         h2d_bytes = 0
         if session:

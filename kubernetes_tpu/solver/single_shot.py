@@ -371,6 +371,13 @@ class SingleShotSolver:
         (tests/test_sharding.py asserts bit-equality on an 8-way mesh)."""
         if static is None:
             static = trivial_static_tensors(pods, nodes.padded, nodes.schedulable)
+        # index-dtype audit (solver/budget.py): the admission sort key
+        # (target << 32 + inv_prio) and the class-rank key (rc * P +
+        # idx) must fit int64 at this shape — typed failure at dispatch
+        # instead of a silent device-side wrap at 2^31-scale inputs
+        from .budget import assert_index_headroom
+
+        assert_index_headroom(pods.padded, nodes.padded)
         rc_req, rc_static, rc_of = request_classes(pods, static)
         args = [
             nodes.allocatable,

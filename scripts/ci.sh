@@ -90,6 +90,26 @@ python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile crash_restart \
 python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile fleet_mixed \
     --fleet 2 --dispatcher streaming --selfcheck
 
+echo "== backlog drain smoke: HBM-budget-planned chunked streaming =="
+# backlog_drain: a seeded mega-backlog (sim-relative) drained at cycle 0
+# through Scheduler.drain_backlog — chunk size planned by the HBM budget
+# model (solver/budget.py), chunks streamed down the ring with cross-
+# batch occupancy chaining, then delete churn + fresh arrivals. The
+# profile forces the budget planner to auto-split (budget one byte
+# below the base chunk's own estimate), so the grep pins the split
+# path engaging non-vacuously (budget_splits >= 1); the drain must
+# never trip the livelock backstop (fallbacks=0). --selfcheck proves
+# the whole budget-plan -> chunk -> chain pipeline byte-deterministic.
+backlog_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 4 \
+    --profile backlog_drain --selfcheck)
+echo "$backlog_out"
+echo "$backlog_out" | grep -qE "budget_splits=[1-9]" \
+    || { echo "BACKLOG SMOKE: the budget auto-split never engaged"; exit 1; }
+echo "$backlog_out" | grep -qE "fallbacks=0 " \
+    || { echo "BACKLOG SMOKE: the drain engaged the livelock backstop"; exit 1; }
+echo "$backlog_out" | grep -qE "stream_chained=[0-9]+" \
+    || { echo "BACKLOG SMOKE: no chain accounting in the footer"; exit 1; }
+
 echo "== chaos smoke: solver fallback ladder + poison quarantine =="
 # solver_flaky: every device-tier solve fails during the fault window
 # (virtual t in [2,5)), then heals. The run's resilience invariant

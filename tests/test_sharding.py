@@ -303,6 +303,84 @@ def test_padding_rows_never_bound(mesh):
     assert int((asg >= 0).sum()) == 8
 
 
+def test_pad_multiple_at_100k_nodes_mesh8(mesh):
+    """Snapshot.pad_multiple at 10x-proven-scale node counts (ISSUE 12
+    satellite): with >= 100k NON-multiple node counts under the virtual
+    mesh-8, the padding honors lcm(LANE, devices), padded columns stay
+    masked (valid=False/schedulable=False) across delete churn, and a
+    real sharded session solve never binds a padding row. Property-
+    swept over several awkward counts host-side (the cheap part); the
+    solve runs once at the largest."""
+    import math
+
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.server.bulk import columnar_pod_batch
+    from kubernetes_tpu.solver.exact import ExactSolver, ExactSolverConfig
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.snapshot import Snapshot
+    from kubernetes_tpu.tensorize.schema import LANE
+
+    q = math.lcm(LANE, N_DEVICES)
+
+    def build(n):
+        cache = SchedulerCache()
+        for i in range(n):
+            cache.add_node(
+                Node(
+                    name=f"n{i:06}",
+                    allocatable={
+                        "cpu": 16_000, "memory": 64 << 30, "pods": 110
+                    },
+                )
+            )
+        snap = Snapshot()
+        snap.pad_multiple = N_DEVICES
+        return cache, snap, snap.update(cache)
+
+    def check_padding(b, n_live, fresh=True):
+        assert b.padded % q == 0 and b.padded >= n_live
+        assert int(b.valid.sum()) == n_live
+        assert int(b.schedulable.sum()) == n_live
+        # every non-live column is masked out of filter/score/argmax
+        pad = ~b.valid
+        assert not b.schedulable[pad].any()
+        if fresh:
+            # never-written padding columns also hold impossible values
+            # (churn-freed slots keep stale numbers by design — the
+            # valid/schedulable mask is the guard, asserted above)
+            assert int(b.allocatable[:, pad].sum()) == 0
+
+    # host-side property sweep: awkward non-multiple counts >= 100k
+    # (prime-ish, q-1, q+1 offsets) all honor the discipline
+    for n in (100_003, 100_608 - 1, 100_608 + 1, 102_400 + 7):
+        _, _, b = build(n)
+        check_padding(b, n)
+
+    # full path at the largest count: delete churn, then a SHARDED
+    # session solve — no binding may reference a padding/invalid slot
+    n = 102_407
+    cache, snap, b = build(n)
+    for i in range(0, 512, 2):
+        cache.remove_node(f"n{i:06}")
+    b = snap.update(cache)
+    check_padding(b, n - 256, fresh=False)
+    pb = columnar_pod_batch(
+        np.full(16, 250, np.int64),
+        np.full(16, 512 << 20, np.int64),
+        None,
+        b.vocab,
+    )
+    solver = ExactSolver(
+        ExactSolverConfig(tie_break="first", group_size=16)
+    )
+    asg = solver.solve(
+        b, pb, col_versions=snap.col_versions, mesh=mesh
+    )
+    assert int((asg >= 0).sum()) == 16
+    for slot in np.asarray(asg):
+        assert b.valid[slot], f"bound to padding/invalid slot {slot}"
+
+
 def test_sim_trace_device_count_invariant(mesh):
     """Same seed, same profile, different device count => byte-identical
     trace AND decision journal (the bit-exact invariance contract,

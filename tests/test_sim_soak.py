@@ -30,6 +30,32 @@ def test_soak_churn_heavy_deep_deterministic(seed):
     assert a.violations == [] and a.settled
 
 
+@pytest.mark.parametrize("seed", [5, 6])
+def test_soak_backlog_drain_mega(seed):
+    """The backlog_drain profile at soak scale (ISSUE 12): a 400-pod
+    seeded mega-backlog (sim-relative) with the hard-shape mix drained
+    through drain_backlog's budget-planned chunked streaming path,
+    byte-deterministic across runs, budget auto-split engaged, zero
+    invariant violations."""
+    import dataclasses
+
+    from kubernetes_tpu.sim import get_profile
+
+    prof = dataclasses.replace(
+        get_profile("backlog_drain"),
+        backlog=400,
+        nodes=24,
+        node_cpu="32",
+    )
+    a = run_sim(prof, seed=seed, cycles=12)
+    b = run_sim(prof, seed=seed, cycles=12)
+    assert a.violations == [], [v.as_dict() for v in a.violations]
+    assert a.settled
+    assert a.summary["backlog"]["budget_splits"] >= 1
+    assert a.summary["backlog"]["chunks"] >= 10
+    assert a.trace.digest() == b.trace.digest()
+
+
 def test_soak_sync_vs_pipelined_agree_on_quiet_cluster():
     """With no faults or churn racing mid-flight (node_flaps is prompt
     delivery), the pipelined and synchronous drivers must settle every

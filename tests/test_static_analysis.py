@@ -341,6 +341,46 @@ def test_tpu003_accepts_keyword_and_positional_dtype():
     assert not active(fs, "TPU003")
 
 
+def test_tpu003_fires_on_narrow_flattened_index():
+    # the 512k x 102k audit (ISSUE 12): a pod·node flattened index
+    # narrowed to int32 in the same expression wraps silently at scale
+    fs = findings_for(
+        """
+        import jax.numpy as jnp
+
+        def flatten(pod_ids, node_ids, n):
+            a = (pod_ids * n + node_ids).astype(jnp.int32)
+            b = (pod_ids * n + node_ids).astype(dtype=jnp.int32)
+            return a, b
+        """,
+        [DtypeDisciplinePass],
+        ctx=_DTYPE_CTX,
+    )
+    hits = active(fs, "TPU003")
+    assert len(hits) == 2  # positional AND keyword dtype forms
+    assert all("flattened-index" in f.message for f in hits)
+
+
+def test_tpu003_narrow_flatten_accepts_int64_and_float_scores():
+    fs = findings_for(
+        """
+        import jax.numpy as jnp
+
+        MAX_NODE_SCORE = 100
+
+        def ok(pod_ids, node_ids, n, frac):
+            wide = (pod_ids.astype(jnp.int64) * n + node_ids)
+            narrow_named = wide.astype(jnp.int32)  # named, not inline
+            score = ((1.0 - frac) * MAX_NODE_SCORE).astype(jnp.int32)
+            ratio = (frac * MAX_NODE_SCORE / 2).astype(jnp.int32)
+            return narrow_named, score, ratio
+        """,
+        [DtypeDisciplinePass],
+        ctx=_DTYPE_CTX,
+    )
+    assert not active(fs, "TPU003")
+
+
 def test_tpu003_scoped_to_configured_paths():
     fs = findings_for(
         "import jax.numpy as jnp\nx = jnp.zeros(3)\n",
