@@ -49,6 +49,7 @@ METRIC_SCAN_PATHS = (
     "kubernetes_tpu/obs/",
     "kubernetes_tpu/fleet/",
     "kubernetes_tpu/rebalance/",
+    "kubernetes_tpu/tuning/",
 )
 
 

@@ -65,6 +65,10 @@ def cmd_config(args) -> int:
             "enablePreemption": cfg.tpu_solver.enable_preemption,
             "groupSize": cfg.tpu_solver.group_size,
             "meshDevices": cfg.tpu_solver.mesh_devices,
+            "streamDepth": cfg.tpu_solver.stream_depth,
+            "pipelineSplit": cfg.tpu_solver.pipeline_split,
+            "backlogChunkPods": cfg.tpu_solver.backlog_chunk_pods,
+            "pallas": cfg.tpu_solver.pallas,
         },
         "rebalance": {
             "enabled": cfg.rebalance.enabled,
@@ -84,6 +88,16 @@ def cmd_config(args) -> int:
                 else None
             ),
             "maxRowAgeSeconds": cfg.fleet.max_row_age_seconds,
+            "flushBatch": cfg.fleet.flush_batch,
+        },
+        "tuning": {
+            "enabled": cfg.tuning.enabled,
+            "evalBatches": cfg.tuning.eval_batches,
+            "hysteresis": cfg.tuning.hysteresis,
+            "settleAfter": cfg.tuning.settle_after,
+            "maxProbes": cfg.tuning.max_probes,
+            "shiftThreshold": cfg.tuning.shift_threshold,
+            "knobs": cfg.tuning.knobs,
         },
         "warnings": cfg.warnings,
     }

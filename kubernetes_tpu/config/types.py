@@ -153,6 +153,10 @@ class FleetSection:
     mesh_slice: "tuple[int, int] | None" = None
     # occupancy-staleness bound (FleetConfig.max_row_age_s)
     max_row_age_seconds: float = 30.0
+    # write-behind flush batch for the remote hub adapter
+    # (FleetConfig.flush_batch); 0 = the adapter default. Auto-tunable
+    # (tuning knob "fleet_flush").
+    flush_batch: int = 0
 
 
 @dataclass
@@ -168,7 +172,96 @@ class TpuSolverSection:
     # 0 = all visible devices, 1 = force single-device, N > 1 = first N.
     # Results are bit-exactly device-count invariant.
     mesh_devices: int = 0
+    # streaming dispatcher work-ring depth (SchedulerConfig.stream_depth)
+    stream_depth: int = 4
+    # RTT-hiding batch split (SchedulerConfig.pipeline_split): 0 =
+    # adaptive (CounterWindow EWMA rule / the tuning controller), 1 =
+    # never split, > 1 = fixed cap
+    pipeline_split: int = 0
+    # backlog drain chunk (SchedulerConfig.backlog_chunk_pods): 0 =
+    # plan from the HBM budget model starting at batchSize
+    backlog_chunk_pods: int = 0
+    # Pallas-kernel tier (ExactSolverConfig.pallas): route the
+    # InterPodAffinity domain aggregation through the MXU kernel.
+    # Default off — see ops/pallas_kernels.py's measured decision.
+    pallas: bool = False
     single_shot: SingleShotSection = field(default_factory=SingleShotSection)
+
+
+# the tunable hot-path knobs (kubernetes_tpu/tuning runtime names);
+# kept literal here so parsing a config never imports the tuning (and
+# transitively metrics/prometheus) machinery
+TUNABLE_KNOBS = (
+    "backlog_chunk",
+    "stream_depth",
+    "pipeline_split",
+    "fleet_flush",
+)
+
+
+@dataclass
+class TuningSection:
+    """``tuning:`` — closed-loop hot-path auto-tuning
+    (kubernetes_tpu/tuning). Ours, like tpuSolver. ``knobs`` names what
+    the runtime may govern; to pin one knob statically, set its
+    tpuSolver/fleet value and drop it from the list (the tuned-profile
+    emitter writes exactly such a pinned document back out). An
+    explicit empty list pins EVERYTHING — the runtime is inert; an
+    absent key means all knobs."""
+
+    enabled: bool = False
+    eval_batches: int = 6
+    hysteresis: float = 0.05
+    settle_after: int = 2
+    max_probes: int = 16
+    shift_threshold: float = 0.75
+    knobs: list[str] = field(
+        default_factory=lambda: list(TUNABLE_KNOBS)
+    )
+
+
+def validate_tuning_params(
+    eval_batches: int,
+    hysteresis: float,
+    settle_after: int,
+    max_probes: int,
+    shift_threshold: float,
+    knobs,
+) -> None:
+    """The ONE home of the tuning-parameter range checks: the YAML
+    loader below and ``TuningConfig.validate`` (kubernetes_tpu/tuning/
+    runtime.py) both call it, so a bound change cannot land in one and
+    not the other. Pure — importable from config parsing without
+    dragging the tuning/metrics machinery in."""
+    if eval_batches < 1:
+        raise ValueError(
+            f"tuning.evalBatches must be >= 1 (got {eval_batches})"
+        )
+    if not 0.0 < hysteresis < 1.0:
+        raise ValueError(
+            f"tuning.hysteresis must be in (0, 1) (got {hysteresis})"
+        )
+    if settle_after < 1:
+        raise ValueError(
+            f"tuning.settleAfter must be >= 1 (got {settle_after})"
+        )
+    if max_probes < 1:
+        raise ValueError(
+            f"tuning.maxProbes must be >= 1 (got {max_probes})"
+        )
+    if shift_threshold <= 0:
+        raise ValueError(
+            f"tuning.shiftThreshold must be > 0 (got {shift_threshold})"
+        )
+    unknown = set(knobs) - set(TUNABLE_KNOBS)
+    if unknown:
+        # a typo'd knob name would silently leave the intended knob
+        # static — the quiet-misconfiguration failure mode, rejected
+        # hard like fleet.meshSlice
+        raise ValueError(
+            f"tuning.knobs: unknown {sorted(unknown)}; "
+            f"known: {list(TUNABLE_KNOBS)}"
+        )
 
 
 @dataclass
@@ -182,6 +275,7 @@ class KubeSchedulerConfiguration:
     tpu_solver: TpuSolverSection = field(default_factory=TpuSolverSection)
     rebalance: RebalanceSection = field(default_factory=RebalanceSection)
     fleet: FleetSection = field(default_factory=FleetSection)
+    tuning: TuningSection = field(default_factory=TuningSection)
     warnings: list[str] = field(default_factory=list)
 
     def profile_for(self, scheduler_name: str) -> Profile | None:
@@ -318,6 +412,10 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
         enable_preemption=bool(ts.get("enablePreemption", True)),
         group_size=int(ts.get("groupSize", 64)),
         mesh_devices=int(ts.get("meshDevices", 0)),
+        stream_depth=int(_nn(ts.get("streamDepth"), 4)),
+        pipeline_split=int(_nn(ts.get("pipelineSplit"), 0)),
+        backlog_chunk_pods=int(_nn(ts.get("backlogChunkPods"), 0)),
+        pallas=bool(_nn(ts.get("pallas"), False)),
         single_shot=SingleShotSection(
             max_rounds=int(ss.get("maxRounds") or 32),
             price_step=int(ss.get("priceStep") or 8),
@@ -331,6 +429,23 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
     )
     if cfg.tpu_solver.tie_break not in ("random", "first"):
         raise ValueError(f"tpuSolver.tieBreak: {cfg.tpu_solver.tie_break!r}")
+    if cfg.tpu_solver.stream_depth < 1:
+        raise ValueError(
+            "tpuSolver.streamDepth must be >= 1 "
+            f"(got {cfg.tpu_solver.stream_depth})"
+        )
+    if cfg.tpu_solver.pipeline_split < 0:
+        # 0 is the adaptive mode; a negative would silently behave as
+        # adaptive too — reject the ambiguity
+        raise ValueError(
+            "tpuSolver.pipelineSplit must be >= 0 "
+            f"(got {cfg.tpu_solver.pipeline_split})"
+        )
+    if cfg.tpu_solver.backlog_chunk_pods < 0:
+        raise ValueError(
+            "tpuSolver.backlogChunkPods must be >= 0 "
+            f"(got {cfg.tpu_solver.backlog_chunk_pods})"
+        )
     if cfg.tpu_solver.single_shot.repair_rounds < 0:
         # a negative would silently disable the repair phase (the
         # solver gates on > 0) — reject like the rebalance knobs do
@@ -381,7 +496,13 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
         hub_address=str(_nn(fl.get("hubAddress"), "")),
         mesh_slice=_parse_mesh_slice(fl.get("meshSlice")),
         max_row_age_seconds=float(_nn(fl.get("maxRowAgeSeconds"), 30.0)),
+        flush_batch=int(_nn(fl.get("flushBatch"), 0)),
     )
+    if cfg.fleet.flush_batch < 0:
+        raise ValueError(
+            "fleet.flushBatch must be >= 0 (0 = the adapter default; "
+            f"got {cfg.fleet.flush_batch})"
+        )
     if cfg.fleet.hub_address and ":" not in cfg.fleet.hub_address:
         raise ValueError(
             'fleet.hubAddress must be "host:port" '
@@ -405,6 +526,34 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
             "fleet.replica is required when any other fleet key is set "
             "(a replica must know its own identity)"
         )
+
+    tu = data.get("tuning") or {}
+    # knobs: an ABSENT key means all knobs; an explicit empty list
+    # means "govern nothing" (everything pinned) — the falsy-`or`
+    # shape would silently expand [] to all four, the exact quiet
+    # misconfiguration the unknown-knob check rejects hard
+    knobs_raw = tu.get("knobs")
+    cfg.tuning = TuningSection(
+        enabled=bool(_nn(tu.get("enabled"), False)),
+        eval_batches=int(_nn(tu.get("evalBatches"), 6)),
+        hysteresis=float(_nn(tu.get("hysteresis"), 0.05)),
+        settle_after=int(_nn(tu.get("settleAfter"), 2)),
+        max_probes=int(_nn(tu.get("maxProbes"), 16)),
+        shift_threshold=float(_nn(tu.get("shiftThreshold"), 0.75)),
+        knobs=(
+            list(TUNABLE_KNOBS)
+            if knobs_raw is None
+            else [str(k) for k in knobs_raw]
+        ),
+    )
+    validate_tuning_params(
+        cfg.tuning.eval_batches,
+        cfg.tuning.hysteresis,
+        cfg.tuning.settle_after,
+        cfg.tuning.max_probes,
+        cfg.tuning.shift_threshold,
+        cfg.tuning.knobs,
+    )
     return cfg
 
 
@@ -529,6 +678,7 @@ def _solver_config(cfg: KubeSchedulerConfiguration, p: Profile):
         disabled_filters=tuple(disabled),
         added_affinity=added,
         spread_defaulting=p.spread_defaulting_type,
+        pallas=cfg.tpu_solver.pallas,
     )
 
 
@@ -563,12 +713,28 @@ def scheduler_config(cfg: KubeSchedulerConfiguration):
             replicas=tuple(cfg.fleet.replicas),
             hub_address=cfg.fleet.hub_address,
             max_row_age_s=cfg.fleet.max_row_age_seconds,
+            flush_batch=cfg.fleet.flush_batch,
+        )
+    tuning = None
+    if cfg.tuning.enabled:
+        from ..tuning.runtime import TuningConfig
+
+        tuning = TuningConfig(
+            eval_batches=cfg.tuning.eval_batches,
+            hysteresis=cfg.tuning.hysteresis,
+            settle_after=cfg.tuning.settle_after,
+            max_probes=cfg.tuning.max_probes,
+            shift_threshold=cfg.tuning.shift_threshold,
+            knobs=tuple(cfg.tuning.knobs),
         )
     return SchedulerConfig(
         batch_size=cfg.tpu_solver.batch_size,
         enable_preemption=cfg.tpu_solver.enable_preemption,
         mesh_devices=cfg.tpu_solver.mesh_devices,
         mesh_slice=cfg.fleet.mesh_slice,
+        stream_depth=cfg.tpu_solver.stream_depth,
+        pipeline_split=cfg.tpu_solver.pipeline_split,
+        backlog_chunk_pods=cfg.tpu_solver.backlog_chunk_pods,
         solver=profiles[cfg.profiles[0].scheduler_name],
         profiles=profiles,
         # honored, not just parsed: the scheduler consults these via the
@@ -576,4 +742,5 @@ def scheduler_config(cfg: KubeSchedulerConfiguration):
         extenders=tuple(cfg.extenders),
         rebalance=rebalance,
         fleet=fleet,
+        tuning=tuning,
     )

@@ -93,6 +93,12 @@ class FleetConfig:
     # rows that may hide peers' placements. Ownership-only pods are
     # unaffected (disjoint shards need no row exchange).
     max_row_age_s: float = 30.0
+    # write-behind flush batch for the remote hub adapter (config key
+    # fleet.flushBatch): plain row mutations buffer client-side and
+    # land as ONE apply_ops RPC at this cap. Auto-tunable at runtime
+    # (kubernetes_tpu/tuning, knob "fleet_flush"); 0 = the adapter's
+    # built-in default. In-process hubs ignore it (no wire to batch).
+    flush_batch: int = 0
 
     def __post_init__(self) -> None:
         if not self.replicas:
@@ -147,10 +153,16 @@ class RemoteOccupancyExchange:
     retried; the wholesale resync republish supersedes it either way.
     """
 
-    _BUFFER_CAP = 256
+    _BUFFER_CAP = 256  # default flush batch (FleetConfig.flush_batch=0)
 
     def __init__(
-        self, target: str, replica: str = "", *, client=None, clock=None
+        self,
+        target: str,
+        replica: str = "",
+        *,
+        client=None,
+        clock=None,
+        flush_batch: int = 0,
     ) -> None:
         from ..server.bulk import BulkClient
 
@@ -160,6 +172,10 @@ class RemoteOccupancyExchange:
             else BulkClient(target, retries=0, clock=clock)
         )
         self._replica = replica
+        # instance flush batch: the auto-tunable write-behind cap
+        # (kubernetes_tpu/tuning knob "fleet_flush"); class default
+        # unless configured
+        self._buffer_cap = int(flush_batch) or self._BUFFER_CAP
         # buffered [kind, arg] mutations awaiting one apply_ops RPC;
         # callers are single-threaded per replica (the scheduler's
         # locked apply phase / driver loop)
@@ -220,7 +236,7 @@ class RemoteOccupancyExchange:
             self._fenced_seen = True
         except Exception:
             self._buffer = ops + self._buffer  # retained for retry
-            if len(self._buffer) > 4 * self._BUFFER_CAP:
+            if len(self._buffer) > 4 * self._buffer_cap:
                 # a long partition must not grow the buffer without
                 # bound: drop it — the raise below sets the caller's
                 # dirty flag, and the first reachable resync
@@ -243,8 +259,15 @@ class RemoteOccupancyExchange:
                 fenced=True,
             )
         self._buffer.append([kind, arg])
-        if len(self._buffer) >= self._BUFFER_CAP:
+        if len(self._buffer) >= self._buffer_cap:
             self.flush()
+
+    def set_buffer_cap(self, n: int) -> None:
+        """Retarget the write-behind flush batch (the auto-tuner's
+        "fleet_flush" knob). Safe at any point: the cap is only
+        consulted on append, and a shrink below the current buffer
+        length simply flushes at the next mutation."""
+        self._buffer_cap = max(int(n), 1)
 
     # -- the OccupancyExchange surface --
 
@@ -376,7 +399,8 @@ class FleetRuntime:
             self.exchange: OccupancyExchange = config.exchange
         elif config.hub_address:
             self.exchange = RemoteOccupancyExchange(
-                config.hub_address, config.replica, clock=clock
+                config.hub_address, config.replica, clock=clock,
+                flush_batch=config.flush_batch,
             )
         else:
             self.exchange = OccupancyExchange()
@@ -439,6 +463,22 @@ class FleetRuntime:
         with cluster.lock:
             self._recompute(cluster.list_nodes())
         metrics.fleet_replicas.set(len(self.membership.alive()))
+
+    # -- write-behind flush batch (the auto-tuner's fleet_flush knob) --
+
+    def flush_batch(self) -> int | None:
+        """Current write-behind flush batch of the remote hub adapter,
+        or None for an in-process hub (nothing to batch — the knob is
+        not tunable then)."""
+        if isinstance(self.exchange, RemoteOccupancyExchange):
+            return self.exchange._buffer_cap
+        return None
+
+    def set_flush_batch(self, n: int) -> None:
+        """Retarget the remote adapter's flush batch (no-op for an
+        in-process hub)."""
+        if isinstance(self.exchange, RemoteOccupancyExchange):
+            self.exchange.set_buffer_cap(n)
 
     _HANDOFF_AFTER = 2
     # bounded re-admission rounds when compare_and_stage loses its

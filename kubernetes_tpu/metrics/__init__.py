@@ -295,6 +295,55 @@ backlog_hbm_measured_bytes = Gauge(
     registry=REGISTRY,
 )
 
+# -- closed-loop hot-path auto-tuning (kubernetes_tpu/tuning) --
+
+tuning_adjustments_total = Counter(
+    "scheduler_tuning_adjustments_total",
+    "Auto-tuning controller decisions, by knob (backlog_chunk|"
+    "stream_depth|pipeline_split|fleet_flush) and action (probe = try "
+    "a neighbor value, accept = probe beat the incumbent by the "
+    "hysteresis margin, revert = probe lost and the incumbent was "
+    "restored, settle = both directions exhausted and the controller "
+    "went inert, unsettle = a workload shift re-opened tuning).",
+    ["knob", "action"],
+    registry=REGISTRY,
+)
+tuning_knob_value = Gauge(
+    "scheduler_tuning_knob_value",
+    "Current value of each auto-tuned hot-path knob (the live setting "
+    "the dispatch loops read; compare with scheduler_tuning_settled to "
+    "tell a converged value from a mid-probe one).",
+    ["knob"],
+    registry=REGISTRY,
+)
+tuning_settled = Gauge(
+    "scheduler_tuning_settled",
+    "1 when the knob's controller has settled (neither direction "
+    "improves past the hysteresis margin); 0 while measuring or "
+    "probing.",
+    ["knob"],
+    registry=REGISTRY,
+)
+tuning_guardrail_rejections_total = Counter(
+    "scheduler_tuning_guardrail_rejections_total",
+    "Tuner proposals rejected by a hard guardrail BEFORE application "
+    "— e.g. a drain-chunk candidate whose HBM budget-model estimate "
+    "(solver/budget.py) exceeds the per-device budget. A rejection is "
+    "the guardrail working; a tuner-applied value failing its guard "
+    "would be a breach, which the sim invariant and bench ladder pin "
+    "at zero.",
+    ["knob"],
+    registry=REGISTRY,
+)
+tuning_workload_shifts_total = Counter(
+    "scheduler_tuning_workload_shifts_total",
+    "Workload shifts the tuning runtime detected after settling (the "
+    "CounterWindow signature moved past tuning.shiftThreshold): every "
+    "settled controller re-opens and re-converges for the new "
+    "regime.",
+    registry=REGISTRY,
+)
+
 # -- crash-restart recovery + commit fencing --
 
 restart_recovery_seconds = Histogram(
@@ -526,7 +575,7 @@ sim_invariant_violations_total = Counter(
     "Invariant violations the simulator's checkers flagged, by "
     "invariant (double_bind|capacity|lost_pod|progress|monotonic|"
     "constraint|journal|global_overcommit|resilience|recovery|"
-    "fencing|rebalance).",
+    "fencing|rebalance|tuning).",
     ["invariant"],
     registry=REGISTRY,
 )

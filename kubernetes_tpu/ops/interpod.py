@@ -20,7 +20,9 @@ INF = jnp.int32(2**30)
 
 
 # traced-region kernel, called from exact.py's jit scope: ktpu: hot
-def domain_counts(dom, cnt, d_pad: int, ident: bool = False):
+def domain_counts(
+    dom, cnt, d_pad: int, ident: bool = False, pallas: bool = False
+):
     """dom, cnt: [T, N] -> (per-node domain totals [T, N], has_key [T, N]).
 
     ``ident=True`` (static): every valid node has a UNIQUE domain in every
@@ -32,16 +34,30 @@ def domain_counts(dom, cnt, d_pad: int, ident: bool = False):
     SchedulingPodAntiAffinity).
 
     Otherwise one segment_sum over T*d_pad flattened segments replaces T
-    hash maps."""
+    hash maps — unless ``pallas=True`` (static; config
+    ``tpuSolver.pallas``), which routes the [T, D] aggregation through
+    the MXU one-hot-contraction kernel
+    (ops/pallas_kernels.domain_counts_padded) and gathers back per node.
+    Bit-identical to the segment_sum (integer adds in both); off by
+    default per the measured negative results in pallas_kernels.py."""
     t, n = dom.shape
     hk = dom >= 0
     if ident:
         return jnp.where(hk, cnt, 0), hk
     dd = jnp.where(hk, dom, 0)
-    seg_ids = (dd + jnp.arange(t, dtype=jnp.int32)[:, None] * d_pad).reshape(-1)
-    seg = jops.segment_sum(
-        jnp.where(hk, cnt, 0).reshape(-1), seg_ids, num_segments=t * d_pad
-    ).reshape(t, d_pad)
+    if pallas:
+        from .pallas_kernels import domain_counts_padded
+
+        seg = domain_counts_padded(dom, cnt, d_pad)
+    else:
+        seg_ids = (
+            dd + jnp.arange(t, dtype=jnp.int32)[:, None] * d_pad
+        ).reshape(-1)
+        seg = jops.segment_sum(
+            jnp.where(hk, cnt, 0).reshape(-1),
+            seg_ids,
+            num_segments=t * d_pad,
+        ).reshape(t, d_pad)
     node_counts = jnp.take_along_axis(seg, dd, axis=1)
     return node_counts, hk
 
@@ -49,7 +65,7 @@ def domain_counts(dom, cnt, d_pad: int, ident: bool = False):
 # traced-region kernel, called from exact.py's jit scope: ktpu: hot
 def filter_and_score(
     ipa, in_cnt, ex_cnt, cls, x, d_pad: int, node_valid,
-    ident: bool = False, score: bool = True,
+    ident: bool = False, score: bool = True, pallas: bool = False,
 ):
     """Returns (allowed [N] bool, raw_score [N] int32).
 
@@ -60,8 +76,12 @@ def filter_and_score(
     fast path (see domain_counts). ``score=False`` (static): the batch has
     no preferred terms and no symmetry weights — skip the scoring section
     (raw is all-zero then anyway)."""
-    in_counts, in_hk = domain_counts(ipa["in_dom"], in_cnt, d_pad, ident)
-    ex_counts, ex_hk = domain_counts(ipa["ex_dom"], ex_cnt, d_pad, ident)
+    in_counts, in_hk = domain_counts(
+        ipa["in_dom"], in_cnt, d_pad, ident, pallas
+    )
+    ex_counts, ex_hk = domain_counts(
+        ipa["ex_dom"], ex_cnt, d_pad, ident, pallas
+    )
     n = in_counts.shape[1]
 
     # 1. existing pods' required anti-affinity vs this pod (symmetry)

@@ -21,9 +21,16 @@ n-tile axis innermost so the output block stays resident and accumulates
 
 Works in interpret mode on CPU (tests) and compiled on the axon TPU.
 
-**Production wiring decision (round 3) — NEGATIVE RESULT, measured:**
-the solver does NOT call this kernel. Three facts, established on this
-box's jax 0.9 + experimental axon PJRT:
+**Production wiring decision (round 3, amended by ISSUE 13) — the
+kernel IS now wired, behind ``tpuSolver.pallas`` (default OFF):**
+``ops/interpod.domain_counts`` routes its [T, D] aggregation through
+``domain_counts_padded`` below when ``ExactSolverConfig.pallas`` is
+set, inside the production per-pod scan, with parity pinned end to end
+by tests/test_pallas_kernels.py (production ExactSolver.solve, flag on
+vs off, bit-identical assignments) and a ladder micro-bench in
+bench.py. The DEFAULT stays off because the round-3 negative results
+stand, measured and unchanged on this box's jax 0.9 + experimental
+axon PJRT:
 
 1. With ``jax_enable_x64`` enabled — which the solver REQUIRES process-wide
    (int64 resource arithmetic; memory bytes overflow int32) — Pallas
@@ -41,9 +48,11 @@ box's jax 0.9 + experimental axon PJRT:
    (zone-topology shapes), below the measured per-call benefit a Pallas
    replacement could deliver here even if it compiled.
 
-The kernel + interpret-mode parity tests stay as the validated fallback:
-if a future jax/axon build fixes the x64 lowering, wiring it is a
-one-line change in the domain_counts dispatchers.
+On a build where the x64 lowering works, enabling the kernel is now a
+config flip (``tpuSolver: {pallas: true}``), not a code change. On
+non-TPU backends ``domain_counts_padded`` selects interpret mode at
+trace time, which is how the tier-1 parity tests exercise the wired
+path under the x64-everywhere test config.
 """
 
 from __future__ import annotations
@@ -107,6 +116,33 @@ def domain_counts_pallas(dom, cnt, d_pad: int, interpret: bool = False):
         out_shape=jax.ShapeDtypeStruct((t, d_pad), jnp.int32),
         interpret=interpret,
     )(dom, cnt)
+
+
+def domain_counts_padded(dom, cnt, d_pad: int):
+    """Production adapter for the per-pod scan (``tpuSolver.pallas``):
+    pad the term axis to T_TILE and the node axis to N_TILE (pad lanes
+    carry dom = -1, which the kernel masks out), run the MXU kernel,
+    slice the pad rows back off. Returns the [T, D] domain totals the
+    dispatcher gathers per node.
+
+    Interpret mode is selected AT TRACE TIME on non-TPU backends (the
+    tier-1 suite runs the wired path this way under x64); a TPU backend
+    lowers the compiled kernel. Called from exact.py's jit scope —
+    padding is trace-time reshaping, not a host sync: ktpu: hot"""
+    import jax as _jax
+
+    t, n = dom.shape
+    tp = -t % T_TILE
+    np_ = -n % N_TILE
+    if tp or np_:
+        dom = jnp.pad(dom, ((0, tp), (0, np_)), constant_values=-1)
+        cnt = jnp.pad(cnt, ((0, tp), (0, np_)))
+    interpret = _jax.default_backend() != "tpu"
+    out = domain_counts_pallas(
+        dom.astype(jnp.int32), cnt.astype(jnp.int32), d_pad,
+        interpret=interpret,
+    )
+    return out[:t]
 
 
 def domain_counts_reference(dom, cnt, d_pad: int):

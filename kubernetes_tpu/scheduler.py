@@ -190,6 +190,17 @@ class SchedulerConfig:
     # (their cache IS their shard); a fence-revoked zombie incarnation
     # skips every pass.
     rebalance: object = None
+    # closed-loop hot-path auto-tuning (kubernetes_tpu/tuning): a
+    # TuningConfig enabling the online controllers that drive the
+    # hot-path knobs (drain chunk size, stream_depth, pipeline_split,
+    # fleet write-behind flush batch) from the measured counters —
+    # bounded hill-climbing with hysteresis and settle detection, under
+    # hard guardrails (a proposed drain chunk must pass the HBM budget
+    # model before it is ever applied; stream-depth changes apply only
+    # at ring-drain boundaries). None = static knobs. To pin ONE knob
+    # while tuning the rest, set its config value and drop it from
+    # TuningConfig.knobs.
+    tuning: object = None
     # commit fencing (state/cluster.py fencing tokens): the lease role
     # this scheduler's binds are fenced under. The incarnation acquires
     # a fresh token at startup — superseding any predecessor — and
@@ -273,6 +284,9 @@ class BacklogDrainReport:
     unschedulable: int = 0
     chunks: int = 0  # streaming batches dispatched
     chunk_pods: int = 0  # planned chunk size (post budget splits)
+    # chunk size at drain end when the auto-tuner governed the knob
+    # (kubernetes_tpu/tuning); 0 = untuned (chunk_pods held throughout)
+    final_chunk_pods: int = 0
     budget_splits: int = 0  # halvings the HBM planner took
     budget_bytes: int = 0  # per-device budget asserted against
     drain_seconds: float = 0.0
@@ -521,12 +535,26 @@ class Scheduler:
         # _conflict_seq so delete-churn never discards plain fit solves
         # (whose device carry absorbs frees conservatively).
         self._occupancy_seq = 0  # ktpu: guarded-by(cluster.lock)
-        # RTT-hiding batch-split estimators (config.pipeline_split == 0):
-        # EWMAs of the blocking device-read wait (≈ tunnel RTT + residual
-        # solve) and of per-pod device time, updated on applied flights.
-        # Driver-thread only.
-        self._rtt_ewma = 0.0
-        self._pod_solve_ewma = 0.0
+        # the tuning layer's measurement surface (kubernetes_tpu/tuning):
+        # ONE window of per-batch counter samples, which also owns the
+        # RTT / per-pod-solve EWMAs the adaptive pipeline-split rule
+        # reads (formerly private _rtt_ewma/_pod_solve_ewma — moved so
+        # the split rule and the split controller can never fight over
+        # the knob from two estimates). Always built: without a tuner
+        # it costs one note_read per blocking flight, nothing per batch.
+        from .tuning.window import CounterWindow
+
+        self.window = CounterWindow(self.clock)
+        # closed-loop auto-tuning runtime (SchedulerConfig.tuning):
+        # per-knob hill-climb controllers ticked once per applied batch
+        # from _record_metrics. None = static knobs.
+        self.tuner = None
+        if self.config.tuning is not None:
+            from .tuning.runtime import TuningRuntime
+
+            self.tuner = TuningRuntime(
+                self.config.tuning, self.window, self.clock
+            )
         # streaming dispatcher (run_streaming) infrastructure: the
         # completion thread + its handle queue are created lazily on the
         # first streaming cycle; the hidden/paid read tally feeds the
@@ -1230,7 +1258,21 @@ class Scheduler:
                 res.host_seconds = (
                     self.clock.perf() - t0 - res.solve_seconds
                 )
-                self._record_metrics(res, len(infos))
+                self._record_metrics(
+                    res, len(infos),
+                    # the tuning window's hard-shape fraction must not
+                    # collapse just because hard batches ROUTED through
+                    # the synchronous cycle (degraded mode, backstop) —
+                    # that would read as a workload shift on an
+                    # unchanged workload (review-caught). The pod scan
+                    # only runs when a tuner is actually sampling.
+                    occ_sensitive=(
+                        self.tuner is not None
+                        and not self._plain_batch(
+                            [i.pod for i in infos]
+                        )
+                    ),
+                )
         except Exception:
             # a mid-cycle outage (non-ignorable extender down, plugin
             # ERROR) surfaces to the caller, but must not strand work:
@@ -2977,15 +3019,29 @@ class Scheduler:
                 return node_name
         return None
 
-    def _record_metrics(self, res: BatchResult, n_pods: int) -> None:
+    def _record_metrics(
+        self,
+        res: BatchResult,
+        n_pods: int,
+        occ_sensitive: bool = False,
+    ) -> None:
         """Batch-level metrics (per-profile attempt counters record in
-        _solve_group); reference names, SURVEY §6.5."""
+        _solve_group); reference names, SURVEY §6.5. Also the tuning
+        tick: every dispatch loop (sync, pipelined, streaming, drain)
+        funnels applied batches through here, so this is where the
+        auto-tuning runtime samples its CounterWindow and drives the
+        per-knob controllers — one chokepoint, no loop grows its own
+        tuning call."""
         metrics.solve_latency_seconds.observe(res.solve_seconds)
         metrics.solve_batch_size.observe(n_pods)
         for _, _, victims in res.preemptions:
             metrics.preemption_attempts_total.inc()
             metrics.preemption_victims.observe(len(victims))
         self._refresh_pending_gauge()
+        if self.tuner is not None and n_pods > 0:
+            self.tuner.observe_batch(
+                self, res, n_pods, occ_sensitive=occ_sensitive
+            )
 
     def _refresh_pending_gauge(self) -> None:
         """Set the pending_pods gauge from the queue's O(1) counters —
@@ -3467,7 +3523,10 @@ class Scheduler:
                     res.host_seconds = tshare + (
                         self.clock.perf() - ta - flight.read_seconds
                     )
-                    self._record_metrics(res, len(infos))
+                    self._record_metrics(
+                        res, len(infos),
+                        occ_sensitive=prep.occ_sensitive,
+                    )
             except SolverFaultError as e:
                 # the solve is the failure (read death / corrupt
                 # output), not the fence: requeue the pods for an
@@ -3506,55 +3565,40 @@ class Scheduler:
         return res
 
     def _note_flight_timing(self, flight: _InFlightSolve, n_pods: int) -> None:
-        """Feed the adaptive batch-split estimators from an applied (or
-        read-then-discarded) flight. Only reads that actually BLOCKED
-        (>1 ms) carry signal: they approximate residual solve + tunnel
-        RTT, an upper bound on the RTT. Post-overlap reads (~0.2 ms on
-        axon) are the overlap WORKING and say nothing about the RTT —
-        folding them in would drive the estimate to ~0 and make the
-        adaptive rule split every batch to the max. EWMAs, not running
-        extrema, so the estimates track tunnel mood both ways. Driver
-        thread only."""
-        read = flight.read_seconds
-        if read < 1e-3 or n_pods <= 0:
-            return
-        self._rtt_ewma = (
-            read
-            if self._rtt_ewma <= 0
-            else 0.7 * self._rtt_ewma + 0.3 * read
-        )
-        per_pod = (flight.dispatch_seconds + read) / n_pods
-        self._pod_solve_ewma = (
-            per_pod
-            if self._pod_solve_ewma <= 0
-            else 0.7 * self._pod_solve_ewma + 0.3 * per_pod
+        """Feed the adaptive batch-split estimators — which live in the
+        shared CounterWindow (kubernetes_tpu/tuning), the one home of
+        every estimate a knob decision reads — from an applied (or
+        read-then-discarded) flight. Driver thread only."""
+        self.window.note_read(
+            flight.read_seconds, flight.dispatch_seconds, n_pods
         )
 
     _MAX_PIPELINE_SPLIT = 8
 
     def _choose_split(self, n_pods: int) -> int:
         """Sub-batch count for one popped batch (the RTT-hiding batch
-        split). A fixed config wins; the adaptive default splits once the
-        estimated device solve time for the batch exceeds the estimated
-        read round trip, so the assignment read of sub-batch i can
-        overlap the solve of i+1 — the knob that attacks the per-batch
-        RTT floor. The solver clamps the request to a feasible
-        (group-aligned) divisor of the padded pod axis."""
+        split). A fixed config wins; with the tuning runtime governing
+        the knob, its hill-climb controller owns the value outright;
+        otherwise the adaptive default (CounterWindow.split_estimate)
+        splits once the estimated device solve time for the batch
+        exceeds the estimated read round trip, so the assignment read
+        of sub-batch i can overlap the solve of i+1 — the knob that
+        attacks the per-batch RTT floor. Controller and adaptive rule
+        read the SAME window, so the two can never fight over the split
+        from divergent private estimates (ISSUE 13 satellite). The
+        solver clamps the request to a feasible (group-aligned) divisor
+        of the padded pod axis."""
         cfg = self.config.pipeline_split
         if cfg == 1:
             return 1
         if cfg > 1:
             return min(cfg, self._MAX_PIPELINE_SPLIT)
-        if self._rtt_ewma <= 0 or self._pod_solve_ewma <= 0:
-            return 1
-        est_solve = n_pods * self._pod_solve_ewma
-        if est_solve <= 2 * self._rtt_ewma:
-            return 1
-        return max(
-            2,
-            min(
-                int(est_solve / self._rtt_ewma), self._MAX_PIPELINE_SPLIT
-            ),
+        if self.tuner is not None:
+            tuned = self.tuner.split_override(n_pods)
+            if tuned is not None:
+                return min(max(tuned, 1), self._MAX_PIPELINE_SPLIT)
+        return self.window.split_estimate(
+            n_pods, self._MAX_PIPELINE_SPLIT
         )
 
     def run_pipelined(self, max_batches: int = 10_000) -> list[BatchResult]:
@@ -4009,6 +4053,14 @@ class Scheduler:
         batches = 0
         try:
             while batches < max_batches:
+                if not slots:
+                    # ring-drain boundary: the ONE point a stream-depth
+                    # change (the auto-tuner's, or an operator flipping
+                    # config.stream_depth on a live scheduler) may take
+                    # effect — an in-flight ring keeps the depth it was
+                    # dispatched under, so a shrink can never strand a
+                    # dispatched-but-unapplied slot
+                    depth = max(self.config.stream_depth, 1)
                 if self.fleet is not None and self.fleet.maybe_resync(
                     self
                 ):
@@ -4436,12 +4488,25 @@ class Scheduler:
             s.dispatch_counts.get("stream_chained", 0)
             for s in self.solvers.values()
         )
+        if self.tuner is not None:
+            # arm the drain-chunk controller: candidates re-run the
+            # budget model (estimate + index-headroom audit) as their
+            # guardrail, so a tuner-proposed chunk can never raise
+            # BudgetExceeded from the dispatch path. The tuner adjusts
+            # config.batch_size between pops — chunk boundaries — and
+            # the streaming ring never sees a mid-chunk change.
+            self.tuner.on_drain_start(self, chunk, budget)
         t0 = self.clock.perf()
         try:
             results = self.run_streaming(max_batches=max_batches)
         finally:
             self.config.batch_size = old_batch
             self._backlog_drain_active = False
+            if self.tuner is not None:
+                self.tuner.on_drain_end(self)
+                report.final_chunk_pods = (
+                    self.tuner.knob_values().get("backlog_chunk", chunk)
+                )
             if self.journal is not None:
                 self.journal.tags.pop("drain_chunk", None)
         dt = self.clock.perf() - t0

@@ -91,6 +91,17 @@ def _print_result(res) -> None:
             f"budget_splits={bl['budget_splits']} "
             f"stream_chained={bl['stream_chained']}"
         )
+    tu = s.get("tuning")
+    if tu:
+        knobs = ",".join(f"{k}={v}" for k, v in sorted(tu["knobs"].items()))
+        print(
+            f"  tuning: probes={tu['probes']} moves={tu['moves']} "
+            f"settled={tu['settled']} shifts={tu['shifts']} "
+            f"guardrail_rejections={tu['guardrail_rejections']} "
+            f"guardrail_breaches={tu['guardrail_breaches']} "
+            f"convergence_batches={tu['convergence_batches']} "
+            f"knobs[{knobs}]"
+        )
     reb = s.get("rebalance")
     if reb:
         print(
@@ -248,6 +259,20 @@ def main(argv=None) -> int:
         help="dump the flight recorder here when an invariant fires",
     )
     parser.add_argument(
+        "--tuning", action="store_true",
+        help="enable the closed-loop auto-tuning runtime "
+        "(kubernetes_tpu/tuning) on any profile: hill-climb "
+        "controllers over stream_depth / pipeline_split / drain "
+        "chunk with sim-sized evaluation windows; the footer's "
+        "tuning line and the tuning invariant report convergence",
+    )
+    parser.add_argument(
+        "--tuned-profile", metavar="PATH",
+        help="after a --tuning run, write the converged knob values "
+        "as a standard KubeSchedulerConfiguration YAML (tuned config "
+        "in, standard config out)",
+    )
+    parser.add_argument(
         "--mesh-devices", type=int, default=1, metavar="N",
         help="shard the node-axis solve over N virtual CPU devices "
         "(SchedulerConfig.mesh_devices; forces the device count before "
@@ -287,6 +312,16 @@ def main(argv=None) -> int:
 
     _configure_jax(args.mesh_devices)
     if args.fleet:
+        if args.tuning:
+            # the multi-scheduler drive builds its own replica configs;
+            # silently dropping the flag would misread as "tuned fleet"
+            print(
+                "error: --tuning is not supported on fleet drives "
+                "(the fleet_flush knob is unit-tested; per-replica "
+                "tuning is future work)",
+                file=sys.stderr,
+            )
+            return 2
         return _run_fleet(args)
     from .harness import replay_trace, run_sim
     from .trace import TraceError
@@ -307,17 +342,28 @@ def main(argv=None) -> int:
     if args.dispatcher is not None:
         pipelined = args.dispatcher == "pipelined"
         streaming = args.dispatcher == "streaming"
+    tuning = True if args.tuning else None
     try:
         res = run_sim(
             args.profile, seed=args.seed, cycles=args.cycles,
             pipelined=pipelined, streaming=streaming,
             flight_dump=args.flight_dump,
             mesh_devices=args.mesh_devices,
+            tuning=tuning,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     _print_result(res)
+    if args.tuned_profile and res.tuned_profile is not None:
+        from pathlib import Path
+
+        from kubernetes_tpu.tuning.profile import dump_yaml
+
+        Path(args.tuned_profile).write_text(
+            dump_yaml(res.tuned_profile)
+        )
+        print(f"  tuned profile written: {args.tuned_profile}")
     if args.trace:
         res.trace.dump(args.trace)
         print(f"  trace written: {args.trace}")
@@ -333,6 +379,7 @@ def main(argv=None) -> int:
             args.profile, seed=args.seed, cycles=args.cycles,
             pipelined=pipelined, streaming=streaming,
             mesh_devices=args.mesh_devices,
+            tuning=tuning,
         )
         if res.journal_lines != res2.journal_lines:
             print(

@@ -143,8 +143,14 @@ class ChurnGenerator:
         # backlog seeding (backlog_drain profiles): the mega-backlog
         # lands as ordinary cycle-0 create_pod events — same hard-shape
         # draw, same trace/replay machinery — BEFORE the cycle's
-        # arrivals, so cycle 0's drive sees the full backlog queued
-        n_arrivals = rng.randint(*p.arrivals)
+        # arrivals, so cycle 0's drive sees the full backlog queued.
+        # Workload shift (tuning_convergence profiles): from shift_at
+        # on, arrivals draw from the shifted band — the regime change
+        # the auto-tuner must detect and re-converge for.
+        arrivals = p.arrivals
+        if p.shift_at >= 0 and cycle >= p.shift_at and p.shift_arrivals:
+            arrivals = p.shift_arrivals
+        n_arrivals = rng.randint(*arrivals)
         if cycle == 0 and p.backlog:
             n_arrivals += p.backlog
 

@@ -59,6 +59,7 @@ from .invariants import (
     check_rebalance,
     check_recovery,
     check_resilience,
+    check_tuning,
     merged_last_outcomes,
     packed_utilization,
 )
@@ -82,6 +83,9 @@ class SimResult:
     # same seed+profile => byte-identical lines
     journal_lines: list[str] = None
     flight_dump: str | None = None  # written on invariant violation
+    # --tuning runs: the converged knobs as a standard
+    # KubeSchedulerConfiguration document (tuning/profile.py)
+    tuned_profile: dict | None = None
 
     @property
     def ok(self) -> bool:
@@ -122,6 +126,7 @@ class SimHarness:
         spans: bool = False,
         flight_dump: str | None = None,
         mesh_devices: int = 1,
+        tuning: bool | None = None,
     ) -> None:
         self.profile = (
             get_profile(profile) if isinstance(profile, str) else profile
@@ -138,6 +143,10 @@ class SimHarness:
         self.streaming = (
             self.profile.streaming if streaming is None else streaming
         )
+        # closed-loop auto-tuning (kubernetes_tpu/tuning): profile
+        # default, overridable per run (the --tuning CLI flag enables
+        # the runtime on ANY profile)
+        self.tuning = self.profile.tuning if tuning is None else tuning
         self.max_settle_rounds = max_settle_rounds
         self._reader = replay
 
@@ -148,6 +157,7 @@ class SimHarness:
             cycles=cycles,
             pipelined=self.pipelined,
             streaming=self.streaming,
+            tuning=self.tuning,
         )
         self.journal = DecisionJournal(
             None if replay is not None else self.trace,
@@ -226,6 +236,27 @@ class SimHarness:
             self.rebalance_tracker = RebalanceTracker(self.cluster)
         from ..resilience import ResilienceConfig
 
+        # sim-sized tuning windows: short enough that both directions
+        # of every knob are probed AND settled within a run's batch
+        # budget (the production defaults evaluate over longer
+        # windows). Hysteresis is WIDE (50%): on virtual time a knob
+        # cannot genuinely change throughput — the measured objective
+        # is pure arrival noise — so the correct converged behavior is
+        # "no direction improves, stay put and settle", and a
+        # production-sized 5% margin would let that noise random-walk
+        # the knobs forever instead.
+        tuning_cfg = None
+        if self.tuning:
+            from ..tuning.runtime import TuningConfig
+
+            tuning_cfg = TuningConfig(
+                eval_batches=2, settle_after=1, hysteresis=0.5,
+                # the tuning_convergence shift is a 1.5x rate change;
+                # 0.7 clears the within-regime arrival noise (uniform
+                # bands over a 4-sample window swing ~±0.4 relative)
+                # while detecting the real shift with margin
+                shift_threshold=0.7, max_probes=4,
+            )
         self._base_config = SchedulerConfig(
             batch_size=self.profile.batch_size,
             # short breaker fault window so probes and re-closes
@@ -246,6 +277,7 @@ class SimHarness:
             extenders=extenders,
             out_of_tree_plugins=plugins,
             rebalance=rebalance_cfg,
+            tuning=tuning_cfg,
             # every sim scheduler binds under a fence token so a
             # crash-restarted incarnation structurally supersedes its
             # predecessor (the commit-fencing layer rides every
@@ -326,6 +358,12 @@ class SimHarness:
         # backlog drain (backlog_drain profiles): cycle 0's
         # drain_backlog report, surfaced in the footer summary
         self._backlog_report = None
+        # was the tuner settled when the profile's workload shift
+        # landed? Shift detection compares against the SETTLED
+        # baseline signature, so a tuner still mid-convergence at the
+        # shift structurally cannot detect it — the invariant's
+        # shift-detected clause is only fair when this is True
+        self._tuner_settled_at_shift = False
         self._counters0 = {
             k: _counter_value(c) for k, c in _DELTA_COUNTERS.items()
         }
@@ -522,6 +560,13 @@ class SimHarness:
                     )
                 )
             if (
+                cycle == self.profile.shift_at
+                and self.scheduler.tuner is not None
+            ):
+                self._tuner_settled_at_shift = (
+                    self.scheduler.tuner.settled()
+                )
+            if (
                 self.crash_injector is not None
                 and cycle == self.profile.crash_at
             ):
@@ -575,6 +620,14 @@ class SimHarness:
             # poison pods keep failing — they are data, not weather,
             # and must stay terminally quarantined through settle
             self.solver_injector.settling = True
+        if self.scheduler.tuner is not None:
+            # the draining tail is teardown, not a workload: freeze the
+            # tuner so quiescence (batch sizes collapsing to the
+            # leftovers) cannot read as a workload shift and unsettle
+            # controllers with nothing left to re-converge on. The
+            # tuning invariant therefore asserts the state AT churn
+            # end: engaged, settled, shift-detected, zero breaches.
+            self.scheduler.tuner.frozen = True
         self.bus.pump_all()
         # 11s rounds clear max backoff (10s) and permit timeouts; the
         # 301s round forces the unschedulable-leftover flush. The flush
@@ -682,6 +735,26 @@ class SimHarness:
                 "pdb_overruns": overruns,
                 "final_packing": round(final_packing, 4),
             }
+        tuning_summary = None
+        tuned_doc = None
+        if self.tuning and self.scheduler.tuner is not None:
+            # all python-side counters over the virtual clock, so
+            # same-seed runs stay byte-identical through the footer
+            tuning_summary = self.scheduler.tuner.summary()
+            from ..tuning.profile import tuned_profile
+
+            tuned_doc = tuned_profile(self.scheduler)
+            check_tuning(
+                self.cycles + self.max_settle_rounds,
+                self.violations,
+                summary=tuning_summary,
+                # only fair when the tuner had SETTLED before the
+                # shift: detection compares against the settled
+                # baseline, which a still-converging tuner doesn't
+                # have yet
+                expect_shift=self.profile.shift_at >= 0
+                and self._tuner_settled_at_shift,
+            )
         bindings = {
             p.key: p.node_name
             for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
@@ -747,6 +820,10 @@ class SimHarness:
             # history, eviction counts from the independent tracker,
             # PDB overruns (must be 0), final packed utilization
             "rebalance": rebalance_summary,
+            # closed-loop auto-tuning (tuning_convergence / --tuning):
+            # probes/moves/settled/shifts/guardrail counters + final
+            # knob values — the tuning invariant's assertion target
+            "tuning": tuning_summary,
             # backlog drain (backlog_drain profiles): counts only —
             # all driver-side and deterministic, so same-seed runs
             # stay byte-identical (wall timings deliberately excluded)
@@ -800,6 +877,7 @@ class SimHarness:
             replay_divergence=divergence,
             journal_lines=all_lines,
             flight_dump=flight_dump,
+            tuned_profile=tuned_doc,
         )
 
     def _diff_replay(self, bindings: dict[str, str]) -> str | None:
@@ -838,12 +916,13 @@ def run_sim(
     spans: bool = False,
     flight_dump: str | None = None,
     mesh_devices: int = 1,
+    tuning: bool | None = None,
 ) -> SimResult:
     """One fresh seeded run (library entry; the CLI and tests use this)."""
     return SimHarness(
         profile, seed=seed, cycles=cycles, pipelined=pipelined,
         streaming=streaming, spans=spans, flight_dump=flight_dump,
-        mesh_devices=mesh_devices,
+        mesh_devices=mesh_devices, tuning=tuning,
     ).run()
 
 
@@ -859,5 +938,6 @@ def replay_trace(path) -> SimResult:
         cycles=int(h["cycles"]),
         pipelined=bool(h["pipelined"]),
         streaming=bool(h.get("streaming", False)),
+        tuning=bool(h.get("tuning", False)),
         replay=reader,
     ).run()

@@ -507,6 +507,85 @@ def check_resilience(
         )
 
 
+def check_tuning(
+    cycle: int,
+    violations: list[Violation],
+    *,
+    summary: dict,
+    expect_shift: bool = False,
+    max_moves_per_knob: int = 8,
+) -> None:
+    """Closed-loop auto-tuning invariants (kubernetes_tpu/tuning),
+    checked after quiescence for profiles that enabled the tuner:
+
+    - **engaged** — the controllers must have probed at least once
+      (zero probes means the tick never reached them and every other
+      clause would pass vacuously);
+    - **settled** — after churn stops, every controller must be
+      settled (a tuner still thrashing a knob on a steady workload is
+      the oscillation hysteresis exists to prevent). Scoped to
+      CONVERGENCE OPPORTUNITY: a shift detected near the end of the
+      drive leaves the tuner legitimately mid-re-convergence, so the
+      clause only fires when the batches seen since the last unsettle
+      reach the controllers' structural settle bound (probe budget x
+      evaluation windows — summary's ``settle_bound``);
+    - **no guardrail breach** — a tuner-APPLIED value failing its
+      guard (e.g. a drain chunk whose HBM estimate exceeds the budget)
+      must never happen: proposals are guarded before application, so
+      ``guardrail_breaches`` is pinned at exactly 0;
+    - **no knob thrash** — accepted moves per knob are bounded
+      (``max_moves_per_knob``): the hysteresis margin makes an A<->B
+      oscillation structurally impossible within one workload regime,
+      so an unbounded move count means the margin logic broke;
+    - **shift detected** — when the profile shifted the workload
+      mid-drive, the tuner must have seen it (``shifts >= 1``) — a
+      settled tuner that sleeps through a regime change serves the OLD
+      workload's knobs forever.
+    """
+    probes = summary.get("probes", 0)
+    if probes < 1:
+        _record(
+            violations, "tuning", cycle,
+            "the tuning runtime never probed a knob — the controllers "
+            "never engaged (every other tuning clause is vacuous)",
+        )
+        return
+    if summary.get("settled") != 1 and summary.get(
+        "batches_since_unsettle", 10**9
+    ) >= summary.get("settle_bound", 0):
+        _record(
+            violations, "tuning", cycle,
+            "tuning controllers still unsettled after quiescence "
+            f"despite {summary.get('batches_since_unsettle')} batches "
+            f"of opportunity (bound {summary.get('settle_bound')}): "
+            f"knobs={summary.get('knobs')} — the hysteresis/settle "
+            "machinery failed to converge on a steady workload",
+        )
+    breaches = summary.get("guardrail_breaches", 0)
+    if breaches != 0:
+        _record(
+            violations, "tuning", cycle,
+            f"{breaches} guardrail breach(es): a tuner-applied value "
+            "failed its guard — proposals must be rejected BEFORE "
+            "application, never applied and rolled back",
+        )
+    moves = summary.get("max_knob_moves", 0)
+    if moves > max_moves_per_knob:
+        _record(
+            violations, "tuning", cycle,
+            f"a knob accepted {moves} moves (> {max_moves_per_knob}) — "
+            "knob thrash: the hysteresis margin is not bounding the "
+            "climb",
+        )
+    if expect_shift and summary.get("shifts", 0) < 1:
+        _record(
+            violations, "tuning", cycle,
+            "the profile shifted the workload mid-drive but the tuner "
+            "never detected it — settled knobs are serving a workload "
+            "that no longer exists",
+        )
+
+
 def merged_last_outcomes(journal_line_sets) -> dict[str, dict]:
     """Last-record-wins merge of decision journals across scheduler
     INCARNATIONS (the process-lifecycle analog of the fleet merge):

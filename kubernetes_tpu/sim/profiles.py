@@ -141,6 +141,18 @@ class Profile:
     # budget_splits>=1 the CI smoke pins, robust to estimator formula
     # changes (an absolute byte figure here would not be)
     backlog_force_split: bool = False
+    # -- closed-loop auto-tuning (kubernetes_tpu/tuning) --
+    # enable the tuning runtime on the sim scheduler (hill-climb
+    # controllers over stream_depth / pipeline_split / drain chunk,
+    # sim-sized evaluation windows — harness builds the TuningConfig)
+    tuning: bool = False
+    # mid-drive workload shift: from this cycle on, arrivals draw from
+    # shift_arrivals instead of arrivals (the tuner must detect the
+    # regime change, unsettle, and re-converge — the tuning invariant
+    # asserts both). -1 = no shift. Events stay self-contained dicts,
+    # so replay is unaffected.
+    shift_at: int = -1
+    shift_arrivals: tuple = ()
 
     def validate(self) -> None:
         if self.watch_delay and (
@@ -425,6 +437,42 @@ PROFILES: dict[str, Profile] = {
             pod_spread_rate=0.25,
             pod_ports_rate=0.2,
             delete_pod_rate=0.6,
+        ),
+        # tuning_convergence: the auto-tuning acceptance profile — a
+        # sustained streaming drive long enough for the hill-climb
+        # controllers (stream_depth / pipeline_split, sim-sized
+        # evaluation windows) to probe both directions and settle, then
+        # a MID-DRIVE WORKLOAD SHIFT (arrivals roughly double at
+        # shift_at) the tuner must detect via the CounterWindow
+        # signature, unsettle on, and re-settle from. The tuning
+        # invariant asserts: controllers engaged (>= 1 probe), settled
+        # at quiescence, zero guardrail breaches, bounded knob moves
+        # (no thrash), and the shift actually detected. Byte-
+        # deterministic under --selfcheck like every profile (the
+        # controllers are pure host python over the virtual clock).
+        Profile(
+            name="tuning_convergence",
+            streaming=True,
+            tuning=True,
+            # capacity headroom matters: the shift detector's signature
+            # is the BIND rate, which only tracks the arrival rate while
+            # the cluster absorbs the load — a saturating cluster's
+            # decaying bind rate would read as an endless workload
+            # drift and shift-storm the controllers. Sized to absorb
+            # the post-shift rate through a 30-cycle soak.
+            nodes=16,
+            node_cpu="32",
+            node_mem="128Gi",
+            batch_size=16,
+            arrivals=(4, 8),
+            pod_spread_rate=0.15,
+            pod_ports_rate=0.1,
+            delete_pod_rate=0.4,
+            # late enough that the controllers have settled AND the
+            # baseline signature has frozen (one full window past the
+            # settle point) before the regime changes
+            shift_at=12,
+            shift_arrivals=(12, 18),
         ),
         # replica_loss: fleet_mixed plus one replica killed mid-drive.
         # The survivors must re-own its shard (ring orphan

@@ -128,6 +128,19 @@ class ExactSolverConfig:
     # service-selected pods without explicit constraints get soft
     # zone/hostname spreading) | List (no cluster defaults)
     spread_defaulting: str = "System"
+    # Pallas-kernel tier (config key tpuSolver.pallas, VERDICT r5
+    # missing #8): route the InterPodAffinity (term, domain) count
+    # aggregation through ops/pallas_kernels.domain_counts_pallas (the
+    # MXU one-hot-contraction kernel) instead of the flattened
+    # segment_sum, inside the production per-pod scan. Default OFF: the
+    # measured negative results stand (pallas_kernels.py module
+    # docstring — the x64 lowering defect on this toolchain, and the
+    # identity fast path already removing the hot hostname case), so
+    # the wiring exists for a build where the lowering works; on
+    # non-TPU backends the kernel runs in interpret mode, which is what
+    # the tier-1 parity tests exercise. The ident fast path still wins
+    # when the tensorizer proves unique domains.
+    pallas: bool = False
 
 
 def grouped_eligible(
@@ -210,6 +223,7 @@ def _mask_and_score(
     use_nominated: bool = False,
     use_nominated_ports: bool = False,
     use_extra_score: bool = False,
+    pallas: bool = False,
 ):
     """One pod's full filter+score pipeline over all nodes against node
     state ``st`` (runtime/framework.go#RunFilterPlugins + #RunScorePlugins,
@@ -277,6 +291,7 @@ def _mask_and_score(
             ipa, st["ipa_in"], st["ipa_ex"], cls, x, ipa_d_pad,
             tables["node_valid"],
             ident=ipa_ident, score=ipa_score and w_interpod > 0,
+            pallas=pallas,
         )
         if "InterPodAffinity" not in disabled:
             mask = mask & ipa_allowed
@@ -1176,6 +1191,7 @@ _RUN_PACKED_STATICS = (
     "spread_soft",
     "ipa_ident",
     "ipa_score",
+    "pallas",
     "use_nominated",
     "use_nominated_ports",
     "use_extra_score",
@@ -1991,6 +2007,7 @@ class ExactSolver:
             spread_soft=spread.has_soft,
             ipa_ident=interpod.ident,
             ipa_score=interpod.has_score,
+            pallas=cfg.pallas,
             use_nominated=use_nominated,
             use_nominated_ports=use_nominated_ports,
             use_extra_score=static.extra_score is not None,

@@ -1,0 +1,207 @@
+"""CounterWindow: the tuning layer's measurement surface.
+
+One bounded window of per-batch samples over the counters the
+scheduling loops already tick — host-side reads of prometheus counter
+cells and driver-side tallies, never a new device sync. Every number a
+tuning controller (or the adaptive pipeline-split rule) consumes comes
+from here, which is the anti-fighting contract of ISSUE 13's satellite:
+two tuners reading two private estimates of the same signal can push a
+knob in opposite directions forever; two tuners reading ONE window
+cannot disagree about what was measured.
+
+The window also owns the RTT / per-pod-solve EWMAs that used to live as
+``Scheduler._rtt_ewma`` / ``_pod_solve_ewma``: ``note_read`` keeps the
+exact update rule (only reads that actually BLOCKED the driver > 1 ms
+carry signal — post-overlap reads are the overlap working, and folding
+them in would drive the estimate to ~0), and ``split_estimate`` is the
+adaptive batch-split rule moved verbatim so the scheduler and the split
+controller evaluate the same formula over the same state.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from .. import metrics
+
+
+def _counter_value(counter) -> float:
+    """Current value of an unlabeled prometheus counter cell (the
+    test-style internal read every delta consumer in this repo uses)."""
+    return counter._value.get()
+
+
+def _labeled_total(counter) -> float:
+    """Sum over every child of a labeled counter (e.g. the CAS-conflict
+    counter's version/fenced kinds) without materializing new labels."""
+    try:
+        with counter._lock:
+            children = list(counter._metrics.values())
+    except AttributeError:
+        return 0.0
+    return float(sum(c._value.get() for c in children))
+
+
+# the counter families one batch sample snapshots (name -> reader).
+# All are driver-side totals the loops already maintain: deltas between
+# consecutive samples are the per-batch signal.
+_COUNTER_READERS = {
+    "unhidden_reads": lambda: _counter_value(
+        metrics.stream_unhidden_reads_total
+    ),
+    "slot_discards": lambda: _counter_value(
+        metrics.stream_slot_discard_total
+    ),
+    "solve_discards": lambda: _counter_value(metrics.solves_discarded_total),
+    "h2d_bytes": lambda: _counter_value(metrics.h2d_bytes_total),
+    "d2h_bytes": lambda: _counter_value(metrics.d2h_bytes_total),
+    "cas_conflicts": lambda: _labeled_total(
+        metrics.fleet_admit_cas_conflict_total
+    ),
+}
+
+
+@dataclass
+class BatchSample:
+    """One applied batch's measurements: absolute per-batch facts plus
+    the counter deltas since the previous sample."""
+
+    pods: int = 0
+    wall_s: float = 0.0  # scheduler-clock seconds since the last sample
+    solve_s: float = 0.0
+    chained: int = 0  # stream_chained dispatch delta
+    occ_sensitive: bool = False  # hard shape (ports/spread/interpod/...)
+    deltas: dict = field(default_factory=dict)
+
+
+class CounterWindow:
+    """Bounded deque of ``BatchSample``s + the split-rule EWMAs."""
+
+    def __init__(self, clock, capacity: int = 128) -> None:
+        self.clock = clock
+        self.samples: deque[BatchSample] = deque(maxlen=capacity)
+        self._last_counters = {
+            k: reader() for k, reader in _COUNTER_READERS.items()
+        }
+        self._last_chained = 0.0
+        self._last_at = clock.perf()
+        # RTT-hiding batch-split estimators (moved from Scheduler):
+        # EWMAs of the blocking device-read wait (~ tunnel RTT +
+        # residual solve) and of per-pod device time. Driver-thread
+        # only, like every mutation on this object.
+        self.rtt_ewma = 0.0
+        self.pod_solve_ewma = 0.0
+        self.batches = 0  # samples ever taken (not capped)
+
+    # -- the split-rule estimators (ISSUE 13 satellite: ONE home) --
+
+    def note_read(
+        self, read_seconds: float, dispatch_seconds: float, n_pods: int
+    ) -> None:
+        """Feed the estimators from an applied (or read-then-discarded)
+        flight. Only reads that actually BLOCKED (> 1 ms) carry signal:
+        they approximate residual solve + tunnel RTT, an upper bound on
+        the RTT. Post-overlap reads (~0.2 ms) are the overlap WORKING
+        and say nothing about the RTT — folding them in would drive the
+        estimate to ~0 and make the adaptive rule split every batch to
+        the max. EWMAs, not running extrema, so the estimates track
+        tunnel mood both ways."""
+        if read_seconds < 1e-3 or n_pods <= 0:
+            return
+        self.rtt_ewma = (
+            read_seconds
+            if self.rtt_ewma <= 0
+            else 0.7 * self.rtt_ewma + 0.3 * read_seconds
+        )
+        per_pod = (dispatch_seconds + read_seconds) / n_pods
+        self.pod_solve_ewma = (
+            per_pod
+            if self.pod_solve_ewma <= 0
+            else 0.7 * self.pod_solve_ewma + 0.3 * per_pod
+        )
+
+    def split_estimate(self, n_pods: int, max_split: int) -> int:
+        """The adaptive pipeline-split rule (formerly
+        ``Scheduler._choose_split``'s private-EWMA branch): split once
+        the estimated device solve time for the batch exceeds the
+        estimated read round trip, so the assignment read of sub-batch
+        i can overlap the solve of i+1."""
+        if self.rtt_ewma <= 0 or self.pod_solve_ewma <= 0:
+            return 1
+        est_solve = n_pods * self.pod_solve_ewma
+        if est_solve <= 2 * self.rtt_ewma:
+            return 1
+        return max(2, min(int(est_solve / self.rtt_ewma), max_split))
+
+    # -- per-batch sampling --
+
+    def note_batch(
+        self,
+        *,
+        pods: int,
+        solve_s: float = 0.0,
+        chained_total: float | None = None,
+        occ_sensitive: bool = False,
+    ) -> BatchSample:
+        """Record one applied batch: absolute facts passed in by the
+        scheduler, counter deltas read here. Called once per applied
+        batch from the metrics-recording chokepoint every dispatch loop
+        (sync, pipelined, streaming, drain) already funnels through."""
+        now = self.clock.perf()
+        deltas = {}
+        for k, reader in _COUNTER_READERS.items():
+            v = reader()
+            deltas[k] = v - self._last_counters[k]
+            self._last_counters[k] = v
+        chained = 0
+        if chained_total is not None:
+            chained = int(chained_total - self._last_chained)
+            self._last_chained = chained_total
+        sample = BatchSample(
+            pods=pods,
+            wall_s=max(now - self._last_at, 0.0),
+            solve_s=solve_s,
+            chained=chained,
+            occ_sensitive=occ_sensitive,
+            deltas=deltas,
+        )
+        self._last_at = now
+        self.samples.append(sample)
+        self.batches += 1
+        return sample
+
+    # -- aggregates the controllers and the shift detector read --
+
+    def recent(self, n: int) -> list[BatchSample]:
+        if n <= 0:
+            return []
+        return list(self.samples)[-n:]
+
+    def hard_fraction(self, n: int) -> float:
+        recent = self.recent(n)
+        if not recent:
+            return 0.0
+        return sum(1 for s in recent if s.occ_sensitive) / len(recent)
+
+    def rate(self, n: int) -> float:
+        """Pods per wall-second over the last ``n`` samples (ratio of
+        sums — robust to how a cycle's arrivals happened to split into
+        pops, which per-batch means are not)."""
+        recent = self.recent(n)
+        if not recent:
+            return 0.0
+        return sum(s.pods for s in recent) / max(
+            sum(s.wall_s for s in recent), 1e-6
+        )
+
+    def signature(self, n: int) -> tuple[float, float]:
+        """A compact workload fingerprint — (arrival-rate proxy,
+        hard-shape fraction) — the shift detector compares across
+        settle points. A large relative move in either component means
+        the workload the tuned values were chosen for is gone. The
+        rate, not the mean batch size: a 15-pod cycle pops as one
+        15-pod batch or a 16-cap batch plus a remainder depending on
+        timing, which whipsaws a per-batch mean while leaving the rate
+        untouched."""
+        return (self.rate(n), self.hard_fraction(n))

@@ -110,6 +110,29 @@ echo "$backlog_out" | grep -qE "fallbacks=0 " \
 echo "$backlog_out" | grep -qE "stream_chained=[0-9]+" \
     || { echo "BACKLOG SMOKE: no chain accounting in the footer"; exit 1; }
 
+echo "== tuning smoke: closed-loop auto-tuning convergence =="
+# tuning_convergence: the hill-climb controllers (stream_depth /
+# pipeline_split, sim-sized evaluation windows) must probe both
+# directions, settle, detect the mid-drive workload shift (arrivals
+# roughly double at cycle 12), and re-settle — all under the tuning
+# invariant (engaged / settled / zero guardrail breaches / bounded
+# moves / shift detected). The greps pin settled=1 and
+# guardrail_breaches=0 non-vacuously; --selfcheck proves the whole
+# controller stack byte-deterministic (pure host python over the
+# virtual clock). The backlog_drain --tuning run exercises the
+# drain-chunk controller under the HBM budget guardrail.
+tune_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 24 \
+    --profile tuning_convergence --selfcheck)
+echo "$tune_out"
+echo "$tune_out" | grep -qE "settled=1 " \
+    || { echo "TUNING SMOKE: controllers never settled"; exit 1; }
+echo "$tune_out" | grep -qE "guardrail_breaches=0 " \
+    || { echo "TUNING SMOKE: a tuner-applied value breached its guardrail"; exit 1; }
+echo "$tune_out" | grep -qE "shifts=[1-9]" \
+    || { echo "TUNING SMOKE: the workload shift was never detected"; exit 1; }
+python -m kubernetes_tpu.sim --seed 0 --cycles 16 --profile backlog_drain \
+    --tuning --selfcheck
+
 echo "== chaos smoke: solver fallback ladder + poison quarantine =="
 # solver_flaky: every device-tier solve fails during the fault window
 # (virtual t in [2,5)), then heals. The run's resilience invariant

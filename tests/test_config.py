@@ -596,6 +596,7 @@ def test_fleet_section_round_trip(tmp_path, capsys):
         "hubAddress": "hub.scheduling.svc:9411",
         "meshSlice": "2/4",
         "maxRowAgeSeconds": 15.0,
+        "flushBatch": 0,
     }
     # null-tolerant: explicit nulls default, fleet stays off
     cfg2 = ct.load(
