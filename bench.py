@@ -272,6 +272,12 @@ def _sustained_shape(
     resilience=None,  # ResilienceConfig override (ladder #9's forced
     # host-greedy arm); None = defaults (top tier)
     tuning=None,  # TuningConfig: the ladder #12 tuned arm; None = static
+    obs=None,  # ObsConfig: the ladder #13 obs-on arm (full tracing +
+    # journal + SLO engine); None = observability off (the default
+    # every other ladder measures)
+    fleet=None,  # FleetConfig factory (called per build): ladder #13
+    # runs BOTH arms as a single-replica fleet so the obs-on arm's
+    # journal-segment shipping to the hub is inside the measured window
 ) -> dict:
     """One open-loop sustained-arrival run: pods arrive at ``rate``/s
     while the scheduler drains concurrently — streaming
@@ -312,6 +318,8 @@ def _sustained_shape(
                 ),
                 resilience=resilience,
                 tuning=tuning,
+                obs=obs,
+                fleet=fleet() if fleet is not None else None,
             ),
         )
         return cs, sched
@@ -418,6 +426,20 @@ def _sustained_shape(
         # accounting and final knob values
         "tuning": (
             sched.tuner.summary() if sched.tuner is not None else None
+        ),
+        # ladder #13 obs-on arm: the live SLO engine's final snapshot
+        # (are-we-meeting-SLOs as measured DURING the run) plus the
+        # journal/span volume the arm paid for
+        "slo": sched.slo.snapshot() if sched.slo is not None else None,
+        "obs_volume": (
+            {
+                "journal_records": sched.journal.total_records,
+                "spans": (
+                    len(sched.flight.spans()) + sched.flight.dropped_spans
+                ),
+            }
+            if sched.journal is not None and sched.flight is not None
+            else None
         ),
     }
 
@@ -1725,6 +1747,112 @@ def ladder12_autotune() -> dict:
     }
 
 
+def ladder13_obs_overhead() -> dict:
+    """#13: observability-overhead A/B (ISSUE 14) — the SAME sustained
+    streaming workload with the FULL obs layer on (spans + bounded
+    flight recorder + per-pod decision journal + live SLO engine) vs
+    everything off, proving the whole fleet-wide tracing/SLO tentpole
+    costs <= 5% sustained throughput. Best-of-3 per arm (the ladder-#7
+    rep convention, widened: a 5% bound is inside two independent
+    runs' wall-clock noise on the dev box, best-of is what makes the
+    A/B about the config).
+
+    Both arms run as a SINGLE-REPLICA fleet over an in-process
+    occupancy hub, so the obs-on arm's journal-segment shipping to the
+    hub's aggregation surface (the cross-replica explain source) is
+    INSIDE the measured window — the overhead number covers tracing +
+    SLO + journal shipping, not just the local layer.
+
+    Hoists slo_p99_pod_latency_s (the SLO engine's own live p99 from
+    the obs-on arm — the 'are we meeting SLOs right now' number
+    measured while the bench ran) and obs_overhead_fraction to the
+    JSON top level."""
+    from kubernetes_tpu.fleet import FleetConfig, OccupancyExchange
+    from kubernetes_tpu.obs import ObsConfig, SloConfig
+
+    def obs_on_cfg():
+        return ObsConfig(
+            spans=True,
+            journal=True,
+            # serve-mode bounds: a long-lived process would configure
+            # exactly this (the unbounded sim retention is a sim
+            # contract, not the production shape)
+            journal_capacity=65_536,
+            slo=SloConfig(latency_objective_s=30.0),
+        )
+
+    shape = dict(
+        kind="plain", n_nodes=500, n_pods=12_000, rate=20_000.0,
+        mode="streaming", split=0, batch=256,
+    )
+
+    hubs: list = []
+
+    def fleet_cfg():
+        # one fresh single-replica fleet + private in-process hub per
+        # scheduler build (warmup and measured runs must not share
+        # state); single-replica degenerates gracefully — ownership-
+        # only admission, no peer rows — and BOTH arms pay it, so the
+        # A/B still isolates the obs layer + its hub journal shipping
+        hub = OccupancyExchange()
+        hubs.append(hub)
+        return FleetConfig(replica="r0", replicas=("r0",), exchange=hub)
+
+    def arm(obs_cfg):
+        return max(
+            (
+                _sustained_shape(
+                    shape["kind"], shape["n_nodes"], shape["n_pods"],
+                    shape["rate"], mode=shape["mode"],
+                    split=shape["split"], batch=shape["batch"],
+                    obs=obs_cfg, fleet=fleet_cfg,
+                )
+                for _ in range(3)
+            ),
+            key=lambda a: a["sustained_pods_per_sec"],
+        )
+
+    off = arm(None)
+    on = arm(obs_on_cfg())
+    shipped = sum(len(h.journal_lines()) for h in hubs)
+    assert shipped > 0, (
+        "the obs-on arm never shipped a journal segment to the hub"
+    )
+    ratio = on["sustained_pods_per_sec"] / max(
+        off["sustained_pods_per_sec"], 1e-9
+    )
+    overhead = max(1.0 - ratio, 0.0)
+    assert on["slo"] is not None, "the obs-on arm must run the SLO engine"
+    assert on["obs_volume"]["journal_records"] > 0
+    assert overhead <= 0.05, (
+        f"observability overhead {overhead:.3f} exceeds the 5% budget "
+        f"(on={on['sustained_pods_per_sec']}, "
+        f"off={off['sustained_pods_per_sec']} pods/s)"
+    )
+    return {
+        "config": (
+            "obs-overhead A/B on the sustained streaming shape "
+            "(12k pods x 500 nodes @ 20k/s, batch 256): spans + "
+            "journal + flight recorder + live SLO engine ON vs "
+            "everything OFF, best-of-3 per arm, BOTH arms a single-"
+            "replica fleet over an in-process occupancy hub so the "
+            "on-arm's journal-segment shipping to the hub aggregation "
+            "surface is inside the measured window; asserts the whole "
+            "layer costs <= 5% sustained throughput"
+        ),
+        "off": off,
+        "on": on,
+        "obs_overhead_fraction": round(overhead, 4),
+        "obs_on_pods_per_sec": on["sustained_pods_per_sec"],
+        "obs_off_pods_per_sec": off["sustained_pods_per_sec"],
+        "slo_p99_pod_latency_s": on["slo"]["p99_pod_latency_s"],
+        "slo_healthy": on["slo"]["healthy"],
+        "journal_records": on["obs_volume"]["journal_records"],
+        "spans": on["obs_volume"]["spans"],
+        "hub_journal_lines_shipped": shipped,
+    }
+
+
 def pallas_microbench() -> dict:
     """The tpuSolver.pallas ladder micro-bench (ISSUE 13 satellite):
     the InterPodAffinity (term, domain) aggregation — jitted
@@ -1996,6 +2124,8 @@ def main() -> None:
     ladders["11_backlog_drain"] = backlog
     autotune = ladder12_autotune()
     ladders["12_autotune"] = autotune
+    obs_overhead = ladder13_obs_overhead()
+    ladders["13_obs_overhead"] = obs_overhead
     ladders["pallas_domain_counts"] = pallas_microbench()
     rebalance = ladder10_rebalance_loop()
     ladders["10_rebalance_loop"] = {
@@ -2100,6 +2230,17 @@ def main() -> None:
                 "tuned_pods_per_sec": autotune["tuned_pods_per_sec"],
                 "tuning_convergence_batches": autotune[
                     "tuning_convergence_batches"
+                ],
+                # ladder #13 hoist (ISSUE 14): what the whole obs
+                # layer (fleet-wide tracing + journal + SLO engine)
+                # costs on the sustained stream, asserted <= 5% inside
+                # the ladder, and the SLO engine's own live p99 from
+                # the obs-on arm
+                "slo_p99_pod_latency_s": obs_overhead[
+                    "slo_p99_pod_latency_s"
+                ],
+                "obs_overhead_fraction": obs_overhead[
+                    "obs_overhead_fraction"
                 ],
                 "vs_baseline": round(headline / BAND_TOP_PODS_PER_SEC, 2),
                 "baseline_note": (

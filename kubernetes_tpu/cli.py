@@ -119,17 +119,25 @@ def cmd_serve(args) -> int:
     cluster = ClusterState()
     sched_cfg = config_types.scheduler_config(cfg)
     sched_cfg.feature_gates = _feature_gates(args)
-    if args.obs or args.obs_journal or args.obs_dump:
-        from .obs import ObsConfig
+    if args.obs or args.obs_journal or args.obs_dump or args.slo:
+        from .obs import ObsConfig, SloConfig
 
         sched_cfg.obs = ObsConfig(
-            spans=True,
-            journal=True,
+            spans=bool(args.obs or args.obs_journal or args.obs_dump),
+            journal=bool(args.obs or args.obs_journal or args.obs_dump),
             journal_path=args.obs_journal,
             dump_path=args.obs_dump,
             # a serving process runs indefinitely: bound the in-memory
             # journal and rely on --obs-journal streaming for history
             journal_capacity=65536,
+            # live SLO engine (GET /debug/slo + scheduler_slo_*):
+            # --slo OBJECTIVE enables it with that per-pod latency
+            # objective in seconds
+            slo=(
+                SloConfig(latency_objective_s=args.slo)
+                if args.slo
+                else None
+            ),
         )
     if args.leader_elect:
         # client-go leaderelection.RunOrDie semantics over the state
@@ -302,6 +310,16 @@ def main(argv: list[str] | None = None) -> int:
         metavar="PATH",
         help="flight-recorder dump target for crash and on-demand dumps "
         "(implies --obs)",
+    )
+    p_serve.add_argument(
+        "--slo",
+        type=float,
+        metavar="SECONDS",
+        default=0.0,
+        help="enable the live SLO engine with this per-pod latency "
+        "objective (first-enqueue -> bind): sliding-window p50/p99, "
+        "bind throughput, multi-window error-budget burn — served at "
+        "GET /debug/slo and exported as scheduler_slo_*",
     )
     p_serve.set_defaults(fn=cmd_serve)
 

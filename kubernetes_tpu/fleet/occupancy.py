@@ -197,11 +197,25 @@ class OccupancyExchange:
         }
         self._node_rows: dict[str, dict[str, NodeRow]] = {}  # replica -> node -> row
         self._pod_rows: dict[str, dict[str, PodRow]] = {}  # replica -> pod -> row
-        # pod handoffs: to-replica -> pod key -> hop count. A replica
-        # whose shard cannot legally host a routed pod (persistent
-        # cross-shard conflict) releases it here for the next replica
-        # in the pod's rendezvous chain (fleet/runtime.py).
-        self._handoffs: dict[str, dict[str, int]] = {}
+        # pod handoffs: to-replica -> pod key -> (hop count, journey
+        # trace id). A replica whose shard cannot legally host a routed
+        # pod (persistent cross-shard conflict) releases it here for
+        # the next replica in the pod's rendezvous chain
+        # (fleet/runtime.py). The trace id is the PR 3 journey trace
+        # threaded ACROSS the handoff: the adopting replica's journal
+        # records continue the same trace, so `obs explain --fleet`
+        # renders enqueue→handoff→re-admit→bind as ONE trace.
+        self._handoffs: dict[str, dict[str, tuple[int, str]]] = {}
+        # append-only journal aggregation surface (the cross-replica
+        # obs tentpole): replicas ship bounded decision-journal
+        # segments — piggybacked on the existing write-behind flush,
+        # no new RPC cadence — and `obs explain --fleet` reads the
+        # merged stream. Bounded: a long-lived hub keeps the recent
+        # window, not unbounded history (replicas' own sinks are the
+        # durable store).
+        from collections import deque
+
+        self._journal: deque[str] = deque(maxlen=262_144)
         # replicas whose solve breaker is open (degraded-mode solve
         # resilience): peers prefer them LAST in rendezvous handoff
         # chains — don't route refugees to a sick replica. The replica
@@ -406,11 +420,38 @@ class OccupancyExchange:
         with self._lock:
             return frozenset(self._degraded)
 
+    # -- journal aggregation (obs explain --fleet's hub surface) --
+
+    def ship_journal(self, replica: str, lines) -> None:
+        """Append a replica's journal segment to the aggregation
+        surface. Reachability-gated (a partitioned replica's segment
+        waits out the partition with its buffered rows) but NOT
+        write-fenced: journal lines are append-only observability of
+        decisions that already happened — a fenced zombie's history is
+        exactly what a post-mortem needs to see."""
+        lines = list(lines)
+        if not lines:
+            return
+        with self._lock:
+            self._check_reachable(replica)
+            self._touch(replica)
+            self._journal.extend(lines)
+        metrics.fleet_journal_segments_total.inc()
+        metrics.fleet_journal_lines_total.inc(len(lines))
+
+    def journal_lines(self) -> list[str]:
+        """The aggregated journal stream, in arrival order. `obs
+        explain --fleet` re-orders per pod with the PR 8 merge rules,
+        so arrival order only needs to be deterministic, not sorted."""
+        with self._lock:
+            return list(self._journal)
+
     # -- pod handoffs --
 
     def hand_off(
         self, to_replica: str, pod_key: str, hops: int,
         from_replica: str | None = None,
+        trace: str = "",
     ) -> None:
         with self._lock:
             if from_replica is not None:
@@ -418,12 +459,16 @@ class OccupancyExchange:
                 self._check_write_fence(from_replica)
                 self._touch(from_replica)
             self._version += 1
-            self._handoffs.setdefault(to_replica, {})[pod_key] = hops
+            self._handoffs.setdefault(to_replica, {})[pod_key] = (
+                hops, trace,
+            )
         self._m["handoff"].inc()
 
-    def claim_handoffs(self, replica: str) -> list[tuple[str, int]]:
+    def claim_handoffs(self, replica: str) -> list[tuple[str, int, str]]:
         """Pop every handoff addressed to ``replica`` (sorted, so
-        claim order is deterministic)."""
+        claim order is deterministic). Each claim is (pod key, hops,
+        journey trace id) — the trace rode the handoff row so the
+        adopting replica's journal continues the SAME trace."""
         with self._lock:
             self._check_reachable(replica)
             self._touch(replica)  # liveness: the poll proves contact
@@ -431,7 +476,10 @@ class OccupancyExchange:
             if not rows:
                 return []
             self._version += 1
-            return sorted(rows.items())
+            return [
+                (k, hops, trace)
+                for k, (hops, trace) in sorted(rows.items())
+            ]
 
     def pending_handoff_keys(self) -> set[str]:
         """Pods released by one replica and not yet claimed by the

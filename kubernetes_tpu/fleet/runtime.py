@@ -180,6 +180,18 @@ class RemoteOccupancyExchange:
         # callers are single-threaded per replica (the scheduler's
         # locked apply phase / driver loop)
         self._buffer: list = []
+        # journal lines ride the SAME apply_ops flush but live in a
+        # SEPARATE buffer: row mutations are superseded by the
+        # wholesale resync republish (replace_pod_rows clears them),
+        # journal lines are append-only history that nothing
+        # re-creates — clearing them with the rows would silently
+        # lose hub-aggregation lines the shipping cursor already
+        # advanced past (review-caught). Bounded: a long partition
+        # drops the OLDEST lines at the cap, counted so the loss is
+        # observable instead of silent.
+        self._journal_buffer: list = []
+        self.journal_lines_dropped = 0
+        self._JOURNAL_BUFFER_CAP = 8192
         # a flush observed the hub write fence (this replica was
         # retired): sticky until re-registration, surfaced as a typed
         # AdmitConflict at the NEXT row mutation so FleetRuntime's
@@ -214,35 +226,57 @@ class RemoteOccupancyExchange:
             )
 
     def flush(self) -> None:
-        """Drain the write-behind buffer as one apply_ops RPC. On a
-        transport failure the buffer is RETAINED (idempotent upserts —
-        a retry replays safely; the wholesale resync republish
-        supersedes it regardless). A fenced rejection DROPS it: a
-        retired replica's rows must not land, and its healed
-        incarnation re-registers from truth."""
+        """Drain the write-behind buffer (rows + piggybacked journal
+        lines) as one apply_ops RPC. On a transport failure both are
+        RETAINED (idempotent upserts — a retry replays safely; the
+        wholesale resync republish supersedes the rows regardless). A
+        fenced rejection DROPS the rows — a retired replica's rows
+        must not land, and its healed incarnation re-registers from
+        truth — but NOT the journal half: the hub applies journal ops
+        before the fence-checked row ops, so the lines of the fenced
+        RPC already landed."""
         from .occupancy import AdmitConflict
 
-        if not self._buffer:
+        if not self._buffer and not self._journal_buffer:
             return
         ops, self._buffer = self._buffer, []
+        jl, self._journal_buffer = self._journal_buffer, []
         try:
-            self._op("apply_ops", replica=self._replica, ops=ops)
+            self._op(
+                "apply_ops", replica=self._replica,
+                ops=[["journal", line] for line in jl] + ops,
+            )
         except AdmitConflict:
             # fenced: the rows must not land — drop, and remember so
             # the next mutation surfaces the typed conflict (the
             # in-process hub raises it inline; silently succeeding
             # here would leave every later row discarded without the
-            # replica ever learning to resync)
+            # replica ever learning to resync). The journal lines
+            # landed server-side before the fence check.
             self._fenced_seen = True
         except Exception:
             self._buffer = ops + self._buffer  # retained for retry
+            self._journal_buffer = jl + self._journal_buffer
             if len(self._buffer) > 4 * self._buffer_cap:
-                # a long partition must not grow the buffer without
-                # bound: drop it — the raise below sets the caller's
-                # dirty flag, and the first reachable resync
+                # a long partition must not grow the buffers without
+                # bound: drop the rows — the raise below sets the
+                # caller's dirty flag, and the first reachable resync
                 # republishes every row wholesale from truth
                 self._buffer.clear()
+            if len(self._journal_buffer) > self._JOURNAL_BUFFER_CAP:
+                # journal lines have no republish path: drop the
+                # OLDEST beyond the cap and COUNT the loss (the hub
+                # keeps a recent window anyway; the replica's own
+                # sinks remain the durable store)
+                excess = (
+                    len(self._journal_buffer) - self._JOURNAL_BUFFER_CAP
+                )
+                del self._journal_buffer[:excess]
+                self.journal_lines_dropped += excess
             raise
+
+    def _pending_flush(self) -> int:
+        return len(self._buffer) + len(self._journal_buffer)
 
     def _buffered(self, kind: str, arg) -> None:
         if self._fenced_seen:
@@ -340,22 +374,41 @@ class RemoteOccupancyExchange:
     def hand_off(
         self, to_replica: str, pod_key: str, hops: int,
         from_replica: str | None = None,
+        trace: str = "",
     ) -> None:
         self.flush()
         self._op(
             "hand_off", to=to_replica, pod=pod_key, hops=int(hops),
+            trace=trace,
             **({"from": from_replica} if from_replica is not None else {}),
         )
 
     def claim_handoffs(self, replica: str) -> list:
         self.flush()
         return [
-            (k, int(h))
-            for k, h in self._op("claim_handoffs", replica=replica)[
+            (row[0], int(row[1]), str(row[2]) if len(row) > 2 else "")
+            for row in self._op("claim_handoffs", replica=replica)[
                 "handoffs"
             ]
             or []
         ]
+
+    def ship_journal(self, replica: str, lines) -> None:
+        """Journal segments ride the SAME apply_ops flush as the
+        buffered row mutations — the tentpole's no-new-RPC-cadence
+        contract — but in their own buffer: they are NOT fence-gated
+        (append-only observability, so they bypass the sticky-fence
+        check — a fenced zombie's history still reaches the hub at
+        its next flush), and they must survive the row buffer's
+        destructive paths (the resync republish clears rows it
+        supersedes; nothing re-creates journal history)."""
+        self._journal_buffer.extend(lines)
+        if self._pending_flush() >= self._buffer_cap:
+            self.flush()
+
+    def journal_lines(self) -> list[str]:
+        self.flush()
+        return list(self._op("journal_lines")["lines"] or [])
 
     def pending_handoff_keys(self) -> set:
         self.flush()
@@ -460,6 +513,11 @@ class FleetRuntime:
         # AdmitConflict — version races and fenced writes)
         self._cas_staged: set[str] = set()  # ktpu: guarded-by(cluster.lock)
         self.cas_conflicts = 0  # ktpu: guarded-by(cluster.lock)
+        # journal-shipping cursor: how many of this replica's journal
+        # records have been shipped to the hub's aggregation surface
+        # (PodDecisionJournal.total_records is monotone, so the cursor
+        # survives a bounded journal's deque eviction)
+        self._journal_shipped = 0
         with cluster.lock:
             self._recompute(cluster.list_nodes())
         metrics.fleet_replicas.set(len(self.membership.alive()))
@@ -479,6 +537,59 @@ class FleetRuntime:
         in-process hub)."""
         if isinstance(self.exchange, RemoteOccupancyExchange):
             self.exchange.set_buffer_cap(n)
+
+    # max journal lines per shipped segment: bounds both the hub-side
+    # append and the piggybacked flush payload (a mega-drain's burst
+    # catches up over the next few cycles instead of one huge RPC)
+    _JOURNAL_SEGMENT_LINES = 1024
+
+    def ship_journal_segment(self, scheduler) -> int:
+        """Ship this replica's journal records written since the last
+        segment to the hub's append-only aggregation surface — the
+        cross-replica `obs explain --fleet` source. Piggybacks on the
+        existing transport cadence: the remote adapter buffers the
+        lines into the SAME write-behind apply_ops flush the row
+        mutations ride (no new RPC cadence); the in-process hub is one
+        locked append. Bounded per call; returns lines shipped."""
+        journal = scheduler.journal
+        if journal is None:
+            return 0
+        pending = journal.total_records - self._journal_shipped
+        if pending <= 0:
+            return 0
+        lines = journal.lines  # flushes the lazy pending records
+        start = len(lines) - pending
+        if start < 0:
+            # a bounded serve journal evicted unshipped lines before
+            # they shipped: skip them (the streaming file sink is the
+            # durable store; the hub keeps the recent window)
+            self._journal_shipped += -start
+            start = 0
+            pending = len(lines)
+        take = min(pending, self._JOURNAL_SEGMENT_LINES)
+        if isinstance(lines, list):
+            # unbounded journal (sims, mega-drains): O(take) slice,
+            # never a full O(total_records) copy per cycle
+            segment = lines[start : start + take]
+        else:
+            from itertools import islice
+
+            segment = list(islice(lines, start, start + take))
+        if not segment:
+            return 0
+        try:
+            self.exchange.ship_journal(self.replica, segment)
+        except ExchangeUnreachable:
+            return 0  # retry next cycle; cursor unmoved
+        except AdmitConflict:
+            # journal shipping is not fence-gated at the hub, but a
+            # remote adapter's piggybacked flush can still surface the
+            # sticky fence — flag the resync like every other handler
+            with self.cluster.lock:
+                self._needs_resync = True
+            return 0
+        self._journal_shipped += take
+        return take
 
     _HANDOFF_AFTER = 2
     # bounded re-admission rounds when compare_and_stage loses its
@@ -621,6 +732,11 @@ class FleetRuntime:
             if now - self._last_lease_poll >= self.config.lease_poll_s:
                 self._last_lease_poll = now
                 self.refresh_membership()
+        # ship the journal segment written since the last cycle to the
+        # hub's aggregation surface (driver thread, outside the cluster
+        # lock: the remote adapter only buffers, the in-process hub is
+        # one locked append)
+        self.ship_journal_segment(scheduler)
         with self.cluster.lock:
             if self._exchange_dirty:
                 # hub writes failed while partitioned: once the hub is
@@ -640,7 +756,7 @@ class FleetRuntime:
             # adopt pods peers handed off to this replica (sorted,
             # deterministic): the claim makes this replica the pod's
             # route owner, so its watch events flow here from now on
-            for key, hops in handoffs:
+            for key, hops, trace in handoffs:
                 try:
                     ns, name = key.split("/", 1)
                     pod = self.cluster.get_pod(ns, name)
@@ -648,6 +764,13 @@ class FleetRuntime:
                     continue  # deleted while in handoff flight
                 if pod.node_name:
                     continue  # bound while in handoff flight
+                if trace and scheduler.journal is not None:
+                    # trace propagation across the handoff: the
+                    # releasing replica's journey trace id rode the
+                    # handoff row — seed it so this replica's records
+                    # for the pod continue the SAME trace (obs explain
+                    # --fleet renders the whole chain as one trace)
+                    scheduler.journal.pod_traces[key] = trace
                 self._routed_here[key] = hops
                 self._routed_away.discard(key)
                 if (
@@ -995,14 +1118,17 @@ class FleetRuntime:
 
     # called from the scheduler's admit-reject branch under
     # cluster.lock: ktpu: holds(cluster.lock)
-    def maybe_hand_off(self, pod: Pod) -> str | None:
+    def maybe_hand_off(self, pod: Pod, trace: str = "") -> str | None:
         """After _HANDOFF_AFTER consecutive reconcile rejections,
         release the pod to the next alive replica in its rendezvous
         chain — its shard may be able to host what this one legally
         cannot (e.g. the under-filled spread domain lives there). Hop
         counts cap the walk at one lap of the fleet; a pod the whole
-        fleet rejected parks unschedulable wherever it stands. Returns
-        the receiving replica, or None to keep the pod local."""
+        fleet rejected parks unschedulable wherever it stands.
+        ``trace`` is the pod's journey trace id — it rides the handoff
+        row so the adopting replica's journal continues the same
+        trace. Returns the receiving replica, or None to keep the pod
+        local."""
         key = pod.key
         if self._reject_counts.get(key, 0) < self._HANDOFF_AFTER:
             return None
@@ -1026,7 +1152,8 @@ class FleetRuntime:
             return None
         try:
             self.exchange.hand_off(
-                target, key, hops + 1, from_replica=self.replica
+                target, key, hops + 1, from_replica=self.replica,
+                trace=trace,
             )
         except ExchangeUnreachable:
             return None  # can't release through a hub we can't reach

@@ -487,6 +487,97 @@ flight_recorder_dumps_total = Counter(
     registry=REGISTRY,
 )
 
+# -- live SLO engine (kubernetes_tpu/obs/slo.py) --
+
+slo_p50_pod_latency_seconds = Gauge(
+    "scheduler_slo_p50_pod_latency_seconds",
+    "Sliding-window median per-pod scheduling latency (first queue "
+    "entry -> bind commit, the bench ladder's sustained-latency "
+    "definition), computed by the live SLO engine from the latencies "
+    "the apply path already materializes — zero new device syncs.",
+    registry=REGISTRY,
+)
+slo_p99_pod_latency_seconds = Gauge(
+    "scheduler_slo_p99_pod_latency_seconds",
+    "Sliding-window p99 per-pod scheduling latency (first queue entry "
+    "-> bind commit) from the live SLO engine — 'are we meeting the "
+    "latency SLO right now' without a bench ladder run.",
+    registry=REGISTRY,
+)
+slo_bind_throughput = Gauge(
+    "scheduler_slo_bind_throughput_pods_per_second",
+    "Pods bound per second over the SLO engine's sliding window "
+    "(ratio of sums, the CounterWindow.rate discipline).",
+    registry=REGISTRY,
+)
+slo_error_budget_burn = Gauge(
+    "scheduler_slo_error_budget_burn",
+    "Multi-window error-budget burn rate: (observed bad-event "
+    "fraction) / (allowed bad fraction), where a bad event is a bound "
+    "pod missing the latency objective or a bind failure. 1.0 burns "
+    "the budget exactly at the sustainable rate; the short window "
+    "catches fast burns, the long window slow ones.",
+    ["window"],
+    registry=REGISTRY,
+)
+slo_healthy = Gauge(
+    "scheduler_slo_healthy",
+    "1 while the SLO engine reads healthy; 0 while the short-window "
+    "burn rate exceeds the degraded threshold (with the minimum event "
+    "count met). The degraded-health signal the fleet handoff "
+    "ordering (exchange degraded flag) and the resilience breaker "
+    "(half-open probes deferred) consume.",
+    registry=REGISTRY,
+)
+
+# -- compile observability (kubernetes_tpu/obs/compile.py) --
+
+xla_compilations_total = Counter(
+    "scheduler_xla_compilations_total",
+    "XLA backend compilations observed by the process-wide compile "
+    "watcher (jax.monitoring backend_compile events) — each one is a "
+    "dispatch that paid a compile stall instead of a cache hit.",
+    registry=REGISTRY,
+)
+xla_compile_seconds_total = Counter(
+    "scheduler_xla_compile_seconds_total",
+    "Cumulative wall seconds spent in XLA backend compilation, as "
+    "observed by the compile watcher.",
+    registry=REGISTRY,
+)
+xla_compile_cache_keys = Gauge(
+    "scheduler_xla_compile_cache_keys",
+    "Distinct compile scopes (dispatch shape/static fingerprints) "
+    "this process has compiled for — the working-set size of the jit "
+    "cache as the scheduler sees it.",
+    registry=REGISTRY,
+)
+xla_recompilations = Gauge(
+    "scheduler_xla_recompilations",
+    "Compilations beyond the first per compile scope: a steady-state "
+    "loop re-paying a compile for a shape it already compiled — the "
+    "silent streaming-hot-path killer the known-shape regression test "
+    "pins at zero. Pairs with scheduler_xla_compile_cache_keys.",
+    registry=REGISTRY,
+)
+
+# -- fleet trace/journal aggregation (the cross-replica obs surface) --
+
+fleet_journal_segments_total = Counter(
+    "scheduler_fleet_journal_segments_total",
+    "Bounded journal segments this replica shipped to the occupancy "
+    "hub's append-only aggregation surface (piggybacked on the "
+    "existing write-behind flush — no new RPC cadence).",
+    registry=REGISTRY,
+)
+fleet_journal_lines_total = Counter(
+    "scheduler_fleet_journal_lines_total",
+    "Decision-journal lines this replica shipped to the hub's "
+    "aggregation surface (obs explain --fleet reads the merged "
+    "stream).",
+    registry=REGISTRY,
+)
+
 # -- continuous rebalancer (kubernetes_tpu/rebalance) --
 
 rebalance_runs_total = Counter(

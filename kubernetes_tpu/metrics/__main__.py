@@ -1,0 +1,130 @@
+"""CLI: auto-generate the metrics reference from the MET001 registry.
+
+    # render docs/METRICS.md from the registered series
+    python -m kubernetes_tpu.metrics --doc
+
+    # drift gate (the tier-1 test + CI use this): exit 1 when the
+    # committed doc no longer matches the registry
+    python -m kubernetes_tpu.metrics --check
+
+The source of truth is ``kubernetes_tpu/metrics/__init__.py`` — the
+same module the MET001 static-analysis pass resolves every
+``metrics.<attr>`` reference against — so the committed reference can
+never silently drift from what the code actually exports: adding or
+renaming a series without regenerating the doc fails
+``tests/test_metrics_doc.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+HEADER = """\
+# Metrics reference
+
+Auto-generated from the registered series in
+`kubernetes_tpu/metrics/__init__.py` (the MET001 registry) by
+`python -m kubernetes_tpu.metrics --doc`. Do not edit by hand —
+regenerate after adding or changing a series;
+`tests/test_metrics_doc.py` asserts this file matches the registry.
+
+| name | type | labels | help |
+|---|---|---|---|
+"""
+
+
+def _rows() -> list[tuple[str, str, str, str]]:
+    """(series name, type, labels, help) per registered metric, sorted
+    by series name. Reads the live module objects, not the AST, so the
+    doc reflects exactly what ``metrics.render()`` exposes."""
+    from prometheus_client import Counter, Gauge, Histogram, Summary
+
+    from kubernetes_tpu import metrics as m
+
+    kinds = {
+        Counter: "counter",
+        Gauge: "gauge",
+        Histogram: "histogram",
+        Summary: "summary",
+    }
+    rows = []
+    for attr in dir(m):
+        obj = getattr(m, attr)
+        kind = kinds.get(type(obj))
+        if kind is None:
+            continue
+        name = obj._name
+        if kind == "counter" and not name.endswith("_total"):
+            # prometheus_client strips the _total suffix internally;
+            # restore the exposition name dashboards key on
+            exposed = name + "_total"
+        else:
+            exposed = name
+        labels = ", ".join(obj._labelnames) if obj._labelnames else "-"
+        help_text = " ".join(obj._documentation.split())
+        rows.append((exposed, kind, labels, help_text))
+    rows.sort()
+    return rows
+
+
+def render_doc() -> str:
+    lines = [HEADER.rstrip("\n")]
+    for name, kind, labels, help_text in _rows():
+        help_md = help_text.replace("|", "\\|")
+        lines.append(f"| `{name}` | {kind} | {labels} | {help_md} |")
+    return "\n".join(lines) + "\n"
+
+
+def doc_path() -> Path:
+    return (
+        Path(__file__).resolve().parents[2] / "docs" / "METRICS.md"
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.metrics",
+        description="Metrics registry tools (doc generation + drift gate).",
+    )
+    parser.add_argument(
+        "--doc", action="store_true",
+        help="write docs/METRICS.md from the registered series",
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="exit 1 when docs/METRICS.md no longer matches the registry",
+    )
+    parser.add_argument(
+        "--stdout", action="store_true",
+        help="print the rendered doc instead of writing the file",
+    )
+    args = parser.parse_args(argv)
+    doc = render_doc()
+    if args.stdout:
+        sys.stdout.write(doc)
+        return 0
+    path = doc_path()
+    if args.check:
+        committed = path.read_text() if path.exists() else ""
+        if committed != doc:
+            print(
+                f"{path}: stale — regenerate with "
+                "`python -m kubernetes_tpu.metrics --doc`",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"{path}: matches the registry")
+        return 0
+    if args.doc:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(doc)
+        print(f"wrote {path} ({len(doc.splitlines())} lines)")
+        return 0
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,17 +28,24 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..utils.clock import Clock
-from .explain import Explanation, explain_pod, parse_stream
+from .explain import (
+    Explanation,
+    explain_pod,
+    merge_fleet_records,
+    parse_stream,
+)
 from .journal import (
     OUTCOMES,
     TERMINAL_OUTCOMES,
     PodDecisionJournal,
     attribute_failure,
+    fleet_merge_key,
     summarize_plugins,
     validate_line,
     validate_lines,
 )
 from .recorder import FlightRecorder, canonical
+from .slo import SloConfig, SloEngine
 from .span import Span, Tracer
 
 __all__ = [
@@ -49,9 +56,13 @@ __all__ = [
     "PodDecisionJournal",
     "FlightRecorder",
     "Explanation",
+    "SloConfig",
+    "SloEngine",
     "explain_pod",
+    "merge_fleet_records",
     "parse_stream",
     "attribute_failure",
+    "fleet_merge_key",
     "summarize_plugins",
     "validate_line",
     "validate_lines",
@@ -79,6 +90,31 @@ class ObsConfig:
     journal_path: str | None = None
     # crash / invariant-violation dump target for the flight recorder
     dump_path: str | None = None
+    # live SLO engine (obs/slo.py): an SloConfig enabling the sliding-
+    # window p50/p99 latency, bind throughput, and multi-window error-
+    # budget burn computation (scheduler_slo_* metrics + GET
+    # /debug/slo + the degraded-health signal). None = off. Independent
+    # of spans/journal — the engine reads only BatchResult numbers the
+    # loops already compute.
+    slo: SloConfig | None = None
+    # deterministic 1-in-N sampling for the PER-WATCH-EVENT enqueue
+    # span — the one span family whose volume scales with event rate
+    # (tens of thousands/s at sustained-stream scale) rather than with
+    # batches. The first event is always sampled and the counter is
+    # deterministic, so same-seed sim runs stay byte-identical. 1 =
+    # span every event (the PR 3 behavior). Batch-level spans
+    # (schedule_batch/dispatch/apply/bind/...) are never sampled: they
+    # are the trace's structure. The shipped default keeps the whole
+    # obs layer inside the <= 5% sustained-throughput budget bench
+    # ladder #13 asserts.
+    enqueue_span_sample_n: int = 64
+    # deterministic 1-in-N sampling for the PER-POD bind span (the
+    # other per-pod-volume family). The decision JOURNAL stays
+    # complete — one record per pod per batch, never sampled; the bind
+    # span only adds the commit's wall duration, which N-sampling
+    # preserves statistically. First bind always sampled; 1 = every
+    # bind (PR 3 behavior).
+    bind_span_sample_n: int = 8
 
 
 class _FileSink:

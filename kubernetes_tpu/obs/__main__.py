@@ -7,6 +7,16 @@
     # from a live scheduler's flight recorder (serve --mode scheduler)
     python -m kubernetes_tpu.obs explain pod-3 --url http://127.0.0.1:10259
 
+    # cross-replica fleet history: merge several replicas' journals
+    # (repeat --trace per file, point --trace at the hub's aggregated
+    # journal, or pull it live with --hub) and order records by the
+    # PR 8 fleet merge/tie-break rules
+    python -m kubernetes_tpu.obs explain pod-3 --fleet \\
+        --trace hub_journal.jsonl
+    python -m kubernetes_tpu.obs explain pod-3 --fleet \\
+        --trace r0.jsonl --trace r1.jsonl
+    python -m kubernetes_tpu.obs explain pod-3 --fleet --hub 127.0.0.1:50051
+
     # schema-check a journal / dump (the CI obs smoke)
     python -m kubernetes_tpu.obs validate journal.jsonl
 
@@ -21,8 +31,21 @@ from pathlib import Path
 
 
 def _load_lines(args) -> list[str]:
-    if args.trace:
-        return Path(args.trace).read_text().splitlines()
+    lines: list[str] = []
+    for trace in args.trace or []:
+        lines.extend(Path(trace).read_text().splitlines())
+    if getattr(args, "hub", None):
+        # the occupancy hub's append-only journal aggregation surface
+        # (fleet/occupancy.py ship_journal): replicas piggyback bounded
+        # journal segments on their write-behind flushes; one HubOp
+        # read returns the merged lines
+        from ..server.bulk import BulkClient
+
+        client = BulkClient(args.hub, retries=0)
+        try:
+            lines.extend(client.hub_op("journal_lines")["lines"] or [])
+        finally:
+            client.close()
     if args.url:
         import json
         import urllib.request
@@ -32,17 +55,22 @@ def _load_lines(args) -> list[str]:
         url = args.url.rstrip("/") + "/debug/flightrecorder"
         with urllib.request.urlopen(url, timeout=10.0) as r:
             doc = json.loads(r.read().decode())
-        return [canonical(rec) for rec in doc.get("decisions") or []] + [
-            canonical(sp) for sp in doc.get("spans") or []
-        ]
-    raise SystemExit("error: one of --trace or --url is required")
+        lines.extend(
+            [canonical(rec) for rec in doc.get("decisions") or []]
+            + [canonical(sp) for sp in doc.get("spans") or []]
+        )
+    if not lines and not (args.trace or args.url or getattr(args, "hub", None)):
+        raise SystemExit(
+            "error: one of --trace, --url, or --hub is required"
+        )
+    return lines
 
 
 def cmd_explain(args) -> int:
     from .explain import explain_pod, parse_stream
 
     decisions, spans = parse_stream(_load_lines(args))
-    out = explain_pod(decisions, args.pod, spans=spans)
+    out = explain_pod(decisions, args.pod, spans=spans, fleet=args.fleet)
     print(out.render())
     return 0 if out.found else 1
 
@@ -76,12 +104,25 @@ def main(argv=None) -> int:
         "pod", help="pod uid, ns/name key, or bare pod name"
     )
     p_explain.add_argument(
-        "--trace", metavar="FILE",
-        help="journal / flight-recorder JSONL to read",
+        "--trace", metavar="FILE", action="append",
+        help="journal / flight-recorder JSONL to read (repeatable: "
+        "--fleet merges several replicas' journals)",
     )
     p_explain.add_argument(
         "--url", metavar="URL",
         help="base URL of a live scheduler (reads /debug/flightrecorder)",
+    )
+    p_explain.add_argument(
+        "--fleet", action="store_true",
+        help="cross-replica mode: merge records from every input "
+        "journal with the PR 8 fleet merge/tie-break rules and render "
+        "the handoff chain (replicas traversed, one journey trace)",
+    )
+    p_explain.add_argument(
+        "--hub", metavar="HOST:PORT",
+        help="bulk gRPC address of a fleet occupancy hub: read its "
+        "aggregated journal surface (replicas ship bounded segments "
+        "piggybacked on their write-behind flushes)",
     )
     p_explain.set_defaults(fn=cmd_explain)
 

@@ -7,6 +7,16 @@ Input is any JSONL stream mixing ``{"k": "dec"}`` decision records and
 ``{"k": "span"}`` spans (a journal file, a flight-recorder dump, or the
 ``/debug/flightrecorder`` JSON body re-flattened by the CLI). Pods
 match by exact uid, exact ``ns/name`` key, or bare pod name.
+
+``--fleet`` mode (``explain_pod(..., fleet=True)``) reconstructs the
+CROSS-REPLICA history: the input is replicas' merged journals (the hub
+aggregation surface, several per-replica files, or one combined dump),
+records are ordered by the PR 8 fleet merge/tie-break key
+(``journal.fleet_merge_key`` — the same rule the fleet sim's
+journal-completeness invariant proved), and the render shows each
+record's writing replica plus the journey ``trace`` id the handoff
+rows propagated, so an enqueue→handoff→re-admit→solve→bind journey
+reads as ONE trace even though it crossed processes.
 """
 
 from __future__ import annotations
@@ -14,7 +24,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass, field
 
-from .journal import TERMINAL_OUTCOMES, summarize_plugins
+from .journal import TERMINAL_OUTCOMES, fleet_merge_key, summarize_plugins
 
 
 @dataclass
@@ -22,10 +32,34 @@ class Explanation:
     ref: str
     records: list[dict] = field(default_factory=list)  # journal order
     spans: list[dict] = field(default_factory=list)  # terminal batch's spans
+    fleet: bool = False  # cross-replica mode (render replica columns)
 
     @property
     def found(self) -> bool:
         return bool(self.records)
+
+    @property
+    def replicas(self) -> list[str]:
+        """Writing replicas in first-appearance order (the handoff
+        chain the pod traversed)."""
+        seen: list[str] = []
+        for rec in self.records:
+            r = rec.get("replica", "")
+            if r and r not in seen:
+                seen.append(r)
+        return seen
+
+    @property
+    def traces(self) -> list[str]:
+        """Distinct journey trace ids in first-appearance order. A
+        single-element list is the propagation proof: every record —
+        across every replica — shares one trace."""
+        seen: list[str] = []
+        for rec in self.records:
+            t = rec.get("trace", "")
+            if t and t not in seen:
+                seen.append(t)
+        return seen
 
     @property
     def terminal(self) -> dict | None:
@@ -42,6 +76,20 @@ class Explanation:
         first = self.records[0]
         uid = first.get("uid") or "?"
         lines = [f"pod {first['pod']} (uid {uid}): {len(self.records)} record(s)"]
+        if self.fleet:
+            reps = self.replicas
+            lines.append(
+                "  replicas: "
+                + (" -> ".join(reps) if reps else "(none tagged)")
+            )
+            traces = self.traces
+            if len(traces) == 1:
+                lines.append(f"  trace: {traces[0]} (one journey trace)")
+            elif traces:
+                lines.append(
+                    f"  trace: {len(traces)} distinct journeys "
+                    f"({', '.join(traces)})"
+                )
         term = self.terminal
         if term is None:
             last = self.records[-1]
@@ -71,6 +119,8 @@ class Explanation:
                 f"t={rec['t']}",
                 rec["outcome"],
             ]
+            if self.fleet and rec.get("replica"):
+                bits.insert(0, f"[{rec['replica']}]")
             if rec.get("node"):
                 bits.append(f"-> {rec['node']}")
             if rec.get("nominated"):
@@ -126,12 +176,49 @@ def _matches(rec: dict, ref: str) -> bool:
     return "/" in pod and pod.split("/", 1)[1] == ref
 
 
+def merge_fleet_records(records: list[dict]) -> list[dict]:
+    """Total-order one pod's records gathered from SEVERAL replicas'
+    journals: the PR 8 merge/tie-break key first (latest-t wins,
+    terminal then 'bound' preferred on ties, within-replica step as
+    the same-replica tiebreak), the writing replica as the final
+    cross-replica determinism tiebreak. Byte-deterministic for any
+    input permutation of the same record set — the `--selfcheck`
+    contract of the fleet explain smoke."""
+    return sorted(
+        records,
+        key=lambda r: (fleet_merge_key(r), r.get("replica", "")),
+    )
+
+
 def explain_pod(
-    decisions: list[dict], ref: str, spans: list[dict] | None = None
+    decisions: list[dict],
+    ref: str,
+    spans: list[dict] | None = None,
+    fleet: bool = False,
 ) -> Explanation:
     records = [r for r in decisions if _matches(r, ref)]
-    out = Explanation(ref=ref, records=records)
+    if fleet:
+        records = merge_fleet_records(records)
+    out = Explanation(ref=ref, records=records, fleet=fleet)
     term = out.terminal
     if term is not None and spans:
-        out.spans = [s for s in spans if s.get("trace") == term["step"]]
+        if fleet:
+            # step counters are per-replica (the merge key's own
+            # caveat), so a bare-step join would attach another
+            # replica's unrelated batch: require the span to carry the
+            # terminal record's replica tag too (the scheduler's root
+            # spans do; untagged spans stay unattributed rather than
+            # wrongly attributed)
+            term_replica = term.get("replica", "")
+            out.spans = [
+                s
+                for s in spans
+                if s.get("trace") == term["step"]
+                and (s.get("attrs") or {}).get("replica", "")
+                == term_replica
+            ]
+        else:
+            out.spans = [
+                s for s in spans if s.get("trace") == term["step"]
+            ]
     return out

@@ -90,7 +90,66 @@ TERMINAL_OUTCOMES = frozenset(
     }
 )
 
+# outcomes that RETIRE a pod's journey trace (obs tentpole): the pod's
+# current scheduling journey is over — a later re-entry (rebalance
+# migration, quarantine re-admit, a fresh incarnation's adoption)
+# starts a new history with a fresh trace. Deliberately narrower than
+# TERMINAL_OUTCOMES: unschedulable/bind_failure/permit verdicts retry
+# the SAME journey, and a trace must survive those retries (and fleet
+# handoffs between them) to render as one chain.
+_TRACE_RETIRING_OUTCOMES = frozenset({"bound", "quarantined", "recovered"})
+
 _REQUIRED_KEYS = ("k", "v", "step", "cycle", "pod", "outcome", "t")
+
+# optional decision-record fields and their required types — the schema
+# catch-up covering everything added since PR 3: journal tags
+# (``replica``/``incarnation`` from the fleet/restart layers,
+# ``drain_chunk``/``drain_trace`` from backlog drains), the journey
+# ``trace`` id the cross-replica handoff propagates, and the per-record
+# extras. ``validate_line`` is STRICT about key membership: a field
+# added to the writer without a validator entry fails tier-1 (and the
+# CI obs smoke) instead of silently passing validate — that is the
+# drift gate.
+_OPTIONAL_FIELDS: dict[str, type] = {
+    "uid": str,
+    "node": str,
+    "reason": str,
+    "profile": str,
+    "nominated": str,
+    "replica": str,
+    "trace": str,
+    "attempts": int,
+    "incarnation": int,
+    "drain_chunk": int,
+    "drain_trace": int,
+    "plugins": dict,
+}
+_KNOWN_KEYS = frozenset(_REQUIRED_KEYS) | frozenset(_OPTIONAL_FIELDS)
+
+# span records: required keys plus the optional ones every emitting
+# site may attach (parent/status/attrs — tuning spans, dispatch spans,
+# the recover/bisect roots all stay inside this surface)
+_SPAN_REQUIRED = ("name", "span", "trace", "start", "end", "dur")
+_SPAN_KNOWN = frozenset(_SPAN_REQUIRED) | {
+    "k", "v", "parent", "status", "attrs",
+}
+
+
+def fleet_merge_key(rec: dict) -> tuple:
+    """The PR 8 cross-replica journal merge/tie-break key, shared
+    between the fleet sim's journal-completeness invariant and
+    ``obs explain --fleet``: latest virtual time wins; on a t-tie
+    prefer terminal, then ``bound`` (a bind is irrevocable — a fenced
+    zombie's same-instant ``bind_failure`` can never supersede the
+    survivor's successful bind), then the within-replica step (steps
+    are NOT comparable across replicas, so it only breaks same-replica
+    ties)."""
+    return (
+        rec["t"],
+        1 if rec["outcome"] in TERMINAL_OUTCOMES else 0,
+        1 if rec["outcome"] == "bound" else 0,
+        rec["step"],
+    )
 
 
 def attribute_failure(prep, idx: int) -> dict[str, list[int]]:
@@ -180,17 +239,48 @@ class PodDecisionJournal:
         # capacity=None keeps every line (the sim's byte-identity and
         # completeness contracts need the full history); a long-running
         # serve process passes a bound and relies on the streaming sink
-        # for durability, so memory stays O(capacity)
+        # for durability, so memory stays O(capacity).
+        #
+        # Serialization is LAZY: ``record`` appends the dict to a
+        # pending list and the canonical-JSON encode runs at the first
+        # ``lines`` read (per-cycle fleet shipping, sim finish, dump,
+        # /debug) — off the per-pod hot path, where the obs-overhead
+        # ladder budgets the whole layer at <= 5%. The byte contract is
+        # unchanged: canonical() is deterministic whenever it runs.
         if capacity is None:
-            self.lines: list[str] = []
+            self._lines: list[str] = []
         else:
             from collections import deque
 
-            self.lines = deque(maxlen=capacity)
+            self._lines = deque(maxlen=capacity)
+        self._pending: list[dict] = []
         # constant fields merged into every record (e.g. the fleet
         # replica identity) — set once at wiring time, before any
         # record is written, so same-seed runs stay byte-identical
         self.tags: dict = {}
+        # journey-trace propagation (the cross-replica tentpole): pod
+        # key -> the trace id its whole scheduling journey shares. The
+        # FIRST record for a pod mints "<origin>:<step>" (origin = the
+        # writing replica/incarnation identity set at wiring time);
+        # every later record re-uses it, a fleet handoff ships it on
+        # the handoff row so the ADOPTING replica's records continue
+        # the SAME trace, and a terminal outcome retires it (a
+        # post-terminal re-admit — quarantine TTL, rebalance eviction —
+        # starts a fresh history with a fresh trace, the documented
+        # history semantics). Deterministic: derived from the step
+        # counter the records already carry.
+        self.pod_traces: dict[str, str] = {}
+        self.origin: str = "s-1"
+        # monotone record count (never decremented by a bounded deque's
+        # eviction): the fleet journal-shipping cursor reads this
+        self.total_records = 0
+        # per-outcome metric children resolved once, and the prometheus
+        # inc BATCHED python-side (one mutex-guarded float add per
+        # record is measurable at per-pod journal volume): counts
+        # accumulate in a plain dict and flush to the registry at every
+        # ``lines`` read / pending flush
+        self._outcome_counters: dict = {}
+        self._outcome_pending: dict[str, int] = {}
 
     def record(
         self,
@@ -228,10 +318,35 @@ class PodDecisionJournal:
             rec["attempts"] = attempts
         if nominated:
             rec["nominated"] = nominated
+        trace = self.pod_traces.get(pod.key)
+        if trace is None:
+            # origin identity + minting step + pod key: unique per
+            # journey, deterministic, and self-describing about WHERE
+            # the journey started (the handoff row ships it onward)
+            trace = f"{self.origin}:{step}:{pod.key}"
+            self.pod_traces[pod.key] = trace
+        rec["trace"] = trace
+        if outcome in _TRACE_RETIRING_OUTCOMES:
+            # the journey genuinely ended: bound (a later rebalance
+            # eviction starts a migration journey), quarantined (the
+            # TTL re-admit starts a new history — documented), or
+            # recovered (the adopting incarnation's records form the
+            # next history). NOT every TERMINAL outcome: unschedulable
+            # / bind_failure / permit verdicts lead to retries of the
+            # SAME journey, and retiring there would shatter one
+            # journey into per-attempt traces.
+            self.pod_traces.pop(pod.key, None)
         if self.tags:
             rec.update(self.tags)
-        self.lines.append(canonical(rec))
-        metrics.journal_records_total.labels(outcome).inc()
+        self.total_records += 1
+        self._pending.append(rec)
+        self._outcome_pending[outcome] = (
+            self._outcome_pending.get(outcome, 0) + 1
+        )
+        if len(self._pending) >= 4096:
+            # amortized flush bound: a serve process that is never
+            # read must not grow the pending list without limit
+            self._flush_pending()
         if self.recorder is not None:
             self.recorder.record_decision(rec)
         if self.sink is not None:
@@ -253,6 +368,28 @@ class PodDecisionJournal:
             attempts=attempts,
         )
 
+    def _flush_pending(self) -> None:
+        pending, self._pending = self._pending, []
+        self._lines.extend(canonical(r) for r in pending)
+        counts, self._outcome_pending = self._outcome_pending, {}
+        for outcome, n in counts.items():
+            counter = self._outcome_counters.get(outcome)
+            if counter is None:
+                counter = self._outcome_counters[outcome] = (
+                    metrics.journal_records_total.labels(outcome)
+                )
+            counter.inc(n)
+
+    @property
+    def lines(self):
+        """The canonical-JSONL record lines (list for unbounded
+        journals, deque for bounded ones). Flushes the lazily-held
+        pending records through ``canonical`` first — every reader
+        sees the complete, deterministic byte stream."""
+        if self._pending:
+            self._flush_pending()
+        return self._lines
+
     def dump(self, path) -> None:
         from pathlib import Path
 
@@ -271,7 +408,13 @@ class PodDecisionJournal:
 def validate_line(line: str) -> str | None:
     """Schema check for one journal/flight-recorder JSONL line. Returns
     an error string, or None when valid. Span lines (``k == "span"``)
-    are accepted and shallow-checked; unknown kinds are errors."""
+    are accepted and shallow-checked; unknown kinds are errors.
+
+    STRICT about key membership on both kinds: a writer-side field
+    added without a matching ``_OPTIONAL_FIELDS`` / ``_SPAN_KNOWN``
+    entry is a validation error, so schema drift fails tier-1 (and the
+    CI obs smoke, which validates a freshly recorded journal) instead
+    of silently passing."""
     try:
         rec = json.loads(line)
     except ValueError as e:
@@ -280,23 +423,50 @@ def validate_line(line: str) -> str | None:
         return "not a JSON object"
     kind = rec.get("k")
     if kind == "span":
-        for key in ("name", "span", "trace", "start", "end", "dur"):
+        for key in _SPAN_REQUIRED:
             if key not in rec:
                 return f"span record missing {key!r}"
+        for key in rec:
+            if key not in _SPAN_KNOWN:
+                return f"span record has unknown field {key!r}"
+        if "attrs" in rec and not isinstance(rec["attrs"], dict):
+            return "span attrs is not an object"
+        if "status" in rec and rec["status"] not in ("ok", "error"):
+            return f"span status {rec['status']!r} not ok|error"
         return None
     if kind != "dec":
         return f"unknown record kind {kind!r}"
     for key in _REQUIRED_KEYS:
         if key not in rec:
             return f"decision record missing {key!r}"
+    for key in rec:
+        if key not in _KNOWN_KEYS:
+            return f"decision record has unknown field {key!r}"
     if rec["v"] != SCHEMA_VERSION:
         return f"unsupported schema version {rec['v']!r}"
+    if not isinstance(rec["pod"], str):
+        return "field 'pod' is not a string"
+    for key in ("step", "cycle"):
+        if not isinstance(rec[key], int) or isinstance(rec[key], bool):
+            return f"field {key!r} is not an integer"
+    if not isinstance(rec["t"], (int, float)) or isinstance(
+        rec["t"], bool
+    ):
+        return "field 't' is not a number"
     if rec["outcome"] not in OUTCOMES:
         return f"unknown outcome {rec['outcome']!r}"
+    for key, typ in _OPTIONAL_FIELDS.items():
+        if key in rec and not isinstance(rec[key], typ):
+            return (
+                f"field {key!r} is {type(rec[key]).__name__}, "
+                f"expected {typ.__name__}"
+            )
+    # int-typed fields must not be bools (bool subclasses int)
+    for key in ("attempts", "incarnation", "drain_chunk", "drain_trace"):
+        if key in rec and isinstance(rec[key], bool):
+            return f"field {key!r} is bool, expected int"
     plugins = rec.get("plugins")
     if plugins is not None:
-        if not isinstance(plugins, dict):
-            return "plugins is not an object"
         for name, pair in plugins.items():
             if (
                 not isinstance(pair, list)

@@ -210,12 +210,21 @@ class SolveResilience:
         # False when the last one re-closes (the occupancy exchange's
         # degraded flag, so peers route refugees elsewhere)
         self.on_degraded = on_degraded
+        # SLO health signal (obs/slo.py, wired by the Scheduler): while
+        # the error budget burns past the degraded threshold, half-open
+        # breaker probes are DEFERRED — the rung under probe already
+        # failed once, and re-probing it while users are actively
+        # missing their SLO risks another failed batch exactly when it
+        # hurts most. The currently-working rung keeps serving; probes
+        # resume (and re-close can complete) once health returns.
+        self.slo_degraded = False
         self._state: dict[str, _ProfileState] = {}
         # python-side counters: the sim footer reads these (reading the
         # shared metrics registry would leak cross-run state)
         self.trips = 0
         self.recloses = 0
         self.probes = 0
+        self.probes_deferred = 0  # probes skipped while SLO-degraded
         self.rebuilds = 0
         if self.config.force_tier is not None and (
             self.config.force_tier not in ladder
@@ -251,6 +260,13 @@ class SolveResilience:
                 metrics.solve_tier.labels(profile).set(idx)
                 return idx, self.ladder[idx]
             if now >= until:
+                if self.slo_degraded:
+                    # SLO consumption: the fault window elapsed, but
+                    # the error budget is burning — keep serving at
+                    # the rung that works and defer the probe until
+                    # health returns
+                    self.probes_deferred += 1
+                    continue
                 # half-open: one probe at the failed rung
                 st.probing = idx
                 self.probes += 1
@@ -397,9 +413,19 @@ class SolveResilience:
             "trips": self.trips,
             "recloses": self.recloses,
             "probes": self.probes,
+            "probes_deferred": self.probes_deferred,
             "rebuilds": self.rebuilds,
             "profiles": per_profile,
         }
+
+    # -- SLO health consumption (obs/slo.py, wired by the Scheduler) --
+
+    def set_slo_degraded(self, degraded: bool) -> None:
+        """While set, ``acquire`` defers half-open probes: don't re-try
+        the rung that already failed while the error budget is
+        actively burning — the working rung keeps serving, the probe
+        (and its re-close) runs once health returns."""
+        self.slo_degraded = bool(degraded)
 
 
 # -- pre-apply output validation --

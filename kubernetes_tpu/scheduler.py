@@ -27,6 +27,7 @@ from . import metrics
 from .api.objects import Pod
 from .framework.interface import CycleState, StatusCode
 from .framework.runtime import WaitingPod
+from .obs.span import _NOOP as _NOOP_SPAN
 from .resilience import (
     ACT_BISECT,
     ACT_DESCEND,
@@ -445,6 +446,47 @@ class Scheduler:
         self.obs, self.journal, self.flight = build_obs(
             self.config.obs, self.clock
         )
+        # compile observability (obs/compile.py): the process-wide
+        # XLA-compile watcher — dispatch brackets attribute compiles to
+        # their shape scope; always on (it only costs work when a
+        # compile already happened)
+        from .obs.compile import WATCHER as _compile_watcher
+
+        _compile_watcher.install()
+        self._compile_watcher = _compile_watcher
+        # live SLO engine (obs/slo.py): sliding-window p50/p99 pod
+        # latency, bind throughput, multi-window error-budget burn —
+        # ticked from _record_metrics off numbers the loops already
+        # compute. None = off (the production default).
+        self.slo = None
+        if self.config.obs is not None and getattr(
+            self.config.obs, "slo", None
+        ) is not None:
+            from .obs.slo import SloEngine
+
+            self.slo = SloEngine(self.config.obs.slo, self.clock)
+            self.slo.on_health_change.append(self._on_slo_health)
+        # degraded-flag combiner: the fleet exchange's degraded flag is
+        # the OR of the solve breaker's state and the SLO engine's
+        # health — either signal routes handoff refugees elsewhere,
+        # and neither may clear the flag while the other still holds it
+        self._breaker_degraded = False
+        self._slo_degraded = False
+        # high-volume span-family sampling state (see _on_event and
+        # _commit_all): deterministic counters, first occurrence
+        # always sampled
+        self._enqueue_events = 0
+        self._enqueue_sample_n = (
+            max(int(self.config.obs.enqueue_span_sample_n), 1)
+            if self.config.obs is not None
+            else 1
+        )
+        self._bind_commits = 0
+        self._bind_sample_n = (
+            max(int(self.config.obs.bind_span_sample_n), 1)
+            if self.config.obs is not None
+            else 1
+        )
         # fleet runtime (kubernetes_tpu/fleet): partition view, shard
         # watch filter, occupancy exchange client. Built before the
         # initial informer sync so the sync itself is shard-scoped.
@@ -461,6 +503,15 @@ class Scheduler:
             self._span_tags = {"replica": self.fleet.replica}
             if self.journal is not None:
                 self.journal.tags["replica"] = self.fleet.replica
+        if self.journal is not None:
+            # journey-trace origin: the identity minted into each
+            # pod's trace id at its FIRST record — replica-qualified in
+            # fleet mode so a cross-replica trace names where the
+            # journey started (the handoff row then ships it onward)
+            self.journal.origin = (
+                f"{self.fleet.replica if self.fleet is not None else 's'}"
+                f"-{self.config.incarnation}"
+            )
         if self.config.incarnation > 1:
             # restarted incarnations tag every record/span so a merged
             # cross-incarnation journal attributes each record to the
@@ -635,11 +686,10 @@ class Scheduler:
             self.config.resilience,
             self.clock,
             build_ladder(self.mesh is not None),
-            on_degraded=(
-                self.fleet.set_solver_degraded
-                if self.fleet is not None
-                else None
-            ),
+            # the combiner ORs the breaker's state with the SLO
+            # engine's health before publishing the fleet degraded
+            # flag (no-op without a fleet runtime)
+            on_degraded=self._on_breaker_degraded,
         )
         # poison-batch quarantine: pod key -> (QueuedPodInfo, release
         # time). Entries re-admit through _release_quarantine at the
@@ -875,6 +925,33 @@ class Scheduler:
             rolled += 1
         return rolled
 
+    # -- degraded-health combiner (breaker state OR SLO health) --
+
+    def _on_breaker_degraded(self, degraded: bool) -> None:
+        """SolveResilience transition hook: the first breaker trip /
+        last re-close. Publishes through the combiner so an
+        SLO-degraded replica stays flagged even while its breakers are
+        closed."""
+        self._breaker_degraded = degraded
+        self._publish_degraded()
+
+    def _on_slo_health(self, healthy: bool) -> None:
+        """SloEngine health-flip hook: the error budget started (or
+        stopped) burning past the threshold. Feeds the resilience
+        layer — a half-open breaker defers its top-tier probe while
+        the SLO is already degraded — and the fleet degraded flag, so
+        handoff chains route refugees to replicas that are actually
+        meeting their SLOs."""
+        self._slo_degraded = not healthy
+        self.resilience.set_slo_degraded(not healthy)
+        self._publish_degraded()
+
+    def _publish_degraded(self) -> None:
+        if self.fleet is not None:
+            self.fleet.set_solver_degraded(
+                self._breaker_degraded or self._slo_degraded
+            )
+
     def reacquire_fence(self) -> None:
         """Re-acquire this scheduler's commit fence after it was
         revoked (lease re-acquired after a partition healed / a stall
@@ -912,7 +989,21 @@ class Scheduler:
         if ev.kind == "Event":
             return  # the scheduler's own recorder output
         if self.obs.enabled:
-            with self.obs.span("enqueue", kind=ev.kind, type=ev.type):
+            # deterministic 1-in-N sampling (ObsConfig.enqueue_span_
+            # sample_n): the enqueue span is the one family whose
+            # volume scales with the EVENT rate, and spanning every
+            # event at sustained-stream scale blows the obs-overhead
+            # budget. The first event always samples; the counter is
+            # deterministic so same-seed sims stay byte-identical.
+            self._enqueue_events += 1
+            n = self._enqueue_sample_n
+            if n <= 1 or self._enqueue_events % n == 1:
+                with self.obs.span(
+                    "enqueue", kind=ev.kind, type=ev.type,
+                    **({"sample_n": n} if n > 1 else {}),
+                ):
+                    self._ingest_event(ev)
+            else:
                 self._ingest_event(ev)
         else:
             self._ingest_event(ev)
@@ -1014,6 +1105,11 @@ class Scheduler:
                 elif pod.scheduler_name in self.solvers:
                     self.queue.update(pod)
             else:  # DELETED
+                if self.journal is not None:
+                    # a deleted pod's journey trace can never continue;
+                    # drop the entry so open-history traces stay
+                    # bounded by live pods
+                    self.journal.pod_traces.pop(pod.key, None)
                 if pod.node_name:
                     freed_node = pod.node_name
                     self.cache.remove_pod(pod.key)
@@ -1329,10 +1425,25 @@ class Scheduler:
         first_err = None
         for entry in pending:
             tb = self.clock.perf()
-            with self.obs.span(
-                "bind", trace_id=entry[6], pod=entry[2].key,
-                node=entry[3],
-            ) as bsp:
+            # bind spans are 1-in-N sampled (ObsConfig.bind_span_
+            # sample_n; deterministic counter, first bind always
+            # sampled): the journal below stays COMPLETE per pod — the
+            # span only adds the commit's wall duration, which
+            # sampling preserves statistically, and per-pod spans at
+            # sustained-stream volume are what the obs-overhead
+            # budget cannot afford
+            self._bind_commits += 1
+            bn = self._bind_sample_n
+            span_ctx = (
+                self.obs.span(
+                    "bind", trace_id=entry[6], pod=entry[2].key,
+                    node=entry[3],
+                    **({"sample_n": bn} if bn > 1 else {}),
+                )
+                if bn <= 1 or self._bind_commits % bn == 1
+                else _NOOP_SPAN
+            )
+            with span_ctx as bsp:
                 try:
                     ok = self._commit_binding(entry, res)
                 except Exception as e:  # a buggy PreBind/PostBind plugin
@@ -1365,6 +1476,15 @@ class Scheduler:
                 self._in_flight.pop(entry[1].key, None)
             # bind failures above requeued pods with backoff
             self._refresh_pending_gauge()
+        if self.slo is not None and (
+            res.e2e_latencies or res.bind_failures or res.scheduled
+        ):
+            # live SLO engine tick: POST-commit (the e2e latencies land
+            # at _commit_binding), one chokepoint for every dispatch
+            # loop — sync, pipelined, streaming, drain. Host arithmetic
+            # over numbers this batch already materialized; zero new
+            # device syncs (the CounterWindow sampling discipline).
+            self.slo.observe_batch(res)
         if first_err is not None:
             raise first_err
 
@@ -2103,10 +2223,32 @@ class Scheduler:
                 if static.extra_score is not None
                 else np.zeros(static.mask.shape, dtype=np.int32)
             )
-            fold_extenders(
-                self.extender_clients, static.reps, slot_nodes,
-                static.mask, extra,
-            )
+            if self.obs.enabled:
+                # cross-process trace propagation: the webhook round
+                # trips carry this batch's trace context so an
+                # extender server sharing the obs layer attributes its
+                # micro-batched evaluation to OUR trace (obs off =
+                # unchanged wire bytes)
+                cur = self.obs.current()
+                tctx = {
+                    "trace": prep.step,
+                    "parent": cur.span_id if cur is not None else None,
+                    "replica": (
+                        self.fleet.replica if self.fleet is not None else ""
+                    ),
+                    "incarnation": self.config.incarnation,
+                }
+                for cl in self.extender_clients:
+                    cl.trace_context = tctx
+            try:
+                fold_extenders(
+                    self.extender_clients, static.reps, slot_nodes,
+                    static.mask, extra,
+                )
+            finally:
+                if self.obs.enabled:
+                    for cl in self.extender_clients:
+                        cl.trace_context = None
             if extra.any():
                 static.extra_score = extra
         if dra_active:
@@ -2213,17 +2355,34 @@ class Scheduler:
         # backlog drains thread the chunk id into the dispatch span so
         # `obs explain` can attribute a pod to its drain chunk
         span_extra = (
-            {"drain_chunk": prep.step - self._drain_chunk_base}
+            {
+                "drain_chunk": prep.step - self._drain_chunk_base,
+                # the drain's root trace id: ties every chunk's spans
+                # into ONE drain trace (set by drain_backlog)
+                "drain_trace": self._drain_chunk_base,
+            }
             if self._backlog_drain_active
             else {}
         )
         # session mode: node tables + carried state stay device-resident;
         # dirty snapshot columns heal by version; only assignments download
+        #
+        # compile attribution (obs/compile.py): any XLA compile firing
+        # inside this bracket counts against the dispatch's shape/
+        # static fingerprint — a steady-state batch re-compiling a
+        # known shape is the silent hot-path killer the watcher's
+        # recompilation gauge (and the known-shape regression test)
+        # exists to catch. The span gets the delta as attributes when
+        # a compile actually happened.
+        compile_scope = self._compile_watcher.scope(
+            f"{prep.profile}:p{prep.pbatch.padded}xn{prep.batch.padded}"
+            f":split{split}:{tier_name}"
+        )
         with self.obs.span(
             "dispatch", trace_id=prep.step, profile=prep.profile,
             defer=defer, healed=heal_stale, split=split,
             mesh_devices=self._mesh_devices, **span_extra,
-        ), _tier_device_context(tier_name):
+        ) as dsp, _tier_device_context(tier_name), compile_scope:
             handle = solver.solve(
                 prep.batch, prep.pbatch, prep.static, prep.ports,
                 prep.spread, prep.interpod,
@@ -2238,6 +2397,12 @@ class Scheduler:
                 stream_carry_out=stream,
                 chain_key=chain_key,
             )
+            n_compiles, compile_s = compile_scope.delta()
+            if n_compiles:
+                dsp.set(
+                    xla_compiles=n_compiles,
+                    xla_compile_s=round(compile_s, 6),
+                )
         dispatch_dt = self.clock.perf() - t1
         if not prep.timing_observed:
             prep.timing_observed = True
@@ -2549,7 +2714,24 @@ class Scheduler:
                     fleet_why = self.fleet.admit(pod, node_name, self.cache)
                     if fleet_why is not None:
                         self._session_stale.add(profile)
-                        handed_to = self.fleet.maybe_hand_off(pod)
+                        # trace propagation across the handoff: mint
+                        # (or reuse) the pod's journey trace BEFORE the
+                        # release so it rides the handoff row — the
+                        # adopting replica's journal continues the SAME
+                        # trace and `obs explain --fleet` renders one
+                        # enqueue→handoff→re-admit→bind chain
+                        pod_trace = ""
+                        if self.journal is not None:
+                            pod_trace = self.journal.pod_traces.get(
+                                pod.key
+                            ) or (
+                                f"{self.journal.origin}:{prep.step}"
+                                f":{pod.key}"
+                            )
+                            self.journal.pod_traces[pod.key] = pod_trace
+                        handed_to = self.fleet.maybe_hand_off(
+                            pod, trace=pod_trace
+                        )
                         if handed_to is not None:
                             # released to a peer whose shard may host
                             # it: drop every local claim on the pod
@@ -2565,6 +2747,11 @@ class Scheduler:
                                         + fleet_why
                                     ),
                                     attempts=info.attempts,
+                                )
+                                # the peer owns the journey now; keep
+                                # no local trace entry behind
+                                self.journal.pod_traces.pop(
+                                    pod.key, None
                                 )
                             continue
                         res.unschedulable.append(pod.key)
@@ -4458,8 +4645,21 @@ class Scheduler:
         budget = hbm.device_budget_bytes(
             budget_bytes or self.config.hbm_budget_bytes
         )
-        shape = self.drain_shape(base_chunk)
-        est, splits = hbm.plan_chunk(shape, budget)  # BudgetExceeded -> caller
+        try:
+            shape = self.drain_shape(base_chunk)
+            est, splits = hbm.plan_chunk(shape, budget)  # BudgetExceeded -> caller
+        except Exception:
+            # the pre-dispatch planning path dies BEFORE run_streaming
+            # (whose own crash handler would dump): a BudgetExceeded /
+            # planner crash here must still leave the ring on disk —
+            # the drain's flight-recorder coverage matches the loops'
+            if self.flight is not None:
+                path = self.flight.dump(trigger="crash")
+                self._log.exception(
+                    "backlog drain planning failed; flight recorder "
+                    "dump: %s", path, extra={"step": self._trace_step},
+                )
+            raise
         chunk = est.chunk_pods
         compact = self.solver.config.compact_wire
         per_chunk = (
@@ -4483,6 +4683,15 @@ class Scheduler:
         self._backlog_drain_active = True
         self._drain_chunk_base = self._trace_step
         steps0 = self._trace_step
+        # the drain's ROOT trace id: every chunk's spans and journal
+        # records carry it (`drain_trace`), so the whole multi-chunk
+        # pass reads as one trace — a chunk's own step stays its batch
+        # trace id, the root ties the chunks together (the trace-id
+        # stability contract tests/test_obs.py pins at a multi-chunk
+        # shape)
+        self._span_tags["drain_trace"] = steps0
+        if self.journal is not None:
+            self.journal.tags["drain_trace"] = steps0
         h2d0 = metrics.h2d_bytes_total._value.get()
         chained0 = sum(
             s.dispatch_counts.get("stream_chained", 0)
@@ -4498,10 +4707,16 @@ class Scheduler:
             self.tuner.on_drain_start(self, chunk, budget)
         t0 = self.clock.perf()
         try:
-            results = self.run_streaming(max_batches=max_batches)
+            with self.obs.span(
+                "drain_backlog", trace_id=steps0, pods=backlog,
+                chunk_pods=chunk, budget_splits=splits,
+                **self._span_tags,
+            ):
+                results = self.run_streaming(max_batches=max_batches)
         finally:
             self.config.batch_size = old_batch
             self._backlog_drain_active = False
+            self._span_tags.pop("drain_trace", None)
             if self.tuner is not None:
                 self.tuner.on_drain_end(self)
                 report.final_chunk_pods = (
@@ -4509,6 +4724,7 @@ class Scheduler:
                 )
             if self.journal is not None:
                 self.journal.tags.pop("drain_chunk", None)
+                self.journal.tags.pop("drain_trace", None)
         dt = self.clock.perf() - t0
 
         report.results = results
@@ -4555,6 +4771,11 @@ class Scheduler:
         drain loop gated on pending would stop ticking while WaitingPods
         still need their timeout settled or a quarantine TTL still needs
         its re-admit, both of which happen at the next cycle's pop."""
+        if self.slo is not None:
+            # idle heartbeat for the SLO engine: the serve drain loop
+            # polls pending every iteration, so a degraded health flip
+            # heals by time even when no batch ever applies again
+            self.slo.tick()
         with self.cluster.lock:
             return (
                 len(self.queue)

@@ -102,7 +102,10 @@ class BulkCore:
     """Method implementations as bytes -> bytes functions (testable without
     a socket, like ExtenderCore's dict -> dict handlers)."""
 
-    def __init__(self, cluster: ClusterState, solver_config=None, exchange=None):
+    def __init__(
+        self, cluster: ClusterState, solver_config=None, exchange=None,
+        tracer=None,
+    ):
         self.cluster = cluster
         self._lock = threading.Lock()
         from ..solver.evaluate import BatchEvaluator
@@ -116,6 +119,16 @@ class BulkCore:
         # the first ExchangeOccupancy call unless an in-process fleet
         # shares its hub explicitly
         self.exchange = exchange
+        # obs span layer: server-side half of the cross-process trace
+        # propagation — a Solve request carrying meta.trace continues
+        # the CALLER's trace (id + parent span + replica + incarnation
+        # as span attributes) instead of starting an anonymous one.
+        # Default: a disabled tracer (one attribute check per call).
+        if tracer is None:
+            from ..obs import Tracer
+
+            tracer = Tracer(enabled=False)
+        self.tracer = tracer
 
     # -- helpers --
 
@@ -162,7 +175,22 @@ class BulkCore:
         mode = meta.get("mode") or "exact"
         commit = bool(meta.get("commit"))
         names = meta.get("names")
-        with self._lock:
+        # cross-process trace context (obs tentpole): the caller's
+        # trace id / parent span / replica / incarnation ride the
+        # request meta; the server-side span joins that trace so the
+        # bulk solve appears in the SAME trace as the caller's batch
+        tctx = meta.get("trace") or {}
+        with self.tracer.span(
+            "bulk_solve",
+            trace_id=tctx.get("trace"),
+            mode=mode,
+            commit=commit,
+            **{
+                k: tctx[k]
+                for k in ("parent", "replica", "incarnation")
+                if tctx.get(k) is not None
+            },
+        ), self._lock:
             nodes, pods_by_node = self._node_view()
             if not nodes:
                 return tensorcodec.encode({"error": "no nodes ingested"})
@@ -321,18 +349,35 @@ class BulkCore:
                 # of buffered stage/commit/withdraw mutations applied in
                 # order — ONE wire round trip instead of one per row.
                 # Idempotent upserts keyed by pod, so a client retrying
-                # a buffer after a transient failure is safe.
-                for kind, arg in meta.get("ops") or []:
+                # a buffer after a transient failure is safe. Journal
+                # segments piggyback on the same flush (kind "journal")
+                # and land FIRST: journal lines are append-only
+                # observability, deliberately not fence-gated, so a
+                # fenced zombie's history still aggregates even though
+                # its row mutations below reject.
+                ops = meta.get("ops") or []
+                journal_lines = [
+                    arg for kind, arg in ops if kind == "journal"
+                ]
+                if journal_lines:
+                    hub.ship_journal(replica, journal_lines)
+                for kind, arg in ops:
                     if kind == "stage":
                         hub.stage(replica, pod_row_from_list(arg))
                     elif kind == "commit":
                         hub.commit(replica, arg)
                     elif kind == "withdraw":
                         hub.withdraw(replica, arg)
+                    elif kind == "journal":
+                        pass  # shipped above, pre-fence
                     else:
                         raise ValueError(
                             f"unknown apply_ops kind {kind!r}"
                         )
+            elif op == "ship_journal":
+                hub.ship_journal(replica, meta.get("lines") or [])
+            elif op == "journal_lines":
+                out["lines"] = hub.journal_lines()
             elif op == "retire":
                 hub.retire(replica)
             elif op == "set_degraded":
@@ -343,10 +388,15 @@ class BulkCore:
                 hub.hand_off(
                     meta["to"], meta["pod"], int(meta.get("hops") or 0),
                     from_replica=meta.get("from") or None,
+                    trace=str(meta.get("trace") or ""),
                 )
             elif op == "claim_handoffs":
+                # (pod, hops, journey trace) — the trace context rides
+                # the handoff row across the wire (the cross-replica
+                # trace propagation tentpole)
                 out["handoffs"] = [
-                    [k, h] for k, h in hub.claim_handoffs(replica)
+                    [k, h, trace]
+                    for k, h, trace in hub.claim_handoffs(replica)
                 ]
             elif op == "pending_handoff_keys":
                 out["keys"] = sorted(hub.pending_handoff_keys())
@@ -462,9 +512,10 @@ def serve_bulk(
     port: int,
     host: str = "127.0.0.1",
     solver_config=None,
+    tracer=None,
 ):
     """Start the bulk gRPC server (non-blocking); returns the grpc server."""
-    core = BulkCore(cluster, solver_config=solver_config)
+    core = BulkCore(cluster, solver_config=solver_config, tracer=tracer)
     server, bound = make_grpc_server(core, port=port, host=host)
     server.start()
     return server
@@ -582,7 +633,7 @@ class BulkClient:
         return tensorcodec.decode(reply)[0]
 
     def solve(self, cpu_milli, mem_bytes, priority=None, mode="exact",
-              names=None, commit=False, namespace=None):
+              names=None, commit=False, namespace=None, trace=None):
         arrays = {
             "cpu_milli": np.asarray(cpu_milli, dtype=np.int64),
             "mem_bytes": np.asarray(mem_bytes, dtype=np.int64),
@@ -590,6 +641,12 @@ class BulkClient:
         if priority is not None:
             arrays["priority"] = np.asarray(priority, dtype=np.int32)
         meta = {"mode": mode, "commit": commit}
+        if trace is not None:
+            # cross-process trace propagation: a dict like
+            # {"trace": <id>, "parent": <span id>, "replica": ...,
+            # "incarnation": ...} — the server-side bulk_solve span
+            # joins the caller's trace instead of starting its own
+            meta["trace"] = dict(trace)
         if names is not None:
             meta["names"] = list(names)
         if namespace is not None:

@@ -180,7 +180,30 @@ class ExtenderCore:
         that fails to decode gets a per-request error (filter: the wire's
         {"error"} shape; prioritize: a DecodeError the HTTP layer turns
         into a 500 for that request alone) — it never poisons the batch."""
-        with self.tracer.span("extender_batch", requests=len(requests)):
+        # cross-process trace propagation: a request carrying the obs
+        # layer's traceContext (the outbound client attaches it per
+        # batch) pins this evaluation span to the CALLER's trace, so a
+        # webhook round trip appears inside the scheduling batch's
+        # trace instead of as an anonymous server-side event
+        tctx = next(
+            (
+                args["traceContext"]
+                for _verb, args in requests
+                if isinstance(args, Mapping)
+                and isinstance(args.get("traceContext"), Mapping)
+            ),
+            None,
+        )
+        attrs = {"requests": len(requests)}
+        trace_id = None
+        if tctx is not None:
+            trace_id = tctx.get("trace")
+            for k in ("parent", "replica", "incarnation"):
+                if tctx.get(k) is not None:
+                    attrs[k] = tctx[k]
+        with self.tracer.span(
+            "extender_batch", trace_id=trace_id, **attrs
+        ):
             return self._run_many(requests)
 
     def _run_many(self, requests: list[tuple[str, Mapping]]) -> list:
@@ -491,6 +514,7 @@ def make_app(
     scheduler=None,
     batch_window: float = 0.002,
     recorder=None,
+    slo=None,
 ):
     """aiohttp application wiring the pure handlers to the wire.
 
@@ -498,7 +522,9 @@ def make_app(
     background task drains the queue: ingested pods are bound by device
     solves — serve --mode scheduler. ``recorder`` (an
     obs.FlightRecorder, defaulting to the scheduler's) backs the
-    ``/debug/flightrecorder`` and ``/debug/spans`` endpoints."""
+    ``/debug/flightrecorder`` and ``/debug/spans`` endpoints; ``slo``
+    (an obs.SloEngine, defaulting to the scheduler's) backs
+    ``GET /debug/slo`` — the live are-we-meeting-SLOs answer."""
     import asyncio
 
     from aiohttp import web
@@ -563,6 +589,19 @@ def make_app(
                 status=404,
             )
         return web.json_response({"spans": recorder.spans()})
+
+    # -- live SLO surface (kubernetes_tpu/obs/slo.py) --
+
+    if slo is None and scheduler is not None:
+        slo = getattr(scheduler, "slo", None)
+
+    async def debug_slo(request):
+        if slo is None:
+            return web.json_response(
+                {"error": "SLO engine disabled (serve --slo)"},
+                status=404,
+            )
+        return web.json_response(slo.snapshot())
 
     # -- ingest surface (the watch-fed view's write side) --
 
@@ -636,6 +675,7 @@ def make_app(
         app.router.add_get(route, healthz)
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
     app.router.add_get("/debug/spans", debug_spans)
+    app.router.add_get("/debug/slo", debug_slo)
     app.router.add_post("/api/nodes", post_nodes)
     app.router.add_delete("/api/nodes/{name}", delete_node)
     app.router.add_post("/api/pods", post_pods)
@@ -742,7 +782,8 @@ def run_server(
         from .bulk import serve_bulk
 
         grpc_server = serve_bulk(
-            cluster, port=grpc_port, solver_config=solver_config
+            cluster, port=grpc_port, solver_config=solver_config,
+            tracer=tracer,
         )
     app = make_app(core, scheduler=scheduler, recorder=recorder)
     try:

@@ -63,6 +63,15 @@ class HTTPExtenderClient:
         self.cfg = cfg
         self.timeout = timeout
         self.transport = transport
+        # cross-process trace propagation (kubernetes_tpu/obs): when
+        # set — the scheduler points it at the current batch's trace
+        # context before folding — every outbound verb carries it as
+        # the payload's optional ``traceContext`` member, so an
+        # extender server sharing the obs layer attributes its
+        # micro-batched evaluation to the CALLER's trace. Servers that
+        # don't know the field ignore it (extender/v1 parsers skip
+        # unknown members; the reference server does).
+        self.trace_context: dict | None = None
 
     @property
     def name(self) -> str:
@@ -89,6 +98,8 @@ class HTTPExtenderClient:
     # -- verbs --
 
     def _post(self, verb: str, payload: dict) -> dict | list:
+        if self.trace_context is not None and isinstance(payload, dict):
+            payload = dict(payload, traceContext=self.trace_context)
         if self.transport is not None:
             try:
                 return self.transport(verb, payload)

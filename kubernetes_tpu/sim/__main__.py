@@ -161,6 +161,14 @@ def _print_fleet_result(res) -> None:
         )
     for rid in sorted(res.journal_digests):
         print(f"  journal[{rid}]={res.journal_digests[rid]}")
+    print(
+        f"  hub_journal: lines={s.get('hub_journal_lines', 0)} "
+        f"digest={s.get('hub_journal_digest', '')[:16]}"
+    )
+    for path in sorted(res.flight_dumps):
+        print(
+            f"  flight recorder dumped [{res.flight_dumps[path]}]: {path}"
+        )
     if res.violations:
         print(f"  {len(res.violations)} INVARIANT VIOLATION(S):")
         for v in res.violations[:20]:
@@ -181,6 +189,7 @@ def _run_fleet(args) -> int:
             args.profile, seed=args.seed, cycles=args.cycles,
             replicas=args.fleet, pipelined=pipelined,
             streaming=streaming, grpc_hub=args.hub_grpc,
+            flight_dump=args.flight_dump,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -189,6 +198,17 @@ def _run_fleet(args) -> int:
     if args.journal:
         from pathlib import Path
 
+        # the hub's aggregated journal (every replica's shipped
+        # segments, one file) — the `obs explain --fleet` source
+        Path(args.journal).write_text(
+            "\n".join(res.hub_journal_lines) + "\n"
+            if res.hub_journal_lines
+            else ""
+        )
+        print(
+            f"  hub journal written: {args.journal} "
+            f"({len(res.hub_journal_lines)} lines)"
+        )
         for rid, lines in sorted(res.journals.items()):
             path = f"{args.journal}.{rid}"
             Path(path).write_text("\n".join(lines) + "\n")
@@ -206,6 +226,14 @@ def _run_fleet(args) -> int:
                 file=sys.stderr,
             )
             return 1
+        if res.hub_journal_lines != res2.hub_journal_lines:
+            print(
+                "NON-DETERMINISTIC: hub-aggregated journals differ "
+                f"({len(res.hub_journal_lines)} vs "
+                f"{len(res2.hub_journal_lines)} lines)",
+                file=sys.stderr,
+            )
+            return 1
         if res.bindings != res2.bindings:
             print(
                 "NON-DETERMINISTIC: final bindings differ",
@@ -214,7 +242,7 @@ def _run_fleet(args) -> int:
             return 1
         print(
             "  selfcheck: two runs produced byte-identical per-replica "
-            "journals"
+            "journals (and hub aggregation)"
         )
     return 0 if res.ok else 1
 

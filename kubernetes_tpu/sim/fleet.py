@@ -73,6 +73,13 @@ class FleetSimResult:
     # per-replica decision journals (canonical JSONL) + digests
     journals: dict[str, list[str]] = field(default_factory=dict)
     journal_digests: dict[str, str] = field(default_factory=dict)
+    # the hub's append-only journal aggregation surface (obs tentpole):
+    # every replica's shipped segments merged in arrival order — the
+    # one-file `obs explain --fleet` source the CLI writes out
+    hub_journal_lines: list[str] = field(default_factory=list)
+    # per-replica flight-recorder dumps written on invariant violation
+    # (path -> replica), mirroring the single harness's trigger
+    flight_dumps: dict[str, str] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -95,7 +102,9 @@ class FleetSimHarness:
         streaming: bool | None = None,
         max_settle_rounds: int = 12,
         grpc_hub: bool = False,
+        flight_dump: str | None = None,
     ) -> None:
+        self.flight_dump_path = flight_dump
         self.profile = (
             get_profile(profile) if isinstance(profile, str) else profile
         )
@@ -411,6 +420,22 @@ class FleetSimHarness:
         return False
 
     def _finish(self, settled: bool) -> FleetSimResult:
+        # final journal ship: drain every alive replica's unshipped
+        # segment tail to the hub's aggregation surface (segments are
+        # bounded per call, so loop until empty), then flush the
+        # remote adapters' write-behind buffers so the piggybacked
+        # lines land before the hub is read
+        for rid, sched in self.schedulers.items():
+            if not self.alive[rid]:
+                continue
+            while sched.fleet.ship_journal_segment(sched) > 0:
+                pass
+        for client in self._hub_clients:
+            try:
+                client.flush()
+            except Exception:
+                pass  # partitioned teardown: the rows stay buffered
+        hub_journal = self.exchange.journal_lines()
         check_fleet_journal_completeness(
             self.cluster,
             list(self.schedulers.values()),
@@ -483,7 +508,27 @@ class FleetSimHarness:
                 for s in self.schedulers.values()
             ),
             "journal_digests": digests,
+            "hub_journal_lines": len(hub_journal),
+            "hub_journal_digest": _digest(hub_journal),
         }
+        flight_dumps: dict[str, str] = {}
+        if self.violations:
+            # the invariant trigger, fleet-wide: dump every replica's
+            # recent-history ring next to the violation report (the
+            # single harness's contract; no-op without a configured
+            # path — FlightRecorder.dump counts the trigger either way)
+            for rid in sorted(self.schedulers):
+                rec = self.schedulers[rid].flight
+                if rec is None:
+                    continue
+                path = (
+                    f"{self.flight_dump_path}.{rid}"
+                    if self.flight_dump_path
+                    else None
+                )
+                written = rec.dump(path=path, trigger="invariant")
+                if written:
+                    flight_dumps[written] = rid
         return FleetSimResult(
             profile=self.profile.name,
             seed=self.seed,
@@ -496,6 +541,8 @@ class FleetSimHarness:
             summary=summary,
             journals=journals,
             journal_digests=digests,
+            hub_journal_lines=hub_journal,
+            flight_dumps=flight_dumps,
         )
 
 
@@ -508,6 +555,7 @@ def run_fleet_sim(
     pipelined: bool | None = None,
     streaming: bool | None = None,
     grpc_hub: bool = False,
+    flight_dump: str | None = None,
 ) -> FleetSimResult:
     """One fresh seeded fleet run (library entry; CLI and tests).
     ``grpc_hub=True`` serves the occupancy hub behind a localhost bulk
@@ -518,4 +566,5 @@ def run_fleet_sim(
     return FleetSimHarness(
         profile, seed=seed, cycles=cycles, replicas=replicas,
         pipelined=pipelined, streaming=streaming, grpc_hub=grpc_hub,
+        flight_dump=flight_dump,
     ).run()

@@ -380,22 +380,18 @@ def check_fleet_journal_completeness(
     each fleet-bound pod to end ``bound``. The blind spot this closes:
     a replica loss orphaning pods that then never reach a terminal
     outcome anywhere."""
-    from ..obs.journal import TERMINAL_OUTCOMES
+    from ..obs.journal import TERMINAL_OUTCOMES, fleet_merge_key
     import json
 
-    # merge key: latest virtual time wins; on a t-tie prefer terminal,
-    # then 'bound' (a bind is irrevocable, so no same-instant record
-    # from another replica can supersede it — e.g. a fenced zombie's
-    # bind_failure racing the survivor's successful bind in the same
-    # cycle), then the within-replica step (steps are NOT comparable
-    # across replicas, so it only breaks same-replica ties)
-    def _key(rec: dict) -> tuple:
-        return (
-            rec["t"],
-            1 if rec["outcome"] in TERMINAL_OUTCOMES else 0,
-            1 if rec["outcome"] == "bound" else 0,
-            rec["step"],
-        )
+    # merge key: the PR 8 tie-break, now shared with `obs explain
+    # --fleet` (obs/journal.py fleet_merge_key) — latest virtual time
+    # wins; on a t-tie prefer terminal, then 'bound' (a bind is
+    # irrevocable, so no same-instant record from another replica can
+    # supersede it — e.g. a fenced zombie's bind_failure racing the
+    # survivor's successful bind in the same cycle), then the
+    # within-replica step (steps are NOT comparable across replicas,
+    # so it only breaks same-replica ties)
+    _key = fleet_merge_key
 
     merged: dict[str, dict] = {}
     for sched in schedulers:

@@ -304,6 +304,26 @@ PROFILES: dict[str, Profile] = {
             delete_pod_rate=0.4,
             fleet_replicas=2,
         ),
+        # fleet_handoff: the handoff-FORCING fleet shape (the obs
+        # cross-replica explain smoke leans on it). Two replicas shard
+        # two zones; a heavy hard-zone-spread cohort means pods routed
+        # to the replica whose zone is already at the global max skew
+        # get reconcile-rejected twice and release through the
+        # exchange's handoff rows to the peer — whose journal then
+        # continues the pod's journey trace. No delete churn: a
+        # handed-off pod's history must survive to the end of the run
+        # so `obs explain --fleet` can render the full
+        # enqueue→handoff→re-admit→bind chain.
+        Profile(
+            name="fleet_handoff",
+            nodes=6,
+            zones=2,
+            arrivals=(3, 6),
+            pod_spread_rate=0.6,
+            pod_anti_rate=0.2,
+            delete_pod_rate=0.0,
+            fleet_replicas=2,
+        ),
         # crash_restart: the scheduler process dies mid-batch — after
         # its pods are assumed and approved, before any bind commits —
         # and a FRESH incarnation is constructed on the same

@@ -271,4 +271,46 @@ obs_pod=$(python -c "import json,sys; print(json.loads(open(sys.argv[1]).readlin
 python -m kubernetes_tpu.obs explain "$obs_pod" --trace "$obs_journal"
 rm -f "$obs_journal"
 
+echo "== obs fleet smoke: cross-replica explain over the gRPC hub =="
+# the handoff-FORCING fleet profile drives a 2-replica fleet against
+# the gRPC-served occupancy hub: replicas ship bounded journal
+# segments to the hub's aggregation surface piggybacked on their
+# write-behind flushes, handoff rows carry each pod's journey trace
+# across the wire, and `obs explain --fleet` reconstructs the full
+# enqueue→handoff→re-admit→bind chain with the PR 8 merge rules.
+# --selfcheck proves the hub-aggregated journal (and therefore the
+# explain output, a pure function of it) byte-identical across runs.
+# The greps pin the tentpole non-vacuously: a handed-off pod must
+# exist, its history must span >= 2 replicas under ONE journey trace,
+# and it must reach a terminal outcome.
+fleet_journal=$(mktemp /tmp/ktpu_fleet_journal.XXXXXX.jsonl)
+python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile fleet_handoff \
+    --fleet 2 --hub-grpc --journal "$fleet_journal" --selfcheck
+python -m kubernetes_tpu.obs validate "$fleet_journal"
+handoff_pod=$(python - "$fleet_journal" <<'PYEOF'
+import collections, json, sys
+by_pod = collections.defaultdict(set)
+for ln in open(sys.argv[1]):
+    rec = json.loads(ln)
+    by_pod[rec["pod"]].add(rec.get("replica"))
+crossed = sorted(p for p, reps in by_pod.items() if len(reps) > 1)
+if not crossed:
+    sys.exit("OBS FLEET SMOKE: no pod was handed off between replicas")
+print(crossed[0])
+PYEOF
+)
+explain_out=$(python -m kubernetes_tpu.obs explain "$handoff_pod" \
+    --fleet --trace "$fleet_journal")
+echo "$explain_out"
+echo "$explain_out" | grep -qE "replicas: r[0-9]+ -> r[0-9]+" \
+    || { echo "OBS FLEET SMOKE: history does not span >= 2 replicas"; exit 1; }
+echo "$explain_out" | grep -q "one journey trace" \
+    || { echo "OBS FLEET SMOKE: the journey shattered into multiple traces"; exit 1; }
+echo "$explain_out" | grep -q "terminal outcome:" \
+    || { echo "OBS FLEET SMOKE: the handed-off pod never reached a terminal outcome"; exit 1; }
+rm -f "$fleet_journal" "$fleet_journal".r*
+
+echo "== metrics doc drift gate =="
+python -m kubernetes_tpu.metrics --check
+
 echo "CI gate: OK"

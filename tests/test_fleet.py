@@ -73,7 +73,8 @@ def test_exchange_handoff_claim_deterministic():
     ex.hand_off("r1", "default/a", 2)
     assert ex.pending_handoff_keys() == {"default/a", "default/b"}
     claimed = ex.claim_handoffs("r1")
-    assert claimed == [("default/a", 2), ("default/b", 1)]  # sorted
+    # sorted; each claim carries the journey trace the handoff shipped
+    assert claimed == [("default/a", 2, ""), ("default/b", 1, "")]
     assert ex.claim_handoffs("r1") == []
     assert ex.pending_handoff_keys() == set()
 

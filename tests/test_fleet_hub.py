@@ -274,8 +274,13 @@ def test_remote_exchange_mirrors_in_process_semantics(hub_server):
             with pytest.raises(AdmitConflict):
                 ex1.compare_and_stage("r1", _row(pod="default/q"), v)
             ex0.commit("r0", "default/p")
-            ex1.hand_off("r0", "default/h", 1, from_replica="r1")
-            assert ex0.claim_handoffs("r0") == [("default/h", 1)]
+            ex1.hand_off(
+                "r0", "default/h", 1, from_replica="r1",
+                trace="r1-1:2:default/h",
+            )
+            assert ex0.claim_handoffs("r0") == [
+                ("default/h", 1, "r1-1:2:default/h")
+            ]
             ex1.set_degraded("r1", True)
             assert ex0.degraded_replicas() == frozenset({"r1"})
         lv = local.peers_view("r1")

@@ -1,0 +1,54 @@
+"""docs/METRICS.md drift gate: the committed auto-generated metrics
+reference must match the registry (`python -m kubernetes_tpu.metrics
+--doc` regenerates it), and every registered series must appear."""
+
+from pathlib import Path
+
+from kubernetes_tpu.metrics.__main__ import doc_path, render_doc
+
+
+class TestMetricsDoc:
+    def test_committed_doc_matches_registry(self):
+        path = doc_path()
+        assert path.exists(), (
+            "docs/METRICS.md is missing — generate it with "
+            "`python -m kubernetes_tpu.metrics --doc`"
+        )
+        assert path.read_text() == render_doc(), (
+            "docs/METRICS.md is stale: a series was added/changed "
+            "without regenerating — run "
+            "`python -m kubernetes_tpu.metrics --doc`"
+        )
+
+    def test_every_registered_series_is_documented(self):
+        from prometheus_client import Counter, Gauge, Histogram
+
+        from kubernetes_tpu import metrics as m
+
+        doc = render_doc()
+        for attr in dir(m):
+            obj = getattr(m, attr)
+            if isinstance(obj, (Counter, Gauge, Histogram)):
+                name = obj._name
+                if isinstance(obj, Counter):
+                    name += "_total"
+                assert f"`{name}`" in doc, f"{name} missing from doc"
+
+    def test_doc_rows_carry_labels(self):
+        doc = render_doc()
+        # a known labeled series renders its label names
+        row = next(
+            ln for ln in doc.splitlines()
+            if "`scheduler_slo_error_budget_burn`" in ln
+        )
+        assert "window" in row
+
+    def test_check_mode_detects_drift(self, tmp_path, monkeypatch):
+        import kubernetes_tpu.metrics.__main__ as mm
+
+        stale = tmp_path / "METRICS.md"
+        stale.write_text("# stale\n")
+        monkeypatch.setattr(mm, "doc_path", lambda: stale)
+        assert mm.main(["--check"]) == 1
+        stale.write_text(render_doc())
+        assert mm.main(["--check"]) == 0

@@ -143,6 +143,7 @@ class TestJournalSchema:
             "k": "dec", "v": 1, "step": 3, "cycle": 9,
             "pod": "default/p", "uid": "u1", "outcome": "bound",
             "t": 5.0, "node": "n1", "attempts": 2,
+            "trace": "s-1:3:default/p",
         }
 
     @pytest.mark.parametrize(
@@ -252,7 +253,11 @@ class TestSchedulerJournal:
             SchedulerConfig(
                 batch_size=2,
                 solver=ExactSolverConfig(tie_break="first"),
-                obs=ObsConfig(spans=True, journal=True),
+                # full-fidelity spans: this test asserts EVERY bind
+                # span's attribution (sampling is covered separately)
+                obs=ObsConfig(
+                    spans=True, journal=True, bind_span_sample_n=1
+                ),
             ),
         )
         for i in range(5):
@@ -541,3 +546,256 @@ def test_build_obs_disabled_returns_nones():
     assert not tracer.enabled and journal is None and recorder is None
     tracer2, journal2, recorder2 = build_obs(ObsConfig())
     assert not tracer2.enabled and journal2 is None and recorder2 is None
+
+
+# -- schema catch-up (every field/outcome added since PR 3) -------------
+
+
+class TestSchemaCatchup:
+    def _base(self, **over):
+        rec = {
+            "k": "dec", "v": 1, "step": 1, "cycle": 1, "pod": "a/b",
+            "outcome": "bound", "t": 0.0,
+        }
+        rec.update(over)
+        return json.dumps(rec)
+
+    def test_accepts_every_current_writer_field(self):
+        line = self._base(
+            uid="u", node="n1", reason="r", profile="default-scheduler",
+            nominated="n2", replica="r0", trace="r0-1:3:a/b",
+            attempts=2, incarnation=2, drain_chunk=4, drain_trace=17,
+            plugins={"NodeResourcesFit": [1, 3]},
+        )
+        assert validate_line(line) is None
+
+    def test_accepts_every_outcome_added_since_pr3(self):
+        for outcome in (
+            "solver_error", "quarantined", "recovered",
+            "evicted_for_rebalance",
+        ):
+            assert validate_line(self._base(outcome=outcome)) is None
+
+    @pytest.mark.parametrize(
+        "over,frag",
+        [
+            # unknown-field strictness: writer drift fails validate
+            ({"mystery_field": 1}, "unknown field"),
+            # tag typing: the fleet/restart/drain tags added since PR 3
+            ({"replica": 7}, "expected str"),
+            ({"incarnation": "two"}, "expected int"),
+            ({"incarnation": True}, "bool, expected int"),
+            ({"drain_chunk": "4"}, "expected int"),
+            ({"drain_trace": 1.5}, "expected int"),
+            ({"trace": 12}, "expected str"),
+            ({"attempts": "many"}, "expected int"),
+            ({"step": 1.5}, "not an integer"),
+            ({"t": "now"}, "not a number"),
+            ({"pod": 9}, "not a string"),
+        ],
+    )
+    def test_known_bad_fixtures_fail(self, over, frag):
+        err = validate_line(self._base(**over))
+        assert err is not None and frag in err, (over, err)
+
+    def test_span_strictness_and_tuning_span_shape(self):
+        # a tuning span as the runtime emits it: accepted
+        span = json.dumps({
+            "k": "span", "v": 1, "name": "tuning", "span": 3,
+            "trace": 9, "parent": 1, "start": 0.0, "end": 1.0,
+            "dur": 1.0, "status": "ok",
+            "attrs": {"knob": "stream_depth", "decision": "probe"},
+        })
+        assert validate_line(span) is None
+        bad_attr = json.dumps({
+            "k": "span", "name": "tuning", "span": 3, "trace": 9,
+            "start": 0.0, "end": 1.0, "dur": 1.0, "attrs": "knob",
+        })
+        assert "attrs is not an object" in validate_line(bad_attr)
+        unknown = json.dumps({
+            "k": "span", "name": "x", "span": 1, "trace": 1,
+            "start": 0.0, "end": 0.0, "dur": 0.0, "surprise": 1,
+        })
+        assert "unknown field" in validate_line(unknown)
+        bad_status = json.dumps({
+            "k": "span", "name": "x", "span": 1, "trace": 1,
+            "start": 0.0, "end": 0.0, "dur": 0.0, "status": "meh",
+        })
+        assert "not ok|error" in validate_line(bad_status)
+
+    def test_live_scheduler_output_validates_clean(self):
+        """The self-consistency half of the drift gate: everything the
+        CURRENT writers emit (incl. trace ids) passes the strict
+        validator — so tightening the validator without updating it
+        for a new field is caught from both directions."""
+        cs = mk_cluster(2)
+        sched = obs_scheduler(cs)
+        for i in range(3):
+            cs.create_pod(
+                MakePod().name(f"p{i}").req({"cpu": "100m"}).obj()
+            )
+        cs.create_pod(MakePod().name("huge").req({"cpu": "64"}).obj())
+        sched.run_until_settled()
+        assert validate_lines(sched.journal.lines) == []
+        # span lines from the flight recorder validate too
+        assert validate_lines(sched.flight.lines()) == []
+
+
+# -- flight-recorder coverage of the streaming loop + drain -------------
+
+
+class TestStreamingFlightDump:
+    def test_streaming_crash_dumps_ring(self, tmp_path, monkeypatch):
+        path = tmp_path / "stream_crash.jsonl"
+        cs = mk_cluster(2)
+        sched = obs_scheduler(cs, dump_path=str(path))
+        cs.create_pod(MakePod().name("p").req({"cpu": "100m"}).obj())
+
+        def boom(*a, **kw):
+            raise RuntimeError("induced streaming death")
+
+        # die inside the streaming loop's apply path (an escaping
+        # exception, not a solver fault the ladder would absorb)
+        monkeypatch.setattr(sched, "_apply_flight", boom)
+        with pytest.raises(RuntimeError):
+            sched.run_streaming(max_batches=4)
+        assert path.exists()
+        kinds = {json.loads(ln)["k"] for ln in path.read_text().splitlines()}
+        assert "span" in kinds
+
+    def test_drain_planning_crash_dumps_ring(self, tmp_path):
+        """drain_backlog's PRE-dispatch path (budget planning) dies
+        before run_streaming's own crash handler could fire — the
+        drain must dump the ring itself."""
+        from kubernetes_tpu.solver.budget import BudgetExceeded
+
+        path = tmp_path / "drain_crash.jsonl"
+        cs = mk_cluster(2)
+        sched = obs_scheduler(cs, dump_path=str(path))
+        cs.create_pod(MakePod().name("p").req({"cpu": "100m"}).obj())
+        with pytest.raises(BudgetExceeded):
+            # a 1-byte budget: no chunk shape can ever fit
+            sched.drain_backlog(budget_bytes=1)
+        assert path.exists()
+
+    def test_fleet_sim_invariant_violation_dumps_every_replica(
+        self, tmp_path
+    ):
+        from kubernetes_tpu.sim.fleet import FleetSimHarness
+        from kubernetes_tpu.sim.invariants import _record
+
+        dump = tmp_path / "fleet_flight.jsonl"
+        h = FleetSimHarness(
+            "fleet_mixed", seed=1, cycles=2, replicas=2,
+            flight_dump=str(dump),
+        )
+        _record(h.violations, "capacity", 0, "synthetic for the test")
+        res = h.run()
+        assert set(res.flight_dumps.values()) == {"r0", "r1"}
+        for path in res.flight_dumps:
+            assert path.startswith(str(dump))
+            assert (tmp_path / path.split("/")[-1]).exists()
+
+
+class TestSpanSampling:
+    def test_bind_and_enqueue_spans_sample_deterministically(self):
+        """The high-volume families (per-event enqueue, per-pod bind)
+        sample 1-in-N with a deterministic counter: the first
+        occurrence always lands, counts match the configured rate, and
+        sampled spans carry sample_n so a reader can re-scale."""
+        cs = mk_cluster(2, cpu="64")
+        sched = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=16,
+                solver=ExactSolverConfig(tie_break="first"),
+                obs=ObsConfig(
+                    spans=True, journal=True,
+                    enqueue_span_sample_n=4, bind_span_sample_n=4,
+                ),
+            ),
+        )
+        for i in range(8):
+            cs.create_pod(
+                MakePod().name(f"p{i}").req({"cpu": "100m"}).obj()
+            )
+        sched.run_until_settled()
+        spans = sched.flight.spans()
+        binds = [s for s in spans if s["name"] == "bind"]
+        # 8 commits at 1-in-4: exactly commits 1 and 5 sampled
+        assert len(binds) == 2
+        assert all(s["attrs"]["sample_n"] == 4 for s in binds)
+        enqueues = [s for s in spans if s["name"] == "enqueue"]
+        assert enqueues  # the first event always samples
+        # the journal stays COMPLETE regardless of span sampling
+        assert len(sched.journal.last_outcomes()) == 8
+
+    def test_sample_n_1_keeps_every_span(self):
+        cs = mk_cluster(2, cpu="64")
+        sched = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=16,
+                solver=ExactSolverConfig(tie_break="first"),
+                obs=ObsConfig(
+                    spans=True, journal=True,
+                    enqueue_span_sample_n=1, bind_span_sample_n=1,
+                ),
+            ),
+        )
+        for i in range(4):
+            cs.create_pod(
+                MakePod().name(f"p{i}").req({"cpu": "100m"}).obj()
+            )
+        sched.run_until_settled()
+        binds = [
+            s for s in sched.flight.spans() if s["name"] == "bind"
+        ]
+        assert len(binds) == 4
+        assert all("sample_n" not in s.get("attrs", {}) for s in binds)
+
+
+# -- trace-id stability across a multi-chunk backlog drain --------------
+
+
+class TestDrainTraceStability:
+    def test_chunks_share_the_drain_root_trace(self):
+        """ISSUE satellite: every chunk's spans and journal records in
+        ONE drain_backlog pass carry the drain's root trace
+        (drain_trace), asserted at a multi-chunk shape."""
+        cs = mk_cluster(4)
+        sched = obs_scheduler(cs)
+        for i in range(12):
+            cs.create_pod(
+                MakePod().name(f"p{i:02d}").req({"cpu": "100m"}).obj()
+            )
+        root = sched._trace_step
+        report = sched.drain_backlog(chunk_pods=4)
+        assert report.chunks >= 3, "need a multi-chunk drain"
+        assert report.drained == 12
+        recs = [json.loads(ln) for ln in sched.journal.lines]
+        drain_recs = [r for r in recs if "drain_trace" in r]
+        assert drain_recs, "drain records must carry drain_trace"
+        assert {r["drain_trace"] for r in drain_recs} == {root}
+        # records from distinct chunks (multi-chunk proof)
+        assert len({r["drain_chunk"] for r in drain_recs}) >= 3
+        # dispatch spans of every chunk carry the same root
+        spans = sched.flight.spans()
+        drain_spans = [
+            s for s in spans
+            if s["name"] == "dispatch"
+            and "drain_trace" in (s.get("attrs") or {})
+        ]
+        assert len({s["attrs"]["drain_trace"] for s in drain_spans}) == 1
+        assert drain_spans[0]["attrs"]["drain_trace"] == root
+        assert len({s["attrs"]["drain_chunk"] for s in drain_spans}) >= 3
+        # the root drain_backlog span exists on the same trace
+        roots = [s for s in spans if s["name"] == "drain_backlog"]
+        assert roots and roots[0]["trace"] == root
+        # the tags are gone after the drain: later records are clean
+        cs.create_pod(MakePod().name("after").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        last = json.loads(sched.journal.lines[-1])
+        assert "drain_trace" not in last and "drain_chunk" not in last
+        # and the whole journal still validates under the strict schema
+        assert validate_lines(sched.journal.lines) == []

@@ -1,0 +1,326 @@
+"""kubernetes_tpu/obs/slo.py — the live SLO engine: sliding-window
+latency quantiles, bind throughput, multi-window error-budget burn,
+the degraded-health signal and its consumers (fleet degraded flag,
+resilience probe deferral), and the /debug/slo snapshot."""
+
+import pytest
+
+from kubernetes_tpu import metrics
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.obs import ObsConfig, SloConfig, SloEngine
+from kubernetes_tpu.scheduler import BatchResult, Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def _batch(scheduled=0, latencies=(), bind_failures=0):
+    res = BatchResult()
+    res.scheduled = [(f"default/p{i}", "n0") for i in range(scheduled)]
+    res.e2e_latencies = list(latencies)
+    res.bind_failures = [
+        (f"default/f{i}", "boom") for i in range(bind_failures)
+    ]
+    return res
+
+
+def mk_engine(**kw):
+    clock = FakeClock()
+    cfg = SloConfig(
+        latency_objective_s=kw.pop("objective", 1.0),
+        availability_target=kw.pop("target", 0.9),
+        window_s=kw.pop("window", 100.0),
+        burn_windows=kw.pop("burn_windows", (10.0, 100.0)),
+        degraded_burn=kw.pop("degraded_burn", 2.0),
+        min_events=kw.pop("min_events", 4),
+    )
+    return SloEngine(cfg, clock), clock
+
+
+class TestSloEngine:
+    def test_quantiles_over_sliding_window(self):
+        eng, clock = mk_engine()
+        eng.observe_batch(
+            _batch(scheduled=5, latencies=[0.1, 0.2, 0.3, 0.4, 0.5])
+        )
+        p50, p99 = eng.latency_quantiles()
+        assert p50 == 0.3
+        # nearest-rank (the ladder's formula): index int(0.99 * 4) = 3
+        assert p99 == 0.4
+        # samples age out of the window
+        clock.advance(200.0)
+        eng.observe_batch(_batch(scheduled=1, latencies=[0.9]))
+        p50, p99 = eng.latency_quantiles()
+        assert p50 == p99 == 0.9
+
+    def test_first_batch_throughput_is_zero_not_absurd(self):
+        """Review-caught: the first bucket's timestamp equals `now`,
+        and dividing by that zero span exported pods/nanosecond."""
+        eng, _ = mk_engine()
+        eng.observe_batch(_batch(scheduled=256, latencies=[0.1] * 256))
+        assert eng.throughput() == 0.0
+
+    def test_tick_heals_degraded_health_without_traffic(self):
+        """Review-caught: a degraded flip must not latch forever once
+        traffic stops — the time-only tick re-evaluates after the bad
+        events age out of the short window."""
+        eng, clock = mk_engine(min_events=4)
+        flips = []
+        eng.on_health_change.append(flips.append)
+        eng.observe_batch(_batch(scheduled=6, latencies=[5.0] * 6))
+        assert not eng.healthy
+        clock.advance(20.0)  # past the 10s short window; NO new batch
+        eng.tick()
+        assert eng.healthy
+        assert flips == [False, True]
+
+    def test_snapshot_is_a_tick_point(self):
+        eng, clock = mk_engine(min_events=4)
+        eng.observe_batch(_batch(scheduled=6, latencies=[5.0] * 6))
+        assert not eng.healthy
+        clock.advance(20.0)
+        assert eng.snapshot()["healthy"] is True
+
+    def test_throughput_is_ratio_of_sums(self):
+        eng, clock = mk_engine()
+        eng.observe_batch(_batch(scheduled=10, latencies=[0.1] * 10))
+        clock.advance(5.0)
+        eng.observe_batch(_batch(scheduled=10, latencies=[0.1] * 10))
+        # 20 pods over the 5s span between first and latest bucket
+        assert eng.throughput() == pytest.approx(4.0)
+
+    def test_burn_rate_zero_when_meeting_objective(self):
+        eng, _ = mk_engine()
+        eng.observe_batch(_batch(scheduled=8, latencies=[0.2] * 8))
+        assert eng.burn_rate(10.0) == 0.0
+        assert eng.healthy
+
+    def test_burn_rate_counts_latency_misses_and_bind_failures(self):
+        eng, _ = mk_engine()
+        # 4 good + 4 over-objective: bad fraction 0.5 vs budget 0.1
+        eng.observe_batch(
+            _batch(scheduled=8, latencies=[0.2] * 4 + [5.0] * 4)
+        )
+        assert eng.burn_rate(10.0) == pytest.approx(5.0)
+        eng2, _ = mk_engine()
+        eng2.observe_batch(_batch(scheduled=4, latencies=[0.1] * 4,
+                                  bind_failures=4))
+        assert eng2.burn_rate(10.0) == pytest.approx(5.0)
+
+    def test_multi_window_burn_diverges(self):
+        eng, clock = mk_engine()
+        # old badness outside the short window, inside the long one
+        eng.observe_batch(
+            _batch(scheduled=4, latencies=[5.0] * 4)
+        )
+        clock.advance(50.0)
+        eng.observe_batch(_batch(scheduled=4, latencies=[0.1] * 4))
+        assert eng.burn_rate(10.0) == 0.0  # short window: clean
+        assert eng.burn_rate(100.0) == pytest.approx(5.0)  # long: burning
+
+    def test_health_flip_requires_min_events(self):
+        eng, _ = mk_engine(min_events=10)
+        eng.observe_batch(_batch(scheduled=4, latencies=[5.0] * 4))
+        assert eng.healthy  # 4 events < min_events=10
+
+    def test_health_flip_fires_callbacks_and_gauge(self):
+        eng, clock = mk_engine(min_events=4)
+        flips = []
+        eng.on_health_change.append(flips.append)
+        eng.observe_batch(_batch(scheduled=6, latencies=[5.0] * 6))
+        assert not eng.healthy
+        assert flips == [False]
+        assert metrics.slo_healthy._value.get() == 0
+        # the badness ages out of the short window -> health returns
+        clock.advance(20.0)
+        eng.observe_batch(_batch(scheduled=6, latencies=[0.1] * 6))
+        assert eng.healthy
+        assert flips == [False, True]
+        assert eng.degraded_flips == 2
+
+    def test_snapshot_shape(self):
+        eng, _ = mk_engine()
+        eng.observe_batch(_batch(scheduled=3, latencies=[0.1, 0.2, 0.3]))
+        snap = eng.snapshot()
+        assert snap["healthy"] is True
+        # nearest-rank over 3 samples: index int(0.99 * 2) = 1
+        assert snap["p99_pod_latency_s"] == 0.2
+        assert set(snap["burn_rates"]) == {"10s", "100s"}
+        assert snap["window_events"] == 3
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SloConfig(latency_objective_s=0).validate()
+        with pytest.raises(ValueError):
+            SloConfig(availability_target=1.5).validate()
+        with pytest.raises(ValueError):
+            SloConfig(burn_windows=()).validate()
+
+
+class TestSchedulerIntegration:
+    def _cluster(self, n=3):
+        cs = ClusterState()
+        for i in range(n):
+            cs.create_node(
+                MakeNode()
+                .name(f"n{i}")
+                .capacity({"cpu": "4", "memory": "8Gi", "pods": "20"})
+                .obj()
+            )
+        return cs
+
+    def test_slo_engine_ticks_from_record_metrics(self):
+        cs = self._cluster()
+        sched = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=16,
+                solver=ExactSolverConfig(tie_break="first"),
+                obs=ObsConfig(slo=SloConfig(latency_objective_s=30.0)),
+            ),
+        )
+        assert sched.slo is not None
+        for i in range(4):
+            cs.create_pod(
+                MakePod().name(f"p{i}").namespace("default")
+                .req({"cpu": "100m"}).obj()
+            )
+        res = sched.schedule_batch()
+        assert len(res.scheduled) == 4
+        # the tick runs post-commit, so the e2e latencies landed
+        snap = sched.slo.snapshot()
+        assert snap["window_events"] == 4
+        assert snap["healthy"] is True
+        assert len(sched.slo._latencies) == 4
+        assert metrics.slo_p99_pod_latency_seconds._value.get() >= 0.0
+
+    def test_no_slo_config_means_engine_off(self):
+        cs = self._cluster()
+        sched = Scheduler(
+            cs,
+            SchedulerConfig(obs=ObsConfig(spans=True, journal=True)),
+        )
+        assert sched.slo is None
+
+    def test_slo_degradation_publishes_fleet_degraded_flag(self):
+        """The degraded-health consumer the ISSUE names: an
+        SLO-degraded replica publishes the exchange degraded flag so
+        handoff chains route refugees elsewhere — and clears it when
+        health returns, WITHOUT fighting the breaker's own flag."""
+        from kubernetes_tpu.fleet import FleetConfig, OccupancyExchange
+
+        cs = self._cluster()
+        hub = OccupancyExchange()
+        sched = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=16,
+                solver=ExactSolverConfig(tie_break="first"),
+                obs=ObsConfig(
+                    slo=SloConfig(
+                        latency_objective_s=0.001,  # everything misses
+                        min_events=2,
+                        burn_windows=(10.0, 100.0),
+                    )
+                ),
+                fleet=FleetConfig(
+                    replica="r0", replicas=("r0", "r1"), exchange=hub
+                ),
+            ),
+            clock=FakeClock(),
+        )
+        res = _batch(scheduled=4, latencies=[5.0] * 4)
+        sched._commit_all([], [], res)  # the post-commit SLO tick
+        assert not sched.slo.healthy
+        assert "r0" in hub.degraded_replicas()
+        # breaker untouched: the flag clears when SLO health returns
+        sched.clock.advance(20.0)
+        sched._commit_all([], [], _batch(scheduled=4, latencies=[0.0] * 4))
+        assert sched.slo.healthy
+        assert "r0" not in hub.degraded_replicas()
+
+    def test_debug_slo_endpoint(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kubernetes_tpu.server.extender import ExtenderCore, make_app
+
+        cs = self._cluster()
+        sched = Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=16,
+                solver=ExactSolverConfig(tie_break="first"),
+                obs=ObsConfig(slo=SloConfig(latency_objective_s=30.0)),
+            ),
+        )
+        for i in range(3):
+            cs.create_pod(
+                MakePod().name(f"p{i}").namespace("default")
+                .req({"cpu": "100m"}).obj()
+            )
+        sched.schedule_batch()
+        core = ExtenderCore(cs, backend="oracle")
+        app = make_app(core, slo=sched.slo)
+
+        async def drive():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/debug/slo")
+                assert r.status == 200
+                doc = await r.json()
+                assert doc["healthy"] is True
+                assert doc["window_events"] == 3
+                assert "burn_rates" in doc
+            finally:
+                await client.close()
+
+        asyncio.new_event_loop().run_until_complete(drive())
+
+    def test_debug_slo_404_when_disabled(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from kubernetes_tpu.server.extender import ExtenderCore, make_app
+
+        cs = self._cluster()
+        app = make_app(ExtenderCore(cs, backend="oracle"))
+
+        async def drive():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/debug/slo")
+                assert r.status == 404
+            finally:
+                await client.close()
+
+        asyncio.new_event_loop().run_until_complete(drive())
+
+    def test_slo_degradation_defers_breaker_probes(self):
+        """Resilience consumption: a half-open probe whose fault
+        window elapsed is DEFERRED while the SLO is degraded, and
+        fires once health returns."""
+        from kubernetes_tpu.resilience import SolveResilience, ResilienceConfig
+
+        clock = FakeClock()
+        r = SolveResilience(
+            ResilienceConfig(trip_after=1, open_seconds=5.0),
+            clock,
+            ("mesh", "single", "cpu", "host"),
+        )
+        st = r._st("default")
+        st.open_until[0] = clock.now() + 5.0
+        clock.advance(10.0)  # window elapsed: probe due
+        r.set_slo_degraded(True)
+        idx, _tier = r.acquire("default")
+        assert idx == 1  # probe deferred: serve at the next rung
+        assert st.probing is None
+        assert r.probes_deferred == 1
+        r.set_slo_degraded(False)
+        idx, _tier = r.acquire("default")
+        assert idx == 0  # health returned: the probe fires
+        assert st.probing == 0

@@ -1,0 +1,346 @@
+"""Cross-process trace propagation (the fleet-wide observability
+tentpole): journey traces minted per pod and stable across retries,
+handoff rows carrying the trace between replicas, the hub's journal
+aggregation surface, the PR 8 merge rules shared with `obs explain
+--fleet`, and the trace context threaded over the extender webhook and
+bulk Solve wire boundaries."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.fleet import OccupancyExchange
+from kubernetes_tpu.obs import (
+    FlightRecorder,
+    ObsConfig,
+    PodDecisionJournal,
+    Tracer,
+    explain_pod,
+    fleet_merge_key,
+    merge_fleet_records,
+)
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def _pod(name="p0", ns="default"):
+    return MakePod().name(name).namespace(ns).req({"cpu": "100m"}).obj()
+
+
+class TestJourneyTrace:
+    def test_trace_minted_once_and_stable_across_retries(self):
+        j = PodDecisionJournal(clock=FakeClock())
+        j.origin = "r0-1"
+        pod = _pod()
+        r1 = j.record(3, 1, pod, "unschedulable")
+        r2 = j.record(5, 2, pod, "discarded")
+        r3 = j.record(7, 3, pod, "bound", node="n0")
+        assert r1["trace"] == "r0-1:3:default/p0"
+        assert r1["trace"] == r2["trace"] == r3["trace"]
+
+    def test_bound_retires_the_trace(self):
+        j = PodDecisionJournal(clock=FakeClock())
+        j.origin = "s-1"
+        pod = _pod()
+        first = j.record(1, 1, pod, "bound", node="n0")
+        # a later migration (evicted_for_rebalance) is a NEW journey
+        again = j.record(9, 2, pod, "evicted_for_rebalance", node="n0")
+        assert first["trace"] != again["trace"]
+        assert again["trace"] == "s-1:9:default/p0"
+
+    def test_non_bound_terminals_keep_the_journey_only_when_retrying(self):
+        j = PodDecisionJournal(clock=FakeClock())
+        pod = _pod()
+        j.record(1, 1, pod, "unschedulable")
+        assert pod.key in j.pod_traces  # retries continue this journey
+        j.record(2, 2, pod, "quarantined")
+        assert pod.key not in j.pod_traces  # TTL re-admit = new history
+
+    def test_seeded_trace_is_reused_verbatim(self):
+        """The adopting replica's journal continues the trace the
+        handoff row shipped — never re-mints."""
+        j = PodDecisionJournal(clock=FakeClock())
+        j.origin = "r1-1"
+        pod = _pod()
+        j.pod_traces[pod.key] = "r0-1:4:default/p0"  # from the claim
+        rec = j.record(2, 1, pod, "bound", node="n1")
+        assert rec["trace"] == "r0-1:4:default/p0"
+
+
+class TestHandoffRowTrace:
+    def test_hand_off_carries_trace_to_claim(self):
+        ex = OccupancyExchange()
+        ex.hand_off("r1", "default/p0", 1, from_replica="r0",
+                    trace="r0-1:4:default/p0")
+        ex.hand_off("r1", "default/a", 2, from_replica="r0")
+        claims = ex.claim_handoffs("r1")
+        assert claims == [
+            ("default/a", 2, ""),
+            ("default/p0", 1, "r0-1:4:default/p0"),
+        ]
+        assert ex.claim_handoffs("r1") == []
+
+
+class TestHubJournalAggregation:
+    def test_ship_and_read_in_arrival_order(self):
+        ex = OccupancyExchange()
+        ex.ship_journal("r0", ['{"a":1}', '{"a":2}'])
+        ex.ship_journal("r1", ['{"b":1}'])
+        assert ex.journal_lines() == ['{"a":1}', '{"a":2}', '{"b":1}']
+
+    def test_partitioned_replica_cannot_ship(self):
+        from kubernetes_tpu.fleet.occupancy import ExchangeUnreachable
+
+        ex = OccupancyExchange()
+        ex.set_partitioned("r0", True)
+        with pytest.raises(ExchangeUnreachable):
+            ex.ship_journal("r0", ['{"x":1}'])
+        assert ex.journal_lines() == []
+
+    def test_fenced_replica_still_ships_journal(self):
+        """Journal lines are append-only observability, deliberately
+        NOT write-fenced: a zombie's history is what the post-mortem
+        needs."""
+        ex = OccupancyExchange()
+        ex.retire("r0")
+        ex.ship_journal("r0", ['{"x":1}'])
+        assert ex.journal_lines() == ['{"x":1}']
+
+    def test_runtime_segment_shipping_is_bounded_and_cursor_driven(self):
+        from kubernetes_tpu.fleet import FleetConfig
+        from kubernetes_tpu.fleet.runtime import FleetRuntime
+        from kubernetes_tpu.state.cluster import ClusterState
+
+        clock = FakeClock()
+        cs = ClusterState(clock=clock)
+        ex = OccupancyExchange(clock=clock)
+        rt = FleetRuntime(
+            FleetConfig(replica="r0", replicas=("r0",), exchange=ex),
+            cs, clock,
+        )
+
+        class _Sched:
+            journal = PodDecisionJournal(clock=clock)
+
+        sched = _Sched()
+        for i in range(5):
+            sched.journal.record(1, 1, _pod(f"p{i}"), "bound", node="n0")
+        assert rt.ship_journal_segment(sched) == 5
+        assert rt.ship_journal_segment(sched) == 0  # cursor advanced
+        sched.journal.record(2, 2, _pod("p9"), "bound", node="n0")
+        assert rt.ship_journal_segment(sched) == 1
+        assert len(ex.journal_lines()) == 6
+
+
+class TestRemoteJournalBuffer:
+    def test_resync_republish_does_not_drop_buffered_journal_lines(self):
+        """Review-caught: journal lines ride the write-behind flush but
+        in their OWN buffer — replace_pod_rows clears the row buffer
+        it supersedes, never the journal history nothing re-creates."""
+        from kubernetes_tpu.fleet.runtime import RemoteOccupancyExchange
+
+        sent = []
+
+        class _FakeClient:
+            def hub_op(self, op, **meta):
+                sent.append((op, meta))
+                return {"version": 1, "lines": []}
+
+            def close(self):
+                pass
+
+        remote = RemoteOccupancyExchange(
+            "unused:0", "r0", client=_FakeClient()
+        )
+        remote.ship_journal("r0", ['{"a":1}', '{"a":2}'])
+        remote.replace_pod_rows("r0", [])  # the resync republish
+        assert remote._journal_buffer == ['{"a":1}', '{"a":2}']
+        remote.flush()
+        ops = next(m["ops"] for op, m in sent if op == "apply_ops")
+        assert ops == [["journal", '{"a":1}'], ["journal", '{"a":2}']]
+
+    def test_journal_buffer_bounded_with_counted_drops(self):
+        from kubernetes_tpu.fleet.runtime import RemoteOccupancyExchange
+
+        class _DownClient:
+            def hub_op(self, op, **meta):
+                raise ConnectionError("hub down")
+
+            def close(self):
+                pass
+
+        remote = RemoteOccupancyExchange(
+            "unused:0", "r0", client=_DownClient()
+        )
+        remote._JOURNAL_BUFFER_CAP = 4
+        from kubernetes_tpu.fleet.occupancy import ExchangeUnreachable
+
+        for i in range(10):
+            remote._journal_buffer.append(f'{{"i":{i}}}')
+        with pytest.raises(ExchangeUnreachable):
+            remote.flush()
+        assert len(remote._journal_buffer) == 4  # oldest dropped
+        assert remote.journal_lines_dropped == 6
+        assert remote._journal_buffer[-1] == '{"i":9}'
+
+
+class TestFleetMerge:
+    def test_merge_key_matches_invariant_semantics(self):
+        bound = {"t": 2.0, "outcome": "bound", "step": 1}
+        failure = {"t": 2.0, "outcome": "bind_failure", "step": 9}
+        open_rec = {"t": 2.0, "outcome": "discarded", "step": 9}
+        assert fleet_merge_key(bound) > fleet_merge_key(failure)
+        assert fleet_merge_key(failure) > fleet_merge_key(open_rec)
+        later = {"t": 3.0, "outcome": "discarded", "step": 1}
+        assert fleet_merge_key(later) > fleet_merge_key(bound)
+
+    def test_merge_is_permutation_invariant(self):
+        recs = [
+            {"t": 1.0, "outcome": "unschedulable", "step": 1,
+             "replica": "r1", "pod": "default/p"},
+            {"t": 2.0, "outcome": "discarded", "step": 2,
+             "replica": "r1", "pod": "default/p"},
+            {"t": 3.0, "outcome": "bound", "step": 2,
+             "replica": "r0", "pod": "default/p"},
+        ]
+        import itertools
+
+        expect = merge_fleet_records(list(recs))
+        for perm in itertools.permutations(recs):
+            assert merge_fleet_records(list(perm)) == expect
+
+    def test_fleet_explain_renders_one_chain(self):
+        decisions = [
+            {"k": "dec", "v": 1, "pod": "default/p", "uid": "", "t": 3.0,
+             "step": 2, "cycle": 5, "outcome": "bound", "node": "n2",
+             "replica": "r0", "trace": "r1-1:1:default/p"},
+            {"k": "dec", "v": 1, "pod": "default/p", "uid": "", "t": 1.0,
+             "step": 1, "cycle": 1, "outcome": "unschedulable",
+             "replica": "r1", "trace": "r1-1:1:default/p"},
+            {"k": "dec", "v": 1, "pod": "default/p", "uid": "", "t": 2.0,
+             "step": 2, "cycle": 3, "outcome": "discarded",
+             "reason": "handed off to r0: skew", "replica": "r1",
+             "trace": "r1-1:1:default/p"},
+        ]
+        out = explain_pod(decisions, "default/p", fleet=True)
+        assert out.replicas == ["r1", "r0"]
+        assert out.traces == ["r1-1:1:default/p"]
+        assert out.terminal["outcome"] == "bound"
+        text = out.render()
+        assert "replicas: r1 -> r0" in text
+        assert "one journey trace" in text
+        assert text.index("[r1] step 1") < text.index("[r0] step 2")
+
+
+class TestFleetSimEndToEnd:
+    def test_handoff_profile_produces_cross_replica_single_trace(self):
+        """The acceptance shape: in the fleet sim with handoffs forced,
+        a handed-off pod's merged history spans >= 2 replicas, shares
+        exactly ONE journey trace, and ends terminally."""
+        from kubernetes_tpu.obs.explain import parse_stream
+        from kubernetes_tpu.sim.fleet import run_fleet_sim
+
+        res = run_fleet_sim("fleet_handoff", seed=0, cycles=8, replicas=2)
+        assert res.ok
+        assert res.hub_journal_lines
+        decisions, _ = parse_stream(res.hub_journal_lines)
+        by_pod: dict[str, set] = {}
+        for rec in decisions:
+            by_pod.setdefault(rec["pod"], set()).add(rec.get("replica"))
+        crossed = [p for p, reps in by_pod.items() if len(reps) > 1]
+        assert crossed, "the handoff-forcing profile produced no handoff"
+        for pod_key in crossed:
+            out = explain_pod(decisions, pod_key, fleet=True)
+            assert len(out.replicas) >= 2
+            assert len(out.traces) == 1, (
+                f"{pod_key}: journey shattered into {out.traces}"
+            )
+
+    def test_hub_journal_deterministic_across_runs(self):
+        from kubernetes_tpu.sim.fleet import run_fleet_sim
+
+        a = run_fleet_sim("fleet_handoff", seed=3, cycles=6, replicas=2)
+        b = run_fleet_sim("fleet_handoff", seed=3, cycles=6, replicas=2)
+        assert a.hub_journal_lines == b.hub_journal_lines
+
+
+class TestWireTraceContext:
+    def test_extender_client_attaches_trace_context(self):
+        from kubernetes_tpu.config.types import Extender
+        from kubernetes_tpu.server.extender_client import HTTPExtenderClient
+
+        seen = []
+
+        def transport(verb, payload):
+            seen.append((verb, payload))
+            return {"nodenames": ["n0"]}
+
+        cl = HTTPExtenderClient(
+            Extender(
+                url_prefix="http://x", filter_verb="filter",
+                node_cache_capable=True,
+            ),
+            transport=transport,
+        )
+        node = MakeNode().name("n0").capacity({"cpu": "1"}).obj()
+        cl.filter(_pod(), [node])
+        assert "traceContext" not in seen[0][1]  # obs off: bytes unchanged
+        cl.trace_context = {"trace": 7, "replica": "r0"}
+        cl.filter(_pod(), [node])
+        assert seen[1][1]["traceContext"] == {"trace": 7, "replica": "r0"}
+
+    def test_extender_server_span_joins_callers_trace(self):
+        from kubernetes_tpu.server.extender import ExtenderCore
+        from kubernetes_tpu.state.cluster import ClusterState
+
+        cs = ClusterState()
+        cs.create_node(
+            MakeNode().name("n0")
+            .capacity({"cpu": "4", "memory": "8Gi", "pods": "10"}).obj()
+        )
+        rec = FlightRecorder()
+        tracer = Tracer(clock=FakeClock(), enabled=True, recorder=rec)
+        core = ExtenderCore(cs, node_cache_capable=True, tracer=tracer)
+        core.filter(
+            {
+                "pod": _pod().to_dict(),
+                "nodenames": ["n0"],
+                "traceContext": {"trace": 42, "replica": "r0",
+                                 "incarnation": 2},
+            }
+        )
+        batch_spans = [
+            s for s in rec.spans() if s["name"] == "extender_batch"
+        ]
+        assert batch_spans
+        sp = batch_spans[-1]
+        assert sp["trace"] == 42
+        assert sp["attrs"]["replica"] == "r0"
+        assert sp["attrs"]["incarnation"] == 2
+
+    def test_bulk_solve_span_joins_callers_trace(self):
+        from kubernetes_tpu.server.bulk import BulkClient, BulkCore, SERVICE
+        from kubernetes_tpu.server import tensorcodec
+        from kubernetes_tpu.state.cluster import ClusterState
+        import numpy as np
+
+        cs = ClusterState()
+        cs.create_node(
+            MakeNode().name("n0")
+            .capacity({"cpu": "4", "memory": "8Gi", "pods": "10"}).obj()
+        )
+        rec = FlightRecorder()
+        tracer = Tracer(clock=FakeClock(), enabled=True, recorder=rec)
+        core = BulkCore(cs, tracer=tracer)
+        payload = tensorcodec.encode(
+            {"mode": "exact",
+             "trace": {"trace": 9, "parent": 3, "replica": "r1"}},
+            {"cpu_milli": np.asarray([100], dtype=np.int64),
+             "mem_bytes": np.asarray([1 << 20], dtype=np.int64)},
+        )
+        core.solve(payload)
+        spans = [s for s in rec.spans() if s["name"] == "bulk_solve"]
+        assert spans
+        assert spans[-1]["trace"] == 9
+        assert spans[-1]["attrs"]["replica"] == "r1"
+        assert spans[-1]["attrs"]["parent"] == 3
