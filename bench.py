@@ -1853,6 +1853,297 @@ def ladder13_obs_overhead() -> dict:
     }
 
 
+def ladder14_hub_failover() -> dict:
+    """#14: hub-failover blackout window (ISSUE 15) — a 2-replica
+    fleet drives a plain backlog plus a required-anti-affinity cohort
+    (the cross-shard admission path: peer-view fetch, CAS, staleness
+    bounds) through the REAL endpoint-failover client against a
+    replicated hub pair (primary + standby, op-log replication, shared
+    real-time lease), and the primary is KILLED mid-drive. Measures the number the HA tentpole
+    exists to bound: wall seconds from the kill to the FIRST
+    post-promotion committed admit (promotion latency is lease-expiry
+    gated, so the lease duration is the floor), plus the per-pod e2e
+    p99 of pods bound inside that window and the admit rate before /
+    during / after — proving conservative admission engaged during the
+    blackout (staleness bound < blackout: cross-shard-constrained
+    placements reject rather than risk overcommit) and full-rate admit
+    resumed after it. The resurrected old primary must reject a write
+    probe with the typed HubDeposed. Hoists hub_failover_blackout_s
+    and hub_failover_p99_latency_s to the JSON top level."""
+    from kubernetes_tpu.fleet import (
+        FleetConfig,
+        HubDeposed,
+        HubLease,
+        LocalHubClient,
+        OccupancyExchange,
+        PodRow,
+        StandbyReplicator,
+    )
+    from kubernetes_tpu.fleet.runtime import RemoteOccupancyExchange
+    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.sim.generators import ZONE_KEY, make_node, make_pod
+    from kubernetes_tpu.solver.exact import ExactSolverConfig
+    from kubernetes_tpu.state.cluster import ClusterState
+    from kubernetes_tpu.utils.clock import Clock
+
+    # small stable shapes on purpose: the blackout window is a
+    # LATENCY measurement (lease expiry + promotion + re-attach), not
+    # a throughput one — a constant-size arrival drip keeps the XLA
+    # pad shapes warm after the warmup phase so the window isn't
+    # polluted by CPU-backend recompiles
+    n_nodes, lease_s = 32, 3.0
+    wave_pods, warm_pods = 16, 192
+    kill_wave, total_waves = 24, 64
+    clock = Clock()
+    lease = HubLease(clock=clock, duration_s=lease_s)
+    primary = OccupancyExchange(clock=clock, hub_id="hub-a", lease=lease)
+    assert primary.try_promote() == 1
+    standby = OccupancyExchange(clock=clock, hub_id="hub-b", lease=lease)
+    replicator = StandbyReplicator(standby, LocalHubClient(primary))
+    cluster = ClusterState()
+    for i in range(n_nodes):
+        # 3 zones over 2 replicas (the sim's fleet geometry): one
+        # replica owns two zones, so a zone-spread pod routed to it
+        # has in-shard slack and the hop-capped handoff walk cannot
+        # wedge on 2-zone parity
+        cluster.create_node(
+            make_node(
+                f"n{i:04d}", "64", "256Gi", {ZONE_KEY: f"z{i % 3}"}
+            )
+        )
+    universe = ("r0", "r1")
+    scheds = {}
+    adapters = []
+    for rid in universe:
+        adapter = RemoteOccupancyExchange(
+            "", rid,
+            clients=[LocalHubClient(primary), LocalHubClient(standby)],
+            clock=clock, flush_client_id=f"{rid}-bench",
+        )
+        adapters.append(adapter)
+        scheds[rid] = Scheduler(
+            cluster,
+            SchedulerConfig(
+                batch_size=wave_pods,
+                mesh_devices=1,
+                solver=ExactSolverConfig(
+                    tie_break="first", group_size=8
+                ),
+                fleet=FleetConfig(
+                    replica=rid, replicas=universe, exchange=adapter,
+                    # staleness bound BELOW the lease-gated blackout
+                    # (so conservative admission must engage inside
+                    # it) but comfortably ABOVE the steady-state drive
+                    # cadence — a bound tighter than one real-time
+                    # loop iteration reads healthy peers as stale and
+                    # starves the spread cohort outright
+                    max_row_age_s=2.0,
+                ),
+            ),
+        )
+    enq_t: dict[str, float] = {}
+    bind_t: dict[str, float] = {}
+    seq = {"n": 0}
+
+    def arrive(count):
+        now = clock.now()
+        for _ in range(count):
+            i = seq["n"]
+            seq["n"] += 1
+            pod = make_pod(
+                f"p{i:05d}", "200m",
+                # a required-anti-affinity cohort drives the
+                # cross-shard admission path (peer-view fetch + CAS +
+                # the staleness machinery the blackout test needs)
+                # WITHOUT the zone-spread shape: a maxSkew-1 cohort
+                # under a deterministic local solver can ping-pong on
+                # the global recheck at REAL-clock backoff pace (the
+                # PR 6 scope note the virtual-time sims exercise with
+                # churn); anti pods are locally enforceable, so the
+                # ladder measures failover latency, not that scope
+                # note. Cohort sized well under the node count so
+                # every pod is satisfiable.
+                shape="anti" if i % 64 == 0 else "plain",
+            )
+            cluster.create_pod(pod)
+            enq_t[pod.key] = now
+
+    t_kill = t_promote = t_first_after = None
+
+    def drive():
+        nonlocal t_first_after
+        before = len(bind_t)
+        for rid in universe:
+            for r in scheds[rid].run_until_settled(max_batches=4):
+                now = clock.now()
+                for pod, _node in r.scheduled:
+                    bind_t[pod] = now
+                    if t_promote is not None and t_first_after is None:
+                        t_first_after = now
+        if len(bind_t) == before:
+            # stalled round: cross-shard-rejected pods park
+            # unschedulable and their production retry path is the
+            # periodic flush (5 min on the serve loop) — the bench
+            # driver ticks it eagerly so the measurement window isn't
+            # dominated by a wall-clock park (backoff still applies)
+            for rid in universe:
+                scheds[rid].queue.move_all_to_active_or_backoff(
+                    "BenchFlush"
+                )
+
+    # warmup: compile every pad shape the drip will produce (plain +
+    # spread batches, the handoff trickle's partial pow2 pads) before
+    # the measured window opens
+    arrive(warm_pods)
+    warm_deadline = time.perf_counter() + 240.0
+
+    def _warm_done():
+        # warmup exists to compile the drip's shapes, not to prove
+        # completeness (the sim owns that): every PLAIN pod bound and
+        # at least one anti pod through the cross-shard admit path
+        plain_warm = [
+            k for k in enq_t if int(k.rsplit("p", 1)[-1]) % 64 != 0
+        ]
+        anti_bound = sum(
+            1
+            for k in bind_t
+            if int(k.rsplit("p", 1)[-1]) % 64 == 0
+        )
+        return (
+            all(k in bind_t for k in plain_warm) and anti_bound >= 1
+        )
+
+    while not _warm_done() and time.perf_counter() < warm_deadline:
+        drive()
+        primary.try_promote()
+        try:
+            replicator.poll()
+        except Exception:
+            pass
+    assert _warm_done(), (
+        f"warmup never settled: {len(bind_t)}/{warm_pods} bound"
+    )
+    deadline = time.perf_counter() + 300.0
+    wave = 0
+    while (
+        wave < total_waves or len(bind_t) < len(enq_t)
+    ) and time.perf_counter() < deadline:
+        if wave < total_waves:
+            arrive(wave_pods)
+        wave += 1
+        drive()
+        if t_kill is None:
+            primary.try_promote()  # same-holder lease renew
+            try:
+                replicator.poll()
+            except Exception:
+                pass
+            if wave >= kill_wave:
+                t_kill = clock.now()
+                primary.set_down(True)
+        elif t_promote is None:
+            if standby.try_promote() is not None:
+                t_promote = clock.now()
+        else:
+            standby.try_promote()  # keep the new primary's lease fresh
+    n_pods = len(enq_t)
+    stale_rejections = sum(
+        s.fleet.stale_rejections for s in scheds.values()
+    )
+    client_failovers = sum(a.failovers for a in adapters)
+    # the resurrected old primary: reads serve, writes fence
+    primary.set_down(False)
+    try:
+        primary.stage(
+            "r0",
+            PodRow(
+                pod="probe/p", node="n0000", zone="z0",
+                namespace="probe", labels=(("app", "probe"),),
+            ),
+        )
+        stale_write_rejected = False
+    except HubDeposed:
+        stale_write_rejected = True
+    for adapter in adapters:
+        try:
+            adapter.close()
+        except Exception:
+            pass
+    assert t_kill is not None and t_promote is not None
+    assert t_first_after is not None, (
+        "no admit ever committed after the promotion — the fleet "
+        "never healed"
+    )
+    # placement-completeness CORRECTNESS is the sim's job (zero lost
+    # rows/handoffs under invariants); the ladder's bar is that the
+    # failover cost no real capacity: every plain pod binds and the
+    # hard-spread cohort stays effectively complete (a straggler
+    # waiting out a real-clock backoff at the deadline is latency,
+    # not loss)
+    unbound = [k for k in enq_t if k not in bind_t]
+    assert all(
+        int(k.rsplit("p", 1)[-1]) % 64 == 0 for k in unbound
+    ), f"plain pods unbound after heal: {unbound[:5]}"
+    assert len(bind_t) >= n_pods * 0.99, (
+        f"only {len(bind_t)}/{n_pods} pods bound — the failover lost "
+        "real capacity"
+    )
+    assert stale_write_rejected, (
+        "the deposed old primary accepted a write probe"
+    )
+    assert standby.hub_epoch == 2 and standby.role == "primary"
+    blackout_s = t_first_after - t_kill
+    assert blackout_s < 60.0, f"unbounded blackout: {blackout_s:.1f}s"
+    # rate before / after, and the e2e p99 of pods bound in the window
+    t0 = min(enq_t.values())
+    pre = [t for t in bind_t.values() if t <= t_kill]
+    post = [t for t in bind_t.values() if t >= t_first_after]
+    pre_rate = len(pre) / max(max(pre) - t0, 1e-9) if pre else 0.0
+    post_rate = (
+        len(post) / max(max(post) - t_first_after, 1e-9)
+        if len(post) > 1
+        else 0.0
+    )
+    window = sorted(
+        bound_at - enq_t[pod]
+        for pod, bound_at in bind_t.items()
+        if t_kill <= bound_at <= t_first_after
+    )
+    p99_window = (
+        window[min(int(len(window) * 0.99), len(window) - 1)]
+        if window
+        else 0.0
+    )
+    return {
+        "config": (
+            f"hub-failover blackout: 2 replicas x {n_pods} pods "
+            "(required-anti-affinity cohort for the cross-shard admit "
+            f"path, {wave_pods}/wave drip) x "
+            f"{n_nodes} nodes over a replicated hub pair (real-time "
+            f"lease {lease_s}s, op-log replication, endpoint-failover "
+            f"client); primary killed at wave {kill_wave}; staleness "
+            "bound 2s (< blackout) so conservative admission engages "
+            "mid-blackout"
+        ),
+        "hub_failover_blackout_s": round(blackout_s, 3),
+        "hub_failover_p99_latency_s": round(p99_window, 3),
+        "promotion_s": round(t_promote - t_kill, 3),
+        "lease_s": lease_s,
+        "pods_bound": len(bind_t),
+        "pods_unbound_at_deadline": len(unbound),
+        "bound_in_window": len(window),
+        "pre_kill_pods_per_sec": round(pre_rate, 1),
+        "post_heal_pods_per_sec": round(post_rate, 1),
+        "stale_rejections": stale_rejections,
+        "client_failovers": client_failovers,
+        "flush_dedup_hits": (
+            primary.flush_dedup_hits + standby.flush_dedup_hits
+        ),
+        "stale_primary_write_rejected": stale_write_rejected,
+        "replication_ops": replicator.ops_applied,
+    }
+
+
 def pallas_microbench() -> dict:
     """The tpuSolver.pallas ladder micro-bench (ISSUE 13 satellite):
     the InterPodAffinity (term, domain) aggregation — jitted
@@ -2126,6 +2417,8 @@ def main() -> None:
     ladders["12_autotune"] = autotune
     obs_overhead = ladder13_obs_overhead()
     ladders["13_obs_overhead"] = obs_overhead
+    hub_failover = ladder14_hub_failover()
+    ladders["14_hub_failover"] = hub_failover
     ladders["pallas_domain_counts"] = pallas_microbench()
     rebalance = ladder10_rebalance_loop()
     ladders["10_rebalance_loop"] = {
@@ -2241,6 +2534,18 @@ def main() -> None:
                 ],
                 "obs_overhead_fraction": obs_overhead[
                     "obs_overhead_fraction"
+                ],
+                # ladder #14 hoist (ISSUE 15): the hub-failover
+                # blackout window — wall seconds from the primary-hub
+                # kill to the first post-promotion committed admit
+                # (conservative admission engaged during it, full-rate
+                # admit after it, asserted inside the ladder) — and
+                # the e2e p99 of pods bound inside that window
+                "hub_failover_blackout_s": hub_failover[
+                    "hub_failover_blackout_s"
+                ],
+                "hub_failover_p99_latency_s": hub_failover[
+                    "hub_failover_p99_latency_s"
                 ],
                 "vs_baseline": round(headline / BAND_TOP_PODS_PER_SEC, 2),
                 "baseline_note": (

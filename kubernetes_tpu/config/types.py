@@ -145,7 +145,9 @@ class FleetSection:
     replicas: list[str] = field(default_factory=list)
     # "host:port" of a bulk gRPC server serving the shared occupancy
     # hub over its HubOp method (fleet/runtime.RemoteOccupancyExchange);
-    # empty = an in-process private hub (single-replica degenerate)
+    # comma-separate several for a replicated hub (primary + standbys —
+    # the client fails over between them, hub HA); empty = an
+    # in-process private hub (single-replica degenerate)
     hub_address: str = ""
     # "rank/count": this replica's EXCLUSIVE mesh slice — contiguous
     # first-N partition of the visible device set, so N replicas on one
@@ -503,11 +505,20 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
             "fleet.flushBatch must be >= 0 (0 = the adapter default; "
             f"got {cfg.fleet.flush_batch})"
         )
-    if cfg.fleet.hub_address and ":" not in cfg.fleet.hub_address:
-        raise ValueError(
-            'fleet.hubAddress must be "host:port" '
-            f"(got {cfg.fleet.hub_address!r})"
-        )
+    if cfg.fleet.hub_address:
+        # one or more comma-separated endpoints (a replicated hub
+        # deployment lists primary + standbys); each must be host:port
+        # — a typo silently degrading to a private hub is the failure
+        # mode this hard validation exists to prevent
+        endpoints = [
+            t.strip() for t in cfg.fleet.hub_address.split(",")
+        ]
+        if not all(t and ":" in t for t in endpoints):
+            raise ValueError(
+                'fleet.hubAddress must be "host:port" (comma-separate '
+                f"several for a replicated hub; got "
+                f"{cfg.fleet.hub_address!r})"
+            )
     if cfg.fleet.max_row_age_seconds <= 0:
         raise ValueError(
             "fleet.maxRowAgeSeconds must be > 0 "

@@ -21,19 +21,31 @@ hub over the bulk gRPC service's ``HubOp`` method
 (``RemoteOccupancyExchange``, config key ``fleet.hubAddress``) with
 admission kept atomic hub-side by the fenced compare-and-stage, and
 each replica owns an exclusive device slice via ``fleet.meshSlice``.
+
+The hub itself is replicated (fleet/ha.py): standby hubs consume the
+primary's op log, a ``HubLease`` grants monotone fencing epochs, and
+``RemoteOccupancyExchange`` takes an endpoint LIST
+(``fleet.hubAddress`` accepts comma-separated "host:port"s) and fails
+over with jittered backoff — a deposed primary rejects writes with the
+typed ``HubDeposed`` and clients verify the epoch on every reply is
+monotone, so a partitioned old primary can never accept a CAS the new
+primary doesn't know about.
 """
 
+from .ha import HubLease, LocalHubClient, StandbyReplicator
 from .membership import FleetMembership, shard_index
 from .occupancy import (
     AdmitConflict,
     COMMITTED,
     PENDING,
     ExchangeUnreachable,
+    HubDeposed,
     NodeRow,
     OccupancyExchange,
     PeerView,
     PodRow,
     decode_rows,
+    dispatch_hub_op,
     encode_rows,
 )
 from .reconciler import CrossShardReconciler
@@ -51,7 +63,12 @@ __all__ = [
     "FleetMembership",
     "FleetRuntime",
     "HashRing",
+    "HubDeposed",
+    "HubLease",
+    "LocalHubClient",
     "NodeRow",
+    "StandbyReplicator",
+    "dispatch_hub_op",
     "OccupancyExchange",
     "PeerView",
     "PodRow",

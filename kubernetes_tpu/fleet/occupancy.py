@@ -55,6 +55,27 @@ observability). Safe by construction, and the write-behind batching in
 RemoteOccupancyExchange collapses most benign churn into one bump per
 flush; per-domain versioning is the refinement if constrained-cohort
 contention ever shows up in that counter (ROADMAP fleet depth note).
+
+High availability (hub HA): the hub is no longer necessarily one
+process. Every mutation appends a version-keyed entry to an
+append-only OP LOG; one or more STANDBY hubs replicate it (snapshot +
+log catch-up on join — fleet/ha.py ``StandbyReplicator``) so a standby
+holds the same versioned row state, handoff queue, and journal
+aggregation deque as the primary. Hubs carry a monotone ``hub_epoch``
+granted by a lease (fleet/ha.py ``HubLease``, the LeaderElector
+discipline applied per-hub): only the current lease holder is PRIMARY
+and may serve the replica-facing surface; a hub whose lease was taken
+over (a deposed old primary, or a not-yet-promoted standby) rejects
+that surface with the typed ``HubDeposed`` — the PR 8 → PR 11 fencing
+ladder extended to the hub tier, so a partitioned old primary can
+never accept a CAS the new primary doesn't know about (CAS version
+continuity across the epoch boundary is the invariant: the standby
+replicated the version counter, so the new primary continues it).
+Debug/replication reads (``hub_status`` / ``journal_lines`` /
+``ops_since`` / ``snapshot``) stay open on a deposed hub — a
+post-mortem needs them — while ``RemoteOccupancyExchange`` verifies
+the epoch on every reply is monotone, so a client that has seen the
+new primary structurally ignores anything an old one still serves.
 """
 
 from __future__ import annotations
@@ -104,6 +125,30 @@ class AdmitConflict(Exception):
     ) -> None:
         self.fenced = fenced
         self.version = version
+        super().__init__(message)
+
+
+class HubDeposed(ExchangeUnreachable):
+    """Typed rejection from a hub that does not hold the primary lease
+    (a deposed old primary after a failover, or a standby that was
+    never promoted): the replica-facing surface — reads and writes
+    alike — must come from the CURRENT primary, or staleness bounds
+    and the CAS fence both unravel. Over the wire this maps to gRPC
+    PERMISSION_DENIED (a status no other hub rejection uses), which
+    ``RemoteOccupancyExchange`` treats as "this endpoint is not the
+    hub": rotate to the next endpoint, never retry here. For a fleet
+    replica a deposed hub is functionally unreachable — hence the
+    subclassing, so every PR 8 conservative-degradation handler
+    (dirty flag, cached-view aging, staleness bounds) runs unchanged —
+    but the process itself is alive: its debug/replication surface
+    (hub_status / journal_lines / ops_since / snapshot) still serves,
+    and the wire mapping + failover client distinguish it from a dead
+    endpoint. Distinct from ``AdmitConflict``, which is a semantic
+    answer about one row and is never retried anywhere."""
+
+    def __init__(self, message: str, *, epoch: int = 0, role: str = "") -> None:
+        self.epoch = epoch
+        self.role = role
         super().__init__(message)
 
 
@@ -161,11 +206,53 @@ class OccupancyExchange:
     service's ``ExchangeOccupancy`` RPC). All iteration is sorted so
     any serialized view is deterministic."""
 
-    def __init__(self, clock=None) -> None:
+    def __init__(
+        self, clock=None, *, hub_id: str = "hub", lease=None,
+        oplog_capacity: int = 65_536,
+    ) -> None:
         from ..utils.clock import Clock
 
         self._lock = threading.Lock()
         self._version = 0
+        # -- high availability (hub HA) --
+        # identity + lease: a standalone hub (lease=None, every
+        # deployment before HA) is permanently primary at epoch 1 —
+        # zero behavior change. With a lease (fleet/ha.py HubLease)
+        # the hub starts as a STANDBY and only serves the
+        # replica-facing surface while it holds the lease; the lease
+        # grant IS the monotone hub_epoch.
+        self._hub_id = hub_id
+        self._lease = lease
+        self._epoch = 1 if lease is None else 0
+        self._role = "primary" if lease is None else "standby"
+        # set at every primary -> deposed transition: a deposed hub
+        # must catch up from its successor (note_caught_up) before
+        # try_promote will re-grant it — re-promoting with stale state
+        # would regress the version counter behind a HIGHER epoch
+        self._needs_catchup = False
+        # append-only op log (replication): every mutation appends
+        # (opseq, version_after, ts, kind, payload); standbys consume
+        # via ops_since / snapshot. Bounded: a standby further behind
+        # than the retained window re-joins via snapshot.
+        from collections import deque as _deque
+
+        self._oplog: _deque = _deque(maxlen=oplog_capacity)
+        self._opseq = 0
+        # idempotent client flush dedup: replica -> (client id, last
+        # applied flush_seq). A retried write-behind flush whose reply
+        # was lost after the server-side apply lands exactly once.
+        self._flush_seen: dict[str, tuple[str, int]] = {}
+        self.flush_dedup_hits = 0
+        # fault seams + failover accounting: set_down models the whole
+        # hub process dying (every op from every replica raises
+        # ExchangeUnreachable); set_flush_fault injects a reply loss
+        # AFTER a server-side apply_ops apply (the double-apply
+        # hazard's trigger); deposed_write_rejections counts writes a
+        # non-primary hub fenced off (the stale-primary proof the
+        # failover sim pins).
+        self._down = False
+        self._flush_faults = 0
+        self.deposed_write_rejections = 0
         # publish timestamps (staleness bounds): replica -> when it
         # last successfully wrote anything to the hub. Off the
         # injectable clock so the sim's virtual timeline covers row
@@ -225,8 +312,191 @@ class OccupancyExchange:
 
     @property
     def version(self) -> int:
+        # bookkeeping surface (wake-version seeding, tests), down-
+        # gated but deliberately NOT role-fenced: admission-relevant
+        # version reads ride peers_version/peers_view, which are.
+        # A stale wake seed only delays a conflict-parked wakeup by
+        # one poll.
         with self._lock:
+            self._check_down_locked()
             return self._version
+
+    @property
+    def hub_epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def opseq(self) -> int:
+        """Applied op-log cursor (replication bookkeeping)."""
+        with self._lock:
+            return self._opseq
+
+    @property
+    def role(self) -> str:
+        with self._lock:
+            return self._role
+
+    # -- high availability: lease, roles, op log --
+
+    def try_promote(self, *, allow_stale: bool = False) -> int | None:
+        """Attempt to take (or retake) the hub lease. Returns the
+        granted epoch, or None while another hub's lease is live. A
+        grant past epoch 1 is a FAILOVER — the previous primary was
+        deposed — and ticks ``scheduler_hub_failover_total``. The
+        standby's replicated state (rows, handoffs, journal, flush
+        dedup, and crucially the VERSION counter) is what it starts
+        serving from, so CAS version continuity holds across the
+        epoch boundary.
+
+        A hub that was DEPOSED refuses to re-promote until it has
+        caught up from the hub that superseded it
+        (``note_caught_up``, set by StandbyReplicator at lag 0):
+        re-acquiring an expired lease at a HIGHER epoch while serving
+        PRE-deposition state would regress the version counter and
+        hide the interim primary's committed rows behind an epoch the
+        clients' monotone check must accept — exactly the continuity
+        the fence exists for. ``allow_stale=True`` is the operator
+        override for the disaster case (every caught-up hub is gone
+        and stale state beats no hub)."""
+        if self._lease is None:
+            return None  # standalone hub: permanently primary
+        with self._lock:
+            if (
+                self._needs_catchup
+                and not allow_stale
+                and self._lease.epoch != self._epoch
+            ):
+                # a SUCCESSOR took the lease past our epoch: our state
+                # may have diverged — refuse until the snapshot
+                # re-join. (Lease epoch == ours means nobody ever took
+                # over — a transient self-expiry with no standby — so
+                # there is no successor timeline to diverge from and
+                # refusing would wedge the only hub forever.)
+                return None
+        granted = self._lease.try_acquire(self._hub_id)
+        if granted is None:
+            return None
+        with self._lock:
+            if (
+                self._needs_catchup
+                and not allow_stale
+                and granted != self._epoch
+            ):
+                # raced a successor's expiry: the grant just advanced
+                # the epoch past our (possibly stale) state — hand the
+                # lease back rather than serve stale rows at an epoch
+                # clients must accept
+                self._lease.release(self._hub_id)
+                return None
+            epoch_advanced = granted != self._epoch
+            became_primary = self._role != "primary"
+            self._epoch = granted
+            self._role = "primary"
+            self._needs_catchup = False
+        if epoch_advanced or became_primary:
+            metrics.hub_epoch.set(granted)
+        if granted > 1 and epoch_advanced:
+            # an actual takeover — NOT the same-holder renewal this
+            # method also serves (review-caught: counting renewals
+            # made the failover counter grow once per serving-loop
+            # tick forever after the first failover)
+            metrics.hub_failover_total.inc()
+        return granted
+
+    def note_caught_up(self) -> None:
+        """Replication reached lag 0 against the current primary
+        (StandbyReplicator): a previously-deposed hub is eligible for
+        promotion again."""
+        with self._lock:
+            self._needs_catchup = False
+
+    @property
+    def needs_catchup(self) -> bool:
+        """True after a deposition, until replication catches up. A
+        deposed hub's history may have DIVERGED from its successor's
+        (ops it acked that never replicated), and its opseq cursor is
+        meaningless against the new timeline — the replicator reads
+        this flag and re-joins via FULL SNAPSHOT instead of a log
+        suffix, so the successor's state replaces (never merges with)
+        the stale one."""
+        with self._lock:
+            return self._needs_catchup
+
+    def heartbeat(self) -> bool:
+        """Primary lease renewal (the hub's liveness loop). A failed
+        renewal means the lease moved on — self-depose so the stale
+        incarnation fences its own replica-facing surface even before
+        any peer tells it anything."""
+        if self._lease is None:
+            return True
+        with self._lock:
+            if self._role != "primary":
+                return False
+        if self._lease.renew(self._hub_id):
+            return True
+        with self._lock:
+            if self._role == "primary":
+                self._role = "deposed"
+                self._needs_catchup = True
+        return False
+
+    def set_down(self, down: bool) -> None:
+        """Fault seam: the hub process is gone (crash/kill). EVERY
+        operation — any replica, reads and writes, replication —
+        raises ExchangeUnreachable until the seam clears. Clearing it
+        models the old process resurfacing (partitioned-zombie style:
+        alive, lease long lost)."""
+        with self._lock:
+            self._down = down
+
+    def set_flush_fault(self, count: int = 1) -> None:
+        """Fault seam: the next ``count`` apply_ops calls apply fully
+        server-side, then raise ExchangeUnreachable — the lost-reply
+        window behind the write-behind double-apply hazard. The
+        client's retry of the same (client, flush_seq) must dedup."""
+        with self._lock:
+            self._flush_faults = int(count)
+
+    # callers hold self._lock
+    def _check_down_locked(self) -> None:
+        if self._down:
+            raise ExchangeUnreachable(
+                f"occupancy hub {self._hub_id} is down"
+            )
+
+    # callers hold self._lock
+    def _ensure_primary_locked(self, *, write: bool, op: str) -> None:
+        """Role fence for the replica-facing surface: only the live
+        lease holder serves it. A primary whose lease silently expired
+        (the deposed-zombie case) discovers it here and self-deposes;
+        writes it rejected are counted — the failover sim's
+        stale-primary-writes-rejected proof."""
+        if self._lease is None:
+            return
+        if self._role == "primary" and not self._lease.valid(self._hub_id):
+            self._role = "deposed"
+            self._needs_catchup = True
+        if self._role != "primary":
+            if write:
+                self.deposed_write_rejections += 1
+            raise HubDeposed(
+                f"hub {self._hub_id} is {self._role} at epoch "
+                f"{self._epoch}: {op!r} must go to the current primary",
+                epoch=self._epoch,
+                role=self._role,
+            )
+
+    # callers hold self._lock; appends one replication entry. ts rides
+    # the entry so a standby's publish stamps replay the PRIMARY's
+    # timeline (read-only touches don't replicate — a promoted
+    # standby's peer ages then read slightly OLDER than truth, which
+    # errs conservative).
+    def _log(self, kind: str, payload: list) -> None:
+        self._opseq += 1
+        self._oplog.append(
+            [self._opseq, self._version, self._clock.now(), kind, payload]
+        )
 
     # -- partition seam (hub reachability, per replica) --
 
@@ -243,6 +513,7 @@ class OccupancyExchange:
     def _check_reachable(self, replica: str) -> None:
         # callers hold self._lock or tolerate the benign race (the
         # partition flag only ever flips between whole sim cycles)
+        self._check_down_locked()
         if replica in self._partitioned:
             raise ExchangeUnreachable(
                 f"replica {replica} is partitioned from the occupancy hub"
@@ -276,6 +547,7 @@ class OccupancyExchange:
         gated, unlike the raw ``version`` property)."""
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=False, op="peers_version")
             self._touch(replica)
             return self._version
 
@@ -290,19 +562,31 @@ class OccupancyExchange:
         zombie's forced resync routes here)."""
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="publish_nodes")
             self._revoked.discard(replica)
             self._version += 1
             self._node_rows[replica] = {r.node: r for r in rows}
             self._touch(replica)
+            self._log(
+                "nodes",
+                [replica, [[r.node, r.zone] for r in self._node_rows[replica].values()]],
+            )
 
     def stage(self, replica: str, row: PodRow) -> None:
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="stage")
             self._check_write_fence(replica)
-            self._version += 1
-            self._pod_rows.setdefault(replica, {})[row.pod] = row
-            self._touch(replica)
+            self._stage_locked(replica, row)
         self._m["staged"].inc()
+
+    # callers hold self._lock and have run the reachability/role/fence
+    # checks (stage, compare_and_stage, apply_ops share this effect)
+    def _stage_locked(self, replica: str, row: PodRow) -> None:
+        self._version += 1
+        self._pod_rows.setdefault(replica, {})[row.pod] = row
+        self._touch(replica)
+        self._log("row", [replica, pod_row_to_list(row)])
 
     def compare_and_stage(
         self, replica: str, row: PodRow, expected_version: int
@@ -318,6 +602,7 @@ class OccupancyExchange:
         replicas reject regardless of version."""
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="cas_stage")
             self._check_write_fence(replica)
             if self._version != expected_version:
                 raise AdmitConflict(
@@ -326,9 +611,7 @@ class OccupancyExchange:
                     "landed first — re-fetch and re-admit",
                     version=self._version,
                 )
-            self._version += 1
-            self._pod_rows.setdefault(replica, {})[row.pod] = row
-            self._touch(replica)
+            self._stage_locked(replica, row)
             version = self._version
         self._m["staged"].inc()
         return version
@@ -341,36 +624,58 @@ class OccupancyExchange:
         fence like publish_nodes (same re-registration argument)."""
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="replace_pod_rows")
             self._revoked.discard(replica)
             self._version += 1
             self._pod_rows[replica] = {r.pod: r for r in rows}
             self._touch(replica)
+            self._log(
+                "rows",
+                [replica, [pod_row_to_list(r) for r in self._pod_rows[replica].values()]],
+            )
 
     def commit(self, replica: str, pod_key: str) -> None:
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="commit")
             self._check_write_fence(replica)
-            row = self._pod_rows.get(replica, {}).get(pod_key)
-            if row is None or row.state == COMMITTED:
+            if not self._commit_locked(replica, pod_key):
                 return
-            self._version += 1
-            self._pod_rows[replica][pod_key] = replace(row, state=COMMITTED)
-            self._touch(replica)
         self._m["committed"].inc()
+
+    # callers hold self._lock post-checks; True if the row transitioned
+    def _commit_locked(self, replica: str, pod_key: str) -> bool:
+        row = self._pod_rows.get(replica, {}).get(pod_key)
+        if row is None or row.state == COMMITTED:
+            return False
+        self._version += 1
+        committed = replace(row, state=COMMITTED)
+        self._pod_rows[replica][pod_key] = committed
+        self._touch(replica)
+        self._log("row", [replica, pod_row_to_list(committed)])
+        return True
 
     def withdraw(self, replica: str, pod_key: str) -> None:
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="withdraw")
             # fenced like every other mutation: today a retired
             # replica's rows are already dropped (nil data effect),
             # but an asymmetric escape hatch is one refactor away from
             # a zombie deleting a live row (review-caught)
             self._check_write_fence(replica)
-            if self._pod_rows.get(replica, {}).pop(pod_key, None) is None:
+            if not self._withdraw_locked(replica, pod_key):
                 return
-            self._version += 1
-            self._touch(replica)
         self._m["withdrawn"].inc()
+
+    # callers hold self._lock post-checks; True if a row was removed
+    def _withdraw_locked(self, replica: str, pod_key: str) -> bool:
+        if self._pod_rows.get(replica, {}).pop(pod_key, None) is None:
+            return False
+        self._version += 1
+        self._touch(replica)
+        self._log("row_del", [replica, pod_key])
+        return True
 
     def retire(self, replica: str) -> None:
         """Drop a dead replica's rows: its committed placements become
@@ -384,6 +689,8 @@ class OccupancyExchange:
         rejects with a typed fenced AdmitConflict until its healed
         incarnation re-registers wholesale."""
         with self._lock:
+            self._check_down_locked()
+            self._ensure_primary_locked(write=True, op="retire")
             self._revoked.add(replica)
             had = (
                 bool(self._node_rows.pop(replica, None))
@@ -396,6 +703,7 @@ class OccupancyExchange:
             self._published_at.pop(replica, None)
             if had:
                 self._version += 1
+            self._log("retire", [replica])
         self._m["retired"].inc()
 
     # -- degraded flags (solve-resilience breaker state) --
@@ -406,6 +714,7 @@ class OccupancyExchange:
         conflict-parked pods re-evaluate their handoff chains."""
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="set_degraded")
             self._check_write_fence(replica)
             if degraded == (replica in self._degraded):
                 return
@@ -415,9 +724,16 @@ class OccupancyExchange:
                 self._degraded.discard(replica)
             self._version += 1
             self._touch(replica)
+            self._log("degraded", [replica, bool(degraded)])
 
     def degraded_replicas(self) -> frozenset:
+        # replica-facing like peers_view (maybe_hand_off orders the
+        # fleet-wide handoff chain by these flags): a deposed hub's
+        # frozen flags must not route refugees toward a peer whose
+        # breaker opened during the blackout (review-caught)
         with self._lock:
+            self._check_down_locked()
+            self._ensure_primary_locked(write=False, op="degraded_replicas")
             return frozenset(self._degraded)
 
     # -- journal aggregation (obs explain --fleet's hub surface) --
@@ -434,16 +750,21 @@ class OccupancyExchange:
             return
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="ship_journal")
             self._touch(replica)
             self._journal.extend(lines)
+            self._log("journal", [replica, lines])
         metrics.fleet_journal_segments_total.inc()
         metrics.fleet_journal_lines_total.inc(len(lines))
 
     def journal_lines(self) -> list[str]:
         """The aggregated journal stream, in arrival order. `obs
         explain --fleet` re-orders per pod with the PR 8 merge rules,
-        so arrival order only needs to be deterministic, not sorted."""
+        so arrival order only needs to be deterministic, not sorted.
+        Down-gated (a dead hub answers nothing); ``debug_state`` is
+        the harness's bypass."""
         with self._lock:
+            self._check_down_locked()
             return list(self._journal)
 
     # -- pod handoffs --
@@ -454,6 +775,8 @@ class OccupancyExchange:
         trace: str = "",
     ) -> None:
         with self._lock:
+            self._check_down_locked()
+            self._ensure_primary_locked(write=True, op="hand_off")
             if from_replica is not None:
                 self._check_reachable(from_replica)
                 self._check_write_fence(from_replica)
@@ -462,6 +785,7 @@ class OccupancyExchange:
             self._handoffs.setdefault(to_replica, {})[pod_key] = (
                 hops, trace,
             )
+            self._log("handoff", [to_replica, pod_key, hops, trace])
         self._m["handoff"].inc()
 
     def claim_handoffs(self, replica: str) -> list[tuple[str, int, str]]:
@@ -471,11 +795,13 @@ class OccupancyExchange:
         adopting replica's journal continues the SAME trace."""
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="claim_handoffs")
             self._touch(replica)  # liveness: the poll proves contact
             rows = self._handoffs.pop(replica, None)
             if not rows:
                 return []
             self._version += 1
+            self._log("claim", [replica])
             return [
                 (k, hops, trace)
                 for k, (hops, trace) in sorted(rows.items())
@@ -483,10 +809,31 @@ class OccupancyExchange:
 
     def pending_handoff_keys(self) -> set[str]:
         """Pods released by one replica and not yet claimed by the
-        next — the fleet lost-pod invariant counts these as tracked."""
+        next — the fleet lost-pod invariant counts these as tracked.
+        Down-gated like every other op (set_down models the whole
+        process dying — a dead hub answers nothing); the sim harness
+        introspects a downed hub via ``debug_state`` instead."""
         with self._lock:
+            self._check_down_locked()
             return {
                 k for rows in self._handoffs.values() for k in rows
+            }
+
+    def debug_state(self) -> dict:
+        """Harness/post-mortem introspection that deliberately
+        bypasses the down seam (reading a dead process's LAST state is
+        what a post-mortem of its persisted image would do): pending
+        handoff keys + journal lines. Never served over the wire —
+        dispatch_hub_op does not expose it."""
+        with self._lock:
+            return {
+                "pending_handoffs": {
+                    k for rows in self._handoffs.values() for k in rows
+                },
+                "journal": list(self._journal),
+                "degraded": sorted(self._degraded),
+                "version": self._version,
+                "opseq": self._opseq,
             }
 
     # -- reading --
@@ -494,6 +841,7 @@ class OccupancyExchange:
     def peers_view(self, replica: str) -> PeerView:
         with self._lock:
             self._check_reachable(replica)
+            self._ensure_primary_locked(write=False, op="peers_view")
             self._touch(replica)  # liveness: the fetch proves contact
             node_rows = tuple(
                 self._node_rows[r][n]
@@ -527,6 +875,290 @@ class OccupancyExchange:
                     for p in sorted(self._pod_rows.get(replica, {}))
                 ),
             )
+
+    # -- idempotent write-behind flush (the apply_ops surface) --
+
+    _FLUSH_OP_KINDS = frozenset({"stage", "commit", "withdraw", "journal"})
+
+    def apply_ops(
+        self, replica: str, ops: list, *,
+        flush_seq: int | None = None, flush_client: str = "",
+    ) -> dict:
+        """One write-behind flush (RemoteOccupancyExchange) applied
+        ATOMICALLY under the hub lock: journal lines land first
+        (append-only observability, deliberately not fence-gated — a
+        fenced zombie's history is what the post-mortem needs), then
+        the buffered stage/commit/withdraw row mutations, fence-
+        checked as a unit.
+
+        IDEMPOTENT on ``(replica, flush_client, flush_seq)``: the
+        client seals each flush batch with a monotone sequence before
+        sending, and a batch whose reply was lost AFTER the
+        server-side apply (UNAVAILABLE on the wire) is retried with
+        the SAME key — the hub recognizes it and drops the retry
+        whole, so rows are never double-staged and journal lines never
+        double-append (the latent hazard this closes: the old path
+        re-landed the entire buffer). ``flush_client`` scopes the
+        sequence to one client incarnation, so a restarted replica
+        starting back at seq 0 is never mistaken for a stale retry.
+        The dedup watermark is itself replicated (a ``flush_seen`` op
+        log entry), so a retry that lands on the PROMOTED standby
+        after a failover still dedups. ``flush_seq=None`` (a caller
+        predating the sealed-batch client) applies without dedup —
+        rows are idempotent upserts either way."""
+        for kind, _arg in ops:
+            if kind not in self._FLUSH_OP_KINDS:
+                # validate BEFORE any effect: a partial apply that
+                # died on a bogus kind would double-append its journal
+                # lines on retry (the seen watermark is only recorded
+                # for fully-applied batches)
+                raise ValueError(f"unknown apply_ops kind {kind!r}")
+        counts = {"staged": 0, "committed": 0, "withdrawn": 0}
+        fenced = False
+        flush_fault = False
+        journal_landed = 0
+        with self._lock:
+            self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="apply_ops")
+            if flush_seq is not None:
+                seen_client, seen_seq = self._flush_seen.get(
+                    replica, ("", -1)
+                )
+                if flush_client == seen_client and int(flush_seq) <= seen_seq:
+                    self.flush_dedup_hits += 1
+                    metrics.fleet_flush_dedup_total.inc()
+                    return {"deduped": True}
+            journal = [arg for kind, arg in ops if kind == "journal"]
+            if journal:
+                self._journal.extend(journal)
+                self._log("journal", [replica, list(journal)])
+            fenced = replica in self._revoked
+            if not fenced:
+                for kind, arg in ops:
+                    if kind == "stage":
+                        self._stage_locked(replica, pod_row_from_list(arg))
+                        counts["staged"] += 1
+                    elif kind == "commit":
+                        counts["committed"] += self._commit_locked(
+                            replica, arg
+                        )
+                    elif kind == "withdraw":
+                        counts["withdrawn"] += self._withdraw_locked(
+                            replica, arg
+                        )
+            if flush_seq is not None:
+                self._flush_seen[replica] = (flush_client, int(flush_seq))
+                self._log(
+                    "flush_seen", [replica, flush_client, int(flush_seq)]
+                )
+            journal_landed = len(journal)
+            if self._flush_faults > 0:
+                self._flush_faults -= 1
+                flush_fault = True
+        for op_name, n in counts.items():
+            if n:
+                self._m[op_name].inc(n)
+        if journal_landed:
+            metrics.fleet_journal_segments_total.inc()
+            metrics.fleet_journal_lines_total.inc(journal_landed)
+        if fenced:
+            raise AdmitConflict(
+                f"replica {replica} is fenced at the hub: the flush's "
+                "row mutations were dropped (its journal lines landed "
+                "— append-only history is not fence-gated)",
+                fenced=True,
+            )
+        if flush_fault:
+            raise ExchangeUnreachable(
+                "injected reply loss AFTER the server-side apply "
+                "(set_flush_fault seam): the client must retry this "
+                "flush under the same (client, seq) key and the hub "
+                "must dedup it"
+            )
+        return {"applied": counts, "journal": journal_landed}
+
+    # -- replication surface (standby catch-up; fleet/ha.py) --
+
+    def ops_since(self, since: int):
+        """Op-log entries past ``since``, plus the latest opseq.
+        Returns ``(None, latest)`` when ``since`` predates the
+        retained window — the standby must re-join via snapshot.
+        Served regardless of role (a deposed primary can still be
+        caught up FROM; replication is not the replica-facing
+        surface), but not while down."""
+        with self._lock:
+            self._check_down_locked()
+            latest = self._opseq
+            if since >= latest:
+                return [], latest
+            floor = self._oplog[0][0] if self._oplog else self._opseq + 1
+            if since < floor - 1:
+                return None, latest
+            return [list(e) for e in self._oplog if e[0] > since], latest
+
+    def snapshot(self) -> dict:
+        """Full JSON-able state export for standby join (and the wire
+        half of repl_sync when the log window has moved past the
+        standby's cursor)."""
+        with self._lock:
+            self._check_down_locked()
+            return {
+                "opseq": self._opseq,
+                "version": self._version,
+                "nodes": {
+                    r: [[n.node, n.zone] for _k, n in sorted(rows.items())]
+                    for r, rows in self._node_rows.items()
+                },
+                "pods": {
+                    r: [pod_row_to_list(p) for _k, p in sorted(rows.items())]
+                    for r, rows in self._pod_rows.items()
+                },
+                "handoffs": {
+                    to: [[k, h, t] for k, (h, t) in sorted(rows.items())]
+                    for to, rows in self._handoffs.items()
+                },
+                "degraded": sorted(self._degraded),
+                "revoked": sorted(self._revoked),
+                "publishedAt": dict(self._published_at),
+                "journal": list(self._journal),
+                "flushSeen": {
+                    r: [c, s] for r, (c, s) in self._flush_seen.items()
+                },
+            }
+
+    def install_snapshot(self, snap: dict) -> None:
+        """Replace this hub's replicated state wholesale (standby
+        join). Role/epoch/lease are NOT part of the snapshot — a
+        standby stays a standby until its own lease grant promotes
+        it."""
+        with self._lock:
+            self._opseq = int(snap.get("opseq") or 0)
+            self._version = int(snap.get("version") or 0)
+            self._node_rows = {
+                r: {n: NodeRow(node=n, zone=z) for n, z in rows}
+                for r, rows in (snap.get("nodes") or {}).items()
+            }
+            self._pod_rows = {
+                r: {
+                    row.pod: row
+                    for row in (pod_row_from_list(v) for v in rows)
+                }
+                for r, rows in (snap.get("pods") or {}).items()
+            }
+            self._handoffs = {
+                to: {k: (int(h), str(t)) for k, h, t in rows}
+                for to, rows in (snap.get("handoffs") or {}).items()
+            }
+            self._degraded = set(snap.get("degraded") or ())
+            self._revoked = set(snap.get("revoked") or ())
+            self._published_at = {
+                r: float(t)
+                for r, t in (snap.get("publishedAt") or {}).items()
+            }
+            self._journal.clear()
+            self._journal.extend(snap.get("journal") or ())
+            self._flush_seen = {
+                r: (str(c), int(s))
+                for r, (c, s) in (snap.get("flushSeen") or {}).items()
+            }
+            self._oplog.clear()
+
+    def apply_replicated(self, entry) -> None:
+        """Apply one op-log entry on a STANDBY: raw state effects,
+        version-keyed — no reachability/fence/role checks (those ran
+        at the primary when the op first landed) and no metric ticks
+        (the op was already counted where it happened). The entry is
+        re-appended to this hub's own log so a healed old primary can
+        later catch up FROM the promoted standby. Entries at or below
+        the applied cursor are ignored (catch-up windows overlap
+        harmlessly)."""
+        opseq, version, ts, kind, payload = entry
+        with self._lock:
+            if opseq <= self._opseq:
+                return
+            if kind == "nodes":
+                r, rows = payload
+                self._node_rows[r] = {
+                    n: NodeRow(node=n, zone=z) for n, z in rows
+                }
+                self._revoked.discard(r)
+                self._published_at[r] = ts
+            elif kind == "row":
+                r, rowlist = payload
+                row = pod_row_from_list(rowlist)
+                self._pod_rows.setdefault(r, {})[row.pod] = row
+                self._published_at[r] = ts
+            elif kind == "rows":
+                r, rows = payload
+                self._pod_rows[r] = {
+                    row.pod: row
+                    for row in (pod_row_from_list(v) for v in rows)
+                }
+                self._revoked.discard(r)
+                self._published_at[r] = ts
+            elif kind == "row_del":
+                r, pod_key = payload
+                self._pod_rows.get(r, {}).pop(pod_key, None)
+                self._published_at[r] = ts
+            elif kind == "retire":
+                (r,) = payload
+                self._revoked.add(r)
+                self._node_rows.pop(r, None)
+                self._pod_rows.pop(r, None)
+                self._handoffs.pop(r, None)
+                self._degraded.discard(r)
+                self._published_at.pop(r, None)
+            elif kind == "degraded":
+                r, flag = payload
+                if flag:
+                    self._degraded.add(r)
+                else:
+                    self._degraded.discard(r)
+            elif kind == "journal":
+                _r, lines = payload
+                self._journal.extend(lines)
+            elif kind == "handoff":
+                to, pod_key, hops, trace = payload
+                self._handoffs.setdefault(to, {})[pod_key] = (
+                    int(hops), str(trace),
+                )
+            elif kind == "claim":
+                (r,) = payload
+                self._handoffs.pop(r, None)
+                self._published_at[r] = ts
+            elif kind == "flush_seen":
+                r, client, seq = payload
+                self._flush_seen[r] = (str(client), int(seq))
+            # unknown kinds are skipped (forward compatibility), but
+            # the cursor still advances — the primary wrote them
+            self._opseq = opseq
+            self._version = version
+            self._oplog.append(list(entry))
+
+    def hub_status(self) -> dict:
+        """The ``GET /debug/hub`` body (and the failover sim's
+        introspection): role, epoch, replicated-state cursors, and
+        the HA counters. Deliberately served by standbys and deposed
+        primaries alike — 'who do you think you are' is exactly the
+        question an operator asks a suspect hub."""
+        with self._lock:
+            self._check_down_locked()
+            return {
+                "hub": self._hub_id,
+                "role": self._role,
+                "epoch": self._epoch,
+                "needs_catchup": self._needs_catchup,
+                "version": self._version,
+                "opseq": self._opseq,
+                "replicas": sorted(self._published_at),
+                "pod_rows": sum(len(v) for v in self._pod_rows.values()),
+                "pending_handoffs": sum(
+                    len(v) for v in self._handoffs.values()
+                ),
+                "journal_lines": len(self._journal),
+                "flush_dedup_hits": self.flush_dedup_hits,
+                "deposed_write_rejections": self.deposed_write_rejections,
+            }
 
 
 # -- wire framing (server/tensorcodec.py, the BatchCarriedUsage wire) --
@@ -615,13 +1247,117 @@ def decode_rows(
 def ingest_payload(exchange: OccupancyExchange, data: bytes) -> bytes:
     """Server half of the ``ExchangeOccupancy`` RPC: replace the
     sender's rows wholesale, reply with the hub's merged view of every
-    OTHER replica (encoded the same way)."""
+    OTHER replica (encoded the same way). Routed through the public
+    replace surface so the mutations land in the replication op log
+    like every other write (they used to poke hub internals, which
+    would have been invisible to a standby)."""
     replica, _version, node_rows, pod_rows = decode_rows(data)
     exchange.publish_nodes(replica, node_rows)
-    with exchange._lock:
-        exchange._version += 1
-        exchange._pod_rows[replica] = {r.pod: r for r in pod_rows}
-        exchange._touch(replica)
+    exchange.replace_pod_rows(replica, pod_rows)
     exchange._m["staged"].inc()
     view = exchange.peers_view(replica)
     return encode_rows("", view.version, view.node_rows, view.pod_rows)
+
+
+def dispatch_hub_op(hub: OccupancyExchange, op: str, meta: Mapping) -> dict:
+    """Dispatch one occupancy-hub operation by name — the ONE op
+    surface behind both transports: ``server/bulk.py``'s HubOp gRPC
+    method (which maps the typed exceptions to status codes) and
+    ``fleet/ha.py``'s LocalHubClient (which raises them directly), so
+    the failover client exercises identical semantics in-process and
+    over the wire. Raises the hub's typed exceptions
+    (ExchangeUnreachable / HubDeposed / AdmitConflict / ValueError);
+    every successful reply carries the hub's ``epoch`` — the value
+    ``RemoteOccupancyExchange`` verifies is monotone (the client-side
+    half of the epoch fence)."""
+    replica = str(meta.get("replica") or "")
+    out: dict = {}
+    if op == "version":
+        out["version"] = hub.version
+    elif op == "peers_version":
+        out["version"] = hub.peers_version(replica)
+    elif op == "publish_nodes":
+        hub.publish_nodes(
+            replica,
+            [NodeRow(node=n, zone=z) for n, z in meta.get("nodes") or []],
+        )
+    elif op == "stage":
+        hub.stage(replica, pod_row_from_list(meta["row"]))
+    elif op == "cas_stage":
+        out["version"] = hub.compare_and_stage(
+            replica,
+            pod_row_from_list(meta["row"]),
+            int(meta["expect"]),
+        )
+    elif op == "replace_pod_rows":
+        hub.replace_pod_rows(
+            replica,
+            [pod_row_from_list(r) for r in meta.get("rows") or []],
+        )
+    elif op == "commit":
+        hub.commit(replica, meta["pod"])
+    elif op == "withdraw":
+        hub.withdraw(replica, meta["pod"])
+    elif op == "apply_ops":
+        # write-behind flush: a batch of buffered stage/commit/
+        # withdraw mutations plus piggybacked journal segments (kind
+        # "journal"), applied atomically and deduped on the client's
+        # (flush_client, flush_seq) key — see OccupancyExchange
+        # .apply_ops for the idempotency contract
+        seq = meta.get("flush_seq")
+        out.update(
+            hub.apply_ops(
+                replica,
+                meta.get("ops") or [],
+                flush_seq=None if seq is None else int(seq),
+                flush_client=str(meta.get("flush_client") or ""),
+            )
+        )
+    elif op == "ship_journal":
+        hub.ship_journal(replica, meta.get("lines") or [])
+    elif op == "journal_lines":
+        out["lines"] = hub.journal_lines()
+    elif op == "retire":
+        hub.retire(replica)
+    elif op == "set_degraded":
+        hub.set_degraded(replica, bool(meta.get("degraded")))
+    elif op == "degraded_replicas":
+        out["replicas"] = sorted(hub.degraded_replicas())
+    elif op == "hand_off":
+        hub.hand_off(
+            meta["to"], meta["pod"], int(meta.get("hops") or 0),
+            from_replica=meta.get("from") or None,
+            trace=str(meta.get("trace") or ""),
+        )
+    elif op == "claim_handoffs":
+        # (pod, hops, journey trace) — the trace context rides the
+        # handoff row across the wire (cross-replica trace propagation)
+        out["handoffs"] = [
+            [k, h, trace] for k, h, trace in hub.claim_handoffs(replica)
+        ]
+    elif op == "pending_handoff_keys":
+        out["keys"] = sorted(hub.pending_handoff_keys())
+    elif op == "peers_view":
+        view = hub.peers_view(replica)
+        out = {
+            "version": view.version,
+            "nodes": [[r.node, r.zone] for r in view.node_rows],
+            "pods": [pod_row_to_list(r) for r in view.pod_rows],
+            "peerAges": [[r, a] for r, a in view.peer_ages],
+        }
+    elif op == "repl_sync":
+        # standby catch-up (fleet/ha.py StandbyReplicator): op-log
+        # entries past the standby's cursor, or the full snapshot when
+        # the retained window has moved past it
+        ops, latest = hub.ops_since(int(meta.get("since") or 0))
+        out["latest"] = latest
+        if ops is None:
+            out["snapshot"] = hub.snapshot()
+        else:
+            out["ops"] = ops
+    elif op == "hub_status":
+        out["status"] = hub.hub_status()
+    else:
+        raise ValueError(f"unknown hub op {op!r}")
+    out["epoch"] = hub.hub_epoch
+    return out

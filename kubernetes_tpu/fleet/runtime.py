@@ -69,8 +69,11 @@ class FleetConfig:
     # hub (single-replica fleet degenerates gracefully).
     exchange: object = None
     # "host:port" of a bulk gRPC server whose HubOp method serves the
-    # shared hub (config key fleet.hubAddress). Ignored when an
-    # exchange object is passed explicitly.
+    # shared hub (config key fleet.hubAddress). Comma-separate several
+    # for a replicated hub deployment ("primary:port,standby:port"):
+    # RemoteOccupancyExchange fails over between them with jittered
+    # backoff, verifying the hub epoch on every reply is monotone.
+    # Ignored when an exchange object is passed explicitly.
     hub_address: str = ""
     # production liveness: poll peers' per-shard leases every
     # lease_poll_s seconds and flip membership when one goes stale
@@ -132,6 +135,30 @@ class RemoteOccupancyExchange:
     would stretch the partition-detection latency the staleness bound
     is calibrated against.
 
+    HUB FAILOVER (hub HA): ``target`` may name SEVERAL endpoints
+    (comma-separated) — a primary and its standbys. An op that fails
+    unreachable-class on the active endpoint (UNAVAILABLE, connection
+    loss, a typed ``HubDeposed`` from a hub that lost its lease, or a
+    reply carrying a LOWER epoch than one already verified — the
+    client-side half of the epoch fence) rotates to the next endpoint
+    under full-jitter backoff; semantic ``AdmitConflict`` rejections
+    never rotate or retry (the existing rule). When a reply's epoch
+    ADVANCES past the highest seen, a failover happened: the adapter
+    records it (``consume_failover``) so FleetRuntime forces a
+    wholesale resync republish — the new primary's replicated rows may
+    trail whatever the old one acked last, and re-registering from
+    cluster truth is the PR 8 dirty-heal path that closes the gap.
+
+    IDEMPOTENT FLUSHES: each flush batch is SEALED with a monotone
+    ``(flush_client, flush_seq)`` key before its first send, and a
+    retry after a lost reply re-sends the SAME sealed batch under the
+    SAME key — the hub dedups it whole, which closes the latent
+    double-apply hazard where UNAVAILABLE after a server-side apply
+    re-landed the entire buffer (double-staged rows, double-appended
+    journal lines). The dedup watermark replicates with the rest of
+    the hub state, so the retry dedups even when it lands on the
+    promoted standby.
+
     WRITE-BEHIND ROW TRAFFIC: plain ``stage`` / ``commit`` /
     ``withdraw`` calls buffer client-side and flush as ONE
     ``apply_ops`` RPC — before every read (so any view this replica
@@ -154,6 +181,9 @@ class RemoteOccupancyExchange:
     """
 
     _BUFFER_CAP = 256  # default flush batch (FleetConfig.flush_batch=0)
+    # base of the full-jitter backoff between endpoint attempts during
+    # a failover rotation (seconds; doubles per extra hop)
+    _FAILOVER_BACKOFF_S = 0.05
 
     def __init__(
         self,
@@ -161,17 +191,61 @@ class RemoteOccupancyExchange:
         replica: str = "",
         *,
         client=None,
+        clients=None,
         clock=None,
         flush_batch: int = 0,
+        flush_client_id: str = "",
     ) -> None:
-        from ..server.bulk import BulkClient
+        import random
 
-        self._client = (
-            client
-            if client is not None
-            else BulkClient(target, retries=0, clock=clock)
-        )
+        from ..server.bulk import BulkClient
+        from ..utils.clock import Clock
+
+        self._clock = clock or Clock()
+        if clients is not None:
+            # explicit client objects (the HA sim/tests: LocalHubClient
+            # per in-process hub) — endpoint i is clients[i]
+            self._clients = list(clients)
+            self._targets = [
+                f"client-{i}" for i in range(len(self._clients))
+            ]
+        elif client is not None:
+            self._clients = [client]
+            self._targets = [target or "client-0"]
+        else:
+            self._targets = [
+                t.strip() for t in str(target).split(",") if t.strip()
+            ]
+            self._clients = [
+                BulkClient(t, retries=0, clock=clock)
+                for t in self._targets
+            ]
+        if not self._clients:
+            raise ValueError("RemoteOccupancyExchange needs >= 1 endpoint")
+        self._active = 0
+        # highest hub epoch verified on any reply — replies below it
+        # come from a deposed primary and are structurally ignored
+        self._seen_epoch = 0
+        self._failover_pending = False
+        self.failovers = 0
+        # deterministic per-replica jitter stream (the sim's
+        # byte-determinism leans on seeded randomness)
+        self._rng = random.Random(f"{replica}/hub-failover")
         self._replica = replica
+        # flush-idempotency identity: scopes this client incarnation's
+        # flush_seq stream at the hub, so a RESTARTED replica starting
+        # back at seq 0 is never mistaken for a stale retry. Random —
+        # it never lands in journals/traces, so determinism holds.
+        if not flush_client_id:
+            import uuid
+
+            flush_client_id = f"{replica or 'r'}-{uuid.uuid4().hex[:8]}"
+        self._flush_client = flush_client_id
+        self._flush_seq = 0
+        # sealed flush batches awaiting an acknowledged apply_ops:
+        # [(seq, ops)] in send order; the OPEN buffers below seal into
+        # one batch at flush time
+        self._sealed: list = []
         # instance flush batch: the auto-tunable write-behind cap
         # (kubernetes_tpu/tuning knob "fleet_flush"); class default
         # unless configured
@@ -200,15 +274,28 @@ class RemoteOccupancyExchange:
         # conflict, so it cannot raise there — review-caught)
         self._fenced_seen = False
 
-    def _op(self, op: str, **meta) -> dict:
+    @property
+    def _client(self):
+        """The active endpoint's client (kept for introspection and
+        the single-endpoint tests that monkeypatch it)."""
+        return self._clients[self._active]
+
+    def _call_endpoint(self, client, op: str, **meta) -> dict:
+        """One attempt against one endpoint, errors normalized to the
+        hub's typed exceptions (a LocalHubClient raises them directly;
+        the gRPC transport arrives as status codes)."""
         import grpc
-        import time
 
-        from .occupancy import AdmitConflict, ExchangeUnreachable
+        from .occupancy import (
+            AdmitConflict,
+            ExchangeUnreachable,
+            HubDeposed,
+        )
 
-        t0 = time.perf_counter()
         try:
-            return self._client.hub_op(op, **meta)
+            return client.hub_op(op, **meta)
+        except (AdmitConflict, ExchangeUnreachable):
+            raise  # already typed (HubDeposed subclasses unreachable)
         except grpc.RpcError as e:
             code = getattr(e, "code", lambda: None)()
             name = code.name if code is not None else ""
@@ -217,66 +304,205 @@ class RemoteOccupancyExchange:
                 raise AdmitConflict(details) from None
             if name == "FAILED_PRECONDITION":
                 raise AdmitConflict(details, fenced=True) from None
+            if name == "PERMISSION_DENIED":
+                raise HubDeposed(details) from None
             raise ExchangeUnreachable(details) from None
         except ConnectionError as e:
             raise ExchangeUnreachable(str(e)) from None
+
+    def _op(self, op: str, **meta) -> dict:
+        """One hub op with endpoint failover: unreachable-class
+        failures (incl. HubDeposed and stale-epoch replies) rotate
+        through the endpoint list under full-jitter backoff; semantic
+        AdmitConflict rejections surface immediately from whichever
+        endpoint answered (and make it the active one — a hub that
+        answers semantically IS the serving primary)."""
+        import time
+
+        from .occupancy import AdmitConflict, ExchangeUnreachable
+
+        t0 = time.perf_counter()
+        try:
+            last: Exception | None = None
+            n = len(self._clients)
+            for attempt in range(n):
+                idx = (self._active + attempt) % n
+                if attempt:
+                    # full jitter: N replicas failing over at the same
+                    # instant must not stampede the standby in lockstep
+                    self._clock.sleep(
+                        self._rng.uniform(
+                            0.0,
+                            self._FAILOVER_BACKOFF_S
+                            * (2 ** (attempt - 1)),
+                        )
+                    )
+                try:
+                    out = self._call_endpoint(
+                        self._clients[idx], op, **meta
+                    )
+                except AdmitConflict:
+                    self._active = idx
+                    raise
+                except ExchangeUnreachable as e:  # incl. HubDeposed
+                    last = e
+                    continue
+                epoch = int(out.get("epoch") or 0)
+                if epoch and epoch < self._seen_epoch:
+                    # a stale (lower-epoch) hub answered — the epoch
+                    # fence says its answer is void: rotate on
+                    last = ExchangeUnreachable(
+                        f"hub endpoint {self._targets[idx]} answered "
+                        f"with stale epoch {epoch} < {self._seen_epoch}"
+                    )
+                    continue
+                if epoch > self._seen_epoch:
+                    if self._seen_epoch:
+                        # the epoch advanced mid-session: a failover.
+                        # Flag it so FleetRuntime forces the wholesale
+                        # resync republish at its next poll.
+                        self._failover_pending = True
+                        self.failovers += 1
+                        metrics.hub_failover_total.inc()
+                    self._seen_epoch = epoch
+                    metrics.hub_epoch.set(epoch)
+                self._active = idx
+                return out
+            raise (
+                last
+                if last is not None
+                else ExchangeUnreachable("no hub endpoints configured")
+            )
         finally:
             metrics.fleet_hub_rpc_seconds.labels(op).observe(
                 time.perf_counter() - t0
             )
 
+    def consume_failover(self) -> bool:
+        """True once per observed hub failover (epoch advance):
+        FleetRuntime polls this in maybe_resync and forces a wholesale
+        republish from cluster truth — the new primary's replicated
+        rows may trail whatever the deposed one acked last."""
+        moved, self._failover_pending = self._failover_pending, False
+        return moved
+
+    def hub_status(self) -> dict:
+        """The serving hub's status plus this client's failover state
+        (the ``GET /debug/hub`` body for a remote-hub fleet)."""
+        out = self._op("hub_status")
+        status = dict(out.get("status") or {})
+        status["client"] = {
+            "endpoints": list(self._targets),
+            "active": self._targets[self._active],
+            "seen_epoch": self._seen_epoch,
+            "failovers": self.failovers,
+            "pending_flush": self._pending_flush(),
+        }
+        return status
+
     def flush(self) -> None:
-        """Drain the write-behind buffer (rows + piggybacked journal
-        lines) as one apply_ops RPC. On a transport failure both are
-        RETAINED (idempotent upserts — a retry replays safely; the
-        wholesale resync republish supersedes the rows regardless). A
-        fenced rejection DROPS the rows — a retired replica's rows
-        must not land, and its healed incarnation re-registers from
-        truth — but NOT the journal half: the hub applies journal ops
-        before the fence-checked row ops, so the lines of the fenced
-        RPC already landed."""
+        """Drain the write-behind buffers: the open buffer (rows +
+        piggybacked journal lines) SEALS into one batch under a fresh
+        ``(flush_client, flush_seq)`` key, then every sealed batch
+        ships in order, one apply_ops RPC each (steady state: exactly
+        one). On a transport failure the unacknowledged batches are
+        RETAINED — a retry re-sends the SAME sealed batch under the
+        SAME key, and the hub's dedup drops it whole if the lost reply
+        hid a completed apply (the double-apply fix). A fenced
+        rejection DROPS that batch's rows — a retired replica's rows
+        must not land; its healed incarnation re-registers from truth
+        — but NOT the journal half: the hub lands journal lines before
+        the fence-checked row ops, so the fenced RPC's lines are
+        already aggregated."""
         from .occupancy import AdmitConflict
 
-        if not self._buffer and not self._journal_buffer:
-            return
-        ops, self._buffer = self._buffer, []
-        jl, self._journal_buffer = self._journal_buffer, []
-        try:
-            self._op(
-                "apply_ops", replica=self._replica,
-                ops=[["journal", line] for line in jl] + ops,
-            )
-        except AdmitConflict:
-            # fenced: the rows must not land — drop, and remember so
-            # the next mutation surfaces the typed conflict (the
-            # in-process hub raises it inline; silently succeeding
-            # here would leave every later row discarded without the
-            # replica ever learning to resync). The journal lines
-            # landed server-side before the fence check.
-            self._fenced_seen = True
-        except Exception:
-            self._buffer = ops + self._buffer  # retained for retry
-            self._journal_buffer = jl + self._journal_buffer
-            if len(self._buffer) > 4 * self._buffer_cap:
-                # a long partition must not grow the buffers without
-                # bound: drop the rows — the raise below sets the
-                # caller's dirty flag, and the first reachable resync
-                # republishes every row wholesale from truth
-                self._buffer.clear()
-            if len(self._journal_buffer) > self._JOURNAL_BUFFER_CAP:
-                # journal lines have no republish path: drop the
-                # OLDEST beyond the cap and COUNT the loss (the hub
-                # keeps a recent window anyway; the replica's own
-                # sinks remain the durable store)
-                excess = (
-                    len(self._journal_buffer) - self._JOURNAL_BUFFER_CAP
+        if self._buffer or self._journal_buffer:
+            ops = [
+                ["journal", line] for line in self._journal_buffer
+            ] + self._buffer
+            self._sealed.append((self._flush_seq, ops))
+            self._flush_seq += 1
+            self._buffer = []
+            self._journal_buffer = []
+        while self._sealed:
+            seq, ops = self._sealed[0]
+            try:
+                self._op(
+                    "apply_ops", replica=self._replica, ops=ops,
+                    flush_seq=seq, flush_client=self._flush_client,
                 )
-                del self._journal_buffer[:excess]
-                self.journal_lines_dropped += excess
-            raise
+            except AdmitConflict:
+                # fenced: the rows must not land — drop the batch, and
+                # remember so the next mutation surfaces the typed
+                # conflict (the in-process hub raises it inline;
+                # silently succeeding here would leave every later row
+                # discarded without the replica ever learning to
+                # resync). Its journal lines landed pre-fence.
+                self._fenced_seen = True
+                self._sealed.pop(0)
+                continue
+            except Exception:
+                self._cap_retained()
+                raise
+            self._sealed.pop(0)
+
+    def _cap_retained(self) -> None:
+        """Bound the retained sealed batches through a long partition:
+        row ops are droppable (the raise sets the caller's dirty flag
+        and the first reachable resync republishes wholesale from
+        truth); journal lines have no republish path, so only the
+        OLDEST beyond the cap drop, counted so the loss is observable
+        instead of silent."""
+        rows = sum(
+            1
+            for _seq, ops in self._sealed
+            for kind, _arg in ops
+            if kind != "journal"
+        )
+        if rows > 4 * self._buffer_cap:
+            self._strip_sealed_rows()
+        jl = sum(
+            1
+            for _seq, ops in self._sealed
+            for kind, _arg in ops
+            if kind == "journal"
+        )
+        excess = jl - self._JOURNAL_BUFFER_CAP
+        if excess > 0:
+            self.journal_lines_dropped += excess
+            trimmed = []
+            for seq, ops in self._sealed:
+                kept = []
+                for op in ops:
+                    if op[0] == "journal" and excess > 0:
+                        excess -= 1
+                        continue
+                    kept.append(op)
+                trimmed.append((seq, kept))
+            self._sealed = trimmed
+        # a batch emptied by the caps still consumed its seq — dropping
+        # it is safe (the hub's dedup watermark only ever compares <=)
+        self._sealed = [(s, ops) for s, ops in self._sealed if ops]
+
+    def _strip_sealed_rows(self) -> None:
+        """Drop the ROW halves of retained sealed batches, keeping
+        journal ops (rows re-create via the wholesale republish;
+        journal history re-creates nowhere). Emptied batches drop
+        whole — their consumed seq is safe, the dedup watermark only
+        compares <=. Shared by the retention cap and the resync
+        republish that supersedes buffered rows."""
+        self._sealed = [
+            (seq, [o for o in ops if o[0] == "journal"])
+            for seq, ops in self._sealed
+        ]
+        self._sealed = [(s, ops) for s, ops in self._sealed if ops]
 
     def _pending_flush(self) -> int:
-        return len(self._buffer) + len(self._journal_buffer)
+        return (
+            len(self._buffer)
+            + len(self._journal_buffer)
+            + sum(len(ops) for _seq, ops in self._sealed)
+        )
 
     def _buffered(self, kind: str, arg) -> None:
         if self._fenced_seen:
@@ -346,8 +572,11 @@ class RemoteOccupancyExchange:
     def replace_pod_rows(self, replica: str, rows) -> None:
         from .occupancy import pod_row_to_list
 
-        # wholesale from truth supersedes anything buffered
+        # wholesale from truth supersedes anything buffered — open
+        # buffer AND the row halves of retained sealed batches (their
+        # journal lines still ship; nothing re-creates journal history)
         self._buffer.clear()
+        self._strip_sealed_rows()
         self._op(
             "replace_pod_rows", replica=replica,
             rows=[pod_row_to_list(r) for r in rows],
@@ -437,7 +666,8 @@ class RemoteOccupancyExchange:
             self.flush()
         except Exception:
             pass  # teardown is best-effort; resync owns recovery
-        self._client.close()
+        for client in self._clients:
+            client.close()
 
 
 class FleetRuntime:
@@ -503,6 +733,11 @@ class FleetRuntime:
         # hub writes that failed while partitioned: rows must republish
         # wholesale at the next reachable resync
         self._exchange_dirty = False  # ktpu: guarded-by(cluster.lock)
+        # retires that failed while the hub was unreachable (a peer
+        # died mid-blackout): re-issued at the next reachable poll —
+        # a dead peer's frozen publish stamp left on the hub would
+        # otherwise age every survivor's staleness bound forever
+        self._pending_retires: set[str] = set()  # ktpu: guarded-by(cluster.lock)
         # conservative-admission rejections under stale rows (the sim's
         # hub_partition invariant asserts the path engaged)
         self.stale_rejections = 0  # ktpu: guarded-by(cluster.lock)
@@ -537,6 +772,24 @@ class FleetRuntime:
         in-process hub)."""
         if isinstance(self.exchange, RemoteOccupancyExchange):
             self.exchange.set_buffer_cap(n)
+
+    def hub_status(self) -> dict:
+        """The ``GET /debug/hub`` body: the serving hub's role / epoch
+        / cursors / HA counters, plus this replica's client-side view
+        (endpoints, active endpoint, verified epoch, failovers,
+        pending flush). Raises ExchangeUnreachable while no hub
+        endpoint answers — the HTTP handler maps that to 503."""
+        if isinstance(self.exchange, RemoteOccupancyExchange):
+            return self.exchange.hub_status()
+        status = self.exchange.hub_status()
+        status["client"] = {
+            "endpoints": ["in-process"],
+            "active": "in-process",
+            "seen_epoch": status.get("epoch", 0),
+            "failovers": 0,
+            "pending_flush": 0,
+        }
+        return status
 
     # max journal lines per shipped segment: bounds both the hub-side
     # append and the piggybacked flush payload (a mega-drain's burst
@@ -685,8 +938,14 @@ class FleetRuntime:
                 # conservative admission. (A SILENT hub-partitioned
                 # peer that is still lease-alive keeps its rows, and
                 # their growing age is exactly what turns peers
-                # conservative.)
-                self.exchange.retire(dead)
+                # conservative.) An unreachable hub (mid-failover
+                # blackout) defers the retire to the dirty-republish
+                # resync instead of crashing the membership transition.
+                try:
+                    self.exchange.retire(dead)
+                except ExchangeUnreachable:
+                    self._pending_retires.add(dead)
+                    self._exchange_dirty = True
         metrics.fleet_replicas.set(len(self.membership.alive()))
 
     # -- the shard-filtered watch predicate --
@@ -738,6 +997,24 @@ class FleetRuntime:
         # one locked append)
         self.ship_journal_segment(scheduler)
         with self.cluster.lock:
+            for dead in sorted(self._pending_retires):
+                # a retire deferred by a mid-blackout unreachable hub:
+                # the dead peer's rows and frozen publish stamp must
+                # come off the (new) hub, or the staleness bound stays
+                # conservative fleet-wide forever
+                try:
+                    self.exchange.retire(dead)
+                except ExchangeUnreachable:
+                    break  # still dark: retry next poll
+                self._pending_retires.discard(dead)
+            consume = getattr(self.exchange, "consume_failover", None)
+            if consume is not None and consume():
+                # the hub epoch advanced (a standby promoted): the new
+                # primary's replicated state may trail whatever the
+                # deposed one acked last — re-register wholesale from
+                # cluster truth (the PR 8 dirty-republish heal), which
+                # the forced resync below does
+                self._needs_resync = True
             if self._exchange_dirty:
                 # hub writes failed while partitioned: once the hub is
                 # reachable again, force a full resync so rows and
@@ -1141,8 +1418,13 @@ class FleetRuntime:
         # degraded replicas (open solve breakers, published through the
         # exchange) sort LAST: refugees route to healthy peers first.
         # Every replica reads the same flag set, so the chain stays a
-        # fleet-wide consistent rendezvous order.
-        degraded = self.exchange.degraded_replicas()
+        # fleet-wide consistent rendezvous order. A dark hub (mid-
+        # failover blackout) yields no flags — the hand_off below
+        # would fail the same way and keep the pod local regardless.
+        try:
+            degraded = self.exchange.degraded_replicas()
+        except ExchangeUnreachable:
+            return None
         chain = sorted(
             alive,
             key=lambda r: (r in degraded, -_h("pod", key, r), r),

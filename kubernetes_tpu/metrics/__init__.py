@@ -443,6 +443,40 @@ fleet_hub_rpc_seconds = Histogram(
     buckets=_BUCKETS,
     registry=REGISTRY,
 )
+hub_epoch = Gauge(
+    "scheduler_hub_epoch",
+    "The occupancy hub's fencing epoch as last observed by this "
+    "process (hub side: the lease grant this hub serves under; client "
+    "side: the highest epoch RemoteOccupancyExchange has verified on a "
+    "HubOp reply — replies from a lower epoch are structurally "
+    "ignored). Monotone per fleet; a step is a hub failover.",
+    registry=REGISTRY,
+)
+hub_failover_total = Counter(
+    "scheduler_hub_failover_total",
+    "Hub failovers: a standby hub was promoted past epoch 1 (hub "
+    "side), or RemoteOccupancyExchange observed the hub epoch advance "
+    "and re-anchored on the new primary (client side — the replica "
+    "then forces a wholesale resync republish, the dirty-heal path).",
+    registry=REGISTRY,
+)
+hub_replication_lag_rows = Gauge(
+    "scheduler_hub_replication_lag_rows",
+    "Standby replication lag in op-log entries: the primary's latest "
+    "opseq minus this standby's applied cursor at the last "
+    "StandbyReplicator poll (0 = caught up; the failover loss window "
+    "is bounded by this).",
+    registry=REGISTRY,
+)
+fleet_flush_dedup_total = Counter(
+    "scheduler_fleet_flush_dedup_total",
+    "Write-behind flushes the hub dropped as duplicates: a retried "
+    "apply_ops batch whose (client, flush_seq) key was already "
+    "applied — the reply of the first attempt was lost after the "
+    "server-side apply, and without the dedup its rows would "
+    "double-stage and its journal lines double-append.",
+    registry=REGISTRY,
+)
 fleet_mesh_slice_devices = Gauge(
     "scheduler_fleet_mesh_slice_devices",
     "Devices in this replica's EXCLUSIVE mesh slice "

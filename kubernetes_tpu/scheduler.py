@@ -4764,6 +4764,16 @@ class Scheduler:
         metrics.backlog_hbm_measured_bytes.set(report.measured_h2d_bytes)
         return report
 
+    def hub_status(self) -> "dict | None":
+        """The ``GET /debug/hub`` body: the occupancy hub's role /
+        epoch / replication cursors plus this replica's client-side
+        failover view (fleet/runtime.py). None when this scheduler is
+        not a fleet replica; raises ExchangeUnreachable while no hub
+        endpoint answers (the HTTP handler maps it to 503)."""
+        if self.fleet is None:
+            return None
+        return self.fleet.hub_status()
+
     @property
     def pending(self) -> int:
         """Work the loop must still drive: queued pods, pods parked at

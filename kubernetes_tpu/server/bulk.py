@@ -288,15 +288,23 @@ class BulkCore:
     def hub_op(self, data: bytes, ctx=None) -> bytes:
         """Occupancy-hub operation dispatch: the full OccupancyExchange
         surface (stage / fenced compare-and-stage / commit / withdraw /
-        retire / handoff / degraded flags / views) as one unary RPC, so
-        N cross-process replicas share ONE hub with the in-process
-        semantics intact. meta.op selects the operation; rows ride the
-        JSON meta (they are compact by construction). Error mapping —
-        the wire half of the typed-conflict contract:
+        idempotent apply_ops flush / retire / handoff / degraded flags
+        / views / replication catch-up / status) as one unary RPC, so N
+        cross-process replicas share ONE hub with the in-process
+        semantics intact. The op table itself lives in
+        ``fleet.occupancy.dispatch_hub_op`` — shared verbatim with the
+        in-process LocalHubClient, so the two transports cannot drift —
+        and every reply carries the hub's ``epoch`` for the client-side
+        monotone fencing check. Error mapping — the wire half of the
+        typed-conflict contract:
 
-        - ``ExchangeUnreachable`` (the sim's partition seam) ->
-          UNAVAILABLE: a transport-class failure the client surfaces as
-          ExchangeUnreachable again;
+        - ``ExchangeUnreachable`` (the sim's partition seam / a downed
+          hub) -> UNAVAILABLE: a transport-class failure the client
+          surfaces as ExchangeUnreachable again;
+        - ``HubDeposed`` (this hub does not hold the primary lease —
+          a deposed old primary or an unpromoted standby) ->
+          PERMISSION_DENIED: RemoteOccupancyExchange rotates to the
+          next endpoint, never retries here;
         - ``AdmitConflict`` (CAS lost its version race) -> ABORTED;
           ``AdmitConflict(fenced=True)`` (hub write fence) ->
           FAILED_PRECONDITION. Both are SEMANTIC rejections: BulkClient
@@ -307,127 +315,43 @@ class BulkCore:
         from ..fleet.occupancy import (
             AdmitConflict,
             ExchangeUnreachable,
-            NodeRow,
-            pod_row_from_list,
-            pod_row_to_list,
+            HubDeposed,
+            dispatch_hub_op,
         )
 
         meta, _arrays = tensorcodec.decode(data)
         op = meta.get("op") or ""
-        replica = meta.get("replica") or ""
         hub = self._hub()
-        try:
-            out: dict = {}
-            if op == "version":
-                out["version"] = hub.version
-            elif op == "peers_version":
-                out["version"] = hub.peers_version(replica)
-            elif op == "publish_nodes":
-                hub.publish_nodes(
-                    replica,
-                    [NodeRow(node=n, zone=z) for n, z in meta.get("nodes") or []],
-                )
-            elif op == "stage":
-                hub.stage(replica, pod_row_from_list(meta["row"]))
-            elif op == "cas_stage":
-                out["version"] = hub.compare_and_stage(
-                    replica,
-                    pod_row_from_list(meta["row"]),
-                    int(meta["expect"]),
-                )
-            elif op == "replace_pod_rows":
-                hub.replace_pod_rows(
-                    replica,
-                    [pod_row_from_list(r) for r in meta.get("rows") or []],
-                )
-            elif op == "commit":
-                hub.commit(replica, meta["pod"])
-            elif op == "withdraw":
-                hub.withdraw(replica, meta["pod"])
-            elif op == "apply_ops":
-                # write-behind flush (RemoteOccupancyExchange): a batch
-                # of buffered stage/commit/withdraw mutations applied in
-                # order — ONE wire round trip instead of one per row.
-                # Idempotent upserts keyed by pod, so a client retrying
-                # a buffer after a transient failure is safe. Journal
-                # segments piggyback on the same flush (kind "journal")
-                # and land FIRST: journal lines are append-only
-                # observability, deliberately not fence-gated, so a
-                # fenced zombie's history still aggregates even though
-                # its row mutations below reject.
-                ops = meta.get("ops") or []
-                journal_lines = [
-                    arg for kind, arg in ops if kind == "journal"
-                ]
-                if journal_lines:
-                    hub.ship_journal(replica, journal_lines)
-                for kind, arg in ops:
-                    if kind == "stage":
-                        hub.stage(replica, pod_row_from_list(arg))
-                    elif kind == "commit":
-                        hub.commit(replica, arg)
-                    elif kind == "withdraw":
-                        hub.withdraw(replica, arg)
-                    elif kind == "journal":
-                        pass  # shipped above, pre-fence
-                    else:
-                        raise ValueError(
-                            f"unknown apply_ops kind {kind!r}"
-                        )
-            elif op == "ship_journal":
-                hub.ship_journal(replica, meta.get("lines") or [])
-            elif op == "journal_lines":
-                out["lines"] = hub.journal_lines()
-            elif op == "retire":
-                hub.retire(replica)
-            elif op == "set_degraded":
-                hub.set_degraded(replica, bool(meta.get("degraded")))
-            elif op == "degraded_replicas":
-                out["replicas"] = sorted(hub.degraded_replicas())
-            elif op == "hand_off":
-                hub.hand_off(
-                    meta["to"], meta["pod"], int(meta.get("hops") or 0),
-                    from_replica=meta.get("from") or None,
-                    trace=str(meta.get("trace") or ""),
-                )
-            elif op == "claim_handoffs":
-                # (pod, hops, journey trace) — the trace context rides
-                # the handoff row across the wire (the cross-replica
-                # trace propagation tentpole)
-                out["handoffs"] = [
-                    [k, h, trace]
-                    for k, h, trace in hub.claim_handoffs(replica)
-                ]
-            elif op == "pending_handoff_keys":
-                out["keys"] = sorted(hub.pending_handoff_keys())
-            elif op == "peers_view":
-                view = hub.peers_view(replica)
-                out = {
-                    "version": view.version,
-                    "nodes": [[r.node, r.zone] for r in view.node_rows],
-                    "pods": [pod_row_to_list(r) for r in view.pod_rows],
-                    "peerAges": [[r, a] for r, a in view.peer_ages],
-                }
-            else:
+        # hub spans carry the epoch: one span per HubOp with the hub's
+        # identity attributes, so a trace crossing a failover shows
+        # WHICH hub incarnation served each op (disabled tracer = one
+        # attribute check)
+        with self.tracer.span(
+            "hub_op", op=op, hub_epoch=hub.hub_epoch,
+        ):
+            try:
+                out = dispatch_hub_op(hub, op, meta)
+            except HubDeposed as e:
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+                raise
+            except ExchangeUnreachable as e:
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                raise
+            except AdmitConflict as e:
                 if ctx is not None:
                     ctx.abort(
-                        grpc.StatusCode.INVALID_ARGUMENT,
-                        f"unknown hub op {op!r}",
+                        grpc.StatusCode.FAILED_PRECONDITION
+                        if e.fenced
+                        else grpc.StatusCode.ABORTED,
+                        str(e),
                     )
-                raise ValueError(f"unknown hub op {op!r}")
-        except ExchangeUnreachable as e:
-            if ctx is not None:
-                ctx.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-            raise
-        except AdmitConflict as e:
-            if ctx is not None:
-                ctx.abort(
-                    grpc.StatusCode.FAILED_PRECONDITION
-                    if e.fenced
-                    else grpc.StatusCode.ABORTED,
-                    str(e),
-                )
-            raise
+                raise
+            except ValueError as e:
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                raise
         return tensorcodec.encode(out)
 
     def evaluate(self, data: bytes) -> bytes:
@@ -532,7 +456,10 @@ class BulkClient:
     """Columnar in, columnar out — now with production-grade call
     hygiene: every RPC carries a deadline, and transient failures
     (UNAVAILABLE / DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED, plus broken
-    connections) retry with bounded exponential backoff, counted by
+    connections) retry with FULL-JITTER bounded exponential backoff
+    (each wait drawn uniformly from [0, base * 2^attempt) — N clients
+    whose server just failed over must not re-arrive in lockstep and
+    thundering-herd the standby), counted by
     ``scheduler_bulk_retry_total``. A call that keeps failing raises
     the last error — the caller sees exactly one exception after the
     budget, not a raw flake on the first blip.
@@ -550,8 +477,10 @@ class BulkClient:
         deadline_s: float = 30.0,
         backoff_base_s: float = 0.05,
         clock=None,
+        backoff_rng=None,
     ):
         import grpc
+        import random
 
         from ..utils.clock import Clock
 
@@ -560,6 +489,14 @@ class BulkClient:
         self.deadline_s = float(deadline_s)
         self.backoff_base_s = float(backoff_base_s)
         self._clock = clock or Clock()
+        # jitter stream: seeded by the target string so seeded runs
+        # (the sim's --selfcheck) stay deterministic; tests inject
+        # their own to pin exact draws
+        self._backoff_rng = (
+            backoff_rng
+            if backoff_rng is not None
+            else random.Random(f"bulk-backoff/{target}")
+        )
         ident = lambda b: b  # noqa: E731
         self._channel = grpc.insecure_channel(target)
         self._solve = self._channel.unary_unary(
@@ -597,8 +534,11 @@ class BulkClient:
         return False
 
     def _call(self, method: str, fn, payload: bytes, retry: bool = True):
-        """One deadline-bounded RPC with bounded-backoff retries on
-        transient errors."""
+        """One deadline-bounded RPC with full-jitter bounded-backoff
+        retries on transient errors (AWS-style full jitter: the wait is
+        uniform over [0, cap), where cap doubles per attempt — plain
+        exponential backoff keeps simultaneous losers synchronized,
+        which is exactly wrong during a fleet-wide hub failover)."""
         attempts = self.retries + 1 if retry else 1
         last = None
         for attempt in range(attempts):
@@ -607,7 +547,9 @@ class BulkClient:
 
                 metrics.bulk_retry_total.labels(method).inc()
                 self._clock.sleep(
-                    self.backoff_base_s * (2 ** (attempt - 1))
+                    self._backoff_rng.uniform(
+                        0.0, self.backoff_base_s * (2 ** (attempt - 1))
+                    )
                 )
             try:
                 return fn(payload, timeout=self.deadline_s)

@@ -603,6 +603,28 @@ def make_app(
             )
         return web.json_response(slo.snapshot())
 
+    # -- occupancy-hub HA surface (kubernetes_tpu/fleet) --
+
+    async def debug_hub(request):
+        status = None
+        if scheduler is not None and scheduler.fleet is not None:
+            from ..fleet.occupancy import ExchangeUnreachable
+
+            try:
+                status = scheduler.hub_status()
+            except ExchangeUnreachable as e:
+                # mid-blackout: every hub endpoint is down — exactly
+                # what the operator polling this endpoint wants to know
+                return web.json_response(
+                    {"error": f"hub unreachable: {e}"}, status=503
+                )
+        if status is None:
+            return web.json_response(
+                {"error": "not a fleet replica (no occupancy hub)"},
+                status=404,
+            )
+        return web.json_response(status)
+
     # -- ingest surface (the watch-fed view's write side) --
 
     def _items(doc):
@@ -676,6 +698,7 @@ def make_app(
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
     app.router.add_get("/debug/spans", debug_spans)
     app.router.add_get("/debug/slo", debug_slo)
+    app.router.add_get("/debug/hub", debug_hub)
     app.router.add_post("/api/nodes", post_nodes)
     app.router.add_delete("/api/nodes/{name}", delete_node)
     app.router.add_post("/api/pods", post_pods)

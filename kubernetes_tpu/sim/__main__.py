@@ -159,6 +159,20 @@ def _print_fleet_result(res) -> None:
             f"zombie_binds_while_fenced={s['zombie_binds_while_fenced']} "
             f"stale_rejections={s['stale_rejections']}"
         )
+    ha = s.get("hub_ha")
+    if ha:
+        print(
+            f"  hub_ha: failovers={ha['promotions']} "
+            f"epoch={ha['epoch']} "
+            f"blackout_cycles={ha['blackout_cycles']} "
+            f"stale_writes_rejected={ha['deposed_write_rejections']} "
+            f"dedup_hits={ha['flush_dedup_hits']} "
+            f"client_failovers={ha['client_failovers']} "
+            f"replicated_ops={ha['replication_ops']} "
+            f"journal_missing={ha['hub_journal_missing']} "
+            f"old_primary_reads_ok={ha['old_primary_reads_ok']} "
+            f"stale_rejections={s['stale_rejections']}"
+        )
     for rid in sorted(res.journal_digests):
         print(f"  journal[{rid}]={res.journal_digests[rid]}")
     print(

@@ -53,6 +53,7 @@ from .invariants import (
     _record,
     check_constraints,
     check_fleet_journal_completeness,
+    check_hub_failover,
     check_hub_partition,
     check_no_global_overcommit,
 )
@@ -141,41 +142,113 @@ class FleetSimHarness:
             self.cluster.create_node(node)
 
         # the hub shares the virtual clock so occupancy-row aging (the
-        # staleness bounds) rides the same timeline as everything else
-        self.exchange = OccupancyExchange(clock=self.clock)
-        # gRPC-backed hub: the SAME hub object served behind the bulk
-        # boundary's HubOp method on localhost — every replica talks to
-        # it through a RemoteOccupancyExchange over a real socket (real
-        # tensorcodec wire framing, real status-code conflict mapping),
-        # while the harness keeps direct access for its fault seams
-        # (set_partitioned / retire) and invariants. Virtual time is
-        # untouched (RPC wall time never enters the FakeClock) and the
-        # drive stays single-threaded round-robin, so same seed + flags
-        # reproduce byte-identical journals ACROSS RUNS (--selfcheck).
-        # Journals are not byte-identical to the in-process-hub drive:
-        # the client's write-behind row buffer legitimately shifts WHEN
+        # staleness bounds) rides the same timeline as everything else.
+        # HA mode (profile.hub_failover_at >= 0) runs a PRIMARY +
+        # STANDBY hub pair under one HubLease: the primary holds epoch
+        # 1, the standby replicates its op log (the harness is the
+        # hubs' serving loop — replication polls + lease heartbeats
+        # tick once per cycle, deterministic on the virtual clock),
+        # and replicas reach them through RemoteOccupancyExchange's
+        # endpoint-failover client, in-process (LocalHubClient per
+        # hub) or over real gRPC (one bulk server per hub).
+        self.ha = self.profile.hub_failover_at >= 0
+        self.hub_lease = None
+        self.hub_primary = None
+        self.hub_standby = None
+        self._replicator = None
+        self._primary_down = False
+        self._promotions = 0
+        self._blackout_cycles = 0
+        self._old_primary_reads_ok = None
+        if self.ha:
+            from ..fleet.ha import HubLease, StandbyReplicator
+
+            self.hub_lease = HubLease(
+                clock=self.clock, duration_s=self.profile.hub_lease_s
+            )
+            self.hub_primary = OccupancyExchange(
+                clock=self.clock, hub_id="hub-a", lease=self.hub_lease
+            )
+            assert self.hub_primary.try_promote() == 1
+            self.hub_standby = OccupancyExchange(
+                clock=self.clock, hub_id="hub-b", lease=self.hub_lease
+            )
+            # self.exchange is the harness's introspection handle (the
+            # invariants' pending-handoff/journal reads, the fault
+            # seams): the CURRENT primary — re-pointed at promotion
+            self.exchange = self.hub_primary
+        else:
+            self.exchange = OccupancyExchange(clock=self.clock)
+        # gRPC-backed hub: the SAME hub object(s) served behind the
+        # bulk boundary's HubOp method on localhost — every replica
+        # talks through a RemoteOccupancyExchange over a real socket
+        # (real tensorcodec wire framing, real status-code conflict
+        # mapping), while the harness keeps direct access for its
+        # fault seams (set_partitioned / set_down / retire) and
+        # invariants. Virtual time is untouched (RPC wall time never
+        # enters the FakeClock) and the drive stays single-threaded
+        # round-robin, so same seed + flags reproduce byte-identical
+        # journals ACROSS RUNS (--selfcheck). Journals are not
+        # byte-identical to the in-process-hub drive: the client's
+        # write-behind row buffer legitimately shifts WHEN
         # commit/withdraw bumps land on the hub version counter, which
         # re-times conflict-parked wakeups — every invariant still
         # holds, which is the actual contract.
         self.grpc_hub = grpc_hub
-        self._hub_server = None
+        self._hub_servers: list = []
         self._hub_clients: list = []
         self.universe = tuple(f"r{i}" for i in range(self.n))
         replica_exchange = {rid: self.exchange for rid in self.universe}
-        if grpc_hub:
+        if grpc_hub or self.ha:
             from ..fleet.runtime import RemoteOccupancyExchange
-            from ..server.bulk import BulkCore, make_grpc_server
 
-            core = BulkCore(self.cluster, exchange=self.exchange)
-            self._hub_server, port = make_grpc_server(core, port=0)
-            self._hub_server.start()
+            hubs = (
+                [self.hub_primary, self.hub_standby]
+                if self.ha
+                else [self.exchange]
+            )
+            if grpc_hub:
+                from ..server.bulk import BulkCore, make_grpc_server
+
+                targets = []
+                for hub in hubs:
+                    core = BulkCore(self.cluster, exchange=hub)
+                    server, port = make_grpc_server(core, port=0)
+                    server.start()
+                    self._hub_servers.append(server)
+                    targets.append(f"127.0.0.1:{port}")
+                make_clients = lambda rid: dict(  # noqa: E731
+                    target=",".join(targets)
+                )
+            else:
+                from ..fleet.ha import LocalHubClient
+
+                make_clients = lambda rid: dict(  # noqa: E731
+                    target="", clients=[LocalHubClient(h) for h in hubs]
+                )
             replica_exchange = {}
             for rid in self.universe:
                 remote = RemoteOccupancyExchange(
-                    f"127.0.0.1:{port}", rid, clock=self.clock
+                    replica=rid, clock=self.clock,
+                    # deterministic flush identity: it only rides RPC
+                    # meta (never journals/traces), but a stable id
+                    # keeps run-to-run wire traffic identical too
+                    flush_client_id=f"{rid}-sim",
+                    **make_clients(rid),
                 )
                 self._hub_clients.append(remote)
                 replica_exchange[rid] = remote
+            if self.ha:
+                from ..server.bulk import BulkClient
+
+                source = (
+                    BulkClient(targets[0], retries=0, clock=self.clock)
+                    if grpc_hub
+                    else LocalHubClient(self.hub_primary)
+                )
+                self._replicator = StandbyReplicator(
+                    self.hub_standby, source
+                )
         self.schedulers: dict[str, Scheduler] = {}
         for rid in self.universe:
             self.schedulers[rid] = Scheduler(
@@ -277,6 +350,108 @@ class FleetSimHarness:
         for r in survivors:
             self.schedulers[r].fleet.set_alive(survivors)
 
+    # -- hub HA (the hub_failover profile) --
+
+    def _ha_tick(self, cycle: int) -> None:
+        """One deterministic HA maintenance round per cycle — runs
+        AFTER the cycle's clock advance and BEFORE its drive, so the
+        serving hub's lease renewal covers the drive's ops even
+        through the settle ladder's long (11s/301s) rounds. The
+        harness IS the hubs' serving loops on the virtual timeline:
+        lease maintenance (``try_promote`` — a same-holder re-acquire
+        renews WITHOUT bumping the epoch, so steady state never looks
+        like a failover), the standby's replication poll, the
+        kill/promote/heal schedule, and the one injected reply-loss
+        that proves the idempotent flush path."""
+        from ..fleet.occupancy import ExchangeUnreachable
+
+        if not self._primary_down:
+            # replication poll BEFORE the kill check: one poll per
+            # tick means the standby is caught up to the last
+            # completed cycle when the kill lands (lag within the
+            # kill's own cycle heals through the clients' retained
+            # sealed buffers and the forced republish — rows — while
+            # journal lines ride the same retained buffers)
+            try:
+                self._replicator.poll()
+            except ExchangeUnreachable:
+                pass
+        if cycle == self.profile.hub_failover_at:
+            self._kill_primary(cycle)
+        if not self._primary_down:
+            self.hub_primary.try_promote()  # same-holder lease renew
+        elif self._promotions:
+            # the promoted standby is the serving hub: keep ITS lease
+            # fresh (an unrenewed lease would self-depose it — the
+            # exact failure mode the fencing check exists to catch)
+            self.hub_standby.try_promote()
+        else:
+            # blackout: takeover only succeeds once the dead
+            # primary's lease expires — the fencing window
+            granted = self.hub_standby.try_promote()
+            if granted is not None:
+                self._promotions += 1
+                # the standby is the serving hub now: re-point the
+                # harness's introspection handle (invariants, journal
+                # aggregation reads, retire calls)
+                self.exchange = self.hub_standby
+            else:
+                self._blackout_cycles += 1
+        if cycle == 1:
+            # deterministic reply loss: the next apply_ops flush
+            # applies server-side, then the reply is lost — the
+            # client's sealed-batch retry must dedup (the invariant's
+            # dedup_hits >= 1 clause)
+            self.hub_primary.set_flush_fault(1)
+        if (
+            cycle == self.profile.hub_failover_heal
+            and self._primary_down
+            and self._promotions
+        ):
+            self._heal_old_primary(cycle)
+
+    def _kill_primary(self, cycle: int) -> None:
+        """The primary hub process dies: every op from every replica
+        raises ExchangeUnreachable (UNAVAILABLE over the wire), its
+        lease renewals stop, and the fleet enters the blackout window
+        — conservative admission until the standby's lease grant."""
+        self._primary_down = True
+        self.hub_primary.set_down(True)
+        metrics.sim_faults_injected_total.labels("hub_failover").inc()
+
+    def _heal_old_primary(self, cycle: int) -> None:
+        """The OLD primary resurfaces (partitioned-zombie style:
+        alive, lease long taken over). It must keep serving its
+        debug/read surface — the post-mortem path — while 100% of
+        replica-facing writes reject with the typed HubDeposed (its
+        own lease-validity check self-deposes it on the first write
+        attempt; a replica that failed over already ignores it via
+        the epoch-monotone check)."""
+        from ..fleet.occupancy import HubDeposed, PodRow
+
+        self.hub_primary.set_down(False)
+        try:
+            status = self.hub_primary.hub_status()
+            self._old_primary_reads_ok = bool(status.get("hub"))
+        except Exception:
+            self._old_primary_reads_ok = False
+        # the write probe: a straggler replica (or the zombie itself)
+        # pushing a row at the old primary must get the typed fence
+        probe = PodRow(
+            pod="probe/stale-write", node="n0", zone="z0",
+            namespace="probe", labels=(("app", "probe"),),
+        )
+        try:
+            self.hub_primary.stage(self.universe[0], probe)
+        except HubDeposed:
+            pass  # counted in deposed_write_rejections — the proof
+        else:
+            _record(
+                self.violations, "hub_failover", cycle,
+                "a replica-facing write LANDED on the deposed old "
+                "primary — the hub epoch fence leaked",
+            )
+
     def _partition_hub(self, cycle: int) -> None:
         """The hub_partition fault: the last replica loses its network
         path to the occupancy hub AND its lease renewals stall (the
@@ -322,7 +497,12 @@ class FleetSimHarness:
         """Fleet lost-pod accounting: every unbound pod some alive
         replica routes must be tracked by a queue / in-flight map /
         WaitingPods map somewhere, or sit in a pending handoff row."""
-        tracked: set[str] = set(self.exchange.pending_handoff_keys())
+        # debug_state bypasses the down seam: mid-blackout the
+        # (dead) hub's last-known handoff rows still count as
+        # tracked — they replicate to the standby and re-surface
+        tracked: set[str] = set(
+            self.exchange.debug_state()["pending_handoffs"]
+        )
         solver_names: set[str] = set()
         for rid, sched in self.schedulers.items():
             if not self.alive[rid]:
@@ -346,7 +526,7 @@ class FleetSimHarness:
                 )
 
     def _settled(self) -> bool:
-        if self.exchange.pending_handoff_keys():
+        if self.exchange.debug_state()["pending_handoffs"]:
             return False
         for rid, sched in self.schedulers.items():
             if not self.alive[rid]:
@@ -364,8 +544,8 @@ class FleetSimHarness:
         finally:
             for client in self._hub_clients:
                 client.close()
-            if self._hub_server is not None:
-                self._hub_server.stop(grace=None)
+            for server in self._hub_servers:
+                server.stop(grace=None)
 
     def _run(self) -> FleetSimResult:
         for cycle in range(self.cycles):
@@ -383,6 +563,10 @@ class FleetSimHarness:
                 apply_event(self.cluster, ev)
                 self._events_applied += 1
             self.clock.advance(1.0)
+            if self.ha:
+                # post-advance, pre-drive: the serving hub's lease
+                # renewal covers this drive's ops
+                self._ha_tick(cycle)
             self._drive(cycle)
             self._check(cycle)
         settled = self._quiesce()
@@ -397,7 +581,8 @@ class FleetSimHarness:
                 self.cycles + self.max_settle_rounds,
                 "fleet failed to quiesce after churn stopped: "
                 f"queues={queues} "
-                f"handoffs={sorted(self.exchange.pending_handoff_keys())}",
+                f"handoffs="
+                f"{sorted(self.exchange.debug_state()['pending_handoffs'])}",
             )
         return self._finish(settled)
 
@@ -413,6 +598,12 @@ class FleetSimHarness:
         for i, adv in enumerate(advances):
             cycle = self.cycles + i
             self.clock.advance(adv)
+            if self.ha:
+                # post-advance like the main loop: the serving hub's
+                # lease renewal covers this round's drive, and a kill
+                # near the end of the driven cycles still promotes
+                # during the settle ladder instead of deadlocking it
+                self._ha_tick(cycle)
             self._drive(cycle)
             self._check(cycle)
             if i >= flush_round and self._settled():
@@ -463,6 +654,66 @@ class FleetSimHarness:
                     for s in self.schedulers.values()
                 ),
             )
+        hub_ha = None
+        if self.ha:
+            # journal aggregation completeness after heal: every line
+            # each replica's journal holds must be on the SERVING
+            # hub's aggregation surface (pre-kill lines arrived via
+            # replication, blackout lines via the clients' retained
+            # sealed buffers re-flushed from the cursor)
+            hub_lines = set(hub_journal)
+            hub_journal_missing = sum(
+                1
+                for rid, sched in self.schedulers.items()
+                if self.alive[rid]
+                for line in sched.journal.lines
+                if line not in hub_lines
+            )
+            hub_ha = {
+                "promotions": self._promotions,
+                "epoch": self.exchange.hub_epoch,
+                "blackout_cycles": self._blackout_cycles,
+                # the OLD primary's count is the stale-primary-fence
+                # proof; the standby's own (pre-promotion writes that
+                # rotated onto it during the blackout) is reported
+                # separately — it is the failover client working, not
+                # the fence under test
+                "deposed_write_rejections": (
+                    self.hub_primary.deposed_write_rejections
+                ),
+                "standby_write_rejections": (
+                    self.hub_standby.deposed_write_rejections
+                ),
+                "flush_dedup_hits": (
+                    self.hub_primary.flush_dedup_hits
+                    + self.hub_standby.flush_dedup_hits
+                ),
+                "client_failovers": sum(
+                    c.failovers for c in self._hub_clients
+                ),
+                "replication_ops": self._replicator.ops_applied,
+                "replication_snapshots": (
+                    self._replicator.snapshots_installed
+                ),
+                "old_primary_reads_ok": self._old_primary_reads_ok,
+                "hub_journal_missing": hub_journal_missing,
+            }
+            check_hub_failover(
+                self.cycles + self.max_settle_rounds,
+                self.violations,
+                promotions=self._promotions,
+                epoch=self.exchange.hub_epoch,
+                deposed_write_rejections=hub_ha[
+                    "deposed_write_rejections"
+                ],
+                flush_dedup_hits=hub_ha["flush_dedup_hits"],
+                stale_rejections=sum(
+                    s.fleet.stale_rejections
+                    for s in self.schedulers.values()
+                ),
+                hub_journal_missing=hub_journal_missing,
+                old_primary_reads_ok=self._old_primary_reads_ok,
+            )
         bindings = {
             p.key: p.node_name
             for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
@@ -510,6 +761,8 @@ class FleetSimHarness:
             "journal_digests": digests,
             "hub_journal_lines": len(hub_journal),
             "hub_journal_digest": _digest(hub_journal),
+            # hub-HA counters (the hub_failover profile; None without)
+            "hub_ha": hub_ha,
         }
         flight_dumps: dict[str, str] = {}
         if self.violations:

@@ -695,6 +695,91 @@ def check_hub_partition(
         )
 
 
+def check_hub_failover(
+    cycle: int,
+    violations: list[Violation],
+    *,
+    promotions: int,
+    epoch: int,
+    deposed_write_rejections: int,
+    flush_dedup_hits: int,
+    stale_rejections: int,
+    hub_journal_missing: int,
+    old_primary_reads_ok,
+    expect_dedup: bool = True,
+) -> None:
+    """Hub-HA invariants (the hub_failover profile): a primary-hub
+    kill mid-drive must heal WITHOUT operator action, and the fencing
+    epoch must make the old primary harmless.
+
+    - **exactly one failover** — the standby promoted once, and the
+      fleet ends at epoch 2 (initial grant + one takeover; more would
+      mean lease flapping, zero would mean the fault never engaged);
+    - **stale-primary writes rejected** — the resurrected old primary
+      rejected >= 1 replica-facing write with the typed HubDeposed
+      (that none LANDED is covered by the overcommit/constraint checks
+      that run every cycle — here we pin that the fence actually
+      fired, not vacuously);
+    - **idempotent flush proven** — the injected reply-loss-after-
+      apply forced >= 1 hub-side dedup hit (the double-apply hazard's
+      regression clause, exercised inside the chaos loop);
+    - **conservative admission engaged** — the blackout window drove
+      >= 1 staleness rejection instead of admitting against a view
+      the dead hub could no longer refresh;
+    - **journal aggregation complete** — after heal, every line each
+      replica's journal shipped is present in the serving hub's
+      aggregation surface (zero lost to the failover: pre-kill lines
+      arrived via replication, blackout lines via the cursor-retrying
+      client buffers);
+    - **old primary serves reads** — its debug/status surface stayed
+      readable after resurrection (the operator's post-mortem path).
+    """
+    if promotions != 1:
+        _record(
+            violations, "hub_failover", cycle,
+            f"expected exactly one standby promotion, saw {promotions} "
+            "(0 = the kill never engaged, >1 = lease flapping)",
+        )
+    if epoch != 2:
+        _record(
+            violations, "hub_failover", cycle,
+            f"fleet ended at hub epoch {epoch}, expected 2 (initial "
+            "grant + exactly one epoch-fenced takeover)",
+        )
+    if deposed_write_rejections < 1:
+        _record(
+            violations, "hub_failover", cycle,
+            "the deposed old primary never rejected a replica-facing "
+            "write — the stale-primary fence was never exercised",
+        )
+    if expect_dedup and flush_dedup_hits < 1:
+        _record(
+            violations, "hub_failover", cycle,
+            "no write-behind flush was deduped — the injected "
+            "reply-loss-after-apply never forced the idempotency path",
+        )
+    if stale_rejections < 1:
+        _record(
+            violations, "hub_failover", cycle,
+            "no placement was rejected by the staleness bound during "
+            "the blackout — conservative admission never engaged",
+        )
+    if hub_journal_missing > 0:
+        _record(
+            violations, "hub_failover", cycle,
+            f"{hub_journal_missing} journal line(s) shipped by "
+            "replicas are missing from the serving hub's aggregation "
+            "surface after heal — the failover lost history",
+        )
+    if old_primary_reads_ok is False:
+        _record(
+            violations, "hub_failover", cycle,
+            "the resurrected old primary failed to serve its "
+            "read/status surface — post-mortem reads must survive "
+            "deposition",
+        )
+
+
 class RebalanceTracker:
     """Independent witness for the rebalancer's eviction activity:
     subscribes straight to the state service and counts the Events-API

@@ -112,6 +112,23 @@ class Profile:
     # crosses the bound inside the window and conservative admission
     # actually engages.
     fleet_max_row_age_s: float = 30.0
+    # -- hub HA (fleet/ha.py: replicated hub + epoch-fenced failover) --
+    # kill the PRIMARY occupancy hub at this cycle: the fleet drive
+    # runs a primary + standby hub pair (op-log replication, shared
+    # HubLease), replicas reach them through RemoteOccupancyExchange's
+    # endpoint-failover client, and the kill opens a blackout window —
+    # conservative admission engages — until the standby's lease grant
+    # promotes it at the next epoch. -1 = single hub (no HA).
+    hub_failover_at: int = -1
+    # resurrect the OLD primary's reachability at this cycle: it must
+    # keep serving its debug/read surface while 100% of replica-facing
+    # writes reject with the typed HubDeposed (the stale-primary fence
+    # the invariant pins). -1 = never.
+    hub_failover_heal: int = -1
+    # hub lease duration (virtual seconds): the fencing window — the
+    # standby can only promote after the dead primary's lease expires,
+    # so this bounds the failover blackout from below.
+    hub_lease_s: float = 2.0
     # -- continuous rebalancer (kubernetes_tpu/rebalance) --
     # enable the background defragmentation loop on the sim scheduler
     rebalance: bool = False
@@ -379,6 +396,43 @@ PROFILES: dict[str, Profile] = {
             fleet_replicas=2,
             hub_partition_at=2,
             hub_partition_heal=6,
+            fleet_max_row_age_s=2.0,
+        ),
+        # hub_failover: the hub HA chaos profile — a 2-replica fleet
+        # drives against a REPLICATED hub (primary + standby, op-log
+        # replication, shared lease) through the endpoint-failover
+        # client, and the primary is KILLED mid-drive. The blackout
+        # window (kill → standby's lease grant) must degrade to the
+        # proven conservative-admission path (stale rejections >= 1,
+        # zero overcommit), the promotion must heal everything without
+        # operator action (replicas re-attach via epoch-advance
+        # detection + forced wholesale republish; zero rows / handoffs
+        # / journal lines lost; hard-spread contention spanning the
+        # epoch boundary still decides exactly one CAS winner — the
+        # constraint/overcommit invariants run every cycle), a
+        # deterministic reply-loss injection must prove the idempotent
+        # flush dedup (dedup_hits >= 1), and the resurrected OLD
+        # primary must keep serving reads while 100% of its
+        # replica-facing writes reject with the typed HubDeposed.
+        # Asserted by the hub_failover invariant; byte-deterministic
+        # under --selfcheck like every profile.
+        Profile(
+            name="hub_failover",
+            nodes=9,
+            zones=3,
+            arrivals=(3, 6),
+            pod_spread_rate=0.3,
+            pod_anti_rate=0.1,
+            pod_ports_rate=0.1,
+            delete_pod_rate=0.3,
+            fleet_replicas=2,
+            hub_failover_at=3,
+            hub_failover_heal=8,
+            # a 3s lease makes the blackout span >= 3 driven cycles:
+            # enough that some cross-shard-constrained admission
+            # attempt lands inside it at any seed (the invariant's
+            # conservative-admission clause must engage non-vacuously)
+            hub_lease_s=3.0,
             fleet_max_row_age_s=2.0,
         ),
         # fragmentation: heavy plain arrivals + heavy deletes carve the

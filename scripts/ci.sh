@@ -238,6 +238,35 @@ echo "$part_grpc" | grep -qE "fenced_commits=[1-9][0-9]* zombie_binds_while_fenc
 echo "$part_grpc" | grep -qE "stale_rejections=[1-9]" \
     || { echo "GRPC HUB SMOKE: conservative admission never engaged"; exit 1; }
 
+echo "== hub HA smoke: epoch-fenced failover chaos (ISSUE 15) =="
+# the hub_failover profile kills the PRIMARY occupancy hub mid-drive:
+# a standby replicated from the primary's op log must promote at the
+# next lease epoch WITHOUT operator action, replicas must fail over
+# (endpoint rotation + epoch-advance detection + forced wholesale
+# republish), conservative admission must cover the blackout, and the
+# resurrected OLD primary must keep serving reads while 100% of its
+# replica-facing writes reject with the typed HubDeposed. A
+# deterministic reply-loss-after-apply injection proves the idempotent
+# flush dedup inside the chaos loop (the write-behind double-apply
+# hazard's regression). Greps pin each fault engaging non-vacuously:
+# failovers==1, stale-primary writes rejected >= 1, dedup hits >= 1,
+# zero journal lines lost; zero lost rows/handoffs ride the run's own
+# overcommit/lost-pod/journal invariants. Driven over the REAL gRPC
+# hub pair; --selfcheck proves byte-determinism across runs.
+ha_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 12 \
+    --profile hub_failover --fleet 2 --hub-grpc --selfcheck)
+echo "$ha_out"
+echo "$ha_out" | grep -qE "hub_ha: failovers=1 epoch=2" \
+    || { echo "HUB HA SMOKE: expected exactly one failover to epoch 2"; exit 1; }
+echo "$ha_out" | grep -qE "stale_writes_rejected=[1-9]" \
+    || { echo "HUB HA SMOKE: the deposed primary never rejected a write"; exit 1; }
+echo "$ha_out" | grep -qE "dedup_hits=[1-9]" \
+    || { echo "HUB HA SMOKE: the idempotent flush dedup never engaged"; exit 1; }
+echo "$ha_out" | grep -qE "journal_missing=0" \
+    || { echo "HUB HA SMOKE: the failover lost hub journal lines"; exit 1; }
+echo "$ha_out" | grep -qE "stale_rejections=[1-9]" \
+    || { echo "HUB HA SMOKE: conservative admission never covered the blackout"; exit 1; }
+
 echo "== multichip: 8-device forced-host mesh smoke =="
 # sharded-vs-unsharded exact-path equivalence on an 8-way virtual CPU
 # mesh (conftest.py forces the device count before jax initializes):

@@ -309,10 +309,11 @@ def test_remote_exchange_partition_maps_to_unreachable(hub_server):
         remote.stage("r1", _row())  # buffers client-side, no raise yet
         with pytest.raises(ExchangeUnreachable):
             remote.peers_view("r1")  # flush-before-read surfaces it
-        assert remote._buffer  # retained for retry, not lost
+        # retained for retry (sealed under its flush_seq), not lost
+        assert remote._pending_flush() == 1
         hub.set_partitioned("r1", False)
         remote.peers_view("r1")  # flush succeeds on heal
-        assert not remote._buffer
+        assert remote._pending_flush() == 0
         assert [r.pod for r in hub.peers_view("rx").pod_rows] == [
             "default/p"
         ]
@@ -443,8 +444,12 @@ def test_bulk_client_never_retries_cas_conflict(hub_server):
 
 def test_bulk_client_retries_transient_hub_op(monkeypatch):
     """The flip side: UNAVAILABLE from a flaky channel still retries
-    with backoff (hub ops get the same transient hygiene as every
-    bulk RPC when the caller opts into retries)."""
+    with FULL-JITTER backoff (hub ops get the same transient hygiene
+    as every bulk RPC when the caller opts into retries): each wait is
+    uniform over [0, base * 2^attempt) so N clients losing the same
+    server never re-arrive in lockstep."""
+    import random
+
     import grpc
 
     class FakeErr(grpc.RpcError):
@@ -466,6 +471,7 @@ def test_bulk_client_retries_transient_hub_op(monkeypatch):
     client.deadline_s = 1.0
     client.backoff_base_s = 0.01
     client._clock = SpyClock()
+    client._backoff_rng = random.Random(0)
     calls = {"n": 0}
 
     from kubernetes_tpu.server import tensorcodec
@@ -479,6 +485,11 @@ def test_bulk_client_retries_transient_hub_op(monkeypatch):
     client._hub_op = flaky
     assert client.hub_op("version") == {"version": 7}
     assert calls["n"] == 3 and len(sleeps) == 2
+    # full jitter: draws land inside the doubling caps and match the
+    # injected stream exactly (deterministic given the seeded rng)
+    rng = random.Random(0)
+    assert sleeps == [rng.uniform(0.0, 0.01), rng.uniform(0.0, 0.02)]
+    assert 0.0 <= sleeps[0] < 0.01 and 0.0 <= sleeps[1] < 0.02
 
 
 # -- the two-process race (acceptance) ---------------------------------------

@@ -179,9 +179,16 @@ class TestRemoteJournalBuffer:
             remote._journal_buffer.append(f'{{"i":{i}}}')
         with pytest.raises(ExchangeUnreachable):
             remote.flush()
-        assert len(remote._journal_buffer) == 4  # oldest dropped
+        # retained in the sealed batch, oldest beyond the cap dropped
+        retained = [
+            arg
+            for _seq, ops in remote._sealed
+            for kind, arg in ops
+            if kind == "journal"
+        ]
+        assert len(retained) == 4  # oldest dropped
         assert remote.journal_lines_dropped == 6
-        assert remote._journal_buffer[-1] == '{"i":9}'
+        assert retained[-1] == '{"i":9}'
 
 
 class TestFleetMerge:
