@@ -1,18 +1,24 @@
 """kubernetes_tpu.analysis — tracer-safety & lock-discipline analyzer.
 
-A self-contained AST static analyzer (stdlib only) for the two bug
-classes the batched scheduler cannot afford: accidental host<->device
-syncs on the solve hot path (TPU001/TPU002/TPU003) and undisciplined
-access to the shared mutable state the pipelined loop threads through
-watch ingest (LOCK001), plus metric-name drift (MET001).
+A self-contained AST static analyzer (stdlib only). Two engine tiers:
+
+- per-module passes (PR 1): accidental host<->device syncs on the
+  solve hot path (TPU001/TPU002/TPU003), lexical lock discipline
+  (LOCK001), metric-name drift (MET001);
+- project passes (Analyzer v2) over the cross-module symbol table and
+  call graph (:mod:`.project`): lock-order deadlock detection
+  (LOCK002), epoch/role fence discipline (FENCE001), retry discipline
+  (RETRY001), cross-module host-sync escape (TPU004), and two-way
+  metrics-doc drift (MET002).
 
 Usage::
 
-    python -m kubernetes_tpu.analysis [--json] [paths...]
+    python -m kubernetes_tpu.analysis [--json] [--sarif out] [paths...]
     findings = analysis.run_paths(["kubernetes_tpu/"])
 
 Annotations and rule semantics: analysis/README.md. The in-process
-pytest gate is tests/test_static_analysis.py.
+pytest gate is tests/test_static_analysis.py; the suppression-debt
+ratchet baseline lives in analysis/suppression_baseline.json.
 """
 
 from __future__ import annotations
@@ -27,19 +33,30 @@ from .core import (
     apply_suppressions,
     suppression_findings,
 )
-from .passes import ALL_PASSES
+from .passes import ALL_PASSES, ALL_PROJECT_PASSES
+from .project import ProjectGraph, ProjectPass, build_project
 from .registry import default_context
 
 __all__ = [
     "ALL_PASSES",
+    "ALL_PROJECT_PASSES",
     "AnalysisContext",
     "Finding",
     "Pass",
+    "ProjectGraph",
+    "ProjectPass",
     "SourceModule",
     "analyze_module",
+    "analyze_project",
+    "analyze_source",
+    "analyze_sources",
+    "build_project",
     "default_context",
+    "load_modules",
     "run_paths",
 ]
+
+_SORT_KEY = lambda f: (f.path, f.line, f.rule, f.message)  # noqa: E731
 
 
 def analyze_module(
@@ -47,15 +64,15 @@ def analyze_module(
     ctx: AnalysisContext | None = None,
     passes=None,
 ) -> list[Finding]:
-    """Run the pass set over one parsed module, apply suppressions, and
-    enforce the reason requirement (KTPU000)."""
+    """Run the per-module pass set over one parsed module, apply
+    suppressions, and enforce the reason requirement (KTPU000)."""
     ctx = ctx or default_context()
     findings: list[Finding] = []
     for cls in passes or ALL_PASSES:
         findings.extend(cls().run(module, ctx))
     apply_suppressions(module, findings)
     findings.extend(suppression_findings(module))
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    findings.sort(key=_SORT_KEY)
     return findings
 
 
@@ -65,9 +82,68 @@ def analyze_source(
     ctx: AnalysisContext | None = None,
     passes=None,
 ) -> list[Finding]:
-    """Fixture-test entry point: analyze an in-memory snippet."""
+    """Fixture-test entry point: analyze an in-memory snippet with the
+    per-module passes."""
     return analyze_module(
         SourceModule.parse(filename, source=source), ctx=ctx, passes=passes
+    )
+
+
+def analyze_project(
+    modules,
+    ctx: AnalysisContext | None = None,
+    passes=None,
+    project_passes=None,
+) -> list[Finding]:
+    """The full engine: per-module passes on each module, project
+    passes once over the cross-module graph, suppressions applied to
+    everything by line, one globally stable-sorted finding list."""
+    ctx = ctx or default_context()
+    modules = list(modules)
+    per_module: dict[str, list[Finding]] = {m.path: [] for m in modules}
+    stray: list[Finding] = []  # non-module paths (e.g. the metrics doc)
+
+    for module in modules:
+        for cls in passes if passes is not None else ALL_PASSES:
+            per_module[module.path].extend(cls().run(module, ctx))
+
+    project = build_project(modules, ctx)
+    use = (
+        project_passes if project_passes is not None else ALL_PROJECT_PASSES
+    )
+    for cls in use:
+        for f in cls().run_project(project, ctx):
+            if f.path in per_module:
+                per_module[f.path].append(f)
+            else:
+                stray.append(f)
+
+    findings: list[Finding] = []
+    for module in modules:
+        batch = per_module[module.path]
+        apply_suppressions(module, batch)
+        batch.extend(suppression_findings(module))
+        findings.extend(batch)
+    findings.extend(stray)
+    findings.sort(key=_SORT_KEY)
+    return findings
+
+
+def analyze_sources(
+    sources: dict,
+    ctx: AnalysisContext | None = None,
+    passes=(),
+    project_passes=None,
+) -> list[Finding]:
+    """Fixture-test entry point for PROJECT rules: a dict of
+    {filename: source} forming one in-memory project. Per-module passes
+    default to OFF so project-rule fixtures stay single-purpose."""
+    modules = [
+        SourceModule.parse(name, source=src)
+        for name, src in sorted(sources.items())
+    ]
+    return analyze_project(
+        modules, ctx=ctx, passes=passes, project_passes=project_passes
     )
 
 
@@ -88,23 +164,18 @@ def collect_files(paths) -> list[Path]:
     return files
 
 
-def run_paths(
-    paths=None,
-    ctx: AnalysisContext | None = None,
-    passes=None,
-) -> list[Finding]:
-    """Analyze files/directories (default: the kubernetes_tpu package
-    this module ships in). Returns ALL findings; callers filter on
-    ``suppressed`` for gating."""
+def load_modules(paths=None) -> tuple[list[SourceModule], list[Finding]]:
+    """Parse the analyzed set (default: the kubernetes_tpu package this
+    module ships in); unparsable files become KTPU001 findings."""
     if not paths:
         paths = [Path(__file__).resolve().parents[1]]
-    ctx = ctx or default_context()
-    findings: list[Finding] = []
+    modules: list[SourceModule] = []
+    broken: list[Finding] = []
     for f in collect_files(paths):
         try:
-            module = SourceModule.parse(f)
+            modules.append(SourceModule.parse(f))
         except SyntaxError as e:
-            findings.append(
+            broken.append(
                 Finding(
                     rule="KTPU001",
                     path=str(f),
@@ -112,6 +183,21 @@ def run_paths(
                     message=f"syntax error: {e.msg}",
                 )
             )
-            continue
-        findings.extend(analyze_module(module, ctx=ctx, passes=passes))
+    return modules, broken
+
+
+def run_paths(
+    paths=None,
+    ctx: AnalysisContext | None = None,
+    passes=None,
+    project_passes=None,
+) -> list[Finding]:
+    """Analyze files/directories (default: the kubernetes_tpu package).
+    Returns ALL findings; callers filter on ``suppressed`` for gating."""
+    modules, broken = load_modules(paths)
+    findings = analyze_project(
+        modules, ctx=ctx, passes=passes, project_passes=project_passes
+    )
+    findings.extend(broken)
+    findings.sort(key=_SORT_KEY)
     return findings

@@ -18,9 +18,20 @@ Annotation grammar (shared by all passes; see analysis/README.md):
 - ``# ktpu: cold``        — mark an error/diagnosis path: stops hot/jit
   scope propagation into this function.
 - ``# ktpu: holds(expr)`` — the function below/beside runs with
-  ``self.<expr>`` held by every caller (LOCK001).
+  ``self.<expr>`` held by every caller (LOCK001, LOCK002).
 - ``# ktpu: guarded-by(expr)`` — trailing an attribute assignment in
   ``__init__``: registers the attribute as guarded by ``self.<expr>``.
+- ``# ktpu: replicated`` — trailing an attribute assignment in
+  ``__init__``: the attribute is hub-replicated state; FENCE001
+  requires every method touching it to run a fence check first.
+- ``# ktpu: fence-check`` — the function below/beside IS the role/
+  epoch fence check; reaching it (directly or through helpers)
+  satisfies FENCE001.
+- ``# ktpu: fence-exempt(reason)`` — the function below/beside
+  deliberately skips the fence (replication path, harness bypass…).
+  The reason is REQUIRED; a reasonless exemption is a finding.
+- ``# ktpu: fenced-by-caller`` — private helper whose callers have
+  already run the fence checks (the ``_locked`` suffix convention).
 """
 
 from __future__ import annotations
@@ -41,6 +52,10 @@ _HOT_RE = re.compile(r"#.*\bktpu:\s*hot\b")
 _COLD_RE = re.compile(r"#.*\bktpu:\s*cold\b")
 _HOLDS_RE = re.compile(r"#.*\bktpu:\s*holds\(([^)]+)\)")
 _GUARDED_RE = re.compile(r"#.*\bktpu:\s*guarded-by\(([^)]+)\)")
+_REPLICATED_RE = re.compile(r"#.*\bktpu:\s*replicated\b")
+_FENCE_CHECK_RE = re.compile(r"#.*\bktpu:\s*fence-check\b")
+_FENCE_EXEMPT_RE = re.compile(r"#.*\bktpu:\s*fence-exempt\(([^)]*)\)")
+_FENCED_BY_CALLER_RE = re.compile(r"#.*\bktpu:\s*fenced-by-caller\b")
 
 
 @dataclass
@@ -160,6 +175,33 @@ class SourceModule:
                     return m.group(1).strip()
         return None
 
+    def replicated_mark(self, stmt: ast.stmt) -> bool:
+        """``replicated`` mark trailing (or directly above) a statement.
+        The line-above form only counts on a comment-ONLY line — a mark
+        trailing the PREVIOUS statement must not bleed onto this one."""
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        for line in range(stmt.lineno - 1, end + 1):
+            text = self.comments.get(line)
+            if text and _REPLICATED_RE.search(text):
+                if line >= stmt.lineno:
+                    return True
+                src = self.source.splitlines()[line - 1]
+                if src.lstrip().startswith("#"):
+                    return True
+        return False
+
+    def is_fence_check(self, func: ast.AST) -> bool:
+        return self._match_mark(func, _FENCE_CHECK_RE) is not None
+
+    def fence_exempt(self, func: ast.AST) -> str | None:
+        """The exemption reason, '' when the mark is present but empty
+        (itself a finding), None when unmarked."""
+        m = self._match_mark(func, _FENCE_EXEMPT_RE)
+        return m.group(1).strip() if m else None
+
+    def is_fenced_by_caller(self, func: ast.AST) -> bool:
+        return self._match_mark(func, _FENCED_BY_CALLER_RE) is not None
+
 
 def _rel_path(p: Path) -> str:
     """Path relative to the directory CONTAINING the kubernetes_tpu
@@ -195,6 +237,14 @@ class AnalysisContext:
     metric_scan_paths: tuple = ()
     # metric attribute -> prometheus name (None => resolve from package)
     metric_attrs: dict | None = None
+    # exception class names that must never be swallowed by a retry
+    # loop (RETRY001) — semantic rejections, not transport faults
+    non_retryable_errors: tuple = ("AdmitConflict",)
+    # rel-path suffix of the metrics registry module (MET002)
+    metrics_module_suffix: str = "kubernetes_tpu/metrics/__init__.py"
+    # METRICS.md content override for fixture tests (None => read the
+    # file next to the registry module)
+    metrics_doc_text: str | None = None
 
     def is_sanctioned(self, rel: str, qualname: str) -> bool:
         for suffix, qn in self.sanctioned_sync:

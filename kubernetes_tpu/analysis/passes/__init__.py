@@ -1,5 +1,13 @@
-"""The analyzer's rule set. Each module holds one pass; ALL_PASSES is
-the shipped order (cheap scoping passes first, cross-file MET001 last).
+"""The analyzer's rule set.
+
+Two tiers since Analyzer v2:
+
+- **ALL_PASSES** — per-module passes (one file at a time, the PR 1
+  engine): cheap scoping passes first, cross-file MET001 last.
+- **ALL_PROJECT_PASSES** — project passes, run ONCE over the whole
+  analyzed set against the cross-module call graph
+  (:mod:`..project`): lock-order deadlock detection, fence and retry
+  discipline, cross-module host-sync escape, metrics-doc drift.
 """
 
 from __future__ import annotations
@@ -9,6 +17,11 @@ from .tracedbranch import TracedBranchPass
 from .dtypes import DtypeDisciplinePass
 from .locks import LockDisciplinePass
 from .metricnames import MetricNamePass
+from .lockorder import LockOrderPass
+from .fence import FencePass
+from .retry import RetryPass
+from .xsync import CrossModuleSyncPass
+from .metricsdoc import MetricsDocPass
 
 ALL_PASSES = (
     HostSyncPass,
@@ -18,11 +31,25 @@ ALL_PASSES = (
     MetricNamePass,
 )
 
+ALL_PROJECT_PASSES = (
+    LockOrderPass,
+    FencePass,
+    RetryPass,
+    CrossModuleSyncPass,
+    MetricsDocPass,
+)
+
 __all__ = [
     "ALL_PASSES",
+    "ALL_PROJECT_PASSES",
     "HostSyncPass",
     "TracedBranchPass",
     "DtypeDisciplinePass",
     "LockDisciplinePass",
     "MetricNamePass",
+    "LockOrderPass",
+    "FencePass",
+    "RetryPass",
+    "CrossModuleSyncPass",
+    "MetricsDocPass",
 ]

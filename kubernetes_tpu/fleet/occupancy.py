@@ -213,7 +213,7 @@ class OccupancyExchange:
         from ..utils.clock import Clock
 
         self._lock = threading.Lock()
-        self._version = 0
+        self._version = 0  # ktpu: replicated
         # -- high availability (hub HA) --
         # identity + lease: a standalone hub (lease=None, every
         # deployment before HA) is permanently primary at epoch 1 —
@@ -241,7 +241,7 @@ class OccupancyExchange:
         # idempotent client flush dedup: replica -> (client id, last
         # applied flush_seq). A retried write-behind flush whose reply
         # was lost after the server-side apply lands exactly once.
-        self._flush_seen: dict[str, tuple[str, int]] = {}
+        self._flush_seen: dict[str, tuple[str, int]] = {}  # ktpu: replicated
         self.flush_dedup_hits = 0
         # fault seams + failover accounting: set_down models the whole
         # hub process dying (every op from every replica raises
@@ -282,8 +282,8 @@ class OccupancyExchange:
             for op in ("staged", "committed", "withdrawn", "retired",
                        "handoff")
         }
-        self._node_rows: dict[str, dict[str, NodeRow]] = {}  # replica -> node -> row
-        self._pod_rows: dict[str, dict[str, PodRow]] = {}  # replica -> pod -> row
+        self._node_rows: dict[str, dict[str, NodeRow]] = {}  # replica -> node -> row; ktpu: replicated
+        self._pod_rows: dict[str, dict[str, PodRow]] = {}  # replica -> pod -> row; ktpu: replicated
         # pod handoffs: to-replica -> pod key -> (hop count, journey
         # trace id). A replica whose shard cannot legally host a routed
         # pod (persistent cross-shard conflict) releases it here for
@@ -292,7 +292,7 @@ class OccupancyExchange:
         # threaded ACROSS the handoff: the adopting replica's journal
         # records continue the same trace, so `obs explain --fleet`
         # renders enqueue→handoff→re-admit→bind as ONE trace.
-        self._handoffs: dict[str, dict[str, tuple[int, str]]] = {}
+        self._handoffs: dict[str, dict[str, tuple[int, str]]] = {}  # ktpu: replicated
         # append-only journal aggregation surface (the cross-replica
         # obs tentpole): replicas ship bounded decision-journal
         # segments — piggybacked on the existing write-behind flush,
@@ -302,15 +302,16 @@ class OccupancyExchange:
         # durable store).
         from collections import deque
 
-        self._journal: deque[str] = deque(maxlen=262_144)
+        self._journal: deque[str] = deque(maxlen=262_144)  # ktpu: replicated
         # replicas whose solve breaker is open (degraded-mode solve
         # resilience): peers prefer them LAST in rendezvous handoff
         # chains — don't route refugees to a sick replica. The replica
         # keeps serving its own shard (the fallback ladder guarantees
         # forward progress); this flag only shapes cross-shard routing.
-        self._degraded: set[str] = set()
+        self._degraded: set[str] = set()  # ktpu: replicated
 
     @property
+    # ktpu: fence-exempt(down-gated wake-seed read; admission-relevant version reads ride peers_version, which is fenced)
     def version(self) -> int:
         # bookkeeping surface (wake-version seeding, tests), down-
         # gated but deliberately NOT role-fenced: admission-relevant
@@ -466,6 +467,7 @@ class OccupancyExchange:
             )
 
     # callers hold self._lock
+    # ktpu: fence-check
     def _ensure_primary_locked(self, *, write: bool, op: str) -> None:
         """Role fence for the replica-facing surface: only the live
         lease holder serves it. A primary whose lease silently expired
@@ -492,6 +494,7 @@ class OccupancyExchange:
     # timeline (read-only touches don't replicate — a promoted
     # standby's peer ages then read slightly OLDER than truth, which
     # errs conservative).
+    # ktpu: fenced-by-caller
     def _log(self, kind: str, payload: list) -> None:
         self._opseq += 1
         self._oplog.append(
@@ -519,6 +522,7 @@ class OccupancyExchange:
                 f"replica {replica} is partitioned from the occupancy hub"
             )
 
+    # ktpu: fenced-by-caller
     def _check_write_fence(self, replica: str) -> None:
         # callers hold self._lock
         if replica in self._revoked:
@@ -582,6 +586,7 @@ class OccupancyExchange:
 
     # callers hold self._lock and have run the reachability/role/fence
     # checks (stage, compare_and_stage, apply_ops share this effect)
+    # ktpu: fenced-by-caller
     def _stage_locked(self, replica: str, row: PodRow) -> None:
         self._version += 1
         self._pod_rows.setdefault(replica, {})[row.pod] = row
@@ -644,6 +649,7 @@ class OccupancyExchange:
         self._m["committed"].inc()
 
     # callers hold self._lock post-checks; True if the row transitioned
+    # ktpu: fenced-by-caller
     def _commit_locked(self, replica: str, pod_key: str) -> bool:
         row = self._pod_rows.get(replica, {}).get(pod_key)
         if row is None or row.state == COMMITTED:
@@ -669,6 +675,7 @@ class OccupancyExchange:
         self._m["withdrawn"].inc()
 
     # callers hold self._lock post-checks; True if a row was removed
+    # ktpu: fenced-by-caller
     def _withdraw_locked(self, replica: str, pod_key: str) -> bool:
         if self._pod_rows.get(replica, {}).pop(pod_key, None) is None:
             return False
@@ -757,6 +764,7 @@ class OccupancyExchange:
         metrics.fleet_journal_segments_total.inc()
         metrics.fleet_journal_lines_total.inc(len(lines))
 
+    # ktpu: fence-exempt(down-gated observability read; a standby's merged journal is exactly what obs explain --fleet wants)
     def journal_lines(self) -> list[str]:
         """The aggregated journal stream, in arrival order. `obs
         explain --fleet` re-orders per pod with the PR 8 merge rules,
@@ -807,6 +815,7 @@ class OccupancyExchange:
                 for k, (hops, trace) in sorted(rows.items())
             ]
 
+    # ktpu: fence-exempt(down-gated sim-invariant surface; reads on a standby are harmless and never on the wire)
     def pending_handoff_keys(self) -> set[str]:
         """Pods released by one replica and not yet claimed by the
         next — the fleet lost-pod invariant counts these as tracked.
@@ -819,6 +828,7 @@ class OccupancyExchange:
                 k for rows in self._handoffs.values() for k in rows
             }
 
+    # ktpu: fence-exempt(post-mortem bypass: reading a dead process's last state; dispatch_hub_op never exposes it)
     def debug_state(self) -> dict:
         """Harness/post-mortem introspection that deliberately
         bypasses the down seam (reading a dead process's LAST state is
@@ -863,8 +873,14 @@ class OccupancyExchange:
             )
             return PeerView(self._version, node_rows, pod_rows, peer_ages)
 
+    # ktpu: fence-exempt(replication-verification surface: standbys and tests compare raw rows across roles; down-gated, never on the wire)
     def replica_rows(self, replica: str) -> tuple[tuple[NodeRow, ...], tuple[PodRow, ...]]:
+        """Raw row export for one replica (replication verification:
+        standby-vs-primary comparisons in the HA tests and sims).
+        Down-gated like every read — a dead hub answers nothing;
+        ``debug_state`` is the deliberate bypass."""
         with self._lock:
+            self._check_down_locked()
             return (
                 tuple(
                     self._node_rows.get(replica, {})[n]
@@ -996,6 +1012,7 @@ class OccupancyExchange:
                 return None, latest
             return [list(e) for e in self._oplog if e[0] > since], latest
 
+    # ktpu: fence-exempt(replication pull path: a standby joining MUST read the primary's state; down-gated)
     def snapshot(self) -> dict:
         """Full JSON-able state export for standby join (and the wire
         half of repl_sync when the log window has moved past the
@@ -1026,6 +1043,7 @@ class OccupancyExchange:
                 },
             }
 
+    # ktpu: fence-exempt(standby join: the replication apply path MUST write while not primary — fencing it would invert HA)
     def install_snapshot(self, snap: dict) -> None:
         """Replace this hub's replicated state wholesale (standby
         join). Role/epoch/lease are NOT part of the snapshot — a
@@ -1063,6 +1081,7 @@ class OccupancyExchange:
             }
             self._oplog.clear()
 
+    # ktpu: fence-exempt(standby log replay: the replication apply path MUST write while not primary — fencing it would invert HA)
     def apply_replicated(self, entry) -> None:
         """Apply one op-log entry on a STANDBY: raw state effects,
         version-keyed — no reachability/fence/role checks (those ran
@@ -1135,6 +1154,7 @@ class OccupancyExchange:
             self._version = version
             self._oplog.append(list(entry))
 
+    # ktpu: fence-exempt(down-gated observability read; role/epoch are part of the PAYLOAD here, not a gate)
     def hub_status(self) -> dict:
         """The ``GET /debug/hub`` body (and the failover sim's
         introspection): role, epoch, replicated-state cursors, and

@@ -1341,6 +1341,7 @@ class FleetRuntime:
                     ),
                     peers.version,
                 )
+            # ktpu: ignore[RETRY001]: CAS loop, not a replay — each attempt re-fetches peers.version and re-runs the host-side recheck before re-staging, so a version conflict retries a NEW request; fenced conflicts break out below. Bounded by _CAS_ATTEMPTS.
             except AdmitConflict as e:
                 metrics.fleet_admit_cas_conflict_total.labels(
                     "fenced" if e.fenced else "version"

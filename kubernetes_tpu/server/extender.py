@@ -437,6 +437,7 @@ class MicroBatcher:
         # loop until no request arrived while the previous batch was in the
         # executor — submit() only spawns a new task when this one is done,
         # so returning with _pending non-empty would strand those futures
+        # ktpu: ignore[RETRY001]: batch pump, not a retry loop — a failed batch FAILS its futures (nothing replayed) and the sleep is the fixed micro-batch window cadence, so jitter would be wrong
         while True:
             await asyncio.sleep(self.window)
             batch, self._pending = self._pending, []
@@ -713,9 +714,11 @@ def make_app(
 
             async def loop_task():
                 import logging
+                import random
 
                 log = logging.getLogger("kubernetes_tpu.serve")
                 log.info("scheduler drain loop running")
+                failures = 0
                 while True:
                     progressed = False
                     if scheduler.pending:
@@ -733,10 +736,20 @@ def make_app(
                             )
                         except Exception:
                             # a failed burst must not kill the drain loop —
-                            # log and retry (pods stay queued)
+                            # log and retry (pods stay queued). Full-jitter
+                            # backoff: a fixed sleep re-hammers a hub that
+                            # is mid-failover in lockstep with every other
+                            # replica's drain loop
+                            failures += 1
                             log.exception("pipelined drain burst failed")
-                            await asyncio.sleep(1.0)
+                            await asyncio.sleep(
+                                random.uniform(
+                                    0.0,
+                                    min(1.0 * 2 ** (failures - 1), 30.0),
+                                )
+                            )
                             continue
+                        failures = 0
                         progressed = any(
                             r.progressed for r in results
                         )

@@ -1,6 +1,10 @@
 #!/usr/bin/env bash
 # CI gate: the correctness/perf layers in order of cost —
-#   1. static analysis (scripts/lint.py — TPU001..MET001, instant)
+#   1. static analysis (full Analyzer v2: per-module TPU001..MET001 plus
+#      the project rules LOCK002/FENCE001/RETRY001/TPU004/MET002, the
+#      suppression-debt ratchet, and the lock-order artifact drift
+#      check; findings uploaded as SARIF + JSON artifacts; budgeted at
+#      < 10 s wall so the gate stays instant)
 #   2. tier-1 tests   (ROADMAP.md invocation, minus the soak marker)
 #   3. sim smokes     (one fixed-seed run per scenario profile, plus a
 #      determinism self-check on the flagship churn profile)
@@ -12,8 +16,21 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== lint: static analyzer =="
-python scripts/lint.py
+echo "== lint: static analyzer (project rules + ratchet + lock-order) =="
+# one invocation does everything: findings as JSON (stdout -> artifact),
+# SARIF artifact, suppression-debt ratchet, lock-order drift check.
+# Wall-time budget: the analyzer must stay under 10 s or it stops being
+# the gate everyone runs first.
+mkdir -p artifacts
+SECONDS=0
+python scripts/lint.py --json --sarif artifacts/analysis.sarif \
+    --ratchet --check-lock-order > artifacts/analysis.json
+lint_elapsed=$SECONDS
+echo "-- analyzer wall time: ${lint_elapsed}s (budget 10s) --"
+if [ "$lint_elapsed" -ge 10 ]; then
+    echo "LINT BUDGET: analyzer took ${lint_elapsed}s (>= 10s)"
+    exit 1
+fi
 
 if [ -z "${SKIP_TESTS:-}" ]; then
     echo "== tier-1 tests =="

@@ -530,3 +530,737 @@ def test_met001_shipped_registry_resolves_real_usage():
     assert attrs["solves_discarded_total"] == (
         "scheduler_tpu_solves_discarded_total"
     )
+
+
+# ===========================================================================
+# -- Analyzer v2: project-wide rules over the cross-module call graph ------
+# ===========================================================================
+
+from kubernetes_tpu.analysis import analyze_sources, build_project
+from kubernetes_tpu.analysis.core import SourceModule
+from kubernetes_tpu.analysis.passes import (
+    CrossModuleSyncPass,
+    FencePass,
+    LockOrderPass,
+    MetricsDocPass,
+    RetryPass,
+)
+
+
+def project_findings(sources, project_passes, ctx=None):
+    return analyze_sources(
+        {name: textwrap.dedent(src) for name, src in sources.items()},
+        ctx=ctx,
+        project_passes=project_passes,
+    )
+
+
+# -- LOCK002 lock-order deadlocks -------------------------------------------
+
+_LOCK_CYCLE = {
+    "registry.py": """
+        import threading
+        from cache import Cache
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.rows = {}
+
+            def merge(self, cache: Cache):
+                with self._lock:
+                    cache.invalidate()
+    """,
+    "cache.py": """
+        import threading
+        from registry import Registry
+
+        class Cache:
+            def __init__(self, registry: Registry):
+                self._lock = threading.Lock()
+                self.registry = registry
+
+            def invalidate(self):
+                with self._lock:
+                    pass
+
+            def refresh(self):
+                with self._lock:
+                    self.registry.merge(self)
+    """,
+}
+
+
+def test_lock002_detects_cross_module_cycle():
+    """registry holds its lock and calls cache.invalidate (acquires
+    cache lock); cache.refresh holds its lock and calls registry.merge
+    (acquires registry lock) — opposite orders, classic deadlock."""
+    fs = project_findings(_LOCK_CYCLE, [LockOrderPass])
+    hits = active(fs, "LOCK002")
+    assert any("cycle" in f.message for f in hits), [
+        f.render() for f in fs
+    ]
+    cycle = next(f for f in hits if "cycle" in f.message)
+    assert "Registry._lock" in cycle.message
+    assert "Cache._lock" in cycle.message
+
+
+def test_lock002_consistent_order_is_clean_and_proves_an_order():
+    sources = {
+        "registry.py": """
+            import threading
+            from cache import Cache
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def merge(self, cache: Cache):
+                    with self._lock:
+                        cache.invalidate()
+        """,
+        "cache.py": """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def invalidate(self):
+                    with self._lock:
+                        pass
+        """,
+    }
+    fs = project_findings(sources, [LockOrderPass])
+    assert not active(fs, "LOCK002")
+    from kubernetes_tpu.analysis.passes.lockorder import get_analysis
+
+    modules = [
+        SourceModule.parse(n, source=textwrap.dedent(s))
+        for n, s in sorted(sources.items())
+    ]
+    project = build_project(modules, AnalysisContext())
+    analysis_result = get_analysis(project)
+    assert not analysis_result.cycles()
+    order = analysis_result.order()
+    # registry's lock is held when cache's is acquired -> registry first
+    assert order.index("registry.py::Registry._lock") < order.index(
+        "cache.py::Cache._lock"
+    )
+
+
+def test_lock002_self_deadlock_on_nonreentrant_lock():
+    fs = project_findings(
+        {
+            "core.py": """
+                import threading
+
+                class Core:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+            """
+        },
+        [LockOrderPass],
+    )
+    hits = active(fs, "LOCK002")
+    assert len(hits) == 1
+    assert "self-deadlock" in hits[0].message
+    assert "re-acquires" in hits[0].message  # the call-path variant
+
+
+def test_lock002_rlock_reentry_is_fine():
+    fs = project_findings(
+        {
+            "core.py": """
+                import threading
+
+                class Core:
+                    def __init__(self):
+                        self.lock = threading.RLock()
+
+                    def outer(self):
+                        with self.lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self.lock:
+                            pass
+            """
+        },
+        [LockOrderPass],
+    )
+    assert not active(fs, "LOCK002")
+
+
+def test_lock002_holds_annotation_contributes_edges():
+    """A callback annotated holds(cluster.lock) that takes another lock
+    creates the same edge a lexical nesting would."""
+    fs = project_findings(
+        {
+            "a.py": """
+                import threading
+
+                class Cluster:
+                    def __init__(self):
+                        self.lock = threading.Lock()
+
+                class Watcher:
+                    def __init__(self, cluster: Cluster):
+                        self.cluster = cluster
+                        self._lock = threading.Lock()
+
+                    # fires under the cluster lock: ktpu: holds(cluster.lock)
+                    def on_event(self):
+                        with self._lock:
+                            pass
+
+                    def sweep(self):
+                        with self._lock:
+                            with self.cluster.lock:
+                                pass
+            """
+        },
+        [LockOrderPass],
+    )
+    hits = active(fs, "LOCK002")
+    assert any("cycle" in f.message for f in hits), [
+        f.render() for f in fs
+    ]
+
+
+def test_lock002_artifact_is_current_at_head():
+    """docs/LOCK_ORDER.md must match what the analyzer derives — the
+    committed order is the provable one, and it is cycle-free."""
+    from pathlib import Path
+
+    from kubernetes_tpu.analysis import default_context, load_modules
+    from kubernetes_tpu.analysis.passes.lockorder import (
+        get_analysis,
+        lock_order_markdown,
+    )
+
+    modules, broken = load_modules(None)
+    assert not broken
+    project = build_project(modules, default_context())
+    assert not get_analysis(project).cycles()
+    artifact = lock_order_markdown(project)
+    committed = (
+        Path(__file__).resolve().parents[1] / "docs" / "LOCK_ORDER.md"
+    )
+    assert committed.read_text() == artifact, (
+        "docs/LOCK_ORDER.md drifted — regenerate: "
+        "python -m kubernetes_tpu.analysis --write-lock-order"
+    )
+
+
+# -- FENCE001 epoch/role fence discipline -----------------------------------
+
+_FENCE_BASE = """
+    import threading
+
+    class Hub:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rows = {{}}  # ktpu: replicated
+            self._role = "standby"
+
+        # ktpu: fence-check
+        def _ensure_primary(self):
+            if self._role != "primary":
+                raise RuntimeError("deposed")
+
+{methods}
+"""
+
+
+def fence_fixture(methods):
+    return {
+        "hub.py": _FENCE_BASE.format(
+            methods=textwrap.indent(textwrap.dedent(methods), "        ")
+        )
+    }
+
+
+def test_fence001_fires_on_unfenced_write():
+    fs = project_findings(
+        fence_fixture(
+            """
+            def stage(self, key, row):
+                with self._lock:
+                    self._rows[key] = row
+            """
+        ),
+        [FencePass],
+    )
+    hits = active(fs, "FENCE001")
+    assert len(hits) == 1
+    assert "writes replicated state 'self._rows'" in hits[0].message
+
+
+def test_fence001_mutator_call_counts_as_write():
+    fs = project_findings(
+        fence_fixture(
+            """
+            def wipe(self):
+                self._rows.clear()
+            """
+        ),
+        [FencePass],
+    )
+    hits = active(fs, "FENCE001")
+    assert len(hits) == 1
+    assert "writes" in hits[0].message
+
+
+def test_fence001_direct_fence_call_satisfies():
+    fs = project_findings(
+        fence_fixture(
+            """
+            def stage(self, key, row):
+                with self._lock:
+                    self._ensure_primary()
+                    self._rows[key] = row
+            """
+        ),
+        [FencePass],
+    )
+    assert not active(fs, "FENCE001")
+
+
+def test_fence001_fence_through_helper_satisfies():
+    """The check reached through an intermediate gate helper still
+    counts — resolution is interprocedural, not lexical."""
+    fs = project_findings(
+        fence_fixture(
+            """
+            def _gate(self):
+                self._ensure_primary()
+
+            def stage(self, key, row):
+                with self._lock:
+                    self._gate()
+                    self._rows[key] = row
+            """
+        ),
+        [FencePass],
+    )
+    assert not active(fs, "FENCE001")
+
+
+def test_fence001_annotations_exempt_and_reasonless_exempt_fires():
+    fs = project_findings(
+        fence_fixture(
+            """
+            # ktpu: fenced-by-caller
+            def _stage_locked(self, key, row):
+                self._rows[key] = row
+
+            # ktpu: fence-exempt(replication apply path)
+            def install(self, rows):
+                self._rows = dict(rows)
+
+            # ktpu: fence-exempt()
+            def peek(self):
+                return dict(self._rows)
+            """
+        ),
+        [FencePass],
+    )
+    hits = active(fs, "FENCE001")
+    assert len(hits) == 1
+    assert "no reason" in hits[0].message
+
+
+def test_fence001_cross_module_check_resolves():
+    """Fence helper inherited from a base class in ANOTHER module."""
+    fs = project_findings(
+        {
+            "base.py": """
+                class Fenced:
+                    # ktpu: fence-check
+                    def _ensure_primary(self):
+                        raise RuntimeError
+            """,
+            "hub.py": """
+                from base import Fenced
+
+                class Hub(Fenced):
+                    def __init__(self):
+                        self._rows = {}  # ktpu: replicated
+
+                    def stage(self, key, row):
+                        self._ensure_primary()
+                        self._rows[key] = row
+
+                    def leak(self, key):
+                        return self._rows.get(key)
+            """,
+        },
+        [FencePass],
+    )
+    hits = active(fs, "FENCE001")
+    assert len(hits) == 1
+    assert "'Hub.leak' reads" in hits[0].message
+
+
+# -- RETRY001 retry discipline ----------------------------------------------
+
+def test_retry001_swallowed_nonretryable_fires():
+    fs = project_findings(
+        {
+            "client.py": """
+                class AdmitConflict(Exception):
+                    pass
+
+                def admit(op):
+                    for attempt in range(5):
+                        try:
+                            return op()
+                        except AdmitConflict:
+                            continue
+            """
+        },
+        [RetryPass],
+    )
+    hits = active(fs, "RETRY001")
+    assert any("AdmitConflict" in f.message for f in hits)
+    assert any("backoff" in f.message for f in hits)
+
+
+def test_retry001_reraise_is_the_sanctioned_idiom():
+    fs = project_findings(
+        {
+            "client.py": """
+                import random
+                import time
+
+                class AdmitConflict(Exception):
+                    pass
+
+                def admit(op):
+                    for attempt in range(5):
+                        try:
+                            return op()
+                        except AdmitConflict:
+                            raise
+                        except IOError:
+                            time.sleep(random.uniform(0, 0.1 * 2 ** attempt))
+            """
+        },
+        [RetryPass],
+    )
+    assert not active(fs, "RETRY001")
+
+
+def test_retry001_backoff_through_cross_module_helper():
+    """sleep(uniform(...)) hidden in another module's helper still
+    counts as backoff — resolved through the project graph."""
+    fs = project_findings(
+        {
+            "backoff.py": """
+                import random
+                import time
+
+                def full_jitter(attempt):
+                    time.sleep(random.uniform(0, 0.1 * 2 ** attempt))
+            """,
+            "client.py": """
+                from backoff import full_jitter
+
+                def fetch(op):
+                    for attempt in range(5):
+                        try:
+                            return op()
+                        except IOError:
+                            full_jitter(attempt)
+            """,
+        },
+        [RetryPass],
+    )
+    assert not active(fs, "RETRY001")
+
+
+def test_retry001_constant_sleep_is_not_backoff():
+    fs = project_findings(
+        {
+            "client.py": """
+                import time
+
+                def fetch(op):
+                    while True:
+                        try:
+                            return op()
+                        except IOError:
+                            time.sleep(1.0)
+            """
+        },
+        [RetryPass],
+    )
+    hits = active(fs, "RETRY001")
+    assert len(hits) == 1
+    assert "backoff" in hits[0].message
+
+
+def test_retry001_work_drain_loops_are_out_of_scope():
+    """while <condition>: drain loops and plain iteration are NOT retry
+    loops — the shape is pinned to for-range / while-True."""
+    fs = project_findings(
+        {
+            "drain.py": """
+                def flush(self):
+                    while self._sealed:
+                        try:
+                            self._send_one()
+                        except IOError:
+                            self._requeue()
+
+                def broadcast(replicas, op):
+                    for replica in replicas:
+                        try:
+                            op(replica)
+                        except IOError:
+                            pass
+            """
+        },
+        [RetryPass],
+    )
+    assert not active(fs, "RETRY001")
+
+
+# -- TPU004 cross-module host-sync escape -----------------------------------
+
+def test_tpu004_catches_cross_module_item():
+    fs = project_findings(
+        {
+            "apply.py": """
+                from readers import scalar_of
+
+                # ktpu: hot
+                def apply_assignments(batch):
+                    return [scalar_of(x) for x in batch]
+            """,
+            "readers.py": """
+                def scalar_of(x):
+                    return x.item()
+            """,
+        },
+        [CrossModuleSyncPass],
+    )
+    hits = active(fs, "TPU004")
+    assert len(hits) == 1
+    assert ".item() forces a host sync in 'scalar_of'" in hits[0].message
+    assert "apply_assignments -> scalar_of" in hits[0].message
+
+
+def test_tpu004_cold_barrier_stops_the_scope():
+    fs = project_findings(
+        {
+            "apply.py": """
+                from readers import debug_dump
+
+                # ktpu: hot
+                def apply_assignments(batch):
+                    debug_dump(batch)
+            """,
+            "readers.py": """
+                # ktpu: cold
+                def debug_dump(batch):
+                    return [x.item() for x in batch]
+            """,
+        },
+        [CrossModuleSyncPass],
+    )
+    assert not active(fs, "TPU004")
+
+
+def test_tpu004_typed_method_receiver_resolves():
+    """A hot method calling a helper METHOD on a typed attribute from
+    another module is still traced into."""
+    fs = project_findings(
+        {
+            "sched.py": """
+                from store import Store
+
+                class Scheduler:
+                    def __init__(self, store: Store):
+                        self.store = store
+
+                    # ktpu: hot
+                    def commit(self, row):
+                        self.store.put(row)
+            """,
+            "store.py": """
+                class Store:
+                    def put(self, row):
+                        self.total = row.cost.item()
+            """,
+        },
+        [CrossModuleSyncPass],
+    )
+    hits = active(fs, "TPU004")
+    assert len(hits) == 1
+    assert "'Store.put'" in hits[0].message
+
+
+def test_tpu004_head_is_clean():
+    """The shipped package has no cross-module sync escapes."""
+    findings = analysis.run_paths()
+    assert not active(findings, "TPU004")
+
+
+# -- MET002 metrics registry <-> doc drift ----------------------------------
+
+_MET2_REGISTRY = """
+    from prometheus_client import Counter, Gauge
+
+    solves = Counter("scheduler_solves", "solve batches")
+    depth = Gauge("scheduler_queue_depth", "queue depth")
+"""
+
+
+def met2_ctx(doc_text):
+    return AnalysisContext(
+        metrics_module_suffix="metrics.py",
+        metrics_doc_text=textwrap.dedent(doc_text),
+    )
+
+
+def test_met002_clean_when_registry_matches_doc():
+    fs = project_findings(
+        {"metrics.py": _MET2_REGISTRY},
+        [MetricsDocPass],
+        ctx=met2_ctx(
+            """
+            | metric | help |
+            |--------|------|
+            | `scheduler_solves_total` | solve batches |
+            | `scheduler_queue_depth` | queue depth |
+            """
+        ),
+    )
+    assert not active(fs, "MET002")
+
+
+def test_met002_fires_both_ways():
+    fs = project_findings(
+        {"metrics.py": _MET2_REGISTRY},
+        [MetricsDocPass],
+        ctx=met2_ctx(
+            """
+            | metric | help |
+            |--------|------|
+            | `scheduler_solves_total` | solve batches |
+            | `scheduler_ghost_seconds` | never registered |
+            """
+        ),
+    )
+    hits = active(fs, "MET002")
+    assert len(hits) == 2
+    missing = next(f for f in hits if "queue_depth" in f.message)
+    assert "missing from docs/METRICS.md" in missing.message
+    assert missing.path == "metrics.py"
+    stale = next(f for f in hits if "ghost" in f.message)
+    assert "not registered" in stale.message
+    assert stale.path == "docs/METRICS.md"
+
+
+def test_met002_counter_total_suffix_normalized():
+    """A Counter registered without _total is compared against its
+    exposed name — same normalization as the doc generator."""
+    fs = project_findings(
+        {"metrics.py": _MET2_REGISTRY},
+        [MetricsDocPass],
+        ctx=met2_ctx(
+            """
+            | `scheduler_solves` | wrong: raw registration name |
+            | `scheduler_queue_depth` | queue depth |
+            """
+        ),
+    )
+    hits = active(fs, "MET002")
+    assert any("scheduler_solves_total" in f.message for f in hits)
+    assert any("'scheduler_solves' is not registered" in f.message
+               for f in hits)
+
+
+def test_met002_head_registry_matches_shipped_doc():
+    findings = analysis.run_paths()
+    assert not active(findings, "MET002")
+
+
+# -- suppression-debt ratchet -----------------------------------------------
+
+def test_ratchet_holds_at_head():
+    from kubernetes_tpu.analysis.ratchet import (
+        check_ratchet,
+        count_suppressions,
+        load_baseline,
+    )
+
+    modules, _ = analysis.load_modules(None)
+    baseline = load_baseline()
+    assert baseline is not None, (
+        "missing analysis/suppression_baseline.json — write one: "
+        "python -m kubernetes_tpu.analysis --write-baseline"
+    )
+    assert not check_ratchet(count_suppressions(modules), baseline)
+
+
+def test_ratchet_fails_on_growth_per_rule_and_total():
+    from kubernetes_tpu.analysis.ratchet import check_ratchet
+
+    counts = {"total": 3, "rules": {"TPU001": 2, "FENCE001": 1}}
+    msgs = check_ratchet(
+        counts, {"total": 3, "rules": {"TPU001": 3, "FENCE001": 0}}
+    )
+    assert any("FENCE001" in m for m in msgs)
+    assert not any("total" in m and "grew" in m for m in msgs)
+    msgs = check_ratchet(counts, {"total": 2, "rules": counts["rules"]})
+    assert any("count grew" in m for m in msgs)
+    assert not check_ratchet(counts, counts)
+
+
+def test_missing_baseline_is_a_violation():
+    from kubernetes_tpu.analysis.ratchet import check_ratchet
+
+    assert check_ratchet({"total": 0, "rules": {}}, None)
+
+
+# -- SARIF + stable output --------------------------------------------------
+
+def test_sarif_carries_suppressions_and_all_rules():
+    import json as _json
+
+    from kubernetes_tpu.analysis.sarif import render_sarif
+
+    findings = analysis.run_paths()
+    doc = _json.loads(render_sarif(findings))
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "ktpu-analysis"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    for rid in ("LOCK002", "FENCE001", "RETRY001", "TPU004", "MET002"):
+        assert rid in rule_ids
+    suppressed = [
+        r for r in run["results"] if r.get("suppressions")
+    ]
+    assert suppressed, "suppressed findings must survive into SARIF"
+    for r in suppressed:
+        assert r["level"] == "warning"
+        assert r["suppressions"][0]["justification"].strip()
+    for r in run["results"]:
+        if not r.get("suppressions"):
+            assert r["level"] == "error"
+
+
+def test_findings_are_stable_sorted():
+    findings = analysis.run_paths()
+    key = [(f.path, f.line, f.rule, f.message) for f in findings]
+    assert key == sorted(key)
