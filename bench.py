@@ -2144,6 +2144,186 @@ def ladder14_hub_failover() -> dict:
     }
 
 
+def ladder15_gang() -> dict:
+    """#15: gang throughput + time-to-full-gang (ISSUE 17) — a
+    DL-training backlog of pod GROUPS (gangs) over an accelerator-
+    heterogeneous cluster, driven through the gang gate's park /
+    assemble / atomic-commit machinery. Members of every gang arrive
+    SPLIT across two waves on purpose: wave 0 parks every half-gang
+    (gang_incomplete, zero binds — the all-or-nothing invariant under
+    load), wave 1 completes them and the gate re-pulls the parked
+    halves via take_for_gang, so the measured window covers the whole
+    assembly lifecycle, not just a lucky same-batch arrival. Measures
+    gang-member binds/sec end to end, the per-gang time from first
+    member creation to the atomic commit (p50/p99 — the number the
+    gang gate exists to bound), and the fraction of workload-classed
+    pods the heterogeneity throughput term steered onto their fastest
+    accelerator class. Asserts zero partial gangs at every
+    observation point and exactly one atomic commit per gang. Hoists
+    gang_pods_per_sec and gang_time_to_full_p99_s to the JSON top
+    level."""
+    from kubernetes_tpu import metrics
+    from kubernetes_tpu.gang import ACCEL_CLASS_LABEL, GangConfig
+    from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+    from kubernetes_tpu.sim.generators import make_node, make_pod
+    from kubernetes_tpu.solver.exact import ExactSolverConfig
+    from kubernetes_tpu.state.cluster import ClusterState
+
+    n_nodes, n_gangs, gang_size = 48, 40, 4
+    warm_gangs = 24
+    classes = ("tpu-v5e", "tpu-v4", "gpu-a100")
+    # transformer gangs run fastest on v5e, resnet on v4 — the Gavel-
+    # style objective should steer each class to its best accelerator
+    # since capacity is deliberately nowhere near binding
+    table = {
+        "transformer": {"tpu-v5e": 1.0, "tpu-v4": 0.7, "gpu-a100": 0.4},
+        "resnet": {"tpu-v5e": 0.7, "tpu-v4": 1.0, "gpu-a100": 0.4},
+    }
+    best = {"transformer": "tpu-v5e", "resnet": "tpu-v4"}
+    cluster = ClusterState()
+    accel_of = {}
+    for i in range(n_nodes):
+        accel = classes[i % len(classes)]
+        accel_of[f"n{i:03d}"] = accel
+        cluster.create_node(
+            make_node(
+                f"n{i:03d}", "64", "256Gi", {ACCEL_CLASS_LABEL: accel}
+            )
+        )
+    sched = Scheduler(
+        cluster,
+        SchedulerConfig(
+            batch_size=64,
+            mesh_devices=1,
+            solver=ExactSolverConfig(tie_break="first", group_size=8),
+            gang=GangConfig(
+                # assembly gaps here are batch-cadence, not operator
+                # timescale: keep the timeout/quarantine machinery far
+                # out of the measurement's way
+                min_member_timeout=600.0,
+                quarantine_after=1_000,
+                throughput_weight=8,
+                class_throughput=table,
+            ),
+        ),
+    )
+    clock = sched.clock
+    wc_of: dict[str, str] = {}
+    gang_of_pod: dict[str, str] = {}
+    created_at: dict[str, float] = {}
+    bind_t: dict[str, float] = {}
+    seq = {"n": 0}
+
+    def arrive_members(gid: str, wc: str, count: int):
+        if gid not in created_at:
+            created_at[gid] = clock.now()
+        for _ in range(count):
+            i = seq["n"]
+            seq["n"] += 1
+            pod = make_pod(
+                f"{gid}-m{i:04d}", "500m",
+                gang=gid, gang_min=gang_size, workload_class=wc,
+            )
+            cluster.create_pod(pod)
+            wc_of[pod.key] = wc
+            gang_of_pod[pod.key] = f"default/{gid}"
+
+    def drive():
+        for r in sched.run_until_settled(max_batches=16):
+            now = clock.now()
+            for pod, _node in r.scheduled:
+                bind_t[pod] = now
+        # all-or-nothing at every observation point: a gang is either
+        # fully bound or fully pending, never split
+        by_gid: dict[str, int] = {}
+        for k in bind_t:
+            by_gid[gang_of_pod[k]] = by_gid.get(gang_of_pod[k], 0) + 1
+        partial = {
+            g: c for g, c in by_gid.items() if c != gang_size
+        }
+        assert not partial, f"partially bound gangs: {partial}"
+
+    # warmup: complete gangs, same 64-batch pad shapes the measured
+    # waves produce, so the window isn't polluted by CPU-backend
+    # recompiles
+    for g in range(warm_gangs):
+        arrive_members(f"warm{g:03d}", "transformer", gang_size)
+    drive()
+    assert len(bind_t) == warm_gangs * gang_size, (
+        f"warmup never settled: {len(bind_t)} bound"
+    )
+    commits0 = metrics.gang_commits_total._value.get()
+    bound0 = metrics.gang_bound_pods_total._value.get()
+    warm_keys = set(bind_t)
+    t0 = clock.now()
+    # wave 0: HALF of every gang — the gate must park all of them
+    for g in range(n_gangs):
+        wc = "transformer" if g % 2 == 0 else "resnet"
+        arrive_members(f"g{g:03d}", wc, gang_size // 2)
+    drive()
+    assert len(bind_t) == len(warm_keys), (
+        "a half-assembled gang bound pods"
+    )
+    # wave 1: the completing halves — take_for_gang re-pulls the
+    # parked members and every gang commits atomically
+    for g in range(n_gangs):
+        wc = "transformer" if g % 2 == 0 else "resnet"
+        arrive_members(f"g{g:03d}", wc, gang_size // 2)
+    drive()
+    wall_s = max(clock.now() - t0, 1e-9)
+    n_pods = n_gangs * gang_size
+    measured = {k: t for k, t in bind_t.items() if k not in warm_keys}
+    assert len(measured) == n_pods, (
+        f"only {len(measured)}/{n_pods} gang pods bound"
+    )
+    commits = int(metrics.gang_commits_total._value.get() - commits0)
+    assert commits == n_gangs, (
+        f"{commits} atomic commits for {n_gangs} gangs"
+    )
+    assert (
+        metrics.gang_bound_pods_total._value.get() - bound0 == n_pods
+    )
+    # heterogeneity steering: fraction of measured pods whose node
+    # carries their workload class's fastest accelerator
+    on_best = sum(
+        1
+        for k in measured
+        if accel_of[cluster.get_pod(*k.split("/")).node_name]
+        == best[wc_of[k]]
+    )
+    best_frac = on_best / n_pods
+    assert best_frac > 0.5, (
+        f"throughput term never steered: {best_frac:.2f} on best class"
+    )
+    ttf = sorted(
+        max(
+            measured[k]
+            for k in measured
+            if gang_of_pod[k] == f"default/g{g:03d}"
+        )
+        - created_at[f"g{g:03d}"]
+        for g in range(n_gangs)
+    )
+    p50 = ttf[len(ttf) // 2]
+    p99 = ttf[min(int(len(ttf) * 0.99), len(ttf) - 1)]
+    return {
+        "config": (
+            f"{n_gangs} gangs x {gang_size} members over {n_nodes} "
+            f"nodes in {len(classes)} accelerator classes; members "
+            "split across two arrival waves (park -> assemble -> "
+            "atomic commit); heterogeneity throughput term weight "
+            f"{sched.config.gang.throughput_weight}"
+        ),
+        "gang_pods_per_sec": round(n_pods / wall_s, 1),
+        "gang_time_to_full_p50_s": round(p50, 3),
+        "gang_time_to_full_p99_s": round(p99, 3),
+        "gangs_committed": commits,
+        "gang_pods_bound": len(measured),
+        "partial_gangs": 0,  # asserted after every drive above
+        "best_accel_fraction": round(best_frac, 3),
+    }
+
+
 def pallas_microbench() -> dict:
     """The tpuSolver.pallas ladder micro-bench (ISSUE 13 satellite):
     the InterPodAffinity (term, domain) aggregation — jitted
@@ -2419,6 +2599,8 @@ def main() -> None:
     ladders["13_obs_overhead"] = obs_overhead
     hub_failover = ladder14_hub_failover()
     ladders["14_hub_failover"] = hub_failover
+    gang = ladder15_gang()
+    ladders["15_gang"] = gang
     ladders["pallas_domain_counts"] = pallas_microbench()
     rebalance = ladder10_rebalance_loop()
     ladders["10_rebalance_loop"] = {
@@ -2546,6 +2728,15 @@ def main() -> None:
                 ],
                 "hub_failover_p99_latency_s": hub_failover[
                     "hub_failover_p99_latency_s"
+                ],
+                # ladder #15 hoist (ISSUE 17): gang-member binds/sec
+                # through the gang gate's park/assemble/atomic-commit
+                # path (split-wave arrivals, zero partial gangs and
+                # one commit per gang asserted inside the ladder) and
+                # the per-gang first-member-to-commit p99
+                "gang_pods_per_sec": gang["gang_pods_per_sec"],
+                "gang_time_to_full_p99_s": gang[
+                    "gang_time_to_full_p99_s"
                 ],
                 "vs_baseline": round(headline / BAND_TOP_PODS_PER_SEC, 2),
                 "baseline_note": (

@@ -68,6 +68,10 @@ class MakePod:
         self._pod.labels.update(m)
         return self
 
+    def annotation(self, k: str, v: str) -> "MakePod":
+        self._pod.annotations[k] = v
+        return self
+
     # -- spec --
     def node(self, n: str) -> "MakePod":
         self._pod.node_name = n

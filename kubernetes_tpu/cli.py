@@ -90,6 +90,14 @@ def cmd_config(args) -> int:
             "maxRowAgeSeconds": cfg.fleet.max_row_age_seconds,
             "flushBatch": cfg.fleet.flush_batch,
         },
+        "gang": {
+            "enabled": cfg.gang.enabled,
+            "minMemberTimeoutSeconds": cfg.gang.min_member_timeout_seconds,
+            "quarantineAfter": cfg.gang.quarantine_after,
+            "throughputWeight": cfg.gang.throughput_weight,
+            "classThroughputWorkloads": sorted(cfg.gang.class_throughput),
+            "classThroughputPath": cfg.gang.class_throughput_path,
+        },
         "tuning": {
             "enabled": cfg.tuning.enabled,
             "evalBatches": cfg.tuning.eval_batches,

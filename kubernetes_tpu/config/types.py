@@ -25,6 +25,10 @@ Covered surface:
   — this replica's EXCLUSIVE contiguous slice of the visible device
   set), maxRowAgeSeconds — the active-active scale-out tier
   (kubernetes_tpu/fleet)
+- gang (ours): enabled, minMemberTimeoutSeconds, quarantineAfter,
+  throughputWeight, classThroughput / classThroughputPath — all-or-
+  nothing pod-group scheduling plus the heterogeneity-aware
+  effective-throughput objective (kubernetes_tpu/gang)
 
 Unknown plugin names and unsupported pluginConfig args are collected into
 `warnings` rather than rejected — the validation posture of a scheduler that
@@ -162,6 +166,29 @@ class FleetSection:
 
 
 @dataclass
+class GangSection:
+    """``gang:`` — all-or-nothing pod-group scheduling and the
+    heterogeneity-aware effective-throughput objective
+    (kubernetes_tpu/gang). Ours, like tpuSolver: the reference's gang
+    support lives out of tree (scheduler-plugins coscheduling)."""
+
+    enabled: bool = False
+    # how long an incomplete group may wait for its remaining members
+    # before the whole gang is quarantined
+    min_member_timeout_seconds: float = 30.0
+    # consecutive failed all-or-nothing rounds before the gang is
+    # quarantined instead of requeued
+    quarantine_after: int = 3
+    # score points per unit of relative throughput (0 = objective off)
+    throughput_weight: int = 0
+    # inline (workload class -> accelerator class -> relative
+    # throughput) matrix; mutually exclusive with classThroughputPath
+    class_throughput: dict = field(default_factory=dict)
+    # path to a JSON file holding the same matrix
+    class_throughput_path: str = ""
+
+
+@dataclass
 class TpuSolverSection:
     batch_size: int = 1024
     tie_break: str = "random"  # random | first
@@ -278,6 +305,7 @@ class KubeSchedulerConfiguration:
     rebalance: RebalanceSection = field(default_factory=RebalanceSection)
     fleet: FleetSection = field(default_factory=FleetSection)
     tuning: TuningSection = field(default_factory=TuningSection)
+    gang: GangSection = field(default_factory=GangSection)
     warnings: list[str] = field(default_factory=list)
 
     def profile_for(self, scheduler_name: str) -> Profile | None:
@@ -565,7 +593,68 @@ def load(data: Mapping | str) -> KubeSchedulerConfiguration:
         cfg.tuning.shift_threshold,
         cfg.tuning.knobs,
     )
+
+    gg = data.get("gang") or {}
+    cfg.gang = GangSection(
+        enabled=bool(_nn(gg.get("enabled"), False)),
+        min_member_timeout_seconds=float(
+            _nn(gg.get("minMemberTimeoutSeconds"), 30.0)
+        ),
+        quarantine_after=int(_nn(gg.get("quarantineAfter"), 3)),
+        throughput_weight=int(_nn(gg.get("throughputWeight"), 0)),
+        class_throughput=dict(_nn(gg.get("classThroughput"), {}) or {}),
+        class_throughput_path=str(_nn(gg.get("classThroughputPath"), "")),
+    )
+    if cfg.gang.min_member_timeout_seconds <= 0:
+        raise ValueError(
+            "gang.minMemberTimeoutSeconds must be > 0 "
+            f"(got {cfg.gang.min_member_timeout_seconds})"
+        )
+    if cfg.gang.quarantine_after < 1:
+        # 0 would quarantine every gang on its first incomplete round —
+        # plausibly intended as "off", so reject the ambiguity hard
+        raise ValueError(
+            "gang.quarantineAfter must be >= 1 "
+            f"(got {cfg.gang.quarantine_after})"
+        )
+    if cfg.gang.throughput_weight < 0:
+        raise ValueError(
+            "gang.throughputWeight must be >= 0 (0 = objective off; "
+            f"got {cfg.gang.throughput_weight})"
+        )
+    if cfg.gang.class_throughput and cfg.gang.class_throughput_path:
+        # the quiet failure mode: both set, one silently wins
+        raise ValueError(
+            "gang.classThroughput and gang.classThroughputPath are "
+            "mutually exclusive"
+        )
+    _validate_throughput_table(cfg.gang.class_throughput)
     return cfg
+
+
+def _validate_throughput_table(table: Mapping) -> None:
+    """Hard-validate the inline (workload -> accelerator -> relative
+    throughput) matrix — a malformed row silently scoring 0 is exactly
+    the quiet capacity loss gang scoring exists to prevent."""
+    for wl, per in table.items():
+        if not isinstance(per, Mapping):
+            raise ValueError(
+                f"gang.classThroughput[{wl!r}] must be a mapping of "
+                f"accelerator class -> relative throughput (got {per!r})"
+            )
+        for ac, rel in per.items():
+            try:
+                val = float(rel)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"gang.classThroughput[{wl!r}][{ac!r}] must be a "
+                    f"number (got {rel!r})"
+                ) from None
+            if val < 0:
+                raise ValueError(
+                    f"gang.classThroughput[{wl!r}][{ac!r}] must be "
+                    f">= 0 (got {val})"
+                )
 
 
 def _parse_mesh_slice(value) -> "tuple[int, int] | None":
@@ -726,6 +815,20 @@ def scheduler_config(cfg: KubeSchedulerConfiguration):
             max_row_age_s=cfg.fleet.max_row_age_seconds,
             flush_batch=cfg.fleet.flush_batch,
         )
+    gang = None
+    if cfg.gang.enabled:
+        from ..gang import GangConfig, load_throughput_table
+
+        table = cfg.gang.class_throughput
+        if cfg.gang.class_throughput_path:
+            table = load_throughput_table(cfg.gang.class_throughput_path)
+            _validate_throughput_table(table)
+        gang = GangConfig(
+            min_member_timeout=cfg.gang.min_member_timeout_seconds,
+            quarantine_after=cfg.gang.quarantine_after,
+            throughput_weight=cfg.gang.throughput_weight,
+            class_throughput=dict(table),
+        )
     tuning = None
     if cfg.tuning.enabled:
         from ..tuning.runtime import TuningConfig
@@ -754,4 +857,5 @@ def scheduler_config(cfg: KubeSchedulerConfiguration):
         rebalance=rebalance,
         fleet=fleet,
         tuning=tuning,
+        gang=gang,
     )

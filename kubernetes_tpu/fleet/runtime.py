@@ -882,12 +882,25 @@ class FleetRuntime:
         return self._assignment.get(name) == self.replica
 
     # same locked callers as owns_node: ktpu: holds(cluster.lock)
-    def routes_pod(self, pod_key: str) -> bool:
+    def routes_pod(self, pod_key: str, pod: Pod | None = None) -> bool:
         if pod_key in self._routed_here:
             return True
         if pod_key in self._routed_away:
             return False
-        return self._ring_alive().route(pod_key) == self.replica
+        # pod-group members route by their GANG id, not their own key:
+        # the gang gate assembles a group from ONE replica's queue, so
+        # splitting members across the ring would make every gang
+        # permanently short. Callers that have the Pod pass it; key-only
+        # callers (handoff rows) are never gang members (handoff is
+        # disabled for them in _apply_group).
+        route_key = pod_key
+        if pod is not None:
+            from ..gang import GangTracker
+
+            gid = GangTracker.gang_of(pod)
+            if gid is not None:
+                route_key = f"gang:{gid}"
+        return self._ring_alive().route(route_key) == self.replica
 
     def set_alive(self, replicas) -> bool:
         """Membership transition (the sim's replica_loss driver; the
@@ -970,9 +983,9 @@ class FleetRuntime:
                 # routing replica also listens so its queue/in-flight
                 # bookkeeping sees external binds of pods it tracked
                 return self.owns_node(pod.node_name) or self.routes_pod(
-                    pod.key
+                    pod.key, pod
                 )
-            return self.routes_pod(pod.key)
+            return self.routes_pod(pod.key, pod)
         # cluster-scoped kinds (DRA objects, Events, ...) pass through
         return True
 
@@ -1112,7 +1125,7 @@ class FleetRuntime:
                 continue
             # unbound: adopt pods now routed here (a dead replica's
             # orphans), shed pods routed away
-            routed = self.routes_pod(pod.key)
+            routed = self.routes_pod(pod.key, pod)
             is_tracked = (
                 pod.key in tracked
                 or pod.key in scheduler._in_flight
@@ -1247,7 +1260,16 @@ class FleetRuntime:
     def _needs_reconcile(pod: Pod) -> bool:
         """Does this pod carry a constraint whose scope can cross the
         shard boundary (hard topology spread, required anti-affinity)?
-        Everything else is fully enforced by the shard-local solve."""
+        Everything else is fully enforced by the shard-local solve.
+
+        Pod-group members always reconcile: each member's pending row
+        must land at the hub through the fenced CAS so peers see a
+        staging gang (and so a stale view / AdmitConflict on ANY member
+        fails the whole gang round before a single bind)."""
+        from ..gang import GANG_LABEL
+
+        if GANG_LABEL in pod.labels:
+            return True
         if any(
             c.when_unsatisfiable == "DoNotSchedule"
             for c in pod.topology_spread_constraints
@@ -1272,7 +1294,15 @@ class FleetRuntime:
         recheck against the same view; the hub serializes their CAS
         calls, exactly one lands, the loser re-fetches (now seeing the
         winner's pending row) and re-admits — or rejects and requeues
-        after _CAS_ATTEMPTS rounds of contention."""
+        after _CAS_ATTEMPTS rounds of contention.
+
+        Pod-group members stage through this same fenced CAS one row at
+        a time; gang atomicity lives one layer up: the scheduler stages
+        EVERY member before any binds, a single member's AdmitConflict
+        fails the whole gang round, and the release sweep withdraws the
+        already-staged rows (scheduler._release_gang_round via
+        _unreserve_all → withdraw) so peers never see a half-staged
+        gang outlive its round."""
         if not self.owns_node(node_name):
             metrics.fleet_reconcile_conflicts_total.labels(
                 "ownership"

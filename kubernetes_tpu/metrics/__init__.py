@@ -232,6 +232,42 @@ quarantine_readmits_total = Counter(
     "TTL'd backoff elapsed.",
     registry=REGISTRY,
 )
+# -- gang scheduling (kubernetes_tpu/gang) --
+
+gang_commits_total = Counter(
+    "scheduler_gang_commits_total",
+    "Pod groups committed atomically: every solved member bound in one "
+    "all-or-nothing bind_gang call.",
+    registry=REGISTRY,
+)
+gang_bound_pods_total = Counter(
+    "scheduler_gang_bound_pods_total",
+    "Pods bound as members of an atomic gang commit.",
+    registry=REGISTRY,
+)
+gang_incomplete_total = Counter(
+    "scheduler_gang_incomplete_total",
+    "Gang rounds released without a commit: a member failed, a fence "
+    "discarded a sub-solve, or the atomic bind was rejected — every "
+    "staged placement rolled back and the gang requeued (a partial "
+    "gang is never bound).",
+    registry=REGISTRY,
+)
+gang_quarantined_total = Counter(
+    "scheduler_gang_quarantined_total",
+    "Pod groups quarantined as a unit: the quorum never assembled "
+    "before the min-member timeout, or consecutive released rounds hit "
+    "the configured limit.",
+    registry=REGISTRY,
+)
+gang_assembly_seconds = Histogram(
+    "scheduler_gang_assembly_seconds",
+    "Time from a gang's first appearance at the pop gate to its atomic "
+    "commit (time-to-full-gang).",
+    buckets=_BUCKETS,
+    registry=REGISTRY,
+)
+
 mesh_devices = Gauge(
     "scheduler_mesh_devices",
     "Devices in the node-axis solve mesh the scheduler dispatches "
@@ -509,7 +545,7 @@ journal_records_total = Counter(
     "Per-pod decision-journal records written, by outcome "
     "(bound|unschedulable|bind_failure|permit_wait|permit_rejected|"
     "permit_timeout|discarded|solver_error|quarantined|recovered|"
-    "evicted_for_rebalance).",
+    "evicted_for_rebalance|gang_incomplete).",
     ["outcome"],
     registry=REGISTRY,
 )
@@ -700,7 +736,7 @@ sim_invariant_violations_total = Counter(
     "Invariant violations the simulator's checkers flagged, by "
     "invariant (double_bind|capacity|lost_pod|progress|monotonic|"
     "constraint|journal|global_overcommit|resilience|recovery|"
-    "fencing|rebalance|tuning).",
+    "fencing|rebalance|tuning|no_partial_gang_ever_bound).",
     ["invariant"],
     registry=REGISTRY,
 )

@@ -73,6 +73,12 @@ OUTCOMES = frozenset(
         # re-enters the queue and its next attempt journals the
         # migration's outcome.
         "evicted_for_rebalance",
+        # the pod's gang (kubernetes_tpu/gang) did not land whole this
+        # round — a member failed, the quorum never assembled, or the
+        # atomic commit was released — so every staged placement was
+        # rolled back and the gang requeued. Non-terminal: the gang
+        # retries as a unit (a partial gang is never bound).
+        "gang_incomplete",
     }
 )
 # a pod whose LAST journal record is one of these has a settled fate for

@@ -134,7 +134,17 @@ class Rebalancer:
         the auction's scoring scope (solver/single_shot.py), so their
         placements are never judged movable. Conservative by design:
         the rebalancer only touches pods whose improvement it can
-        actually compute."""
+        actually compute.
+
+        Pod-group members are co-movable-or-not: migrating one member
+        alone would break the gang's co-placement, and the auction
+        re-places pods individually, so gang pods are conservatively
+        never movable (the whole gang moves only via eviction + a fresh
+        atomic gang solve, which the rebalancer does not drive)."""
+        from ..gang import GANG_LABEL
+
+        if GANG_LABEL in pod.labels:
+            return False
         if pod.scheduler_name not in scheduler.solvers:
             return False
         if scheduler.cache.is_assumed(pod.key):

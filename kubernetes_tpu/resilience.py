@@ -452,6 +452,12 @@ def validate_assignments(
     ``disabled``: the profile's disabled Filter plugins — with
     "NodeResourcesFit" disabled, overcommit is LEGAL solver output and
     the capacity half is skipped (the structural checks still run).
+
+    Gang note: a pod group solved as one chained sub-batch flows
+    through here one sub-flight at a time like any other chain —
+    ``prep.validated_usage`` already carries usage across the gang's
+    sub-flights, so a corrupt solve for a later member is caught
+    against the load of earlier members the same gang round staged.
     """
     a = np.asarray(assignments)
     if a.ndim != 1:

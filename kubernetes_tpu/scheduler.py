@@ -211,6 +211,17 @@ class SchedulerConfig:
     # (single-owner deployments that never restart in place); fleet
     # replicas default to their per-shard lease name.
     fence_role: str | None = None
+    # gang scheduling (kubernetes_tpu/gang): a GangConfig enabling
+    # all-or-nothing pod groups (the `scheduling.x-k8s.io/pod-group`
+    # label + min-member annotation) — a gang's members pop as a unit,
+    # solve through the ordinary chained sub-batch machinery, stage
+    # through assume/Reserve/Permit like any pod, and then COMMIT AS
+    # ONE: every member binds through ClusterState.bind_gang or every
+    # member's placement is released and the gang requeues with a
+    # `gang_incomplete` journal record. Carries the heterogeneity
+    # objective too (gang/throughput.py). None = off (zero hot-path
+    # cost beyond one attribute check per batch).
+    gang: object = None
 
 
 class _Rejected(Exception):
@@ -247,6 +258,10 @@ class BatchResult:
     )
     # (pod, nominated node, victim keys) per successful preemption
     preemptions: list[tuple[str, str, list[str]]] = field(default_factory=list)
+    # pod keys whose gang round failed all-or-nothing this cycle: their
+    # staged placements were released and they requeued as a unit with
+    # a `gang_incomplete` journal record (kubernetes_tpu/gang)
+    gang_released: list[str] = field(default_factory=list)
     solve_seconds: float = 0.0
     host_seconds: float = 0.0
     # per-pod schedule latency (pop -> bind committed), for the p99 metric
@@ -269,6 +284,7 @@ class BatchResult:
             or self.bind_failures
             or self.quarantined
             or self.rebalance_evictions
+            or self.gang_released
         )
 
 
@@ -696,6 +712,22 @@ class Scheduler:
         # next pop once their TTL'd backoff elapses.
         self._quarantine: dict[str, tuple] = {}  # ktpu: guarded-by(cluster.lock)
         self._quarantine_counts: dict[str, int] = {}  # ktpu: guarded-by(cluster.lock)
+        # gang scheduling (kubernetes_tpu/gang): assembly/retry tracker
+        # plus the per-batch all-or-nothing round ledger. A round is
+        # created when a complete gang enters a batch (gang id ->
+        # {"expect": member keys, "done": resolved keys, "staged":
+        # approved pending entries, "failed": bool, "reason": str}) and
+        # resolves in _commit_all: every member staged -> ONE atomic
+        # bind_gang commit; any member failed -> every staged placement
+        # releases and the gang requeues (journal `gang_incomplete`).
+        from .gang import GangTracker
+
+        self._gang = (
+            GangTracker(self.config.gang)
+            if self.config.gang is not None
+            else None
+        )
+        self._gang_rounds: dict[str, dict] = {}  # ktpu: guarded-by(cluster.lock)
         # ladder tier each profile last dispatched at: a tier change
         # moves the solve to different devices, so the resident session
         # must re-upload from host truth (driver thread only)
@@ -816,6 +848,20 @@ class Scheduler:
             for node in cluster.list_nodes():
                 if self.fleet is None or self.fleet.owns_node(node.name):
                     self.cache.add_node(node)
+            gangs_rolled = 0
+            if restart and self._gang is not None:
+                # half-staged gang rollback BEFORE pod adoption: a crash
+                # between a gang's member binds (or between a fleet
+                # stage and the gang commit) can leave a STRICT SUBSET
+                # of a pod group bound — exactly the partial gang the
+                # all-or-nothing contract forbids. Evict the stranded
+                # members we own (delete+recreate collapses to unbound
+                # under the same identity), so the adoption loop below
+                # re-queues them and the gang reassembles whole. Runs
+                # before `subscribe`, so the eviction's DELETED/ADDED
+                # pair reaches no one — adoption sees post-rollback
+                # truth directly.
+                gangs_rolled = self._rollback_partial_gangs()
             for pod in cluster.list_pods():
                 if pod.node_name:
                     if self.fleet is None or self.fleet.owns_node(
@@ -824,7 +870,7 @@ class Scheduler:
                         self.cache.add_pod(pod)
                 else:
                     if self.fleet is not None and not self.fleet.routes_pod(
-                        pod.key
+                        pod.key, pod
                     ):
                         continue
                     if pod.nominated_node_name:
@@ -867,6 +913,7 @@ class Scheduler:
             rsp.set(
                 adopted=adopted, recovered=recovered,
                 claims_rolled_back=claims_rolled,
+                gangs_rolled_back=gangs_rolled,
             )
         dt = self.clock.perf() - t_rec
         metrics.restart_recovery_seconds.observe(dt)
@@ -909,7 +956,7 @@ class Scheduler:
                 if pod.scheduler_name not in self.solvers:
                     continue  # a foreign scheduler's pod: not ours
                 if self.fleet is not None and not self.fleet.routes_pod(
-                    key
+                    key, pod
                 ):
                     continue  # a peer's pod: leave it alone
                 stale.append(key)
@@ -923,6 +970,63 @@ class Scheduler:
                 c.results = ()
             self.cluster.update_resource_claim(c)
             rolled += 1
+        return rolled
+
+    # runs inside _recover's locked region: ktpu: holds(cluster.lock)
+    def _rollback_partial_gangs(self) -> int:
+        """Restart-only: find pod groups where 0 < bound members <
+        min-member — a predecessor crashed mid-gang (between member
+        binds, or between a fleet stage and the atomic commit) — and
+        evict the stranded bound members this scheduler owns, so the
+        whole gang returns to Pending and reassembles atomically.
+        Members on peer-owned nodes are left alone (the peer's own
+        restart pass rolls its shard back); PDB-gated evictions (429)
+        are tolerated per pod — the gang then completes on a later
+        pass rather than losing protected members."""
+        from .gang import GangTracker
+
+        groups: dict[str, list] = {}
+        for pod in self.cluster.list_pods():
+            gid = GangTracker.gang_of(pod)
+            if gid is not None:
+                groups.setdefault(gid, []).append(pod)
+        rolled = 0
+        for gid in sorted(groups):
+            members = groups[gid]
+            bound = [p for p in members if p.node_name]
+            if not bound:
+                continue
+            need = max(GangTracker.min_member(p) for p in members)
+            if len(bound) >= need:
+                continue  # complete (or over-satisfied): legitimate
+            evicted = 0
+            for p in bound:
+                if self.fleet is not None and not self.fleet.owns_node(
+                    p.node_name
+                ):
+                    continue
+                try:
+                    self.cluster.evict(
+                        p.namespace,
+                        p.name,
+                        fence=(self._fence_role, self._fence_token)
+                        if self._fence_role is not None
+                        else None,
+                    )
+                    evicted += 1
+                except ApiError as e:
+                    self._log.warning(
+                        "gang rollback: could not evict stranded "
+                        "member %s of %s: %s", p.key, gid, e,
+                    )
+            if evicted:
+                rolled += 1
+                metrics.gang_incomplete_total.inc()
+                self._log.info(
+                    "gang rollback: pod group %s had %d/%d members "
+                    "bound at restart; evicted %d stranded member(s) "
+                    "back to Pending", gid, len(bound), need, evicted,
+                )
         return rolled
 
     # -- degraded-health combiner (breaker state OR SLO health) --
@@ -1330,6 +1434,10 @@ class Scheduler:
             infos = self.queue.pop_batch(self.config.batch_size)
             for i in infos:
                 self._in_flight[i.key] = i
+            if self._gang is not None:
+                # gang gate: complete pod groups enter the batch whole
+                # (contiguous), incomplete ones park until assembled
+                infos = self._gang_gate(infos, res)
             sp.set(pods=len(infos))
             # idle/empty cycles change the queues too (waiting
             # settlement, leftover flush, the pop itself)
@@ -1379,6 +1487,16 @@ class Scheduler:
             raise
         finally:
             self._commit_all(infos, pending, res)
+            if self._gang is not None:
+                # a member quarantined/bisected out of the batch never
+                # resolves its round: release the leftovers so staged
+                # siblings can't stay assumed across batches
+                with self.cluster.lock:
+                    if self._gang_rounds:
+                        self._release_gang_rounds_for(
+                            {i.key for i in infos},
+                            "gang round unresolved at batch end", res,
+                        )
             res.completed_at = self.clock.perf()
         return res
 
@@ -1388,19 +1506,31 @@ class Scheduler:
         """Backoff-requeue every popped pod a mid-cycle exception left
         neither approved, parked, nor already requeued (shared by the
         sync and pipelined failure paths)."""
+        released: set = set()
+        if self._gang is not None:
+            # abort every gang round this batch touched FIRST: staged
+            # members release (unreserve + requeue) here, so the loop
+            # below must treat them as handled
+            with self.cluster.lock:
+                if self._gang_rounds:
+                    released = self._release_gang_rounds_for(
+                        {i.key for i in infos},
+                        "batch aborted mid-cycle", res,
+                    )
         handled = (
             {e[2].key for e in pending}
             | set(res.unschedulable)
             | {k for k, _ in res.bind_failures}
             | set(res.quarantined)
             | set(self._waiting)
+            | released
         )
         with self.cluster.lock:
             base = self.queue.scheduling_cycle
             for info in infos:
                 if info.key not in handled:
                     if self.fleet is not None and not self.fleet.routes_pod(
-                        info.key
+                        info.key, info.pod
                     ):
                         # handed off to a peer earlier in this batch:
                         # requeueing locally would double-track the pod
@@ -1415,13 +1545,30 @@ class Scheduler:
     ) -> None:
         """The binding-cycle pass for a batch's approved pods, plus
         in-flight bookkeeping teardown for exactly this batch (the
-        pipelined loop keeps other batches' in-flight entries live)."""
+        pipelined loop keeps other batches' in-flight entries live).
+        Gang rounds resolve here first: a round whose every member
+        staged commits atomically via _commit_gang below; a failed or
+        short round releases every staged placement (the
+        all-or-nothing contract)."""
+        gang_ready: list = []
+        if self._gang is not None:
+            with self.cluster.lock:
+                if self._gang_rounds:
+                    gang_ready = self._resolve_gang_rounds(res)
         hook = self._pre_commit_hook
-        if hook is not None and pending:
+        hook_pending = pending
+        if gang_ready:
+            # the crash seam must see the gang's staged entries too:
+            # killing the process here is exactly the "assumed + staged
+            # but nothing committed" window the restart rollback covers
+            hook_pending = pending + [
+                e for _gid, rd in gang_ready for e in rd["staged"]
+            ]
+        if hook is not None and hook_pending:
             # sim seam: the batch has assumed + approved its pods but
             # committed nothing — the exact point a crash-restart drive
             # kills the process (sim/harness.py crash_restart)
-            hook(pending)
+            hook(hook_pending)
         first_err = None
         for entry in pending:
             tb = self.clock.perf()
@@ -1466,12 +1613,28 @@ class Scheduler:
             metrics.framework_extension_point_duration_seconds.labels(
                 "Bind", "Success" if ok else "Error", "all"
             ).observe(self.clock.perf() - tb)
+        for gid, rd in gang_ready:
+            # one atomic all-or-nothing commit per complete gang round
+            try:
+                self._commit_gang(gid, rd, res)
+            except Exception as e:
+                first_err = first_err or e
         # LOCK001 (pre-analyzer gap): these pops ran unlocked, racing the
         # watch handler's in-flight refresh (_on_event could KeyError-skip
         # or resurrect an entry mid-pop on the ingest thread)
         with self.cluster.lock:
+            # members of still-unresolved gang rounds (a split batch:
+            # siblings ride a later flight) stay under the in-flight
+            # fence — tearing them down would let a watch event
+            # re-enqueue a pod whose placement is still staged
+            gang_live = {
+                k
+                for rd2 in self._gang_rounds.values()
+                for k in rd2["expect"]
+            } if self._gang_rounds else set()
             for info in infos:
-                self._in_flight.pop(info.key, None)
+                if info.key not in gang_live:
+                    self._in_flight.pop(info.key, None)
             for entry in pending:
                 self._in_flight.pop(entry[1].key, None)
             # bind failures above requeued pods with backoff
@@ -1723,13 +1886,44 @@ class Scheduler:
         to the offending pod(s): each half re-enters the resilient
         solve, halves without the poison proceed normally, and a
         singleton that still fails is quarantined with a terminal
-        journal outcome and a TTL'd backoff re-admit."""
+        journal outcome and a TTL'd backoff re-admit.
+
+        Gang members are an indivisible unit: bisection never splits
+        THROUGH a pod group (the gate made gangs contiguous, so the
+        midpoint just shifts to the nearest group boundary), and a
+        slice reduced to one whole unsatisfiable gang quarantines the
+        group as a unit instead of bisecting into it."""
+        if self._gang is not None and infos:
+            gids = [self._gang.gang_of(i.pod) for i in infos]
+            if gids[0] is not None and all(g == gids[0] for g in gids):
+                # the poison isolated to ONE whole gang: all-or-nothing
+                # applies to quarantine too
+                self._quarantine_gang(gids[0], infos, exc, res)
+                return
         if len(infos) == 1:
             self._quarantine_pod(
                 infos[0], base_cycle + cycle_offsets[0] + 1, exc, res
             )
             return
         mid = len(infos) // 2
+        if self._gang is not None:
+            # shift the split point off a gang's interior: prefer the
+            # nearest boundary where the two neighbors are not members
+            # of the same group (one exists — the all-same-gang case
+            # returned above)
+            def _boundary(b: int) -> bool:
+                return not (
+                    gids[b - 1] is not None and gids[b - 1] == gids[b]
+                )
+
+            if not _boundary(mid):
+                for d in range(1, len(infos)):
+                    if mid - d >= 1 and _boundary(mid - d):
+                        mid = mid - d
+                        break
+                    if mid + d <= len(infos) - 1 and _boundary(mid + d):
+                        mid = mid + d
+                        break
         with self.obs.span(
             "bisect", trace_id=self._trace_step, profile=profile,
             pods=len(infos), depth=depth,
@@ -1858,7 +2052,10 @@ class Scheduler:
                 and key not in self._in_flight
                 and key not in self._quarantine
                 and cur.scheduler_name in self.solvers
-                and (self.fleet is None or self.fleet.routes_pod(key))
+                and (
+                    self.fleet is None
+                    or self.fleet.routes_pod(key, cur)
+                )
             ):
                 self.queue.add(cur)
         self._refresh_pending_gauge()
@@ -1870,6 +2067,11 @@ class Scheduler:
         through the synchronous resilient path. Externally bound or
         deleted pods drop out (mirrors _discard_flight)."""
         with self.cluster.lock:
+            if self._gang is not None and self._gang_rounds:
+                self._release_gang_rounds_for(
+                    {i.key for i in infos},
+                    "gang member's dispatch failed before any flight",
+                )
             for info in infos:
                 self._in_flight.pop(info.key, None)
                 try:
@@ -1883,6 +2085,343 @@ class Scheduler:
                 info.pod = cur
                 self.queue.requeue_popped(info)
             self._refresh_pending_gauge()
+
+    # -- gang scheduling (kubernetes_tpu/gang): all-or-nothing pod
+    # groups. The gate assembles groups at pop time, _apply_group
+    # STAGES members instead of queueing them for individual commit,
+    # and _commit_all resolves each round — one atomic bind_gang when
+    # every member staged, a full release + requeue otherwise. --
+
+    # called from the locked pop regions of all three loops:
+    # ktpu: holds(cluster.lock)
+    def _gang_gate(
+        self, infos: list, res: BatchResult | None = None
+    ) -> list:
+        """Rewrite a popped batch so pod groups enter it whole or not
+        at all: pull a ready gang's remaining members straight out of
+        the queue (any heap position, any backoff state), park an
+        incomplete gang's members back as unschedulable (journal
+        ``gang_incomplete``) until the group assembles or times out,
+        and quarantine a gang that timed out or exhausted its
+        all-or-nothing retries. Ready gangs re-enter the batch as
+        CONTIGUOUS runs — the bisection boundary alignment depends on
+        it — after the non-gang pods, which keep pop order."""
+        tracker = self._gang
+        if tracker is None:
+            return infos
+        groups: dict[str, list] = {}
+        out: list = []
+        for info in infos:
+            gid = tracker.gang_of(info.pod)
+            if gid is None:
+                out.append(info)
+            else:
+                groups.setdefault(gid, []).append(info)
+        if not groups:
+            return infos
+        from .gang import GangUnsatisfiableError
+
+        popped_keys = {i.key for i in infos}
+        now = self.clock.now()
+        cfg = tracker.config
+        for gid in sorted(groups):
+            members = groups[gid]
+            taken = self.queue.take_for_gang(
+                lambda p, _g=gid: tracker.gang_of(p) == _g,
+                exclude=popped_keys,
+            )
+            for t in taken:
+                self._in_flight[t.key] = t
+            members = members + taken
+            need = max(tracker.min_member(m.pod) for m in members)
+            first = tracker.note_seen(gid, now)
+            if len(members) >= need:
+                rounds = tracker.incomplete_rounds(gid)
+                if rounds >= cfg.quarantine_after:
+                    self._quarantine_gang(
+                        gid, members,
+                        GangUnsatisfiableError(
+                            f"pod group {gid} failed its all-or-"
+                            f"nothing round {rounds} consecutive "
+                            "times"
+                        ),
+                        res,
+                    )
+                    continue
+                self._gang_rounds[gid] = {
+                    "expect": {m.key for m in members},
+                    "done": set(),
+                    "staged": [],
+                    "failed": False,
+                    "reason": "",
+                }
+                out.extend(members)
+                continue
+            if now - first > cfg.min_member_timeout:
+                self._quarantine_gang(
+                    gid, members,
+                    GangUnsatisfiableError(
+                        f"pod group {gid} assembled only "
+                        f"{len(members)}/{need} members within "
+                        f"{cfg.min_member_timeout:.0f}s"
+                    ),
+                    res,
+                )
+                continue
+            # incomplete and still inside the assembly window: park
+            # every present member as unschedulable — NOT requeue_popped,
+            # which would re-pop the same partial group every cycle in a
+            # busy loop. A later member's pop (or the leftover flush)
+            # brings them back through take_for_gang above.
+            cycle = self.queue.scheduling_cycle
+            for m in members:
+                self._requeue(m, cycle)
+                if self.journal is not None:
+                    self.journal.record(
+                        self._trace_step, cycle, m.pod,
+                        "gang_incomplete",
+                        reason=(
+                            f"waiting for pod group {gid}: "
+                            f"{len(members)}/{need} members present"
+                        ),
+                        attempts=m.attempts,
+                    )
+        return out
+
+    # ktpu: holds(cluster.lock) — called from _apply_group's locked region
+    def _gang_round_of(self, pod: Pod) -> dict | None:
+        """The live all-or-nothing round this pod belongs to, if any."""
+        if self._gang is None or not self._gang_rounds:
+            return None
+        gid = self._gang.gang_of(pod)
+        if gid is None:
+            return None
+        rd = self._gang_rounds.get(gid)
+        if rd is not None and pod.key in rd["expect"]:
+            return rd
+        return None
+
+    # ktpu: holds(cluster.lock) — called from _apply_group's locked region
+    def _gang_note_fail(self, rd: dict | None, pod: Pod, reason: str) -> None:
+        """Mark a gang member's attempt resolved-as-failed: the round
+        can never commit, and _commit_all releases every staged
+        sibling once all members have resolved."""
+        if rd is None:
+            return
+        rd["done"].add(pod.key)
+        rd["failed"] = True
+        if not rd["reason"]:
+            rd["reason"] = f"member {pod.key} failed: {reason}"
+
+    # ktpu: holds(cluster.lock)
+    def _resolve_gang_rounds(self, res: BatchResult) -> list:
+        """Sweep rounds whose every member has resolved: a clean round
+        (all staged) moves to the atomic-commit list; a failed or
+        short round releases every staged placement and the gang
+        requeues whole. Returns [(gid, round)] ready to commit."""
+        ready: list = []
+        for gid in sorted(self._gang_rounds):
+            rd = self._gang_rounds[gid]
+            if not rd["expect"] <= rd["done"]:
+                continue  # members still unresolved (a later flight)
+            del self._gang_rounds[gid]
+            if rd["failed"] or len(rd["staged"]) < len(rd["expect"]):
+                self._release_gang_round(
+                    gid, rd, res,
+                    rd["reason"] or "not every member could be placed",
+                )
+            else:
+                ready.append((gid, rd))
+        return ready
+
+    # ktpu: holds(cluster.lock)
+    def _release_gang_round(
+        self, gid: str, rd: dict, res: BatchResult | None, reason: str
+    ) -> set:
+        """All-or-nothing rollback: unreserve every STAGED member's
+        placement (assume, volumes, claims, fleet row — the same
+        rollback every individual failure path uses) and requeue it
+        with backoff; journal ``gang_incomplete`` per released member.
+        A partial gang is never left bound — this is the release half
+        of the atomicity contract."""
+        released: set = set()
+        for entry in rd["staged"]:
+            state, info, pod, node_name, cycle, _t0, step = entry
+            self._unreserve_all(state, pod, node_name)
+            self._requeue(info, cycle)
+            released.add(pod.key)
+            if res is not None:
+                res.gang_released.append(pod.key)
+            if self.journal is not None:
+                self.journal.record(
+                    step, cycle, pod, "gang_incomplete",
+                    node=node_name, reason=reason,
+                    attempts=info.attempts,
+                )
+        metrics.gang_incomplete_total.inc()
+        if self._gang is not None:
+            self._gang.note_incomplete(gid)
+        self._log.info(
+            "pod group %s round released (%d staged placement(s) "
+            "rolled back): %s", gid, len(released), reason,
+            extra={"step": self._trace_step},
+        )
+        self._refresh_pending_gauge()
+        return released
+
+    # ktpu: holds(cluster.lock)
+    def _release_gang_rounds_for(
+        self, keys: set, reason: str, res: BatchResult | None = None
+    ) -> set:
+        """Force-resolve every live round touching ``keys`` (a
+        discarded flight, an aborted batch, a quarantined member):
+        the round can no longer complete, so its staged placements
+        release and the gang requeues whole."""
+        released: set = set()
+        if not self._gang_rounds:
+            return released
+        for gid in sorted(self._gang_rounds):
+            rd = self._gang_rounds[gid]
+            if not (rd["expect"] & keys):
+                continue
+            del self._gang_rounds[gid]
+            released |= self._release_gang_round(gid, rd, res, reason)
+        return released
+
+    def _quarantine_gang(
+        self, gid: str, members: list, exc: Exception,
+        res: BatchResult | None,
+    ) -> None:
+        """Quarantine a WHOLE pod group — bisection never splits
+        through a gang, and an unsatisfiable gang (min-member timeout,
+        exhausted all-or-nothing retries) leaves the queue as a unit.
+        Members re-admit together after the TTL'd backoff
+        (_release_quarantine), and the gate reassembles them."""
+        res = BatchResult() if res is None else res
+        with self.cluster.lock:
+            rd = self._gang_rounds.pop(gid, None)
+            if rd is not None and rd["staged"]:
+                self._release_gang_round(
+                    gid, rd, res, f"gang quarantined: {exc!r}"
+                )
+        for m in members:
+            self._quarantine_pod(
+                m, self.queue.scheduling_cycle, exc, res
+            )
+        metrics.gang_quarantined_total.inc()
+        if self._gang is not None:
+            self._gang.note_quarantined(gid)
+        self._log.warning(
+            "pod group %s quarantined whole (%d member(s)): %r",
+            gid, len(members), exc, extra={"step": self._trace_step},
+        )
+
+    def _commit_gang(self, gid: str, rd: dict, res: BatchResult) -> None:
+        """The atomic binding cycle for one complete gang round:
+        per-member PreBind (plugins, volumes, DRA claims), then ONE
+        all-or-nothing ``ClusterState.bind_gang`` commit under this
+        incarnation's fence. Any failure — a PreBind rejection, a
+        fence revocation, a member bound externally mid-flight —
+        releases EVERY member's placement and the gang requeues whole:
+        zero partial binds, by construction. Runs without the cluster
+        lock held (the commit may cross a wire), like
+        _commit_binding."""
+        entries = rd["staged"]
+        step = entries[0][6] if entries else self._trace_step
+        with self.obs.span(
+            "bind_gang", trace_id=step, gang=gid, pods=len(entries),
+        ) as gsp:
+            try:
+                for entry in entries:
+                    state, info, pod, node_name, cycle, _t0, _s = entry
+                    for p in self.registry.pre_bind:
+                        st = p.pre_bind(state, pod, node_name)
+                        if not st.is_success:
+                            raise _Rejected(
+                                f"PreBind plugin {p.name()} rejected "
+                                f"{pod.key}: " + "; ".join(st.reasons)
+                            )
+                    if pod.pvc_names:
+                        self.volume_binder.bind_pod_volumes(pod)
+                    if self._dra and pod.resource_claim_names:
+                        self.claim_allocator.bind_pod_claims(pod)
+                self.cluster.bind_gang(
+                    [
+                        (e[2].namespace, e[2].name, e[3])
+                        for e in entries
+                    ],
+                    fence=(
+                        (self._fence_role, self._fence_token)
+                        if self._fence_role is not None
+                        else None
+                    ),
+                )
+            except (
+                ApiError, VolumeBindingError, _Rejected, ExtenderError,
+            ) as e:
+                reason = e.reason if isinstance(e, ApiError) else str(e)
+                fenced = isinstance(e, ApiError) and e.fenced
+                gsp.set(ok=False, reason=reason)
+                with self.cluster.lock:
+                    if fenced:
+                        metrics.commit_fenced_total.inc()
+                        self._fenced_commits += 1
+                        self._log.warning(
+                            "gang bind of %s fenced: this "
+                            "incarnation's commit fence (role %r) was "
+                            "revoked — no member bound",
+                            gid, self._fence_role,
+                            extra={"step": step},
+                        )
+                    self._release_gang_round(
+                        gid, rd, res, f"gang bind failed: {reason}"
+                    )
+                return
+            gsp.set(ok=True)
+        now_perf = self.clock.perf()
+        with self.cluster.lock:
+            for entry in entries:
+                state, info, pod, node_name, cycle, _t0, estep = entry
+                self.cache.finish_binding(pod.key)
+                self.volume_binder.finish(pod.key)
+                self.claim_allocator.finish(pod.key)
+                if self.fleet is not None:
+                    self.fleet.commit(pod.key)
+                self._event(
+                    pod, "Scheduled",
+                    f"Successfully assigned {pod.key} to {node_name} "
+                    f"(pod group {gid}, all {len(entries)} members "
+                    "bound atomically)",
+                    action="Binding",
+                )
+                res.scheduled.append((pod.key, node_name))
+                if self.journal is not None:
+                    self.journal.record(
+                        estep, cycle, pod, "bound",
+                        node=node_name, attempts=info.attempts,
+                    )
+                self._in_flight.pop(pod.key, None)
+            self._refresh_pending_gauge()
+        for entry in entries:
+            state, info, pod, node_name, _cycle, t_start, _s = entry
+            res.latencies.append(now_perf - t_start)
+            e2e = max(
+                self.clock.now() - info.initial_attempt_timestamp, 0.0
+            )
+            res.e2e_latencies.append(e2e)
+            metrics.pod_scheduling_attempts.observe(info.attempts)
+            metrics.pod_scheduling_sli_duration_seconds.labels(
+                str(min(info.attempts, 16))
+            ).observe(e2e)
+            for p in self.registry.post_bind:
+                p.post_bind(state, pod, node_name)
+        metrics.gang_commits_total.inc()
+        metrics.gang_bound_pods_total.inc(len(entries))
+        first = self._gang.note_complete(gid) if self._gang else None
+        if first is not None:
+            metrics.gang_assembly_seconds.observe(
+                max(self.clock.now() - first, 0.0)
+            )
 
     def _tensorize_group(
         self,
@@ -2072,6 +2611,24 @@ class Scheduler:
                         return (parts, _base(p))
                     return parts
 
+            if (
+                self._gang is not None
+                and self._gang.config.class_throughput
+                and self._gang.config.throughput_weight > 0
+            ):
+                # heterogeneity objective (gang/throughput.py): pods of
+                # different workload classes score differently per
+                # accelerator class, so they must not share a class rep
+                from .gang import WORKLOAD_CLASS_LABEL
+
+                base_gang = class_key_extra
+
+                def class_key_extra(p, _base=base_gang):
+                    parts = (p.labels.get(WORKLOAD_CLASS_LABEL),)
+                    if _base is not None:
+                        return (parts, _base(p))
+                    return parts
+
             static = _timed(
                 "NodeAffinity",  # the static-mask family's dominant member
                 build_static_tensors,
@@ -2100,6 +2657,15 @@ class Scheduler:
                 )
             else:
                 ports = trivial_port_tensors(pbatch, batch.padded)
+            # spread/interpod count nominated pods host-side with no
+            # device-side self-exclusion (unlike ports' nominated_slot), so
+            # drop batch pods' own nominations — a pod must not see itself
+            # as an already-standing peer
+            if need_spread or need_interpod:
+                _batch_keys = {p.key for p in pods}
+                nom_peers = [
+                    (q, s) for q, s in nom_pairs if q.key not in _batch_keys
+                ]
             spread = None
             if need_spread:
                 spread = _timed(
@@ -2108,6 +2674,7 @@ class Scheduler:
                     placed_by_slot, batch.padded, static.c_pad,
                     services=services,
                     defaulting=solver.config.spread_defaulting,
+                    nominated=nom_peers,
                 )
             interpod = None
             if need_interpod:
@@ -2116,6 +2683,7 @@ class Scheduler:
                     pods, static.reps, pbatch, slot_nodes,
                     placed_by_slot, batch.padded, static.c_pad,
                     hard_pod_affinity_weight=solver.config.hard_pod_affinity_weight,
+                    nominated=nom_peers,
                 )
 
             # nominated-pod load (RunFilterPluginsWithNominatedPods analog):
@@ -2251,6 +2819,17 @@ class Scheduler:
                         cl.trace_context = None
             if extra.any():
                 static.extra_score = extra
+        if self._gang is not None:
+            # heterogeneity-aware scoring (gang/throughput.py): Gavel's
+            # effective-throughput objective accumulates into the same
+            # generic extra_score donor the folds above use, so every
+            # solver path (fused + grouped) applies it with zero new
+            # kernel surface. AFTER the fold-cache block (a cache hit
+            # REPLACES extra_score) and the extender fold; BEFORE the
+            # DRA mask fold, which only touches the mask.
+            from .gang import fold_throughput
+
+            fold_throughput(static, slot_nodes, self._gang.config)
         if dra_active:
             # dynamicresources Filter: fold per-class claim feasibility
             # into the static mask (allocated claims pin to their node).
@@ -2641,9 +3220,14 @@ class Scheduler:
                     msg = fe_generic
                 fiterr_memo[key] = msg
                 return msg
+            gang_staged = 0
             for idx, (info, a) in enumerate(zip(infos, assignments)):
                 pod = info.pod
                 cycle = base_cycle + cycle_offsets[idx] + 1
+                # gang members STAGE instead of entering pending, and
+                # any failure marks their whole round failed — the
+                # all-or-nothing resolution happens in _commit_all
+                rd = self._gang_round_of(pod)
                 if a < 0:
                     # failure path: PostFilter — defaultpreemption first, then
                     # out-of-tree PostFilter plugins (first success nominates)
@@ -2689,6 +3273,7 @@ class Scheduler:
                         preempt_dt += self.clock.perf() - tpf
                     res.unschedulable.append(pod.key)
                     self._requeue(info, cycle)
+                    self._gang_note_fail(rd, pod, "unschedulable")
                     why = unsched_reason.get(pod.key) or fit_error_for(
                         pod, pod_base + idx
                     )
@@ -2729,8 +3314,15 @@ class Scheduler:
                                 f":{pod.key}"
                             )
                             self.journal.pod_traces[pod.key] = pod_trace
-                        handed_to = self.fleet.maybe_hand_off(
-                            pod, trace=pod_trace
+                        handed_to = (
+                            self.fleet.maybe_hand_off(
+                                pod, trace=pod_trace
+                            )
+                            if rd is None
+                            # gang members never hand off alone: the
+                            # group must land together, so a rejected
+                            # member retries locally with its siblings
+                            else None
                         )
                         if handed_to is not None:
                             # released to a peer whose shard may host
@@ -2756,6 +3348,7 @@ class Scheduler:
                             continue
                         res.unschedulable.append(pod.key)
                         self._requeue(info, cycle)
+                        self._gang_note_fail(rd, pod, fleet_why)
                         self._event(
                             pod, "FailedScheduling", fleet_why,
                             type_="Warning",
@@ -2781,6 +3374,7 @@ class Scheduler:
                         self.fleet.withdraw(pod.key)
                     res.bind_failures.append((pod.key, str(e)))
                     self._requeue(info, cycle)
+                    self._gang_note_fail(rd, pod, str(e))
                     if self.journal is not None:
                         self.journal.record(
                             prep.step, cycle, pod, "bind_failure",
@@ -2835,6 +3429,7 @@ class Scheduler:
                     self._unreserve_all(state, pod, node_name)
                     res.bind_failures.append((pod.key, str(e)))
                     self._requeue(info, cycle)
+                    self._gang_note_fail(rd, pod, str(e))
                     self._event(
                         pod, "FailedScheduling", str(e), type_="Warning",
                     )
@@ -2851,6 +3446,32 @@ class Scheduler:
                 # WaitingPods map — it stays assumed (+reserved) and the
                 # binding completes or rolls back in a later cycle
                 verdict = self._run_permit(state, pod, node_name)
+                if isinstance(verdict, dict) and rd is not None:
+                    # Permit WAIT is unsupported for pod-group members
+                    # (documented limitation): a parked member would
+                    # hold every sibling's staged placement hostage
+                    # across cycles — convert to a rejection so the
+                    # round resolves this batch and the gang retries
+                    permit_why = (
+                        "Permit WAIT is unsupported for pod-group "
+                        "members (plugins: "
+                        + ",".join(sorted(verdict)) + ")"
+                    )
+                    self._unreserve_all(state, pod, node_name)
+                    res.unschedulable.append(pod.key)
+                    self._requeue(info, cycle)
+                    self._gang_note_fail(rd, pod, permit_why)
+                    self._event(
+                        pod, "FailedScheduling", permit_why,
+                        type_="Warning", action="Permit",
+                    )
+                    if self.journal is not None:
+                        self.journal.record(
+                            prep.step, cycle, pod, "permit_rejected",
+                            node=node_name, reason=permit_why,
+                            profile=profile, attempts=info.attempts,
+                        )
+                    continue
                 if isinstance(verdict, dict):
                     wp = WaitingPod(pod, node_name, verdict, self.clock.now())
                     self._waiting[pod.key] = (
@@ -2872,6 +3493,7 @@ class Scheduler:
                         f"permit plugin {verdict[0]} rejected: "
                         + "; ".join(verdict[1].reasons)
                     )
+                    self._gang_note_fail(rd, pod, permit_why)
                     self._event(
                         pod, "FailedScheduling", permit_why,
                         type_="Warning", action="Permit",
@@ -2885,10 +3507,16 @@ class Scheduler:
                     continue
 
                 # approved: the binding cycle commits AFTER the lock drops
-                # (schedule_batch's pending pass)
-                pending.append(
-                    (state, info, pod, node_name, cycle, t0, prep.step)
-                )
+                # (schedule_batch's pending pass). Gang members STAGE
+                # on their round instead — they commit atomically (or
+                # release together) when the round resolves.
+                entry = (state, info, pod, node_name, cycle, t0, prep.step)
+                if rd is not None:
+                    rd["staged"].append(entry)
+                    rd["done"].add(pod.key)
+                    gang_staged += 1
+                else:
+                    pending.append(entry)
                 # keep the lazily-snapshotted preemption view in sync with
                 # assumes made later in this batch, so a subsequent failing
                 # pod's dry-run sees current node occupancy (the cache-backed
@@ -2912,7 +3540,7 @@ class Scheduler:
         # "scheduled" attempts = this group's approved bindings (upstream
         # observes at scheduling-cycle end; a later bind failure records
         # separately under the error paths, like the binding goroutine)
-        n_sched = len(pending) - pending_before
+        n_sched = len(pending) - pending_before + gang_staged
         n_unsched = len(res.unschedulable) - unsched_before
         n_fail = len(res.bind_failures) - failures_before
         if n_sched:
@@ -3640,6 +4268,15 @@ class Scheduler:
             pods=len(infos), fence=prep.fence,
         ):
             self._session_stale.add(prep.profile)
+            if self._gang is not None and self._gang_rounds:
+                # a discarded flight can never resolve its gang rounds:
+                # staged siblings from earlier flights of the same
+                # batch release + requeue here (this flight's own
+                # members were never staged — they requeue below)
+                self._release_gang_rounds_for(
+                    {i.key for i in infos},
+                    "gang member's solve was discarded",
+                )
             for info in infos:
                 self._in_flight.pop(info.key, None)
                 if self.journal is not None:
@@ -3871,9 +4508,14 @@ class Scheduler:
                     self._reap_expired_assumes()
                     self.queue.flush_unschedulable_leftover()
                     infos = self.queue.pop_batch(self.config.batch_size)
-                    base_cycle = self.queue.scheduling_cycle - len(infos)
                     for i in infos:
                         self._in_flight[i.key] = i
+                    if self._gang is not None:
+                        # gang gate BEFORE base_cycle: the gate moves
+                        # pods in and out of the batch, and base_cycle
+                        # must describe the batch that actually runs
+                        infos = self._gang_gate(infos)
+                    base_cycle = self.queue.scheduling_cycle - len(infos)
                     plain = bool(infos) and self._plain_batch(
                         [i.pod for i in infos]
                     )
@@ -4271,9 +4913,12 @@ class Scheduler:
                     self._reap_expired_assumes()
                     self.queue.flush_unschedulable_leftover()
                     infos = self.queue.pop_batch(self.config.batch_size)
-                    base_cycle = self.queue.scheduling_cycle - len(infos)
                     for i in infos:
                         self._in_flight[i.key] = i
+                    if self._gang is not None:
+                        # gang gate BEFORE base_cycle (see run_pipelined)
+                        infos = self._gang_gate(infos)
+                    base_cycle = self.queue.scheduling_cycle - len(infos)
                     self._refresh_pending_gauge()
                 if not infos:
                     if slots:

@@ -114,6 +114,15 @@ def _print_result(res) -> None:
             f"pdb_overruns={reb['pdb_overruns']} "
             f"final_packing={reb['final_packing']}"
         )
+    g = s.get("gang")
+    if g:
+        print(
+            f"  gang: commits={g['gang_commits']} "
+            f"bound_pods={g['gang_bound_pods']} "
+            f"incomplete_rounds={g['gang_incomplete_rounds']} "
+            f"partial_gangs={g['partial_gangs']} "
+            f"quarantined_gangs={g['quarantined_gangs']}"
+        )
     if s.get("crashes") or s.get("incarnations", 1) > 1:
         print(
             f"  lifecycle: incarnations={s['incarnations']} "
@@ -172,6 +181,15 @@ def _print_fleet_result(res) -> None:
             f"journal_missing={ha['hub_journal_missing']} "
             f"old_primary_reads_ok={ha['old_primary_reads_ok']} "
             f"stale_rejections={s['stale_rejections']}"
+        )
+    g = s.get("gang")
+    if g:
+        print(
+            f"  gang: commits={g['gang_commits']} "
+            f"bound_pods={g['gang_bound_pods']} "
+            f"incomplete_rounds={g['gang_incomplete_rounds']} "
+            f"partial_gangs={g['partial_gangs']} "
+            f"quarantined_gangs={g['quarantined_gangs']}"
         )
     for rid in sorted(res.journal_digests):
         print(f"  journal[{rid}]={res.journal_digests[rid]}")
@@ -349,7 +367,18 @@ def main(argv=None) -> int:
 
         for name in sorted(PROFILES):
             p = PROFILES[name]
-            print(f"{name}: pipelined={p.pipelined} nodes={p.nodes}")
+            line = f"{name}: pipelined={p.pipelined} nodes={p.nodes}"
+            if p.gang_rate > 0 or p.gang_short_at >= 0:
+                # gang profiles carry the pod-group workload knobs
+                # (kubernetes_tpu/gang): surface them so the listing
+                # says WHICH profiles drive the gang gate and how
+                line += (
+                    f" gang_rate={p.gang_rate}"
+                    f" gang_sizes={p.gang_sizes}"
+                    f" gang_short_at={p.gang_short_at}"
+                    f" accel_classes={len(p.gang_accel_classes)}"
+                )
+            print(line)
         return 0
 
     _configure_jax(args.mesh_devices)

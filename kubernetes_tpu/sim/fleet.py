@@ -56,7 +56,9 @@ from .invariants import (
     check_hub_failover,
     check_hub_partition,
     check_no_global_overcommit,
+    check_no_partial_gangs,
 )
+from .harness import _GANG_COUNTERS, _counter_value, _gang_throughput_table
 from .profiles import Profile, get_profile
 
 
@@ -249,8 +251,33 @@ class FleetSimHarness:
                 self._replicator = StandbyReplicator(
                     self.hub_standby, source
                 )
+        # gang scheduling (gang profiles): every replica shares the same
+        # GangConfig — gangs route whole (by gang id) so one replica
+        # assembles and atomically commits each gang, staging members
+        # through the fenced hub CAS. Same quarantine-TTL reasoning as
+        # the single harness (harness._base_config): park quarantined
+        # gangs past the settle horizon.
+        self._gang_profile = (
+            self.profile.gang_rate > 0 or self.profile.gang_short_at >= 0
+        )
+        gang_cfg = None
+        resilience_cfg = None
+        if self._gang_profile:
+            from ..gang import GangConfig
+            from ..resilience import ResilienceConfig
+
+            gang_cfg = GangConfig(
+                min_member_timeout=self.profile.gang_min_member_timeout,
+                quarantine_after=self.profile.gang_quarantine_after,
+                throughput_weight=self.profile.gang_throughput_weight,
+                class_throughput=_gang_throughput_table(self.profile),
+            )
+            resilience_cfg = ResilienceConfig(quarantine_ttl=3600.0)
         self.schedulers: dict[str, Scheduler] = {}
         for rid in self.universe:
+            cfg_kwargs: dict = {}
+            if resilience_cfg is not None:
+                cfg_kwargs["resilience"] = resilience_cfg
             self.schedulers[rid] = Scheduler(
                 self.cluster,
                 SchedulerConfig(
@@ -261,12 +288,14 @@ class FleetSimHarness:
                         group_size=self.profile.group_size,
                     ),
                     obs=ObsConfig(journal=True),
+                    gang=gang_cfg,
                     fleet=FleetConfig(
                         replica=rid,
                         replicas=self.universe,
                         exchange=replica_exchange[rid],
                         max_row_age_s=self.profile.fleet_max_row_age_s,
                     ),
+                    **cfg_kwargs,
                 ),
                 clock=self.clock,
             )
@@ -279,6 +308,9 @@ class FleetSimHarness:
             rid: 0 for rid in self.universe
         }
         self._events_applied = 0
+        self._gang_counters0 = {
+            k: _counter_value(c) for k, c in _GANG_COUNTERS.items()
+        }
         self._lost_replica: str | None = None
         # hub-partition / zombie state (the hub_partition profile):
         # the zombie keeps DRIVING while partitioned — unlike a lost
@@ -490,6 +522,9 @@ class FleetSimHarness:
     def _check(self, cycle: int) -> None:
         self.tracker.drain(cycle, self.violations)
         check_constraints(self.cluster, cycle, self.violations)
+        # fleet-wide: gangs must land atomically no matter which
+        # replica owned them (a no-op without gang labels)
+        check_no_partial_gangs(self.cluster, cycle, self.violations)
         self._check_fleet_lost_pods(cycle)
         self.monotonic.observe(cycle, self.violations)
 
@@ -524,6 +559,25 @@ class FleetSimHarness:
                     "replica's queue/in-flight/waiting maps nor a "
                     "pending handoff row",
                 )
+
+    def _gang_summary(self) -> dict | None:
+        if not self._gang_profile:
+            return None
+        from ..gang import GangTracker
+
+        gang_bound: set[str] = set()
+        gang_unbound: set[str] = set()
+        for p in self.cluster.list_pods():
+            gid = GangTracker.gang_of(p)
+            if gid is not None:
+                (gang_bound if p.node_name else gang_unbound).add(gid)
+        return {
+            "partial_gangs": len(gang_bound & gang_unbound),
+            **{
+                k: int(_counter_value(c) - self._gang_counters0[k])
+                for k, c in _GANG_COUNTERS.items()
+            },
+        }
 
     def _settled(self) -> bool:
         if self.exchange.debug_state()["pending_handoffs"]:
@@ -763,6 +817,11 @@ class FleetSimHarness:
             "hub_journal_digest": _digest(hub_journal),
             # hub-HA counters (the hub_failover profile; None without)
             "hub_ha": hub_ha,
+            # gang scheduling (gang profiles; None without): partial
+            # gangs fleet-wide must be 0 — atomicity survives replica
+            # loss because gangs route whole and commit through one
+            # replica's fenced CAS round
+            "gang": self._gang_summary(),
         }
         flight_dumps: dict[str, str] = {}
         if self.violations:

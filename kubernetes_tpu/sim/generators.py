@@ -54,16 +54,30 @@ PDB_GUARD_LABEL = "sim.kubernetes.io/pdb-guard"
 def make_pod(
     name: str, cpu: str, priority: int = 0, shape: str = "plain",
     port: int = 0, poison: bool = False, pdb_guard: bool = False,
+    gang: str = "", gang_min: int = 0, workload_class: str = "",
 ) -> Pod:
     """``shape``: plain | spread (hard maxSkew=1 zone spread over the
     app=spread cohort) | anti (required hostname anti-affinity over
     app=anti) | ports (hostPort ``port``). ``poison`` marks the pod
     with POISON_LABEL (its presence breaks the solve — the bisection
     quarantine's food). ``pdb_guard`` joins the PDB-guarded cohort the
-    rebalancer must never evict."""
+    rebalancer must never evict. ``gang`` joins the named pod group
+    (kubernetes_tpu/gang): the pod carries the pod-group label plus the
+    ``gang_min`` min-member annotation, and ``workload_class`` labels
+    it for the heterogeneity throughput term."""
     from ..api.wrappers import MakePod
 
     b = MakePod().name(name).req({"cpu": cpu, "memory": "1Gi"})
+    if gang:
+        from ..gang import GANG_LABEL, MIN_MEMBER_ANNOTATION
+
+        b = b.label(GANG_LABEL, gang).annotation(
+            MIN_MEMBER_ANNOTATION, str(gang_min or 1)
+        )
+    if workload_class:
+        from ..gang import WORKLOAD_CLASS_LABEL
+
+        b = b.label(WORKLOAD_CLASS_LABEL, workload_class)
     if priority:
         b = b.priority(priority)
     if shape == "spread":
@@ -100,6 +114,7 @@ class ChurnGenerator:
         self._pod_seq = 0
         self._node_seq = 0
         self._flap_seq = 0
+        self._gang_seq = 0
 
     # -- seeding (before the scheduler exists; not part of the trace —
     # replay re-derives it from the header's profile) --
@@ -112,13 +127,24 @@ class ChurnGenerator:
 
     def _make_labeled_node(self) -> Node:
         """Node with a deterministic zone label (z{seq % zones}) so the
-        spread-shaped arrivals have topology domains to spread over."""
+        spread-shaped arrivals have topology domains to spread over —
+        and, on gang profiles, a seq-derived accelerator-class label
+        (RNG-free like the zone, so node identity never shifts the gen
+        stream) feeding the heterogeneity throughput term."""
         zone = f"z{self._node_seq % max(self.profile.zones, 1)}"
+        labels = {"topology.kubernetes.io/zone": zone}
+        if self.profile.gang_accel_classes:
+            from ..gang import ACCEL_CLASS_LABEL
+
+            classes = self.profile.gang_accel_classes
+            labels[ACCEL_CLASS_LABEL] = classes[
+                self._node_seq % len(classes)
+            ]
         return make_node(
             self._next_node_name(),
             self.profile.node_cpu,
             self.profile.node_mem,
-            labels={"topology.kubernetes.io/zone": zone},
+            labels=labels,
         )
 
     def _next_node_name(self) -> str:
@@ -184,6 +210,23 @@ class ChurnGenerator:
                 pdb_guard=pdb_guard,
             )
             events.append({"op": "create_pod", "pod": pod.to_dict()})
+
+        # gang arrivals (kubernetes_tpu/gang): each gang's members all
+        # land this cycle as ordinary create_pod events (self-contained
+        # wire dicts — replay needs no gang logic here). Draws are
+        # guarded on the gang knobs so non-gang profiles consume no RNG
+        # (existing traces stay byte-identical).
+        if p.gang_rate:
+            for _ in range(_count(rng, p.gang_rate)):
+                events.extend(self._gang_events(rng.choice(p.gang_sizes)))
+        if p.gang_short_at >= 0 and cycle == p.gang_short_at:
+            # the never-satisfiable gang: min-member is one more than
+            # the members that will ever exist, so the quorum cannot
+            # assemble and the whole gang must ride gang_incomplete
+            # rounds into quarantine
+            events.extend(
+                self._gang_events(max(p.gang_sizes), short=True)
+            )
 
         # pod deletes (any pod — pending or bound; bound deletes free
         # capacity, pending deletes exercise mid-flight removal)
@@ -270,6 +313,33 @@ class ChurnGenerator:
                 break
             events.append(ev)
         return events
+
+    def _gang_events(self, size: int, short: bool = False) -> list[dict]:
+        """Create-pod events for one pod group: ``size`` members, one
+        shared cpu request and workload class (DL replicas are
+        homogeneous), min-member = size — or size + 1 when ``short``,
+        making the gang permanently unsatisfiable."""
+        p, rng = self.profile, self.rng
+        self._gang_seq += 1
+        gid = f"g{self._gang_seq:03}"
+        wc = (
+            rng.choice(p.gang_workload_classes)
+            if p.gang_workload_classes
+            else ""
+        )
+        cpu = rng.choice(p.pod_cpu_choices)
+        min_member = size + 1 if short else size
+        out = []
+        for _ in range(size):
+            pod = make_pod(
+                self._next_pod_name(),
+                cpu,
+                gang=gid,
+                gang_min=min_member,
+                workload_class=wc,
+            )
+            out.append({"op": "create_pod", "pod": pod.to_dict()})
+        return out
 
     def _external_bind_event(
         self, staged: list[dict], staged_alloc: dict[str, int]

@@ -56,6 +56,7 @@ from .invariants import (
     check_constraints,
     check_journal_completeness,
     check_lost_pods,
+    check_no_partial_gangs,
     check_rebalance,
     check_recovery,
     check_resilience,
@@ -110,6 +111,34 @@ _DELTA_COUNTERS = {
 
 def _counter_value(c) -> float:
     return c._value.get()  # prometheus_client internal, test-style read
+
+
+# gang footer block (gang profiles): within-run deltas of the gang
+# counters. Deltas of GLOBAL metrics rather than scheduler-object
+# state, so the numbers survive crash_restart incarnation swaps.
+_GANG_COUNTERS = {
+    "gang_commits": metrics.gang_commits_total,
+    "gang_bound_pods": metrics.gang_bound_pods_total,
+    "gang_incomplete_rounds": metrics.gang_incomplete_total,
+    "quarantined_gangs": metrics.gang_quarantined_total,
+}
+
+
+def _gang_throughput_table(profile: Profile) -> dict:
+    """Deterministic workload-class x accelerator-class effective-
+    throughput table derived from the profile's class lists alone (no
+    RNG: same profile => same table => byte-identical solves). Rows are
+    rotations of a fixed ladder, so every workload class prefers a
+    different accelerator class — real placement pressure for the
+    heterogeneity term to resolve."""
+    ladder = (1.0, 0.75, 0.5, 0.25)
+    return {
+        wc: {
+            ac: ladder[(i + j) % len(ladder)]
+            for j, ac in enumerate(profile.gang_accel_classes)
+        }
+        for i, wc in enumerate(profile.gang_workload_classes)
+    }
 
 
 class SimHarness:
@@ -257,14 +286,41 @@ class SimHarness:
                 # while detecting the real shift with margin
                 shift_threshold=0.7, max_probes=4,
             )
+        gang_cfg = None
+        self._gang_profile = (
+            self.profile.gang_rate > 0 or self.profile.gang_short_at >= 0
+        )
+        resilience_kwargs: dict = {
+            "open_seconds": self.profile.resilience_open_s
+        }
+        if self._gang_profile:
+            from ..gang import GangConfig
+
+            gang_cfg = GangConfig(
+                min_member_timeout=self.profile.gang_min_member_timeout,
+                quarantine_after=self.profile.gang_quarantine_after,
+                throughput_weight=self.profile.gang_throughput_weight,
+                class_throughput=_gang_throughput_table(self.profile),
+            )
+            # park the quarantined gang PAST the settle horizon: a TTL
+            # re-admit landing in the settle tail would re-park the
+            # gang `gang_incomplete` (non-terminal) with no waking
+            # event left to drive it back to quarantine, misreading
+            # "terminally quarantined" as "dropped" in the journal-
+            # completeness invariant. The re-admit cycle itself is
+            # unit-tested (tests/test_gang.py), not sim-driven.
+            resilience_kwargs["quarantine_ttl"] = 3600.0
         self._base_config = SchedulerConfig(
             batch_size=self.profile.batch_size,
             # short breaker fault window so probes and re-closes
             # land inside the run's virtual timeline (the
             # resilience invariant asserts the re-close)
-            resilience=ResilienceConfig(
-                open_seconds=self.profile.resilience_open_s
-            ),
+            resilience=ResilienceConfig(**resilience_kwargs),
+            # gang scheduling (gang profiles): pod groups admitted,
+            # queued, and bound atomically, with the heterogeneity
+            # throughput table derived deterministically from the
+            # profile's class lists
+            gang=gang_cfg,
             # node-axis solve mesh: results are bit-exactly device-
             # count invariant, so a mesh_devices=N run's trace and
             # journal must be byte-identical to the single-device run
@@ -366,6 +422,9 @@ class SimHarness:
         self._tuner_settled_at_shift = False
         self._counters0 = {
             k: _counter_value(c) for k, c in _DELTA_COUNTERS.items()
+        }
+        self._gang_counters0 = {
+            k: _counter_value(c) for k, c in _GANG_COUNTERS.items()
         }
 
     # -- fault delivery inside the dispatch→apply window --
@@ -513,6 +572,9 @@ class SimHarness:
         self.tracker.drain(cycle, self.violations)
         check_capacity(self.cluster, cycle, self.violations)
         check_constraints(self.cluster, cycle, self.violations)
+        # every cycle, every profile: a no-op without gang labels, and
+        # the gang tentpole's core contract when they exist
+        check_no_partial_gangs(self.cluster, cycle, self.violations)
         check_lost_pods(
             self.cluster,
             self.scheduler,
@@ -735,6 +797,25 @@ class SimHarness:
                 "pdb_overruns": overruns,
                 "final_packing": round(final_packing, 4),
             }
+        gang_summary = None
+        if self._gang_profile:
+            from ..gang import GangTracker
+
+            gang_bound: set[str] = set()
+            gang_unbound: set[str] = set()
+            for p in self.cluster.list_pods():
+                gid = GangTracker.gang_of(p)
+                if gid is not None:
+                    (gang_bound if p.node_name else gang_unbound).add(gid)
+            gang_summary = {
+                # the headline number the CI smoke pins to 0: gangs
+                # with both bound and unbound live members at the end
+                "partial_gangs": len(gang_bound & gang_unbound),
+                **{
+                    k: int(_counter_value(c) - self._gang_counters0[k])
+                    for k, c in _GANG_COUNTERS.items()
+                },
+            }
         tuning_summary = None
         tuned_doc = None
         if self.tuning and self.scheduler.tuner is not None:
@@ -824,6 +905,11 @@ class SimHarness:
             # probes/moves/settled/shifts/guardrail counters + final
             # knob values — the tuning invariant's assertion target
             "tuning": tuning_summary,
+            # gang scheduling (gang profiles): partial_gangs must be 0
+            # (the atomic-commit contract) and quarantined_gangs >= 1
+            # when the profile seeds a never-satisfiable gang — both
+            # pinned by the CI gang smoke
+            "gang": gang_summary,
             # backlog drain (backlog_drain profiles): counts only —
             # all driver-side and deterministic, so same-seed runs
             # stay byte-identical (wall timings deliberately excluded)

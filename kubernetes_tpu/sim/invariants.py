@@ -20,6 +20,9 @@ tests/test_sim_invariants.py feeds each one a crafted violation):
   pods (the checks guarding the pipelined loop's occupancy-carrying
   path; spread skew is deliberately unchecked — node churn re-shapes
   domains after placement);
+- ``check_no_partial_gangs`` — no pod group is ever partially bound:
+  a gang with one bound and one unbound live member means the atomic
+  gang commit (kubernetes_tpu/gang) leaked a partial bind;
 - ``MonotonicCounters``      — sampled Counter series never decrease;
 - ``check_resilience``       — under injected solver-boundary faults:
   the fallback ladder engaged (breaker trips), the breaker re-closed
@@ -44,7 +47,7 @@ from ..state.cluster import ClusterState, Event
 class Violation:
     invariant: str  # double_bind | capacity | lost_pod | progress |
     # monotonic | constraint | journal | global_overcommit |
-    # resilience | recovery | fencing | rebalance
+    # resilience | recovery | fencing | rebalance | gang
     cycle: int
     detail: str
 
@@ -247,6 +250,39 @@ def check_constraints(
                             f"matching pod {other.key}",
                         )
                         break
+
+
+def check_no_partial_gangs(
+    cluster: ClusterState, cycle: int, violations: list[Violation]
+) -> None:
+    """No pod group is ever partially bound (the gang tentpole's sim
+    contract, ISSUE 17): a violation is a gang with at least one BOUND
+    and at least one UNBOUND live member. Sound because the scheduler
+    binds a gang only through ``ClusterState.bind_gang`` — atomic under
+    the cluster lock — and this runs after every drive: any path that
+    bound some members and released the rest would be caught here
+    before the next cycle's churn. Delete churn cannot fake a
+    violation (removing a bound member leaves the survivors all-bound;
+    removing a queued member leaves them all-unbound), and a
+    half-CREATED gang mid-arrival is all-unbound too.
+    """
+    from ..gang import GangTracker
+
+    bound: dict[str, list[str]] = {}
+    unbound: dict[str, list[str]] = {}
+    for pod in cluster.list_pods():
+        gid = GangTracker.gang_of(pod)
+        if gid is None:
+            continue
+        side = bound if pod.node_name else unbound
+        side.setdefault(gid, []).append(pod.key)
+    for gid in sorted(set(bound) & set(unbound)):
+        _record(
+            violations, "gang", cycle,
+            f"pod group {gid} is partially bound: "
+            f"bound={sorted(bound[gid])} pending={sorted(unbound[gid])} "
+            "— gang commit must be atomic (all members or none)",
+        )
 
 
 def check_lost_pods(

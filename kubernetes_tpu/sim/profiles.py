@@ -170,6 +170,35 @@ class Profile:
     # so replay is unaffected.
     shift_at: int = -1
     shift_arrivals: tuple = ()
+    # -- gang scheduling (kubernetes_tpu/gang, ISSUE 17) --
+    # P(a cycle spawns a pod group): all members arrive the same cycle
+    # carrying the pod-group label + min-member annotation, and the
+    # scheduler must bind the whole gang atomically or none of it
+    # (check_no_partial_gangs asserts exactly that, every cycle).
+    # 0 = no gangs (all gang knobs are inert — existing profiles'
+    # event streams stay byte-identical).
+    gang_rate: float = 0.0
+    gang_sizes: tuple[int, ...] = (2, 3)
+    # spawn one NEVER-SATISFIABLE gang at this cycle: min-member is set
+    # one above the members actually created, so the quorum can never
+    # assemble — the gang must ride gang_incomplete rounds into a
+    # whole-gang quarantine (the CI smoke pins quarantined_gangs >= 1
+    # off this). -1 = never.
+    gang_short_at: int = -1
+    # GangConfig knobs for the sim scheduler (harness._base_config):
+    # sim-sized so assembly timeouts and the quarantine ladder resolve
+    # within a run's virtual timeline (production defaults are longer)
+    gang_min_member_timeout: float = 3.0
+    gang_quarantine_after: int = 3
+    # heterogeneity-aware placement: nodes get an accelerator-class
+    # label (gang_accel_classes[seq % len], seq-based like zones so
+    # node identity stays RNG-free), gang members a workload-class
+    # label, and the harness derives a deterministic effective-
+    # throughput table over the cross product (Gavel's objective,
+    # folded into the solve as a score term). () / 0 = term off.
+    gang_accel_classes: tuple[str, ...] = ()
+    gang_workload_classes: tuple[str, ...] = ()
+    gang_throughput_weight: int = 0
 
     def validate(self) -> None:
         if self.watch_delay and (
@@ -181,6 +210,16 @@ class Profile:
                 "capacity reductions makes transient overcommit legitimate, "
                 "so the capacity invariant would be unsound — see module "
                 "docstring)"
+            )
+        if (self.gang_rate > 0 or self.gang_short_at >= 0) and any(
+            self.pod_priorities
+        ):
+            raise ValueError(
+                f"profile {self.name}: gang arrivals cannot be combined "
+                "with non-zero pod priorities (preemption can evict a "
+                "bound gang member, and the gang gate cannot count "
+                "already-bound members toward a re-assembly quorum — a "
+                "documented design limit, see kubernetes_tpu/gang)"
             )
 
 
@@ -553,6 +592,92 @@ PROFILES: dict[str, Profile] = {
         # redistribution + resync) and every pod it owned — queued,
         # in-flight, or handed off — must still reach a terminal
         # journal outcome somewhere in the fleet.
+        # gang: the DL-training workload profile (kubernetes_tpu/gang,
+        # ISSUE 17). Most arrivals are pod groups — all members land
+        # the same cycle with the pod-group label + min-member
+        # annotation — and the scheduler must solve each gang as one
+        # chained sub-batch and bind it atomically (all members or
+        # none; check_no_partial_gangs runs every cycle). Nodes carry
+        # accelerator-class labels and gangs workload classes, so the
+        # heterogeneity throughput term (Gavel's objective) scores
+        # non-vacuously. One never-satisfiable gang (gang_short_at)
+        # must ride gang_incomplete rounds into a whole-gang
+        # quarantine — the CI smoke pins partial_gangs == 0 AND
+        # quarantined_gangs >= 1. Delete churn hits bound and queued
+        # members alike (a queued member's deletion strands its gang
+        # short → quarantine is its only exit). Priority-0 only: see
+        # validate(). Two replicas make the same profile drivable
+        # --fleet, where gang members route by gang id so each gang
+        # assembles whole on one replica and stages through the
+        # fenced CAS member-by-member.
+        Profile(
+            name="gang",
+            nodes=8,
+            zones=2,
+            arrivals=(1, 3),
+            gang_rate=0.7,
+            gang_sizes=(2, 3),
+            gang_short_at=2,
+            gang_min_member_timeout=2.0,
+            gang_quarantine_after=1,
+            gang_accel_classes=("tpu-v5e", "tpu-v4", "gpu-a100"),
+            gang_workload_classes=("transformer", "resnet"),
+            gang_throughput_weight=2,
+            # pod-delete churn only: it wakes parked gang members each
+            # cycle (assembly-timeout rounds need re-pops) AND keeps
+            # node ownership static so the profile stays fleet-drivable
+            # (the no-global-overcommit invariant's ownership half is
+            # exact without node churn, like fleet_mixed)
+            delete_pod_rate=0.4,
+            fleet_replicas=2,
+        ),
+        # gang_crash: the gang profile with the scheduler killed
+        # mid-batch at the commit point (pods assumed + approved,
+        # nothing bound). The crash seam fires BEFORE any gang bind,
+        # so no gang can be half-bound by the dying incarnation, and
+        # the successor's recovery pass must roll back any half-staged
+        # gang rounds (_rollback_partial_gangs) before re-adopting —
+        # partial_gangs must stay 0 across the incarnation boundary.
+        Profile(
+            name="gang_crash",
+            nodes=8,
+            zones=2,
+            arrivals=(1, 3),
+            gang_rate=0.7,
+            gang_sizes=(2, 3),
+            gang_short_at=2,
+            gang_min_member_timeout=2.0,
+            gang_quarantine_after=1,
+            gang_accel_classes=("tpu-v5e", "tpu-v4", "gpu-a100"),
+            gang_workload_classes=("transformer", "resnet"),
+            gang_throughput_weight=2,
+            delete_pod_rate=0.4,
+            node_add_rate=0.2,
+            crash_at=4,
+        ),
+        # gang_replica_loss: the gang profile driven --fleet with one
+        # replica killed mid-drive. Gangs route whole (by gang id) so
+        # the dead replica takes entire gangs with it — the survivor
+        # re-owns them via the ring and must still land each one
+        # atomically or quarantine it; no partial gang may survive
+        # the failover fleet-wide.
+        Profile(
+            name="gang_replica_loss",
+            nodes=8,
+            zones=2,
+            arrivals=(1, 3),
+            gang_rate=0.7,
+            gang_sizes=(2, 3),
+            gang_short_at=2,
+            gang_min_member_timeout=2.0,
+            gang_quarantine_after=1,
+            gang_accel_classes=("tpu-v5e", "tpu-v4", "gpu-a100"),
+            gang_workload_classes=("transformer", "resnet"),
+            gang_throughput_weight=2,
+            delete_pod_rate=0.4,
+            fleet_replicas=2,
+            replica_loss_at=4,
+        ),
         Profile(
             name="replica_loss",
             nodes=9,

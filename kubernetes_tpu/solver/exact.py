@@ -313,7 +313,10 @@ def _mask_and_score(
     if w_image:
         score = score + w_image * tables["image_score"][cls]
     if use_extra_score:
-        # out-of-tree ScorePlugins, folded per class (weights pre-applied)
+        # out-of-tree ScorePlugins + the gang heterogeneity objective
+        # (gang/throughput.py's workload-class x accelerator-class
+        # effective-throughput term), folded per class with weights
+        # pre-applied — the kernel stays objective-agnostic
         score = score + tables["extra_score"][cls]
     if use_spread and w_spread and spread_soft:
         score = score + w_spread * sp.soft_scores(

@@ -365,6 +365,55 @@ class ClusterState:
         pod.resource_version = self._next_rv()
         self._emit("MODIFIED", "Pod", pod)
 
+    def bind_gang(
+        self,
+        bindings: "list[tuple[str, str, str]]",
+        fence: "tuple[str, int] | None" = None,
+    ) -> None:
+        """All-or-nothing bind of a pod group: ``bindings`` is a list
+        of (namespace, name, node_name). EVERY precondition — the
+        fencing token (checked once, the whole gang shares one commit
+        epoch), each pod's existence and unbound state, each target
+        node's existence, and the injected ``bind_fault`` hook per
+        pair — is validated BEFORE the first mutation, so a rejection
+        anywhere leaves the store byte-identical and no partial gang
+        can ever land. Models one transactional apiserver request (the
+        co-scheduler's PodGroup bind); the watch bus sees the same
+        per-pod MODIFIED events a sequence of single binds would
+        emit, in binding order."""
+        if fence is not None:
+            role, token = fence
+            if not self.fence_valid(role, token):
+                self.fence_rejections[role] = (
+                    self.fence_rejections.get(role, 0) + 1
+                )
+                raise ApiError(
+                    "Conflict",
+                    f"fenced: token {token} for role {role!r} is no "
+                    f"longer valid (current "
+                    f"{self._fences.get(role)}); the incarnation lost "
+                    "its lease or was superseded",
+                    fenced=True,
+                )
+        pods = []
+        for namespace, name, node_name in bindings:
+            pod = self.get_pod(namespace, name)
+            if pod.node_name:
+                raise ApiError(
+                    "Conflict",
+                    f"{pod.key} already bound to {pod.node_name}",
+                )
+            if node_name not in self._nodes:
+                raise ApiError("NotFound", f"node {node_name}")
+            if self.bind_fault is not None:
+                self.bind_fault(pod, node_name)
+            pods.append((pod, node_name))
+        # validation passed for the WHOLE gang: commit atomically
+        for pod, node_name in pods:
+            pod.node_name = node_name
+            pod.resource_version = self._next_rv()
+            self._emit("MODIFIED", "Pod", pod)
+
     def evict(
         self,
         namespace: str,

@@ -275,6 +275,32 @@ class PriorityQueue:
             out.append(info)
         return out
 
+    def take_for_gang(self, matches, exclude=frozenset()) -> list[QueuedPodInfo]:
+        """Pop every queued pod for which ``matches(pod)`` is true out
+        of the active/backoff/unschedulable structures, with exactly
+        ``pop_batch``'s per-pod bookkeeping (attempt charge +
+        scheduling-cycle advance). The scheduler's gang gate uses this
+        to pull the rest of a ready pod group into the batch
+        regardless of heap position or backoff state — a gang pops as
+        a UNIT. Gated pods stay put (their PreEnqueue gates have not
+        cleared, and a gang cannot be ready while a member is gated).
+        Heap entries for taken pods go stale and are skipped by the
+        lazy-deletion discipline every pop already applies."""
+        out: list[QueuedPodInfo] = []
+        for key in sorted(self._where):
+            if key in exclude or self._where.get(key) == "gated":
+                continue
+            info = self._info.get(key)
+            if info is None or not matches(info.pod):
+                continue
+            info.attempts += 1
+            self.scheduling_cycle += 1
+            self._unschedulable.pop(key, None)
+            self._unset_where(key)
+            del self._info[key]
+            out.append(info)
+        return out
+
     # -- failure / retry paths --
 
     def requeue_popped(self, info: QueuedPodInfo) -> None:

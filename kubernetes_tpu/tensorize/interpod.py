@@ -159,7 +159,13 @@ def build_interpod_tensors(
     padded_n: int,
     c_pad: int,
     hard_pod_affinity_weight: int = 1,
+    nominated: Sequence[tuple[Pod, int]] = (),
 ) -> InterpodTensors:
+    """``nominated`` carries (pod, node slot) pairs for unbound pods whose
+    ``status.nominatedNodeName`` resolved to a live slot: they fold into
+    ``in_cnt0`` and ``ex_cnt0`` exactly like placed pods (the
+    RunFilterPluginsWithNominatedPods convention), so both the incoming
+    terms and the symmetry direction see a nominated peer at its slot."""
     # ---- incoming terms per class ----
     in_terms: list[tuple[int, PodAffinityTerm, int, int]] = []  # (cls, term, kind, w)
     per_class: list[tuple[list[int], list[int], list[int]]] = []
@@ -194,6 +200,11 @@ def build_interpod_tensors(
 
     placed_pods: list[tuple[int, Pod]] = [
         (slot, p) for slot, ps in placed_by_slot.items() for p in ps
+    ]
+    # nominated pods count exactly like placed pods at their slot — both
+    # in the incoming count state and as existing-side term owners
+    placed_pods += [
+        (n_i, p) for p, n_i in nominated if 0 <= n_i < padded_n
     ]
     owner_map_placed: list[tuple[int, int]] = []  # (slot, ex_id)
     for slot, p in placed_pods:

@@ -89,13 +89,21 @@ def build_spread_tensors(
     c_pad: int,
     services: Sequence | None = None,
     defaulting: str = "System",
+    nominated: Sequence[tuple[Pod, int]] = (),
 ) -> SpreadTensors:
     """class_reps comes from the static tensorizer so all per-class tables
     share one class id space (xs carries class_of for the gather).
 
     ``services`` + ``defaulting`` feed PodTopologySpreadArgs.defaultingType
     =System: classes with no explicit constraints get the soft
-    zone/hostname system defaults when a service selects them."""
+    zone/hostname system defaults when a service selects them.
+
+    ``nominated`` carries (pod, node slot) pairs for unbound pods whose
+    ``status.nominatedNodeName`` resolved to a live slot: they count in
+    ``cnt0`` exactly like placed pods (the
+    RunFilterPluginsWithNominatedPods convention the synchronous filter
+    path already applies via the ports tensorizer) so a spread
+    constraint sees a nominated peer as occupying its slot."""
     # collect instances per class
     per_class: list[tuple[list, list]] = []  # (hard ECs, soft ECs)
     insts: list[tuple[int, osp.EffectiveConstraint, bool, Pod]] = []
@@ -193,6 +201,14 @@ def build_spread_tensors(
                 if p.namespace == rep.namespace
                 and osp._sel_matches(ec.selector, p.labels)
             )
+        for p, n_i in nominated:
+            # nominated-pod parity: count a matching nominated pod at
+            # its slot exactly like a placed pod
+            if 0 <= n_i < padded_n and (
+                p.namespace == rep.namespace
+                and osp._sel_matches(ec.selector, p.labels)
+            ):
+                cnt0[j, n_i] += 1
 
         for p_i, pod in enumerate(pods):
             placed_match[p_i, j] = pod.namespace == rep.namespace and (

@@ -218,6 +218,42 @@ echo "$reb_out" | grep -qE "over_budget=0" \
 echo "$reb_out" | grep -qE "pdb_overruns=0" \
     || { echo "REBALANCE SMOKE: an eviction violated a PDB"; exit 1; }
 
+echo "== gang smoke: all-or-nothing pod groups + heterogeneity =="
+# the gang profile mixes pod-group arrivals (sizes 2-3, heterogeneous
+# accelerator/workload classes feeding the effective-throughput
+# objective) with one deliberately SHORT gang (min-member one above
+# what ever arrives) under delete churn. The run's invariant layer
+# asserts no pod group is EVER partially bound (check_no_partial_gangs
+# after every drive) plus journal completeness through the
+# gang_incomplete/quarantined outcomes; the greps pin the machinery
+# engaging non-vacuously — >= 1 atomic gang commit, zero partial
+# gangs at finish, and the short gang quarantined as a unit.
+# --selfcheck proves the whole gate/round/commit pipeline
+# byte-deterministic. gang_crash kills the scheduler at the exact
+# assumed+staged-but-uncommitted window (crash between stage and
+# commit): the fresh incarnation's rollback must reassemble
+# half-staged gangs with zero partial binds. gang_replica_loss drives
+# the same arrivals through a 2-replica fleet (every member stages
+# through the fenced hub CAS) and kills one replica mid-drive — the
+# survivor re-owns the shard with the partial-gang invariant still
+# fleet-wide.
+gang_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 12 \
+    --profile gang --selfcheck)
+echo "$gang_out"
+echo "$gang_out" | grep -qE "gang: commits=[1-9]" \
+    || { echo "GANG SMOKE: no atomic gang commit ever landed"; exit 1; }
+echo "$gang_out" | grep -qE "partial_gangs=0 " \
+    || { echo "GANG SMOKE: a pod group was partially bound"; exit 1; }
+echo "$gang_out" | grep -qE "quarantined_gangs=[1-9]" \
+    || { echo "GANG SMOKE: the short gang was never quarantined"; exit 1; }
+python -m kubernetes_tpu.sim --seed 0 --cycles 12 --profile gang_crash \
+    --selfcheck
+gang_fleet=$(python -m kubernetes_tpu.sim --seed 0 --cycles 12 \
+    --profile gang_replica_loss --fleet 2 --selfcheck)
+echo "$gang_fleet"
+echo "$gang_fleet" | grep -qE "partial_gangs=0 " \
+    || { echo "GANG SMOKE: fleet replica loss left a partial gang"; exit 1; }
+
 echo "== fleet smoke: 2-replica sharded drive =="
 # two active replicas sharding one cluster (shard-filtered watches,
 # cross-shard occupancy exchange, handoff protocol) under the

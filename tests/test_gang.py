@@ -1,0 +1,572 @@
+"""Gang scheduling (kubernetes_tpu/gang): all-or-nothing pod groups
+end to end — tracker bookkeeping, config parsing, the atomic
+``bind_gang`` store commit, the scheduler's assembly gate
+(park / timeout / quarantine / TTL re-admit), the atomicity edges the
+ISSUE names (mid-gang fence discard, crash between stage and commit,
+cross-shard gangs under injected AdmitConflict), and the
+heterogeneity-aware effective-throughput objective."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu import metrics
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.gang import (
+    ACCEL_CLASS_LABEL,
+    GANG_LABEL,
+    MIN_MEMBER_ANNOTATION,
+    WORKLOAD_CLASS_LABEL,
+    GangConfig,
+    GangTracker,
+)
+from kubernetes_tpu.obs import ObsConfig
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ApiError, ClusterState
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def _ctr(c) -> float:
+    return c._value.get()  # prometheus_client internal, test-style read
+
+
+def _cluster(n_nodes=4, cpu="4", clock=None):
+    cs = ClusterState(clock=clock)
+    for i in range(n_nodes):
+        cs.create_node(
+            MakeNode()
+            .name(f"n{i}")
+            .capacity({"cpu": cpu, "memory": "8Gi", "pods": "20"})
+            .obj()
+        )
+    return cs
+
+
+def _member(name, group="train", min_member=3, cpu="1", wc=""):
+    b = (
+        MakePod()
+        .name(name)
+        .req({"cpu": cpu, "memory": "256Mi"})
+        .label(GANG_LABEL, group)
+        .annotation(MIN_MEMBER_ANNOTATION, str(min_member))
+    )
+    if wc:
+        b = b.label(WORKLOAD_CLASS_LABEL, wc)
+    return b.obj()
+
+
+def _cfg(**kw):
+    kw.setdefault("solver", ExactSolverConfig(tie_break="first"))
+    kw.setdefault("gang", GangConfig())
+    kw.setdefault("batch_size", 64)
+    return SchedulerConfig(**kw)
+
+
+def _outcomes(sched, key):
+    return [
+        r["outcome"]
+        for r in (json.loads(line) for line in sched.journal.lines)
+        if r["pod"] == key
+    ]
+
+
+# -- tracker -----------------------------------------------------------------
+
+
+def test_tracker_gang_of_and_min_member():
+    plain = MakePod().name("p").req({"cpu": "1"}).obj()
+    assert GangTracker.gang_of(plain) is None
+    m = _member("m", group="job-a", min_member=4)
+    assert GangTracker.gang_of(m) == "default/job-a"
+    assert GangTracker.min_member(m) == 4
+    # malformed / missing quorum degrades to a singleton gang, not a wedge
+    bad = (
+        MakePod().name("b").req({"cpu": "1"})
+        .label(GANG_LABEL, "g").annotation(MIN_MEMBER_ANNOTATION, "soon")
+        .obj()
+    )
+    assert GangTracker.min_member(bad) == 1
+    nolabel = (
+        MakePod().name("z").req({"cpu": "1"})
+        .annotation(MIN_MEMBER_ANNOTATION, "3").obj()
+    )
+    assert GangTracker.gang_of(nolabel) is None
+    zero = (
+        MakePod().name("zz").req({"cpu": "1"})
+        .label(GANG_LABEL, "g").annotation(MIN_MEMBER_ANNOTATION, "0")
+        .obj()
+    )
+    assert GangTracker.min_member(zero) == 1
+
+
+def test_tracker_round_bookkeeping():
+    t = GangTracker(GangConfig())
+    assert t.note_seen("default/g", 10.0) == 10.0
+    assert t.note_seen("default/g", 99.0) == 10.0  # first-seen sticks
+    assert t.incomplete_rounds("default/g") == 0
+    assert t.note_incomplete("default/g") == 1
+    assert t.note_incomplete("default/g") == 2
+    # a full commit resets failure state and returns the assembly start
+    assert t.note_complete("default/g") == 10.0
+    assert t.incomplete_rounds("default/g") == 0
+    assert t.first_seen("default/g") is None
+    # quarantine clears everything too: TTL re-admit starts fresh
+    t.note_seen("default/h", 5.0)
+    t.note_incomplete("default/h")
+    t.note_quarantined("default/h")
+    assert t.first_seen("default/h") is None
+    assert t.incomplete_rounds("default/h") == 0
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_gang_config_section_parses_and_wires():
+    from kubernetes_tpu.config import types as config_types
+
+    cfg = config_types.load(
+        {
+            "apiVersion": "kubescheduler.config.k8s.io/v1",
+            "kind": "KubeSchedulerConfiguration",
+            "gang": {
+                "enabled": True,
+                "minMemberTimeoutSeconds": 12.5,
+                "quarantineAfter": 2,
+                "throughputWeight": 5,
+                "classThroughput": {"transformer": {"tpu-v4": 1.0}},
+            },
+        }
+    )
+    assert cfg.gang.enabled
+    assert cfg.gang.min_member_timeout_seconds == 12.5
+    sched_cfg = config_types.scheduler_config(cfg)
+    assert isinstance(sched_cfg.gang, GangConfig)
+    assert sched_cfg.gang.quarantine_after == 2
+    assert sched_cfg.gang.class_throughput == {"transformer": {"tpu-v4": 1.0}}
+    # explicit nulls fall back to defaults (_nn), and a disabled (or
+    # absent) section wires no GangConfig at all
+    cfg2 = config_types.load(
+        {"gang": {"enabled": None, "quarantineAfter": None}}
+    )
+    assert not cfg2.gang.enabled
+    assert cfg2.gang.quarantine_after == 3
+    assert config_types.scheduler_config(cfg2).gang is None
+
+
+def test_gang_config_section_rejects_bad_values():
+    from kubernetes_tpu.config import types as config_types
+
+    with pytest.raises(ValueError, match="minMemberTimeoutSeconds"):
+        config_types.load({"gang": {"minMemberTimeoutSeconds": 0}})
+    with pytest.raises(ValueError, match="quarantineAfter"):
+        config_types.load({"gang": {"quarantineAfter": 0}})
+    with pytest.raises(ValueError, match="throughputWeight"):
+        config_types.load({"gang": {"throughputWeight": -1}})
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        config_types.load(
+            {
+                "gang": {
+                    "classThroughput": {"a": {"b": 1.0}},
+                    "classThroughputPath": "/tmp/t.json",
+                }
+            }
+        )
+    with pytest.raises(ValueError, match="classThroughput"):
+        config_types.load(
+            {"gang": {"classThroughput": {"a": {"b": -2.0}}}}
+        )
+
+
+def test_cli_config_dump_includes_gang_section(tmp_path, capsys):
+    import argparse
+
+    from kubernetes_tpu import cli
+
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        "gang:\n"
+        "  enabled: true\n"
+        "  quarantineAfter: 4\n"
+        "  classThroughput:\n"
+        "    resnet: {gpu-a100: 1.0}\n"
+    )
+    args = argparse.Namespace(config=str(p), feature_gates=None)
+    assert cli.cmd_config(args) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["gang"]["enabled"] is True
+    assert out["gang"]["quarantineAfter"] == 4
+    assert out["gang"]["classThroughputWorkloads"] == ["resnet"]
+
+
+# -- ClusterState.bind_gang --------------------------------------------------
+
+
+def test_bind_gang_validates_everything_before_mutating():
+    cs = _cluster(2)
+    for n in ("a", "b", "c"):
+        cs.create_pod(_member(n))
+    # a missing node anywhere in the gang binds NOTHING
+    with pytest.raises(ApiError, match="ghost"):
+        cs.bind_gang(
+            [
+                ("default", "a", "n0"),
+                ("default", "b", "ghost"),
+                ("default", "c", "n1"),
+            ]
+        )
+    assert all(p.node_name == "" for p in cs.list_pods())
+    # an already-bound member anywhere rejects the whole gang
+    cs.bind("default", "c", "n1")
+    rv_before = {p.key: p.resource_version for p in cs.list_pods()}
+    with pytest.raises(ApiError, match="already bound"):
+        cs.bind_gang(
+            [("default", "a", "n0"), ("default", "c", "n0")]
+        )
+    assert cs.get_pod("default", "a").node_name == ""
+    assert {
+        p.key: p.resource_version for p in cs.list_pods()
+    } == rv_before  # byte-identical store on rejection
+    # the clean path commits every member
+    cs.bind_gang([("default", "a", "n0"), ("default", "b", "n1")])
+    assert cs.get_pod("default", "a").node_name == "n0"
+    assert cs.get_pod("default", "b").node_name == "n1"
+
+
+def test_bind_gang_fence_rejection_binds_nothing():
+    cs = _cluster(2)
+    for n in ("a", "b"):
+        cs.create_pod(_member(n, min_member=2))
+    token = cs.grant_fence("sched", holder="inc-1")
+    cs.grant_fence("sched", holder="inc-2")  # revokes inc-1's token
+    with pytest.raises(ApiError) as ei:
+        cs.bind_gang(
+            [("default", "a", "n0"), ("default", "b", "n1")],
+            fence=("sched", token),
+        )
+    assert ei.value.fenced
+    assert all(p.node_name == "" for p in cs.list_pods())
+    assert cs.fence_rejections["sched"] == 1
+
+
+# -- scheduler gate: assembly, park, atomic commit ---------------------------
+
+
+def test_gang_parks_short_then_binds_atomically_when_assembled():
+    clock = FakeClock()
+    cs = _cluster(4, clock=clock)
+    sched = Scheduler(
+        cs,
+        _cfg(
+            obs=ObsConfig(journal=True),
+            gang=GangConfig(min_member_timeout=600.0),
+        ),
+        clock=clock,
+    )
+    commits0 = _ctr(metrics.gang_commits_total)
+    bound0 = _ctr(metrics.gang_bound_pods_total)
+    cs.create_pod(_member("m0"))
+    cs.create_pod(_member("m1"))
+    sched.run_until_settled()
+    # short of quorum: every present member parks, none binds
+    assert all(p.node_name == "" for p in cs.list_pods())
+    assert _outcomes(sched, "default/m0")[-1] == "gang_incomplete"
+    assert "2/3 members present" in json.loads(sched.journal.lines[-1])["reason"]
+    # the last member arrives: its pop drags the parked members out of
+    # the unschedulable store (take_for_gang) and the gang lands whole
+    cs.create_pod(_member("m2"))
+    results = sched.run_until_settled()
+    scheduled = [k for r in results for k, _ in r.scheduled]
+    assert sorted(scheduled) == ["default/m0", "default/m1", "default/m2"]
+    assert all(p.node_name for p in cs.list_pods())
+    assert _ctr(metrics.gang_commits_total) == commits0 + 1
+    assert _ctr(metrics.gang_bound_pods_total) == bound0 + 3
+    for m in ("m0", "m1", "m2"):
+        assert _outcomes(sched, f"default/{m}")[-1] == "bound"
+
+
+def test_gang_capacity_shortfall_releases_all_then_quarantines():
+    clock = FakeClock()
+    cs = _cluster(1, cpu="2", clock=clock)  # fits 2 of the 3 members
+    sched = Scheduler(
+        cs,
+        _cfg(
+            obs=ObsConfig(journal=True),
+            gang=GangConfig(quarantine_after=1, min_member_timeout=600.0),
+        ),
+        clock=clock,
+    )
+    quar0 = _ctr(metrics.gang_quarantined_total)
+    inc0 = _ctr(metrics.gang_incomplete_total)
+    for n in ("m0", "m1", "m2"):
+        cs.create_pod(_member(n))
+    res = sched.run_until_settled()
+    # the round released: placeable members rolled back with the
+    # unplaceable one — zero partial binds
+    assert all(p.node_name == "" for p in cs.list_pods())
+    released = [k for r in res for k in r.gang_released]
+    assert len(released) == 2
+    assert _ctr(metrics.gang_incomplete_total) == inc0 + 1
+    # the leftover flush re-pops the gang; one failed round is the
+    # configured limit, so the gate quarantines the WHOLE group
+    clock.advance(301.0)
+    sched.queue.flush_backoff_completed()
+    sched.run_until_settled()
+    assert all(p.node_name == "" for p in cs.list_pods())
+    assert _ctr(metrics.gang_quarantined_total) == quar0 + 1
+    for m in ("m0", "m1", "m2"):
+        assert _outcomes(sched, f"default/{m}")[-1] == "quarantined"
+    # out of every queue, parked in quarantine as a unit (pending still
+    # counts them: the drain loop must keep ticking toward the TTL)
+    assert sorted(sched._quarantine) == [
+        "default/m0", "default/m1", "default/m2",
+    ]
+    assert sched.pending == 3
+
+
+def test_gang_assembly_timeout_quarantines_and_ttl_readmit_completes():
+    clock = FakeClock()
+    cs = _cluster(4, clock=clock)
+    sched = Scheduler(
+        cs,
+        _cfg(
+            obs=ObsConfig(journal=True),
+            gang=GangConfig(min_member_timeout=5.0),
+        ),
+        clock=clock,
+    )
+    cs.create_pod(_member("m0"))
+    cs.create_pod(_member("m1"))
+    sched.run_until_settled()  # 2/3: parked inside the assembly window
+    clock.advance(301.0)  # past min_member_timeout; leftover flush fires
+    sched.queue.flush_backoff_completed()
+    sched.run_until_settled()
+    assert _outcomes(sched, "default/m0")[-1] == "quarantined"
+    assert _outcomes(sched, "default/m1")[-1] == "quarantined"
+    # the missing member finally arrives: alone it parks (1/3 present —
+    # quarantine cleared the gang's assembly clock, so it waits fresh)
+    cs.create_pod(_member("m2"))
+    sched.run_until_settled()
+    assert _outcomes(sched, "default/m2")[-1] == "gang_incomplete"
+    # TTL elapses: _release_quarantine re-admits the quarantined
+    # members, the gate reassembles the gang whole and it binds
+    clock.advance(61.0)  # past ResilienceConfig.quarantine_ttl (60s)
+    sched.queue.flush_backoff_completed()
+    sched.run_until_settled()
+    assert all(p.node_name for p in cs.list_pods())
+    for m in ("m0", "m1", "m2"):
+        assert _outcomes(sched, f"default/{m}")[-1] == "bound"
+
+
+# -- atomicity edges ---------------------------------------------------------
+
+
+def test_mid_gang_fence_revocation_binds_nothing():
+    clock = FakeClock()
+    cs = _cluster(4, clock=clock)
+    sched = Scheduler(
+        cs,
+        _cfg(obs=ObsConfig(journal=True), fence_role="sched"),
+        clock=clock,
+    )
+    fenced0 = _ctr(metrics.commit_fenced_total)
+    for n in ("m0", "m1", "m2"):
+        cs.create_pod(_member(n))
+    # the seam fires after every member staged but before the atomic
+    # commit — exactly where a superseding incarnation's fence grant
+    # lands in a real takeover
+    sched._pre_commit_hook = lambda pending: cs.grant_fence(
+        "sched", holder="usurper"
+    )
+    sched.schedule_batch()
+    assert all(p.node_name == "" for p in cs.list_pods())
+    assert _ctr(metrics.commit_fenced_total) == fenced0 + 1
+    for m in ("m0", "m1", "m2"):
+        o = _outcomes(sched, f"default/{m}")
+        assert o[-1] == "gang_incomplete"
+    assert cs.fence_rejections["sched"] >= 1
+
+
+def test_crash_between_stage_and_commit_recovers_whole_gang():
+    class _Crash(RuntimeError):
+        pass
+
+    clock = FakeClock()
+    cs = _cluster(4, clock=clock)
+    s1 = Scheduler(cs, _cfg(), clock=clock)
+
+    def _die(pending):
+        raise _Crash("killed between stage and commit")
+
+    s1._pre_commit_hook = _die
+    for n in ("m0", "m1", "m2"):
+        cs.create_pod(_member(n))
+    with pytest.raises(_Crash):
+        s1.schedule_batch()
+    # the crash window: members assumed + staged, NOTHING committed
+    assert all(p.node_name == "" for p in cs.list_pods())
+    # a fresh incarnation re-adopts the orphans and the gang binds whole
+    clock.advance(30.0)
+    s2 = Scheduler(cs, _cfg(incarnation=2), clock=clock)
+    s2.run_until_settled()
+    assert all(p.node_name for p in cs.list_pods())
+
+
+def test_restart_rolls_back_partially_bound_gang():
+    """A predecessor that died between a fleet stage and the gang
+    commit can leave a STRICT SUBSET bound in truth: the restart
+    recovery pass must evict the stranded members so the gang
+    reassembles atomically."""
+    clock = FakeClock()
+    cs = _cluster(4, clock=clock)
+    for n in ("m0", "m1", "m2"):
+        cs.create_pod(_member(n))
+    cs.bind("default", "m0", "n0")  # the wreck: 1/3 bound
+    s2 = Scheduler(cs, _cfg(incarnation=2), clock=clock)
+    # rollback ran inside _recover, before adoption: the stranded
+    # member is Pending again under its own identity
+    assert cs.get_pod("default", "m0").node_name == ""
+    s2.run_until_settled()
+    assert all(p.node_name for p in cs.list_pods())
+    # a COMPLETE gang at restart is legitimate occupancy — never touched
+    clock.advance(30.0)
+    s3 = Scheduler(cs, _cfg(incarnation=3), clock=clock)
+    assert all(p.node_name for p in cs.list_pods())
+    del s3
+
+
+def test_cross_shard_gang_admit_conflict_never_partially_binds():
+    """Fleet mode: every gang member stages through the hub's fenced
+    CAS; injected AdmitConflict on ANY member must fail the WHOLE
+    round (zero binds), and the gang lands whole once the hub heals."""
+    from kubernetes_tpu.fleet import (
+        AdmitConflict,
+        FleetConfig,
+        OccupancyExchange,
+    )
+
+    ZONE = "topology.kubernetes.io/zone"
+    clock = FakeClock()
+    cs = ClusterState(clock=clock)
+    for i in range(4):
+        cs.create_node(
+            MakeNode()
+            .name(f"n{i}")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"})
+            .label(ZONE, f"z{i % 2}")
+            .obj()
+        )
+    ex = OccupancyExchange()
+    gang_cfg = GangConfig(quarantine_after=99, min_member_timeout=1e6)
+    scheds = [
+        Scheduler(
+            cs,
+            SchedulerConfig(
+                batch_size=16,
+                mesh_devices=1,
+                solver=ExactSolverConfig(tie_break="first"),
+                gang=gang_cfg,
+                fleet=FleetConfig(
+                    replica=rid,
+                    replicas=("r0", "r1"),
+                    exchange=ex,
+                    # this test exercises CAS conflicts, not staleness:
+                    # keep the 301s leftover-flush advances below from
+                    # tripping the conservative-admission bound
+                    max_row_age_s=1e6,
+                ),
+            ),
+            clock=clock,
+        )
+        for rid in ("r0", "r1")
+    ]
+    orig_cas = ex.compare_and_stage
+    calls = {"n": 0}
+
+    def _conflict(*a, **kw):
+        calls["n"] += 1
+        raise AdmitConflict("injected CAS contention")
+
+    ex.compare_and_stage = _conflict
+    for n in ("m0", "m1"):
+        cs.create_pod(_member(n, min_member=2))
+
+    def _drive():
+        for s in scheds:
+            s.run_until_settled()
+        bound = [p for p in cs.list_pods() if p.node_name]
+        assert len(bound) in (0, 2), f"partial gang bound: {bound}"
+        return len(bound)
+
+    for _ in range(3):
+        assert _drive() == 0  # every round: whole-gang release, 0 binds
+        clock.advance(301.0)
+        for s in scheds:
+            s.queue.flush_backoff_completed()
+    assert calls["n"] > 0  # the CAS seam actually gated the rounds
+    ex.compare_and_stage = orig_cas  # hub heals
+    for _ in range(3):
+        if _drive() == 2:
+            break
+        clock.advance(301.0)
+        for s in scheds:
+            s.queue.flush_backoff_completed()
+    assert all(p.node_name for p in cs.list_pods())
+
+
+# -- heterogeneity objective -------------------------------------------------
+
+
+def test_throughput_objective_steers_gang_to_fast_accelerator():
+    clock = FakeClock()
+    cs = ClusterState(clock=clock)
+    # identical capacity; the slow class sorts FIRST so the default
+    # first-tiebreak would pick it without the objective
+    for name, accel in (("n0", "gpu-a100"), ("n1", "tpu-v4")):
+        cs.create_node(
+            MakeNode()
+            .name(name)
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"})
+            .label(ACCEL_CLASS_LABEL, accel)
+            .obj()
+        )
+    table = {"transformer": {"tpu-v4": 1.0, "gpu-a100": 0.25}}
+    sched = Scheduler(
+        cs,
+        _cfg(
+            gang=GangConfig(
+                throughput_weight=100, class_throughput=table
+            )
+        ),
+        clock=clock,
+    )
+    for n in ("m0", "m1"):
+        cs.create_pod(_member(n, min_member=2, wc="transformer"))
+    sched.run_until_settled()
+    assert {p.node_name for p in cs.list_pods()} == {"n1"}
+
+
+def test_throughput_objective_off_without_weight():
+    clock = FakeClock()
+    cs = ClusterState(clock=clock)
+    for name, accel in (("n0", "gpu-a100"), ("n1", "tpu-v4")):
+        cs.create_node(
+            MakeNode()
+            .name(name)
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "20"})
+            .label(ACCEL_CLASS_LABEL, accel)
+            .obj()
+        )
+    table = {"transformer": {"tpu-v4": 1.0, "gpu-a100": 0.25}}
+    sched = Scheduler(
+        cs,
+        _cfg(
+            gang=GangConfig(throughput_weight=0, class_throughput=table)
+        ),
+        clock=clock,
+    )
+    for n in ("m0", "m1"):
+        cs.create_pod(_member(n, min_member=2, wc="transformer"))
+    sched.run_until_settled()
+    # weight 0 = objective off: both nodes score equal, packing wins
+    assert all(p.node_name for p in cs.list_pods())

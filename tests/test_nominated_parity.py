@@ -1,0 +1,163 @@
+"""Nominated-pod parity for the spread/interpod tensorizers
+(RunFilterPluginsWithNominatedPods): an unbound pod whose
+``status.nominatedNodeName`` resolved to a live slot must fold into
+the occupancy state EXACTLY like a placed pod at that slot — and a
+batch pod must never count its OWN nomination as a standing peer
+(the scheduler's nom_peers self-exclusion)."""
+
+import numpy as np
+
+from kubernetes_tpu.api.wrappers import MakeNode, MakePod
+from kubernetes_tpu.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.solver.exact import ExactSolverConfig
+from kubernetes_tpu.state.cluster import ClusterState
+from kubernetes_tpu.tensorize.interpod import build_interpod_tensors
+from kubernetes_tpu.tensorize.plugins import build_static_tensors
+from kubernetes_tpu.tensorize.schema import (
+    ResourceVocab,
+    build_node_batch,
+    build_pod_batch,
+)
+from kubernetes_tpu.tensorize.spread import build_spread_tensors
+
+
+def _zone_nodes(n=4, zones=2):
+    return [
+        MakeNode()
+        .name(f"node-{i:03}")
+        .capacity({"cpu": "8", "memory": "32Gi", "pods": "50"})
+        .label("zone", f"z{i % zones}")
+        .label("kubernetes.io/hostname", f"node-{i:03}")
+        .obj()
+        for i in range(n)
+    ]
+
+
+def _spread_pod(name):
+    return (
+        MakePod()
+        .name(name)
+        .label("app", "web")
+        .req({"cpu": "100m"})
+        .spread_constraint(1, "zone", "DoNotSchedule", match_labels={"app": "web"})
+        .obj()
+    )
+
+
+def _build(builder, nodes, pods, peer, slot, as_nominated):
+    vocab = ResourceVocab.build(pods + [peer], nodes)
+    nbatch = build_node_batch(nodes, {}, vocab=vocab)
+    pbatch = build_pod_batch(pods, vocab)
+    slot_nodes = list(nodes) + [None] * (nbatch.padded - len(nodes))
+    static = build_static_tensors(pods, pbatch, slot_nodes, nbatch.padded)
+    placed_by_slot = {} if as_nominated else {slot: [peer]}
+    nominated = [(peer, slot)] if as_nominated else []
+    return builder(
+        pods, static.reps, pbatch, slot_nodes,
+        placed_by_slot, nbatch.padded, static.c_pad,
+        nominated=nominated,
+    )
+
+
+def test_spread_counts_nominated_peer_like_placed():
+    nodes = _zone_nodes()
+    pods = [_spread_pod("p0")]
+    peer = _spread_pod("peer")
+    placed = _build(build_spread_tensors, nodes, pods, peer, 0, False)
+    nom = _build(build_spread_tensors, nodes, pods, peer, 0, True)
+    assert np.array_equal(placed.cnt0, nom.cnt0)
+    assert placed.cnt0[:, 0].max() == 1  # the peer actually counted
+
+
+def test_spread_ignores_nominated_peer_at_dead_slot():
+    nodes = _zone_nodes()
+    pods = [_spread_pod("p0")]
+    peer = _spread_pod("peer")
+    nom = _build(build_spread_tensors, nodes, pods, peer, 999, True)
+    assert nom.cnt0.max() == 0
+
+
+def _anti_pod(name):
+    return (
+        MakePod()
+        .name(name)
+        .label("app", "anti")
+        .req({"cpu": "100m"})
+        .pod_anti_affinity("kubernetes.io/hostname", {"app": "anti"})
+        .obj()
+    )
+
+
+def test_interpod_counts_nominated_peer_like_placed():
+    nodes = _zone_nodes()
+    pods = [_anti_pod("p0")]
+    peer = _anti_pod("peer")
+    placed = _build(build_interpod_tensors, nodes, pods, peer, 1, False)
+    nom = _build(build_interpod_tensors, nodes, pods, peer, 1, True)
+    # the nominated peer feeds both directions exactly like a placed
+    # one: the incoming count state AND the existing-side term owners
+    assert np.array_equal(placed.in_cnt0, nom.in_cnt0)
+    assert np.array_equal(placed.ex_cnt0, nom.ex_cnt0)
+    assert placed.in_cnt0[:, 1].max() == 1
+
+
+def test_batch_pod_does_not_see_its_own_nomination():
+    """A hard-anti pod nominated to a node is itself IN the batch: if
+    its nomination counted as a standing peer it would anti-affine
+    against itself and park forever. The scheduler's nom_peers
+    filtering must let it land on its nominated node."""
+    cs = ClusterState()
+    for n in _zone_nodes(2):
+        cs.create_node(n)
+    pod = (
+        MakePod()
+        .name("self")
+        .label("app", "anti")
+        .req({"cpu": "100m"})
+        .pod_anti_affinity("kubernetes.io/hostname", {"app": "anti"})
+        .nominated_node_name("node-000")
+        .obj()
+    )
+    cs.create_pod(pod)
+    sched = Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=16, solver=ExactSolverConfig(tie_break="first")
+        ),
+    )
+    sched.run_until_settled()
+    assert cs.get_pod("default", "self").node_name == "node-000"
+
+
+def test_nominated_peer_blocks_spread_slot_like_placed_peer():
+    """End to end: an unbound nominated spread peer must steer a
+    same-cohort batch pod away from its zone exactly as a bound peer
+    would (host-side fold, device-side filter)."""
+    cs = ClusterState()
+    for n in _zone_nodes(2, zones=2):  # node-000 -> z0, node-001 -> z1
+        cs.create_node(n)
+    # the nominated peer occupies z0 without being bound: a FOREIGN
+    # scheduler's pod, so it is pure nomination state here — never
+    # popped into our batch, never bound by us
+    cs.create_pod(
+        MakePod()
+        .name("peer")
+        .label("app", "web")
+        .req({"cpu": "100m"})
+        .priority(10)
+        .scheduler_name("other-scheduler")
+        .nominated_node_name("node-000")
+        .obj()
+    )
+    cs.create_pod(_spread_pod("mover"))
+    sched = Scheduler(
+        cs,
+        SchedulerConfig(
+            batch_size=16, solver=ExactSolverConfig(tie_break="first")
+        ),
+    )
+    sched.schedule_batch()
+    mover = cs.get_pod("default", "mover")
+    # z0 holds the nominated peer (count 1), z1 empty: maxSkew=1 lets
+    # either zone pass, but the spread SCORE prefers the empty domain
+    assert mover.node_name == "node-001"

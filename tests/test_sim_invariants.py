@@ -573,3 +573,73 @@ def test_merged_last_outcomes_last_incarnation_wins():
     merged = merged_last_outcomes([inc1, inc2])
     assert merged["default/a"]["outcome"] == "recovered"
     assert merged["default/b"]["outcome"] == "bound"
+
+
+# -- gang (no partial binds) -------------------------------------------------
+
+
+def _gang_pod(name, group="train", min_member=3):
+    from kubernetes_tpu.gang import GANG_LABEL, MIN_MEMBER_ANNOTATION
+
+    return (
+        MakePod()
+        .name(name)
+        .req({"cpu": "1", "memory": "1Gi"})
+        .label(GANG_LABEL, group)
+        .annotation(MIN_MEMBER_ANNOTATION, str(min_member))
+        .obj()
+    )
+
+
+def test_gang_flags_partially_bound_group():
+    from kubernetes_tpu.sim.invariants import check_no_partial_gangs
+
+    cs = _cluster()
+    for n in ("m0", "m1", "m2"):
+        cs.create_pod(_gang_pod(n))
+    # forge the wreck a non-atomic commit would leave: 1/3 bound
+    cs.bind("default", "m0", "n0")
+    violations = []
+    check_no_partial_gangs(cs, 3, violations)
+    assert [v.invariant for v in violations] == ["gang"]
+    assert "default/train" in violations[0].detail
+    assert "default/m0" in violations[0].detail  # names the bound side
+    assert "default/m1" in violations[0].detail  # and the pending side
+
+
+def test_gang_clean_when_fully_bound_or_fully_pending():
+    from kubernetes_tpu.sim.invariants import check_no_partial_gangs
+
+    cs = _cluster()
+    violations = []
+    # all pending: fine (mid-assembly)
+    for n in ("m0", "m1", "m2"):
+        cs.create_pod(_gang_pod(n))
+    check_no_partial_gangs(cs, 0, violations)
+    assert violations == []
+    # all bound: fine (the atomic commit landed)
+    for i, n in enumerate(("m0", "m1", "m2")):
+        cs.bind("default", n, f"n{i % 2}")
+    check_no_partial_gangs(cs, 1, violations)
+    assert violations == []
+    # two independent gangs, each internally consistent: still clean
+    for n in ("x0", "x1"):
+        cs.create_pod(_gang_pod(n, group="other", min_member=2))
+    check_no_partial_gangs(cs, 2, violations)
+    assert violations == []
+
+
+def test_gang_delete_churn_cannot_fake_violation():
+    from kubernetes_tpu.sim.invariants import check_no_partial_gangs
+
+    cs = _cluster()
+    for n in ("m0", "m1"):
+        cs.create_pod(_gang_pod(n, min_member=2))
+    cs.bind("default", "m0", "n0")
+    cs.bind("default", "m1", "n1")
+    # delete churn removes one bound member: the survivor is all-bound,
+    # not a partial gang
+    cs.delete_pod("default", "m0")
+    violations = []
+    check_no_partial_gangs(cs, 5, violations)
+    assert violations == []
