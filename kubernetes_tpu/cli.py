@@ -127,12 +127,19 @@ def cmd_serve(args) -> int:
     cluster = ClusterState()
     sched_cfg = config_types.scheduler_config(cfg)
     sched_cfg.feature_gates = _feature_gates(args)
-    if args.obs or args.obs_journal or args.obs_dump or args.slo:
-        from .obs import ObsConfig, SloConfig
+    telemetry_on = bool(args.telemetry or args.bundle_dir)
+    if (
+        args.obs or args.obs_journal or args.obs_dump or args.slo
+        or telemetry_on
+    ):
+        from .obs import ObsConfig, SentinelConfig, SloConfig
 
         sched_cfg.obs = ObsConfig(
             spans=bool(args.obs or args.obs_journal or args.obs_dump),
-            journal=bool(args.obs or args.obs_journal or args.obs_dump),
+            journal=bool(
+                args.obs or args.obs_journal or args.obs_dump
+                or telemetry_on
+            ),
             journal_path=args.obs_journal,
             dump_path=args.obs_dump,
             # a serving process runs indefinitely: bound the in-memory
@@ -140,12 +147,21 @@ def cmd_serve(args) -> int:
             journal_capacity=65536,
             # live SLO engine (GET /debug/slo + scheduler_slo_*):
             # --slo OBJECTIVE enables it with that per-pod latency
-            # objective in seconds
+            # objective in seconds. --telemetry implies it: the
+            # sentinel's p99 signal reads off the SLO engine.
             slo=(
                 SloConfig(latency_objective_s=args.slo)
                 if args.slo
-                else None
+                else (SloConfig() if telemetry_on else None)
             ),
+            # always-on flight telemetry (GET /debug/profile +
+            # scheduler_profile_* / scheduler_anomaly_*): continuous
+            # per-stage profiler, anomaly sentinel with production-
+            # sized windows, capture-on-anomaly replay bundles under
+            # --bundle-dir (which implies --telemetry)
+            profile=telemetry_on,
+            sentinel=SentinelConfig() if telemetry_on else None,
+            bundle_dir=args.bundle_dir,
         )
     if args.leader_elect:
         # client-go leaderelection.RunOrDie semantics over the state
@@ -328,6 +344,21 @@ def main(argv: list[str] | None = None) -> int:
         "objective (first-enqueue -> bind): sliding-window p50/p99, "
         "bind throughput, multi-window error-budget burn — served at "
         "GET /debug/slo and exported as scheduler_slo_*",
+    )
+    p_serve.add_argument(
+        "--telemetry",
+        action="store_true",
+        help="enable always-on flight telemetry (kubernetes_tpu/obs): "
+        "continuous per-stage profiler + anomaly sentinel (implies the "
+        "SLO engine for the p99 signal), served at GET /debug/profile "
+        "and exported as scheduler_profile_* / scheduler_anomaly_*",
+    )
+    p_serve.add_argument(
+        "--bundle-dir",
+        metavar="DIR",
+        help="write capture-on-anomaly replay bundles into this "
+        "directory (implies --telemetry); replay offline with "
+        "`python -m kubernetes_tpu.obs replay <bundle>`",
     )
     p_serve.set_defaults(fn=cmd_serve)
 

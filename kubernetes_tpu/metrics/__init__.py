@@ -545,7 +545,7 @@ journal_records_total = Counter(
     "Per-pod decision-journal records written, by outcome "
     "(bound|unschedulable|bind_failure|permit_wait|permit_rejected|"
     "permit_timeout|discarded|solver_error|quarantined|recovered|"
-    "evicted_for_rebalance|gang_incomplete).",
+    "evicted_for_rebalance|gang_incomplete|telemetry_anomaly).",
     ["outcome"],
     registry=REGISTRY,
 )
@@ -553,6 +553,40 @@ flight_recorder_dumps_total = Counter(
     "scheduler_tpu_flight_recorder_dumps_total",
     "Flight-recorder ring dumps, by trigger "
     "(crash|invariant|manual|breaker).",
+    ["trigger"],
+    registry=REGISTRY,
+)
+
+# -- flight telemetry (kubernetes_tpu/obs/{profile,sentinel,bundle}) --
+
+profile_stage_seconds = Counter(
+    "scheduler_profile_stage_seconds",
+    "Cumulative wall seconds attributed to each batch stage by the "
+    "continuous per-stage profiler, by stage (tensorize|dispatch|"
+    "fence_wait|deferred_read|validate|apply|bind). Assembled "
+    "host-side from seams the loops already time — zero new device "
+    "syncs; rate() it for the live stage mix.",
+    ["stage"],
+    registry=REGISTRY,
+)
+anomaly_total = Counter(
+    "scheduler_anomaly_total",
+    "Anomalies fired by the telemetry sentinel's multi-window "
+    "regression rules, by signal (pods_per_sec|p99_latency_s|"
+    "chain_fraction|discard_rate|cas_conflict_rate|"
+    "gang_incomplete_rate|breaker). Each firing also journals a "
+    "telemetry_anomaly record and arms a capture-on-anomaly replay "
+    "bundle.",
+    ["signal"],
+    registry=REGISTRY,
+)
+telemetry_bundles_total = Counter(
+    "scheduler_telemetry_bundles_total",
+    "Capture-on-anomaly replay-bundle capture events, by trigger "
+    "(sentinel|breaker|quarantine|invariant|manual). Counts the "
+    "capture decision; whether a bundle directory was written "
+    "additionally depends on a configured bundle dir and the "
+    "per-process bundle budget.",
     ["trigger"],
     registry=REGISTRY,
 )
@@ -736,7 +770,7 @@ sim_invariant_violations_total = Counter(
     "Invariant violations the simulator's checkers flagged, by "
     "invariant (double_bind|capacity|lost_pod|progress|monotonic|"
     "constraint|journal|global_overcommit|resilience|recovery|"
-    "fencing|rebalance|tuning|no_partial_gang_ever_bound).",
+    "fencing|rebalance|tuning|no_partial_gang_ever_bound|telemetry).",
     ["invariant"],
     registry=REGISTRY,
 )

@@ -20,6 +20,13 @@
     # schema-check a journal / dump (the CI obs smoke)
     python -m kubernetes_tpu.obs validate journal.jsonl
 
+    # live per-stage profile + anomaly sentinel (serve --telemetry)
+    python -m kubernetes_tpu.obs top --url http://127.0.0.1:10259
+
+    # re-execute a capture-on-anomaly bundle, assert bit-identical
+    # assignments (the CI telemetry smoke)
+    python -m kubernetes_tpu.obs replay /var/bundles/bundle-00000-sentinel
+
 Exit status: 0 found/valid; 1 pod not found or schema errors; 2 usage.
 """
 
@@ -90,10 +97,59 @@ def cmd_validate(args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    from .profile import render_top
+
+    import json
+
+    if args.snapshot:
+        doc = json.loads(Path(args.snapshot).read_text())
+    else:
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/debug/profile"
+        if args.capture:
+            url += "?capture=1"
+        try:
+            with urllib.request.urlopen(url, timeout=10.0) as r:
+                doc = json.loads(r.read().decode())
+        except OSError as e:
+            print(f"error: {url}: {e}", file=sys.stderr)
+            return 1
+    if doc.get("error"):
+        print(f"error: {doc['error']}", file=sys.stderr)
+        return 1
+    print(render_top(doc))
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Re-execute a captured bundle's solve offline and compare
+    against the recorded assignments. Exit 0 = bit-identical, 1 =
+    diverged (the forensic artifact lies — a real bug), 3 = the solve
+    was structurally non-replayable standalone (chained/split)."""
+    from .bundle import replay_bundle
+
+    try:
+        rep = replay_bundle(args.bundle)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"error: {args.bundle}: {e}", file=sys.stderr)
+        return 1
+    print(
+        f"{args.bundle}: replayable={rep['replayable']} "
+        f"pods={rep['pods']} parts={rep['parts']}"
+    )
+    print(f"  {rep['detail']}")
+    if not rep["replayable"]:
+        return 3
+    return 0 if rep["ok"] else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m kubernetes_tpu.obs",
-        description="Scheduling-trace tools: explain pods, validate traces.",
+        description="Scheduling-trace tools: explain pods, validate "
+        "traces, watch the live stage profile, replay capture bundles.",
     )
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -131,6 +187,38 @@ def main(argv=None) -> int:
     )
     p_val.add_argument("trace", metavar="FILE")
     p_val.set_defaults(fn=cmd_validate)
+
+    p_top = sub.add_parser(
+        "top",
+        help="render a live scheduler's per-stage profile + sentinel "
+        "state (reads GET /debug/profile; serve --telemetry)",
+    )
+    p_top.add_argument(
+        "--url", metavar="URL", default="http://127.0.0.1:10259",
+        help="base URL of a live scheduler (default %(default)s)",
+    )
+    p_top.add_argument(
+        "--snapshot", metavar="FILE",
+        help="render a saved /debug/profile JSON document instead of "
+        "fetching one (offline forensics)",
+    )
+    p_top.add_argument(
+        "--capture", action="store_true",
+        help="also trigger a manual replay-bundle capture (?capture=1)",
+    )
+    p_top.set_defaults(fn=cmd_top)
+
+    p_replay = sub.add_parser(
+        "replay",
+        help="re-execute a capture-on-anomaly bundle offline and "
+        "assert bit-identical assignments (exit 0 identical, 1 "
+        "diverged, 3 not standalone-replayable)",
+    )
+    p_replay.add_argument(
+        "bundle", metavar="DIR",
+        help="bundle directory (bundle-NNNNN-<trigger>/)",
+    )
+    p_replay.set_defaults(fn=cmd_replay)
 
     args = parser.parse_args(argv)
     return args.fn(args)

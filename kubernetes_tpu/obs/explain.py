@@ -22,9 +22,19 @@ reads as ONE trace even though it crossed processes.
 from __future__ import annotations
 
 import json
+import re
 from dataclasses import dataclass, field
 
 from .journal import TERMINAL_OUTCOMES, fleet_merge_key, summarize_plugins
+
+# gang-record reason shapes (scheduler.py _gang_gate / _release_gang_round
+# / _quarantine_gang write these verbatim — the parse below is the read
+# side of that contract)
+_GANG_PARK = re.compile(
+    r"waiting for pod group (?P<gid>\S+): "
+    r"(?P<have>\d+)/(?P<need>\d+) members present"
+)
+_GANG_GID = re.compile(r"pod group (?P<gid>[^\s:]+)")
 
 
 @dataclass
@@ -60,6 +70,56 @@ class Explanation:
             if t and t not in seen:
                 seen.append(t)
         return seen
+
+    @property
+    def gang_events(self) -> list[dict]:
+        """The pod's gang assembly chain, reconstructed from its
+        ``gang_incomplete`` / gang-quarantine records: per round, the
+        pod group id, how many of N members were present (parked
+        rounds), which member's failure released a staged round, and
+        the quarantine verdict. Empty for non-gang pods."""
+        events: list[dict] = []
+        for rec in self.records:
+            outcome = rec.get("outcome", "")
+            reason = rec.get("reason", "")
+            if outcome == "gang_incomplete":
+                park = _GANG_PARK.search(reason)
+                if park:
+                    events.append(
+                        {
+                            "kind": "parked",
+                            "step": rec.get("step"),
+                            "gid": park.group("gid"),
+                            "have": int(park.group("have")),
+                            "need": int(park.group("need")),
+                        }
+                    )
+                    continue
+                kind = "released"
+                if reason.startswith("gang quarantined:"):
+                    kind = "quarantine_release"
+                elif reason.startswith("gang bind failed:"):
+                    kind = "bind_failed"
+                gid = _GANG_GID.search(reason)
+                events.append(
+                    {
+                        "kind": kind,
+                        "step": rec.get("step"),
+                        "gid": gid.group("gid") if gid else "",
+                        "reason": reason,
+                    }
+                )
+            elif outcome == "quarantined" and "pod group" in reason:
+                gid = _GANG_GID.search(reason)
+                events.append(
+                    {
+                        "kind": "quarantined",
+                        "step": rec.get("step"),
+                        "gid": gid.group("gid") if gid else "",
+                        "reason": reason,
+                    }
+                )
+        return events
 
     @property
     def terminal(self) -> dict | None:
@@ -111,6 +171,31 @@ class Explanation:
                 lines.append(f"    plugins: {summarize_plugins(term['plugins'])}")
             if term.get("reason"):
                 lines.append(f"    reason: {term['reason']}")
+        gang = self.gang_events
+        if gang:
+            gid = next((e["gid"] for e in gang if e["gid"]), "?")
+            lines.append(f"  gang assembly (pod group {gid}):")
+            for e in gang:
+                if e["kind"] == "parked":
+                    lines.append(
+                        f"    step {e['step']}: parked — "
+                        f"{e['have']}/{e['need']} members present"
+                    )
+                elif e["kind"] == "quarantined":
+                    lines.append(
+                        f"    step {e['step']}: quarantined — {e['reason']}"
+                    )
+                else:
+                    verb = {
+                        "released": "round released",
+                        "bind_failed": "atomic bind failed, round released",
+                        "quarantine_release": (
+                            "staged round rolled back for quarantine"
+                        ),
+                    }[e["kind"]]
+                    lines.append(
+                        f"    step {e['step']}: {verb} — {e['reason']}"
+                    )
         lines.append("  history:")
         for rec in self.records:
             bits = [

@@ -79,6 +79,12 @@ OUTCOMES = frozenset(
         # rolled back and the gang requeued. Non-terminal: the gang
         # retries as a unit (a partial gang is never bound).
         "gang_incomplete",
+        # the telemetry sentinel fired an anomaly (flight telemetry
+        # tentpole): the "pod" is the synthetic `telemetry/<signal>`
+        # carrier, never a cluster pod, so completeness invariants —
+        # which iterate real pods — ignore it. Non-terminal and
+        # non-retiring by construction (there is no journey to retire).
+        "telemetry_anomaly",
     }
 )
 # a pod whose LAST journal record is one of these has a settled fate for

@@ -488,6 +488,20 @@ class Scheduler:
         # and neither may clear the flag while the other still holds it
         self._breaker_degraded = False
         self._slo_degraded = False
+        # flight telemetry (obs/{profile,timeseries,sentinel,bundle}):
+        # continuous per-stage profiler + anomaly sentinel + capture-
+        # on-anomaly replay bundles, one coordinator ticked from the
+        # commit seam. None = off (the production default) — the hot
+        # path then pays a single attribute check per seam.
+        from .obs import build_telemetry
+
+        self.telemetry = build_telemetry(
+            self.config.obs,
+            self.clock,
+            journal=self.journal,
+            recorder=self.flight,
+        )
+        self._sentinel_degraded = False
         # high-volume span-family sampling state (see _on_event and
         # _commit_all): deterministic counters, first occurrence
         # always sampled
@@ -767,6 +781,13 @@ class Scheduler:
             name: ExactSolver(cfg) for name, cfg in profile_cfgs.items()
         }
         self.solver = next(iter(self.solvers.values()))
+        if self.telemetry is not None and self.telemetry.bundles is not None:
+            # telemetry input-snapshot hook: every profile solver hands
+            # its resolved solve inputs to the bundle capturer (the
+            # capturer only retains them for batches the scheduler
+            # armed, so host-tier/bisection solves don't capture)
+            for s in self.solvers.values():
+                s.capture_hook = self.telemetry.bundles.on_solve_input
         self.preemptor = PreemptionEvaluator()
 
         # nominated-pod index (the reference's nominator map): unbound pods
@@ -1037,6 +1058,10 @@ class Scheduler:
         SLO-degraded replica stays flagged even while its breakers are
         closed."""
         self._breaker_degraded = degraded
+        if degraded and self.telemetry is not None:
+            # forensic capture at the trip: the batch that tripped the
+            # breaker is the newest complete solve record
+            self.telemetry.capture("breaker")
         self._publish_degraded()
 
     def _on_slo_health(self, healthy: bool) -> None:
@@ -1053,7 +1078,9 @@ class Scheduler:
     def _publish_degraded(self) -> None:
         if self.fleet is not None:
             self.fleet.set_solver_degraded(
-                self._breaker_degraded or self._slo_degraded
+                self._breaker_degraded
+                or self._slo_degraded
+                or self._sentinel_degraded
             )
 
     def reacquire_fence(self) -> None:
@@ -1570,6 +1597,7 @@ class Scheduler:
             # kills the process (sim/harness.py crash_restart)
             hook(hook_pending)
         first_err = None
+        bind_wall = 0.0
         for entry in pending:
             tb = self.clock.perf()
             # bind spans are 1-in-N sampled (ObsConfig.bind_span_
@@ -1610,9 +1638,11 @@ class Scheduler:
                                 attempts=info.attempts,
                             )
                 bsp.set(ok=ok)
+            bind_dur = self.clock.perf() - tb
+            bind_wall += bind_dur
             metrics.framework_extension_point_duration_seconds.labels(
                 "Bind", "Success" if ok else "Error", "all"
-            ).observe(self.clock.perf() - tb)
+            ).observe(bind_dur)
         for gid, rd in gang_ready:
             # one atomic all-or-nothing commit per complete gang round
             try:
@@ -1648,6 +1678,19 @@ class Scheduler:
             # over numbers this batch already materialized; zero new
             # device syncs (the CounterWindow sampling discipline).
             self.slo.observe_batch(res)
+        if self.telemetry is not None and (infos or pending):
+            # flight-telemetry tick, same post-commit chokepoint as the
+            # SLO engine: close the batch's stage ledger (the bind wall
+            # just measured is the last stage) and, at window
+            # boundaries, run the sentinel's regression rules. All
+            # host arithmetic; anomalies journal + capture here.
+            self.telemetry.add_stage("bind", bind_wall)
+            self.telemetry.observe_batch(
+                self, step=self._trace_step, pods=len(pending)
+            )
+            if self._sentinel_degraded != self.telemetry.degraded:
+                self._sentinel_degraded = self.telemetry.degraded
+                self._publish_degraded()
         if first_err is not None:
             raise first_err
 
@@ -2311,6 +2354,10 @@ class Scheduler:
         metrics.gang_quarantined_total.inc()
         if self._gang is not None:
             self._gang.note_quarantined(gid)
+        if self.telemetry is not None:
+            # forensic capture: the batch whose solve failure
+            # quarantined the gang is the newest complete record
+            self.telemetry.capture("quarantine", note=f"gang {gid}: {exc!r}")
         self._log.warning(
             "pod group %s quarantined whole (%d member(s)): %r",
             gid, len(members), exc, extra={"step": self._trace_step},
@@ -2957,6 +3004,10 @@ class Scheduler:
             f"{prep.profile}:p{prep.pbatch.padded}xn{prep.batch.padded}"
             f":split{split}:{tier_name}"
         )
+        if self.telemetry is not None and self.telemetry.bundles is not None:
+            # telemetry capture arm: the solver's capture_hook payload
+            # that fires inside solve() below belongs to this batch step
+            self.telemetry.bundles.arm(prep.step, prep.profile)
         with self.obs.span(
             "dispatch", trace_id=prep.step, profile=prep.profile,
             defer=defer, healed=heal_stale, split=split,
@@ -2983,9 +3034,15 @@ class Scheduler:
                     xla_compile_s=round(compile_s, 6),
                 )
         dispatch_dt = self.clock.perf() - t1
+        if self.telemetry is not None:
+            self.telemetry.add_stage("dispatch", dispatch_dt)
         if not prep.timing_observed:
             prep.timing_observed = True
             prep.tensorize_seconds = max(t1 - prep.gs, 0.0)
+            if self.telemetry is not None:
+                self.telemetry.add_stage(
+                    "tensorize", prep.tensorize_seconds
+                )
             metrics.tensorize_seconds.observe(prep.tensorize_seconds)
             # extension-point durations with the reference's metric
             # names: host tensorization maps to PreFilter (documented,
@@ -3080,6 +3137,8 @@ class Scheduler:
                 f"deferred assignment read failed: {e!r}"
             ) from e
         flight.read_seconds = self.clock.perf() - tr
+        if self.telemetry is not None:
+            self.telemetry.add_stage("deferred_read", flight.read_seconds)
         solve_dt = flight.dispatch_seconds + flight.read_seconds
         res.solve_seconds += solve_dt
         # the fused device program IS RunFilterPlugins+RunScorePlugins, so
@@ -3108,12 +3167,25 @@ class Scheduler:
                 # prep-time capacity can only have been FREED since the
                 # solve (capacity-consuming events discard first) — a
                 # flagged overcommit is always corruption, not churn.
+                tv = self.clock.perf()
                 why = validate_assignments(
                     prep, flight.lo, assignments,
                     disabled=frozenset(solver.config.disabled_filters),
                 )
+                if self.telemetry is not None:
+                    self.telemetry.add_stage(
+                        "validate", self.clock.perf() - tv
+                    )
                 if why is not None:
                     raise SolveCorruptError(why)
+            t_apply = self.clock.perf()
+            if self.telemetry is not None and self.telemetry.bundles is not None:
+                # the flight applied (fence passed, output validated):
+                # its assignment slice is what a bundle replay of this
+                # batch must reproduce bit-identically
+                self.telemetry.bundles.note_assignments(
+                    prep.step, flight.lo, assignments
+                )
             # phase 2b: apply assignments — assume / Reserve / Permit /
             # PostFilter — atomically with the watch-event consumers
             preempt_placed: dict[int, list[Pod]] | None = None
@@ -3554,6 +3626,9 @@ class Scheduler:
             )
         if n_fail:
             metrics.schedule_attempts_total.labels("error", profile).inc(n_fail)
+        if self.telemetry is not None:
+            # the locked assume/Reserve/Permit region after validation
+            self.telemetry.add_stage("apply", self.clock.perf() - t_apply)
         return True
 
     def _fold_signature(self, static, slot_nodes) -> bytes:
@@ -4258,6 +4333,16 @@ class Scheduler:
         be chained on it)."""
         metrics.solves_discarded_total.inc()
         prep = flight.prep
+        if self.telemetry is not None:
+            # fence-wait attribution: the discarded flight's dispatch +
+            # read wall was work the fence threw away, and its capture
+            # record can never complete
+            self.telemetry.add_stage(
+                "fence_wait",
+                flight.dispatch_seconds + (flight.read_seconds or 0.0),
+            )
+            if self.telemetry.bundles is not None:
+                self.telemetry.bundles.drop(prep.step)
         self._note_drain_chunk(prep.step)
         if prep.step != self._last_discard_step:
             self._discard_streak += 1

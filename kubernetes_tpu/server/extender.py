@@ -525,7 +525,10 @@ def make_app(
     obs.FlightRecorder, defaulting to the scheduler's) backs the
     ``/debug/flightrecorder`` and ``/debug/spans`` endpoints; ``slo``
     (an obs.SloEngine, defaulting to the scheduler's) backs
-    ``GET /debug/slo`` — the live are-we-meeting-SLOs answer."""
+    ``GET /debug/slo`` — the live are-we-meeting-SLOs answer. The
+    scheduler's flight telemetry (obs.Telemetry, serve --telemetry)
+    backs ``GET /debug/profile`` — per-stage profile + sentinel state,
+    with ``?capture=1`` forcing a manual replay-bundle capture."""
     import asyncio
 
     from aiohttp import web
@@ -603,6 +606,29 @@ def make_app(
                 status=404,
             )
         return web.json_response(slo.snapshot())
+
+    # -- flight telemetry surface (kubernetes_tpu/obs profiler +
+    # sentinel + capture) --
+
+    async def debug_profile(request):
+        telemetry = (
+            getattr(scheduler, "telemetry", None)
+            if scheduler is not None
+            else None
+        )
+        if telemetry is None:
+            return web.json_response(
+                {"error": "flight telemetry disabled (serve --telemetry)"},
+                status=404,
+            )
+        snap = telemetry.snapshot()
+        if request.query.get("capture"):
+            # operator-triggered forensic capture: bundle the most
+            # recent complete batch exactly as an anomaly would
+            telemetry.capture("manual", note="GET /debug/profile?capture=1")
+            snap = telemetry.snapshot()
+            snap["captured"] = True
+        return web.json_response(snap)
 
     # -- occupancy-hub HA surface (kubernetes_tpu/fleet) --
 
@@ -699,6 +725,7 @@ def make_app(
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
     app.router.add_get("/debug/spans", debug_spans)
     app.router.add_get("/debug/slo", debug_slo)
+    app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/hub", debug_hub)
     app.router.add_post("/api/nodes", post_nodes)
     app.router.add_delete("/api/nodes/{name}", delete_node)

@@ -123,6 +123,23 @@ def _print_result(res) -> None:
             f"partial_gangs={g['partial_gangs']} "
             f"quarantined_gangs={g['quarantined_gangs']}"
         )
+    tel = s.get("telemetry")
+    if tel:
+        # the CI telemetry smoke greps anomalies/bundles_captured
+        # off this line — keep the key=value shape stable
+        signals = ",".join(tel["anomaly_signals"]) or "-"
+        triggers = (
+            ",".join(
+                f"{k}={v}" for k, v in sorted(tel["bundle_triggers"].items())
+            )
+            or "-"
+        )
+        print(
+            f"  telemetry: anomalies={tel['anomalies']} "
+            f"signals={signals} "
+            f"bundles_captured={tel['bundles_captured']} "
+            f"triggers={triggers}"
+        )
     if s.get("crashes") or s.get("incarnations", 1) > 1:
         print(
             f"  lifecycle: incarnations={s['incarnations']} "
@@ -319,6 +336,14 @@ def main(argv=None) -> int:
         help="dump the flight recorder here when an invariant fires",
     )
     parser.add_argument(
+        "--bundle-dir", metavar="DIR",
+        help="telemetry profiles (e.g. anomaly_storm): write capture-"
+        "on-anomaly replay bundles into this directory; the telemetry "
+        "invariant replays each one and asserts bit-identical "
+        "assignments (`python -m kubernetes_tpu.obs replay <bundle>` "
+        "does the same offline)",
+    )
+    parser.add_argument(
         "--tuning", action="store_true",
         help="enable the closed-loop auto-tuning runtime "
         "(kubernetes_tpu/tuning) on any profile: hill-climb "
@@ -421,6 +446,7 @@ def main(argv=None) -> int:
             flight_dump=args.flight_dump,
             mesh_devices=args.mesh_devices,
             tuning=tuning,
+            bundle_dir=args.bundle_dir,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

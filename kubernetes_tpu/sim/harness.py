@@ -60,6 +60,7 @@ from .invariants import (
     check_rebalance,
     check_recovery,
     check_resilience,
+    check_telemetry,
     check_tuning,
     merged_last_outcomes,
     packed_utilization,
@@ -156,6 +157,7 @@ class SimHarness:
         flight_dump: str | None = None,
         mesh_devices: int = 1,
         tuning: bool | None = None,
+        bundle_dir: str | None = None,
     ) -> None:
         self.profile = (
             get_profile(profile) if isinstance(profile, str) else profile
@@ -227,6 +229,9 @@ class SimHarness:
                 ),
             )
         self.flight_dump_path = flight_dump
+        # capture-on-anomaly replay bundles (telemetry profiles): the
+        # telemetry invariant replays every bundle written here
+        self.bundle_dir = bundle_dir
         # continuous rebalancer (kubernetes_tpu/rebalance): the
         # fragmentation profile's defragmentation loop, plus a seeded
         # PDB-guarded cohort the rebalancer must never move
@@ -343,9 +348,7 @@ class SimHarness:
             # trace-completeness invariant and the byte-identical-
             # journal determinism contract both ride on it. Spans
             # are opt-in (they multiply recorder traffic).
-            obs=ObsConfig(
-                spans=spans, journal=True, dump_path=flight_dump
-            ),
+            obs=self._build_obs_config(spans, flight_dump, bundle_dir),
         )
         # process lifecycle (crash_restart): incarnations share one
         # virtual timeline; a crash retires the live scheduler's
@@ -426,6 +429,51 @@ class SimHarness:
         self._gang_counters0 = {
             k: _counter_value(c) for k, c in _GANG_COUNTERS.items()
         }
+
+    def _build_obs_config(
+        self,
+        spans: bool,
+        flight_dump: str | None,
+        bundle_dir: str | None,
+    ) -> ObsConfig:
+        """The sim's ObsConfig: journal always on; flight telemetry
+        (profiler + sentinel + capture) only on ``profile.telemetry``
+        profiles, with sim-sized sentinel windows so a 12-cycle run has
+        enough window samples for both spike and drift rules. All
+        telemetry arithmetic rides the FakeClock, so same-seed runs
+        stay byte-identical through the footer summary."""
+        kwargs: dict = {
+            "spans": spans, "journal": True, "dump_path": flight_dump
+        }
+        if self.profile.telemetry:
+            from ..obs import SentinelConfig
+            from ..obs.slo import SloConfig
+
+            kwargs.update(
+                profile=True,
+                # a sync-drive cycle applies ~1 batch, so windows close
+                # every 2 batches and the spike rule (1 fast vs 3 slow,
+                # single-window hysteresis) can fire within the storm's
+                # 3-cycle fault window. min_events=1: sim event volumes
+                # are tiny.
+                sentinel=SentinelConfig(
+                    window_batches=2,
+                    fast_windows=1,
+                    slow_windows=3,
+                    spike_ratio=2.0,
+                    drift_ratio=1.5,
+                    hysteresis=1,
+                    cooldown_windows=4,
+                    min_windows=3,
+                    min_events=1.0,
+                    recover_windows=2,
+                ),
+                # the sentinel's p99 source; export_interval_s=0 keeps
+                # quantiles fresh every observe on the virtual clock
+                slo=SloConfig(export_interval_s=0.0),
+                bundle_dir=bundle_dir,
+            )
+        return ObsConfig(**kwargs)
 
     # -- fault delivery inside the dispatch→apply window --
 
@@ -836,6 +884,32 @@ class SimHarness:
                 expect_shift=self.profile.shift_at >= 0
                 and self._tuner_settled_at_shift,
             )
+        telemetry_summary = None
+        if self.profile.telemetry and self.scheduler.telemetry is not None:
+            tel = self.scheduler.telemetry
+            bsnap = (
+                tel.bundles.snapshot() if tel.bundles is not None else {}
+            )
+            # counts only — no paths, no wall timings — so the
+            # --selfcheck re-run (which omits the bundle directory)
+            # produces a byte-identical footer
+            telemetry_summary = {
+                "anomalies": len(tel.anomalies),
+                "anomaly_signals": sorted(
+                    {a.signal for a in tel.anomalies}
+                ),
+                "bundles_captured": int(bsnap.get("captures", 0)),
+                "bundle_triggers": {
+                    k: bsnap["by_trigger"][k]
+                    for k in sorted(bsnap.get("by_trigger", {}))
+                },
+            }
+            check_telemetry(
+                self.cycles + self.max_settle_rounds,
+                self.violations,
+                summary=telemetry_summary,
+                bundle_dir=self.bundle_dir,
+            )
         bindings = {
             p.key: p.node_name
             for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
@@ -910,6 +984,10 @@ class SimHarness:
             # when the profile seeds a never-satisfiable gang — both
             # pinned by the CI gang smoke
             "gang": gang_summary,
+            # flight telemetry (telemetry profiles): anomaly + capture
+            # counts — the telemetry invariant's assertion target; the
+            # CI telemetry smoke greps these off the footer line
+            "telemetry": telemetry_summary,
             # backlog drain (backlog_drain profiles): counts only —
             # all driver-side and deterministic, so same-seed runs
             # stay byte-identical (wall timings deliberately excluded)
@@ -1003,12 +1081,13 @@ def run_sim(
     flight_dump: str | None = None,
     mesh_devices: int = 1,
     tuning: bool | None = None,
+    bundle_dir: str | None = None,
 ) -> SimResult:
     """One fresh seeded run (library entry; the CLI and tests use this)."""
     return SimHarness(
         profile, seed=seed, cycles=cycles, pipelined=pipelined,
         streaming=streaming, spans=spans, flight_dump=flight_dump,
-        mesh_devices=mesh_devices, tuning=tuning,
+        mesh_devices=mesh_devices, tuning=tuning, bundle_dir=bundle_dir,
     ).run()
 
 

@@ -47,7 +47,7 @@ from ..state.cluster import ClusterState, Event
 class Violation:
     invariant: str  # double_bind | capacity | lost_pod | progress |
     # monotonic | constraint | journal | global_overcommit |
-    # resilience | recovery | fencing | rebalance | gang
+    # resilience | recovery | fencing | rebalance | gang | telemetry
     cycle: int
     detail: str
 
@@ -1012,3 +1012,88 @@ class MonotonicCounters:
                     f"{self._last[name]} -> {cur.get(name, 0.0)}",
                 )
         self._last = cur
+
+
+def check_telemetry(
+    cycle: int,
+    violations: list[Violation],
+    *,
+    summary: dict,
+    bundle_dir: str | None = None,
+) -> None:
+    """Flight-telemetry invariants, checked after quiescence for
+    profiles that enabled the always-on telemetry loop
+    (``profile.telemetry``). This is the closed-loop forensic
+    contract — profile, detect, capture, replay — asserted end to end:
+
+    - **sentinel fired** — a profile that injects a health regression
+      (the ``anomaly_storm`` solver-fault window) must have produced
+      at least one anomaly; a silent sentinel through a storm means
+      the detection rules never engaged;
+    - **capture engaged** — every anomaly/breaker trigger routes
+      through the bundle capturer, so at least one capture event must
+      have been counted (capture counting is independent of whether a
+      bundle directory was configured — the ``--selfcheck`` re-run
+      leans on that);
+    - **bundles replay bit-identical** — every bundle directory
+      written under ``bundle_dir`` must re-execute offline to the
+      exact assignments the live run produced. A replay mismatch is
+      the worst telemetry bug there is: a forensic artifact that lies.
+      Chained/split solves are legitimately non-replayable standalone
+      (the bundle records why), but when a directory was configured at
+      least one written bundle must close the loop.
+    """
+    if summary.get("anomalies", 0) < 1:
+        _record(
+            violations, "telemetry", cycle,
+            "telemetry profile ran a fault storm but the anomaly "
+            "sentinel never fired",
+        )
+    if summary.get("bundles_captured", 0) < 1:
+        _record(
+            violations, "telemetry", cycle,
+            "anomaly/breaker triggers fired but no capture event was "
+            "counted — the capture seam is disconnected",
+        )
+    if not bundle_dir:
+        return
+    import os
+
+    from ..obs.bundle import replay_bundle
+
+    dirs = sorted(
+        d for d in os.listdir(bundle_dir) if d.startswith("bundle-")
+    )
+    if not dirs:
+        _record(
+            violations, "telemetry", cycle,
+            "a bundle directory was configured but no bundle was "
+            "written to it",
+        )
+        return
+    replayed_ok = 0
+    for d in dirs:
+        try:
+            rep = replay_bundle(os.path.join(bundle_dir, d))
+        except Exception as exc:  # noqa: BLE001 - any failure is the finding
+            _record(
+                violations, "telemetry", cycle,
+                f"bundle {d} failed to load/replay: {exc!r}",
+            )
+            continue
+        if not rep["replayable"]:
+            continue
+        if rep["ok"]:
+            replayed_ok += 1
+        else:
+            _record(
+                violations, "telemetry", cycle,
+                f"bundle {d} replay diverged from the live run: "
+                f"{rep['detail']}",
+            )
+    if replayed_ok < 1:
+        _record(
+            violations, "telemetry", cycle,
+            f"{len(dirs)} bundles written but none replayed "
+            "bit-identical — the forensic loop never closed",
+        )

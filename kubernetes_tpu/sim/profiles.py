@@ -199,6 +199,15 @@ class Profile:
     gang_accel_classes: tuple[str, ...] = ()
     gang_workload_classes: tuple[str, ...] = ()
     gang_throughput_weight: int = 0
+    # -- flight telemetry (kubernetes_tpu/obs, ISSUE 18) --
+    # enable the always-on telemetry stack on the sim scheduler:
+    # continuous per-stage profiler + anomaly sentinel (sim-sized
+    # windows, harness._base_config builds the SentinelConfig) +
+    # capture-on-anomaly replay bundles (written when the run passes a
+    # bundle_dir; capture EVENTS count either way, so --selfcheck's
+    # dirless re-run stays byte-identical). The SLO engine rides along
+    # as the sentinel's p99 source.
+    telemetry: bool = False
 
     def validate(self) -> None:
         if self.watch_delay and (
@@ -689,6 +698,32 @@ PROFILES: dict[str, Profile] = {
             delete_pod_rate=0.4,
             fleet_replicas=2,
             replica_loss_at=4,
+        ),
+        # anomaly_storm: the flight-telemetry acceptance profile
+        # (ISSUE 18). A healthy steady-state warmup, then the
+        # solver_flaky fault window [2, 5) kills every device-tier
+        # solve: the breaker trips (its edge anomaly fires at the next
+        # applied batch) and throughput collapses against the warmup
+        # baseline (the spike rule). The sentinel must fire >= 1
+        # anomaly, each firing must journal a telemetry_anomaly record
+        # and capture a replay bundle, and every WRITTEN bundle must
+        # re-execute offline to bit-identical assignments — the
+        # telemetry invariant asserts the whole loop. Sync drive
+        # (pipelined=False): sync solves dispatch unsplit with
+        # allow_heal=True, so every capture is carry-clean and the
+        # replay contract holds by construction. Cycles 0-1 are
+        # fault-free, guaranteeing a complete capture record exists
+        # before the storm. Byte-deterministic under --selfcheck like
+        # every profile (capture events count without a bundle dir).
+        Profile(
+            name="anomaly_storm",
+            pipelined=False,
+            telemetry=True,
+            nodes=8,
+            arrivals=(4, 8),
+            delete_pod_rate=0.4,
+            solver_fault_rate=1.0,
+            solver_fault_window=(2.0, 5.0),
         ),
     )
 }

@@ -1577,6 +1577,14 @@ class _DeviceSession:
         return ct, sum(np.asarray(a).nbytes for a in arrays)
 
 
+def _capture_config_fingerprint(cfg: "ExactSolverConfig") -> dict:
+    """JSON-safe config snapshot for the telemetry capture hook (lazy
+    import: the solver must not pull the obs layer in at module load)."""
+    from ..obs.bundle import config_fingerprint
+
+    return config_fingerprint(cfg)
+
+
 class ExactSolver:
     """Host-facing wrapper: NodeBatch/PodBatch (+ plugin tensors) in,
     assignments out, node state written back (the device-side 'assume')."""
@@ -1590,6 +1598,12 @@ class ExactSolver:
         self.mesh = mesh
         self._step_count = 0
         self._session = _DeviceSession()
+        # flight-telemetry input snapshot hook (obs/bundle.py): when
+        # set, solve() hands over its resolved inputs — pre-PRNG-
+        # increment, pre-default-filling — so a capture-on-anomaly
+        # bundle can re-execute the exact solve offline. Host-side
+        # callable, never touches device state.
+        self.capture_hook = None
         # Cumulative executable-dispatch histogram: "scan" counts whole
         # per-pod-scan solves, "kindK" counts grouped chunks by the
         # _chunk_kinds dispatch (0 slow replay / 1 plain / 2 spread
@@ -1824,6 +1838,29 @@ class ExactSolver:
         cfg = self.config
         if mesh is None:
             mesh = self.mesh
+        if self.capture_hook is not None:
+            # BEFORE the PRNG derivation and the trivial-tensor default
+            # filling: step_count is exactly what a replay must restore,
+            # and None containers stay None (the replayed solve
+            # re-derives the identical trivial tensors, and the bundle
+            # stays small). Raw references — the hook copies host-side.
+            self.capture_hook(
+                nodes=nodes,
+                pods=pods,
+                static=static,
+                ports=ports,
+                spread=spread,
+                interpod=interpod,
+                nominated=nominated,
+                nominated_slot=nominated_slot,
+                step_count=self._step_count,
+                split=split,
+                defer_read=defer_read,
+                session=col_versions is not None,
+                allow_heal=allow_heal,
+                chain_occupancy=chain_occupancy,
+                config=_capture_config_fingerprint(cfg),
+            )
         fdtype = jnp.float64 if cfg.balanced_fdtype == "float64" else jnp.float32
         key = jax.random.PRNGKey(cfg.seed + self._step_count)
         self._step_count += 1

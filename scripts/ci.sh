@@ -254,6 +254,32 @@ echo "$gang_fleet"
 echo "$gang_fleet" | grep -qE "partial_gangs=0 " \
     || { echo "GANG SMOKE: fleet replica loss left a partial gang"; exit 1; }
 
+echo "== telemetry smoke: anomaly storm -> capture -> offline replay =="
+# anomaly_storm: healthy warmup cycles, then a solver-fault window
+# trips the breaker and collapses pods/s — the sentinel must fire
+# (edge + regression rules), every fire must capture a replay bundle,
+# and each carry-clean bundle must re-execute offline to BIT-IDENTICAL
+# assignments (the run's telemetry invariant loads + replays every
+# written bundle). --selfcheck re-runs WITHOUT the bundle dir and
+# byte-compares summaries: capture EVENTS are part of the
+# deterministic record, bundle writing is a pure side effect. The
+# greps pin the loop engaging non-vacuously off the footer line; the
+# explicit `obs replay` exercises the operator CLI end-to-end.
+tele_dir=$(mktemp -d)
+tele_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 12 \
+    --profile anomaly_storm --bundle-dir "$tele_dir" --selfcheck)
+echo "$tele_out"
+echo "$tele_out" | grep -qE "telemetry: anomalies=[1-9]" \
+    || { echo "TELEMETRY SMOKE: the sentinel never fired"; exit 1; }
+echo "$tele_out" | grep -qE "bundles_captured=[1-9]" \
+    || { echo "TELEMETRY SMOKE: no anomaly captured a bundle"; exit 1; }
+tele_bundle=$(ls -d "$tele_dir"/bundle-* | head -1)
+replay_out=$(python -m kubernetes_tpu.obs replay "$tele_bundle")
+echo "$replay_out"
+echo "$replay_out" | grep -q "assignments bit-identical" \
+    || { echo "TELEMETRY SMOKE: offline replay diverged"; exit 1; }
+rm -rf "$tele_dir"
+
 echo "== fleet smoke: 2-replica sharded drive =="
 # two active replicas sharding one cluster (shard-filtered watches,
 # cross-shard occupancy exchange, handoff protocol) under the

@@ -643,3 +643,63 @@ def test_gang_delete_churn_cannot_fake_violation():
     violations = []
     check_no_partial_gangs(cs, 5, violations)
     assert violations == []
+
+
+# -- telemetry --------------------------------------------------------------
+
+
+def _tel_summary(anomalies=1, captures=1):
+    return {"anomalies": anomalies, "bundles_captured": captures}
+
+
+def test_telemetry_flags_silent_sentinel():
+    from kubernetes_tpu.sim.invariants import check_telemetry
+
+    v = []
+    check_telemetry(5, v, summary=_tel_summary(anomalies=0))
+    assert [x.invariant for x in v] == ["telemetry"]
+    assert "sentinel never fired" in v[0].detail
+
+
+def test_telemetry_flags_disconnected_capture_seam():
+    from kubernetes_tpu.sim.invariants import check_telemetry
+
+    v = []
+    check_telemetry(5, v, summary=_tel_summary(captures=0))
+    assert [x.invariant for x in v] == ["telemetry"]
+    assert "capture seam is disconnected" in v[0].detail
+
+
+def test_telemetry_flags_configured_dir_with_no_bundles(tmp_path):
+    from kubernetes_tpu.sim.invariants import check_telemetry
+
+    v = []
+    check_telemetry(
+        5, v, summary=_tel_summary(), bundle_dir=str(tmp_path)
+    )
+    assert [x.invariant for x in v] == ["telemetry"]
+    assert "no bundle was written" in v[0].detail
+
+
+def test_telemetry_flags_unloadable_bundle(tmp_path):
+    from kubernetes_tpu.sim.invariants import check_telemetry
+
+    # a bundle directory with no manifest: load must fail and the
+    # checker must surface it (a truncated capture is itself a finding)
+    (tmp_path / "bundle-00000-sentinel").mkdir()
+    v = []
+    check_telemetry(
+        5, v, summary=_tel_summary(), bundle_dir=str(tmp_path)
+    )
+    details = [x.detail for x in v]
+    assert any("failed to load/replay" in d for d in details)
+    # ... and with every bundle broken, the loop never closed
+    assert any("none replayed bit-identical" in d for d in details)
+
+
+def test_telemetry_clean_without_bundle_dir():
+    from kubernetes_tpu.sim.invariants import check_telemetry
+
+    v = []
+    check_telemetry(5, v, summary=_tel_summary())
+    assert v == []

@@ -1,0 +1,397 @@
+"""ISSUE 18 tentpole: the flight-telemetry loop — profile -> detect ->
+capture -> replay — as units (ring arithmetic, sentinel rules, capturer
+lifecycle, top renderer) and end-to-end (the anomaly_storm sim writes
+real bundles and every carry-clean one replays bit-identical offline).
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.obs import ObsConfig, build_telemetry
+from kubernetes_tpu.obs.bundle import BundleCapturer, replay_bundle
+from kubernetes_tpu.obs.profile import STAGES, StageProfiler, render_top
+from kubernetes_tpu.obs.sentinel import AnomalySentinel, SentinelConfig
+from kubernetes_tpu.obs.timeseries import TimeSeriesRing
+from kubernetes_tpu.utils.clock import FakeClock
+
+# -- timeseries ring --------------------------------------------------------
+
+
+class TestTimeSeriesRing:
+    def test_append_means_and_baseline(self):
+        ring = TimeSeriesRing(8)
+        for v in (10.0, 20.0, 30.0, 40.0, 50.0, 60.0):
+            ring.append(t=v, batches=1, pods=1, signals={"x": v})
+        assert len(ring) == 6
+        assert ring.mean("x", 3) == pytest.approx(50.0)
+        # baseline = the 3 windows before the trailing 3
+        assert ring.mean_prev("x", 3, skip=3) == pytest.approx(20.0)
+        # missing signal reads as 0.0, empty slices too
+        assert ring.mean("nope", 3) == 0.0
+        assert TimeSeriesRing(4).mean("x", 3) == 0.0
+
+    def test_capacity_bound_keeps_seq_monotone(self):
+        ring = TimeSeriesRing(4)
+        for i in range(10):
+            ring.append(t=float(i), batches=1, pods=0, signals={})
+        assert len(ring) == 4
+        assert ring.last().seq == 9  # seq counts evictions too
+
+    def test_rejects_degenerate_capacity(self):
+        with pytest.raises(ValueError):
+            TimeSeriesRing(3)
+
+    def test_snapshot_is_json_ready(self):
+        ring = TimeSeriesRing(8)
+        ring.append(t=1.23456789, batches=2, pods=5, signals={"x": 0.1})
+        snap = ring.snapshot(4)
+        json.dumps(snap)
+        assert snap[-1]["pods"] == 5
+
+
+# -- stage profiler ---------------------------------------------------------
+
+
+class TestStageProfiler:
+    def test_ledger_totals_and_fractions(self):
+        clock = FakeClock()
+        prof = StageProfiler(clock=clock)
+        prof.add("tensorize", 0.25)
+        prof.add("dispatch", 0.5)
+        prof.add("dispatch", 0.25)
+        prof.add("bind", 0.0)  # zero attribution is dropped
+        clock.advance(2.0)
+        entry = prof.observe_batch(step=1, pods=8)
+        assert entry["stages"]["dispatch"] == pytest.approx(0.75)
+        assert entry["stages"]["bind"] == 0.0
+        snap = prof.snapshot()
+        assert snap["batches"] == 1 and snap["pods"] == 8
+        assert set(snap["stage_seconds"]) == set(STAGES)
+        assert snap["stage_fraction"]["tensorize"] == pytest.approx(0.25)
+        assert sum(snap["stage_fraction"].values()) == pytest.approx(1.0)
+
+    def test_wall_is_delta_between_batches(self):
+        clock = FakeClock()
+        prof = StageProfiler(clock=clock)
+        assert prof.observe_batch(step=1, pods=1)["wall_s"] == 0.0
+        clock.advance(1.5)
+        assert prof.observe_batch(step=2, pods=1)["wall_s"] == (
+            pytest.approx(1.5)
+        )
+
+    def test_ledger_is_bounded(self):
+        prof = StageProfiler(clock=FakeClock(), capacity=16)
+        for i in range(40):
+            prof.observe_batch(step=i, pods=1)
+        snap = prof.snapshot(recent=100)
+        assert len(snap["recent"]) == 16
+        assert snap["batches"] == 40  # totals outlive the ring
+
+
+# -- anomaly sentinel -------------------------------------------------------
+
+
+def _small_cfg(**kw) -> SentinelConfig:
+    base = dict(
+        window_batches=1, fast_windows=1, slow_windows=3, spike_ratio=2.0,
+        drift_ratio=1.5, hysteresis=1, cooldown_windows=4, min_windows=3,
+        min_events=1.0, recover_windows=2,
+    )
+    base.update(kw)
+    return SentinelConfig(**base)
+
+
+def _window(sent, **signals):
+    sample = sent.ring.append(
+        t=float(len(sent.fired) + len(sent.ring)), batches=1, pods=0,
+        signals=signals,
+    )
+    return sent.observe_window(sample)
+
+
+class TestAnomalySentinel:
+    def test_warmup_silence_then_spike_on_collapse(self):
+        sent = AnomalySentinel(_small_cfg())
+        for _ in range(4):
+            assert _window(sent, pods_per_sec=1000.0) == []
+        fired = _window(sent, pods_per_sec=100.0)
+        assert [a.kind for a in fired] == ["spike"]
+        assert fired[0].signal == "pods_per_sec"
+        assert sent.degraded
+
+    def test_hysteresis_needs_consecutive_regressions(self):
+        sent = AnomalySentinel(_small_cfg(hysteresis=2))
+        for _ in range(4):
+            _window(sent, pods_per_sec=1000.0)
+        assert _window(sent, pods_per_sec=100.0) == []  # streak 1
+        fired = _window(sent, pods_per_sec=100.0)  # streak 2 -> fires
+        assert [a.kind for a in fired] == ["spike"]
+
+    def test_cooldown_silences_refire(self):
+        sent = AnomalySentinel(_small_cfg())
+        for _ in range(4):
+            _window(sent, pods_per_sec=1000.0)
+        assert _window(sent, pods_per_sec=100.0)
+        # still collapsed: the signal is cooling down, not re-firing
+        assert _window(sent, pods_per_sec=100.0) == []
+        assert sent.fired_total == 1
+
+    def test_degraded_clears_after_clean_recovery_windows(self):
+        sent = AnomalySentinel(_small_cfg())
+        for _ in range(4):
+            _window(sent, pods_per_sec=1000.0)
+        _window(sent, pods_per_sec=100.0)
+        assert sent.degraded
+        _window(sent, pods_per_sec=1000.0)
+        assert sent.degraded  # 1 of recover_windows=2
+        _window(sent, pods_per_sec=1000.0)
+        assert not sent.degraded
+
+    def test_breaker_edge_fires_even_under_tuner_suppression(self):
+        sent = AnomalySentinel(_small_cfg())
+        sample = sent.ring.append(
+            t=0.0, batches=1, pods=0,
+            signals={"breaker": 1.0, "pods_per_sec": 0.0},
+        )
+        fired = sent.observe_window(sample, suppress=True)
+        assert [a.kind for a in fired] == ["edge"]
+        assert sent.suppressed_windows == 1
+
+    def test_event_floor_gates_near_zero_baseline_rates(self):
+        sent = AnomalySentinel(_small_cfg(min_events=3.0))
+        for _ in range(4):
+            _window(sent, discard_rate=0.0)
+        # regressed by ratio but under the absolute floor: noise
+        assert _window(sent, discard_rate=2.0) == []
+        fired = _window(sent, discard_rate=5.0)
+        assert [a.signal for a in fired] == ["discard_rate"]
+
+    def test_drift_catches_slow_degradation_spike_misses(self):
+        sent = AnomalySentinel(_small_cfg())
+        for v in (1000.0, 1000.0, 1000.0, 650.0, 650.0):
+            assert _window(sent, pods_per_sec=v) == []
+        # ring now holds 2x slow_windows; slow=650 vs prev slow=1000
+        fired = _window(sent, pods_per_sec=650.0)
+        assert [a.kind for a in fired] == ["drift"]
+
+    def test_snapshot_schema(self):
+        sent = AnomalySentinel(_small_cfg())
+        for _ in range(4):
+            _window(sent, pods_per_sec=1000.0)
+        _window(sent, pods_per_sec=100.0)
+        snap = sent.snapshot()
+        json.dumps(snap)
+        assert snap["fired_total"] == 1
+        a = snap["recent_anomalies"][-1]
+        assert a["signal"] == "pods_per_sec" and a["kind"] == "spike"
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SentinelConfig(fast_windows=5, slow_windows=3).validate()
+        with pytest.raises(ValueError):
+            SentinelConfig(spike_ratio=1.0).validate()
+
+
+# -- bundle capturer lifecycle ---------------------------------------------
+
+
+@dataclasses.dataclass
+class _FakePods:
+    """Stands in for PodBatch on the in-memory lifecycle paths (the
+    capturer only reads ``num_pods`` and copies ndarray fields there;
+    real-schema encode/decode is proven by the e2e replay below)."""
+
+    num_pods: int
+    cpu: np.ndarray
+
+
+def _solve_payload(n=3):
+    return dict(
+        pods=_FakePods(n, np.arange(n)), step_count=5, split=1,
+        session=False, allow_heal=True, chain_occupancy=False,
+    )
+
+
+class TestBundleCapturer:
+    def test_arm_capture_complete_record_counts_without_dir(self):
+        cap = BundleCapturer(None)
+        cap.arm(7, profile="t")
+        cap.on_solve_input(**_solve_payload())
+        cap.note_assignments(7, 0, [0, 1, 2])
+        assert cap.capture("manual", note="x") is None  # no out_dir
+        snap = cap.snapshot()
+        assert snap["captures"] == 1 and snap["missed"] == 0
+        assert snap["by_trigger"] == {"manual": 1}
+        assert snap["written"] == []
+
+    def test_trigger_with_nothing_complete_is_a_miss(self):
+        cap = BundleCapturer(None)
+        assert cap.capture("sentinel") is None
+        assert cap.snapshot()["missed"] == 1
+
+    def test_partial_coverage_keeps_record_pending(self):
+        cap = BundleCapturer(None)
+        cap.arm(9)
+        cap.on_solve_input(**_solve_payload(n=3))
+        cap.note_assignments(9, 0, [0, 1])
+        assert cap.snapshot()["pending"] == 1
+        cap.note_assignments(9, 2, [2])
+        assert cap.snapshot()["ring_complete"] == 1
+
+    def test_drop_kills_the_armed_record(self):
+        cap = BundleCapturer(None)
+        cap.arm(4)
+        cap.drop(4)
+        cap.on_solve_input(**_solve_payload())  # disarmed: ignored
+        cap.note_assignments(4, 0, [0, 1, 2])
+        assert cap.capture("sentinel") is None
+        assert cap.snapshot()["missed"] == 1
+
+    def test_unarmed_solve_input_is_ignored(self):
+        cap = BundleCapturer(None)
+        cap.on_solve_input(**_solve_payload())
+        assert cap.snapshot()["pending"] == 0
+
+    def test_carry_clean_tag(self):
+        cap = BundleCapturer(None)
+        cap.arm(1)
+        cap.on_solve_input(
+            **{**_solve_payload(), "session": True, "allow_heal": False}
+        )
+        cap.note_assignments(1, 0, [0, 1, 2])
+        rec = cap._ring[-1]
+        assert rec["payload"]["carry_clean"] is False
+
+
+# -- build_telemetry gating -------------------------------------------------
+
+
+class TestBuildTelemetry:
+    def test_everything_off_returns_none(self):
+        assert build_telemetry(None) is None
+        assert build_telemetry(ObsConfig(spans=True, journal=True)) is None
+
+    def test_profile_only(self):
+        tel = build_telemetry(ObsConfig(profile=True))
+        assert tel.profiler is not None
+        assert tel.sentinel is None and tel.bundles is None
+        assert tel.snapshot() == {
+            "enabled": True, "profile": tel.profiler.snapshot(),
+        }
+
+    def test_sentinel_implies_profiler_and_memory_capturer(self):
+        tel = build_telemetry(ObsConfig(sentinel=SentinelConfig()))
+        assert tel.profiler is not None
+        assert tel.bundles is not None and tel.bundles.out_dir is None
+        assert tel.capture("manual") is None  # counts, writes nothing
+        assert tel.bundles.snapshot()["missed"] == 1
+
+
+# -- obs top renderer -------------------------------------------------------
+
+
+class TestRenderTop:
+    def _snapshot(self):
+        return {
+            "enabled": True,
+            "profile": {
+                "batches": 4, "pods": 32,
+                "stage_seconds": {s: 0.1 for s in STAGES},
+                "stage_fraction": {s: 1.0 / len(STAGES) for s in STAGES},
+                "recent": [
+                    {"step": 9, "pods": 8, "wall_s": 0.5,
+                     "h2d_bytes": 1024.0, "d2h_bytes": 64.0}
+                ],
+            },
+            "sentinel": {
+                "degraded": True, "fired_total": 2,
+                "suppressed_windows": 1,
+                "recent_anomalies": [
+                    {"signal": "pods_per_sec", "kind": "spike",
+                     "value": 100.0, "baseline": 1000.0, "window": 7}
+                ],
+            },
+            "bundles": {
+                "captures": 2, "missed": 0,
+                "by_trigger": {"sentinel": 1, "manual": 1},
+                "written": ["/tmp/b/bundle-00000-sentinel",
+                            "/tmp/b/bundle-00001-manual"],
+            },
+        }
+
+    def test_full_snapshot_renders_every_section(self):
+        out = render_top(self._snapshot())
+        assert "flight telemetry — 4 batches, 32 pods" in out
+        for s in STAGES:
+            assert s in out
+        assert "last batch: step=9" in out
+        assert "degraded=True fired_total=2" in out
+        assert "pods_per_sec (spike)" in out
+        # written is a PATH LIST in the snapshot — rendered as a count
+        assert "written=2" in out
+        assert "manual=1,sentinel=1" in out
+
+    def test_tolerates_partially_enabled_telemetry(self):
+        out = render_top({"enabled": True, "profile": {
+            "batches": 0, "pods": 0, "stage_seconds": {},
+            "stage_fraction": {}, "recent": [],
+        }})
+        assert "0 batches" in out
+        assert "sentinel" not in out and "bundles" not in out
+
+    def test_obs_top_cli_renders_snapshot_file(self, tmp_path):
+        f = tmp_path / "snap.json"
+        f.write_text(json.dumps(self._snapshot()))
+        out = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.obs", "top",
+             "--snapshot", str(f)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "flight telemetry — 4 batches" in out.stdout
+
+
+# -- end-to-end: the forensic loop over a real sim --------------------------
+
+
+def test_anomaly_storm_forensic_loop(tmp_path):
+    """The tentpole's closed loop, tier-1: anomaly_storm drives the
+    sentinel (solver faults trip the breaker + collapse pods/s), every
+    fire captures a bundle to disk, and each carry-clean bundle
+    replays offline to BIT-IDENTICAL assignments. A tampered bundle
+    must diverge — the comparison has teeth."""
+    from kubernetes_tpu.sim.harness import run_sim
+
+    r = run_sim(
+        "anomaly_storm", seed=0, cycles=12, bundle_dir=str(tmp_path)
+    )
+    assert r.violations == []
+    tel = r.summary["telemetry"]
+    assert tel["anomalies"] >= 1
+    assert "breaker" in tel["anomaly_signals"]
+    assert tel["bundles_captured"] >= 1
+    assert sum(tel["bundle_triggers"].values()) == tel["bundles_captured"]
+
+    bundles = sorted(str(p) for p in tmp_path.glob("bundle-*"))
+    assert bundles, "sentinel fired but nothing hit disk"
+    replayed = []
+    for b in bundles:
+        rep = replay_bundle(b)
+        if rep["replayable"]:
+            assert rep["ok"], f"{b}: {rep['detail']}"
+            replayed.append(b)
+    assert replayed, "no carry-clean bundle — the loop never closed"
+
+    # tamper with the stored ground truth: replay must catch it
+    mpath = tmp_path / replayed[0].rsplit("/", 1)[1] / "manifest.json"
+    manifest = json.loads(mpath.read_text())
+    manifest["parts"][0]["assignments"][0] += 1
+    mpath.write_text(json.dumps(manifest))
+    rep = replay_bundle(replayed[0])
+    assert rep["replayable"] and not rep["ok"]
+    assert "mismatch" in rep["detail"]
