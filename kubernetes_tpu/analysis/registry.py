@@ -34,6 +34,9 @@ SANCTIONED_SYNC_POINTS = frozenset(
 
 # TPU003 dtype discipline applies where tensors feed the solve pipeline
 # (a weakly-typed float literal silently re-specializes the jit cache).
+# The solver/ prefix covers every engine — exact, single_shot, and the
+# convex-relaxation mega-planner (solver/relax.py, ISSUE 19) — so a new
+# kernel file inherits the discipline without a registry edit.
 DTYPE_PATHS = (
     "kubernetes_tpu/ops/",
     "kubernetes_tpu/solver/",

@@ -331,6 +331,46 @@ backlog_hbm_measured_bytes = Gauge(
     registry=REGISTRY,
 )
 
+# -- convex-relaxation mega-planner (solver/relax.py, ISSUE 19) --
+
+relax_iterations = Histogram(
+    "scheduler_relax_iterations",
+    "Dual-ascent iterations one convex-relaxation solve ran before "
+    "the residual early exit (solver/relax.py): converged plans stop "
+    "well short of the max_iters budget; samples pinned at the budget "
+    "mean the shape is contended past the tolerance.",
+    buckets=(4, 8, 16, 32, 64, 128, 256, 512),
+    registry=REGISTRY,
+)
+relax_residual = Gauge(
+    "scheduler_relax_residual",
+    "Final relative-overcommit residual of the last relaxation solve "
+    "(max over nodes/resources of fractional load/capacity - 1, "
+    "clipped at 0). 0 = the fractional plan fit everywhere; a "
+    "persistent positive value is structural oversubscription the "
+    "rounding clamp absorbs.",
+    registry=REGISTRY,
+)
+relax_repair_rounds = Histogram(
+    "scheduler_relax_repair_rounds",
+    "Auction rounds the integrality-tail repair ran after rounding a "
+    "relaxed plan (0 = the rounding seated everything or repair was "
+    "disabled). Growth here means the relaxation is leaving more "
+    "work to the sequential engine it exists to replace.",
+    buckets=(0, 1, 2, 4, 8, 16, 32, 64),
+    registry=REGISTRY,
+)
+relax_dual_price = Gauge(
+    "scheduler_relax_dual_price",
+    "Converged per-node-group dual price of the last relaxation solve "
+    "(mean over the group's nodes of sum_k lam[k,n] + mu[n], score "
+    "points per normalized capacity unit) — the autoscaler cost "
+    "signal (ROADMAP item #2): a group pinned at 0 has slack, a "
+    "rising price is demand the group cannot absorb.",
+    ["group"],
+    registry=REGISTRY,
+)
+
 # -- closed-loop hot-path auto-tuning (kubernetes_tpu/tuning) --
 
 tuning_adjustments_total = Counter(

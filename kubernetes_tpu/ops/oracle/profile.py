@@ -369,3 +369,34 @@ class FullOracle:
                 )
             self.nodes[oi].add_pod(pod)
         return errors
+
+    def validate_feasible(
+        self, pods: Sequence[Pod], assignments: Sequence[int],
+        names: Sequence[str] | None = None,
+    ) -> list[str]:
+        """Feasibility-only replay for GLOBAL planners (the convex-
+        relaxation mega-planner, ISSUE 19): every placed pick must be
+        in the oracle's FEASIBLE set at that step given identical
+        history — no resource/pod-count overcommit, every filter
+        honored — but not necessarily in the argmax tie set. A global
+        plan trades per-step greedy optimality for global packing;
+        tie-set parity (``validate_assignments``) is the sequential
+        solvers' contract, not the planner's. Unplaced pods are not
+        flagged — under-placement is an objective-quality question the
+        bench/sim ratio floors own, not a validity violation."""
+        index_of = {on.node.name: i for i, on in enumerate(self.nodes)}
+        errors: list[str] = []
+        for step, (pod, pick) in enumerate(zip(pods, assignments)):
+            if pick < 0:
+                continue
+            feasible = self.feasible_set(pod)
+            oi = index_of[names[step]] if names is not None else pick
+            if oi not in feasible:
+                errors.append(
+                    f"step {step} pod {pod.key}: pick {oi} not in "
+                    f"feasible set {feasible[:10]}"
+                    f"{'...' if len(feasible) > 10 else ''}"
+                )
+            # follow the plan anyway to localize subsequent divergence
+            self.nodes[oi].add_pod(pod)
+        return errors

@@ -42,6 +42,7 @@ import numpy as np
 
 from ..api.objects import Pod
 from ..ops.oracle.preemption import classify_pdb_violations
+from ..solver.relax import RelaxConfig, RelaxSolver
 from ..solver.single_shot import SingleShotConfig, SingleShotSolver
 from ..tensorize.plugins import build_static_tensors, trivial_static_tensors
 from ..tensorize.schema import NodeBatch, build_pod_batch
@@ -89,6 +90,28 @@ def plan_auction_config(base: SingleShotConfig | None = None) -> SingleShotConfi
     )
 
 
+# engine routing: below this pods x padded-nodes product the auction's
+# sequential rounds are cheap and its narrow-window consolidation is
+# the better plan; above it the relaxation's matmul iterations win the
+# wall-clock race outright (bench ladder #16: >= 10x at 512k x 102k)
+RELAX_PLAN_CELLS = 1 << 24
+
+
+def plan_engine(n_pods: int, n_nodes_padded: int, engine: str = "auto") -> str:
+    """Resolve the planning engine for a shape: ``"auction"`` or
+    ``"relax"`` force it; ``"auto"`` routes by the pods x nodes cell
+    count — the quantity both engines' dominant terms scale with."""
+    if engine in ("auction", "relax"):
+        return engine
+    if engine != "auto":
+        raise ValueError(f"unknown plan engine: {engine!r}")
+    return (
+        "relax"
+        if n_pods * n_nodes_padded >= RELAX_PLAN_CELLS
+        else "auction"
+    )
+
+
 def plan_moves(
     batch: NodeBatch,
     movable: list[tuple[Pod, int]],
@@ -98,6 +121,8 @@ def plan_moves(
     *,
     slot_nodes=None,
     auction: SingleShotConfig | None = None,
+    engine: str = "auto",
+    relax: RelaxConfig | None = None,
 ) -> list[tuple[Pod, int, int]]:
     """Target assignment for the candidate pods: the auction re-places
     them against the cluster's live load minus their own usage
@@ -115,7 +140,13 @@ def plan_moves(
     for the real solve to bounce it back, a perpetual churn loop the
     strict-gain selection alone cannot prevent (the gain math is
     packing-only). Without ``slot_nodes`` (synthetic tensor callers,
-    e.g. the bench) the mask degrades to schedulable-only."""
+    e.g. the bench) the mask degrades to schedulable-only.
+
+    ``engine``: ``"auction"`` (the narrow-window pack auction),
+    ``"relax"`` (the convex-relaxation mega-planner, solver/relax.py —
+    relaxed solve, deterministic rounding, auction tail repair at the
+    plan posture), or ``"auto"`` (route by shape via ``plan_engine``;
+    churn-budget-sized candidate lists stay on the auction)."""
     if not movable:
         return []
     import dataclasses
@@ -155,9 +186,22 @@ def plan_moves(
         static = trivial_static_tensors(
             pbatch, batch.padded, batch.valid & schedulable
         )
-    assigned = SingleShotSolver(plan_auction_config(auction)).solve(
-        plan_nodes, pbatch, static
-    )
+    chosen = plan_engine(len(pods), batch.padded, engine)
+    if chosen == "relax":
+        # mega-plan posture: pack-objective relaxation, then the SAME
+        # plan auction config repairs the integrality tail (narrow
+        # window, no repair phase) so the end state keeps the
+        # consolidation bias and the auction's feasibility guarantees
+        cfg = relax or RelaxConfig()
+        if cfg.objective != "pack":
+            cfg = dataclasses.replace(cfg, objective="pack")
+        assigned = RelaxSolver(
+            cfg, repair=plan_auction_config(auction)
+        ).solve(plan_nodes, pbatch, static)
+    else:
+        assigned = SingleShotSolver(plan_auction_config(auction)).solve(
+            plan_nodes, pbatch, static
+        )
     out: list[tuple[Pod, int, int]] = []
     for i, (pod, src) in enumerate(movable):
         dst = int(assigned[i])

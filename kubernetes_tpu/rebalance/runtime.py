@@ -53,6 +53,10 @@ class RebalanceConfig:
     # carry the auction target as a nominated-node hint on the evicted
     # pod (the solve then prefers it); off = plain requeue
     nominate: bool = True
+    # planning engine: "auction" | "relax" | "auto" (route by shape —
+    # see rebalance/planner.plan_engine; churn-budget-sized candidate
+    # lists stay on the auction, mega shapes take the relaxation)
+    plan_engine: str = "auto"
 
 
 @dataclass(frozen=True)
@@ -354,6 +358,7 @@ class Rebalancer:
                 raw = plan_moves(
                     batch, movable, fixed_used, fixed_cnt,
                     drain_slots, slot_nodes=slot_nodes,
+                    engine=cfg.plan_engine,
                 )
             plan_solve_s = self.clock.perf() - t0
             metrics.rebalance_plan_seconds.observe(plan_solve_s)

@@ -100,6 +100,14 @@ class SchedulerConfig:
     # against. 0 = auto (PJRT bytes_limit, else the conservative
     # solver/budget.py default floor).
     hbm_budget_bytes: int = 0
+    # mega-planner warm-start for drain_backlog (ISSUE 19): before the
+    # first chunk pops, a convex-relaxation solve (solver/relax.py)
+    # over the whole backlog ranks the activeQ so pods the relaxed
+    # plan co-locates pop adjacently and chunks pack against
+    # pre-fitted capacity instead of re-discovering it chunk by
+    # chunk. Priority stays the primary queue key — the rank only
+    # permutes pods within a priority band (queue.reorder_active).
+    backlog_warm_start: bool = False
     # defaultpreemption: run the PostFilter dry-run for unschedulable pods
     enable_preemption: bool = True
     # node-axis mesh for the device solve (parallel/sharding.py): number
@@ -315,6 +323,11 @@ class BacklogDrainReport:
     estimated_per_device_bytes: int = 0  # HBM model, resident worst case
     estimated_h2d_bytes: int = 0  # HBM model's predicted upload total
     measured_h2d_bytes: int = 0  # h2d counter delta over the drain
+    # mega-planner warm-start (ISSUE 19): activeQ entries re-keyed by
+    # the relaxed plan's rank (0 = warm-start off or nothing ranked)
+    warm_start_ranked: int = 0
+    relax_iterations: int = 0  # dual-ascent iterations the warm-start ran
+    relax_residual: float = 0.0  # final relative-overcommit residual
     results: list = field(default_factory=list)
 
 
@@ -5331,12 +5344,88 @@ class Scheduler:
             pad_multiple=pad_mult,
         )
 
+    def _warm_start_backlog(self, report: BacklogDrainReport) -> None:
+        """Mega-planner warm-start (ISSUE 19): one convex-relaxation
+        solve (solver/relax.py) over the WHOLE queued backlog against
+        the live snapshot, then re-key the activeQ tiebreak with the
+        relaxed plan's target-node rank — pods the global plan
+        co-locates pop adjacently, so each drain chunk arrives at the
+        solver already packed against pre-fitted capacity. Advisory
+        only: the per-chunk solves still place against cluster truth,
+        so a stale plan degrades to the old ordering, never to a wrong
+        binding. The relaxation's duals are exported per node group as
+        the ``scheduler_relax_dual_price`` autoscaler cost signal."""
+        import dataclasses
+
+        from .api.objects import ZONE_LABELS
+        from .solver.relax import RelaxConfig, RelaxSolver, group_prices
+
+        with self.cluster.lock:
+            batch = self.snapshot.update(self.cache)
+            pods = self.queue.active_pods()
+            slot_nodes = []
+            for name in self.snapshot.names:
+                info = self.cache.nodes.get(name) if name else None
+                slot_nodes.append(info.node if info is not None else None)
+        if not pods or batch.num_nodes == 0:
+            return
+        pbatch = build_pod_batch(pods, batch.vocab)
+        static = build_static_tensors(
+            pods, pbatch, slot_nodes, batch.padded
+        )
+        # the relaxation mutates its node batch's occupancy — plan on a
+        # throwaway copy, cluster truth is untouched. No tail repair:
+        # unranked pods just keep their FIFO order within the band.
+        plan_batch = dataclasses.replace(
+            batch,
+            allocatable=batch.allocatable.copy(),
+            used=batch.used.copy(),
+            nonzero_used=batch.used[:2].copy(),
+            pod_count=batch.pod_count.copy(),
+        )
+        solver = RelaxSolver(RelaxConfig(), repair=None)
+        assigned = solver.solve(plan_batch, pbatch, static)
+        stats = solver.last
+        rank = {
+            p.key: int(a)
+            for p, a in zip(pods, assigned)
+            if int(a) >= 0
+        }
+        with self.cluster.lock:
+            report.warm_start_ranked = self.queue.reorder_active(rank)
+        report.relax_iterations = stats.iterations
+        report.relax_residual = stats.residual
+        metrics.relax_iterations.observe(stats.iterations)
+        metrics.relax_residual.set(stats.residual)
+        metrics.relax_repair_rounds.observe(stats.repair_rounds)
+
+        def zone_of(node) -> str:
+            if node is not None:
+                for lbl in ZONE_LABELS:
+                    if lbl in node.labels:
+                        return node.labels[lbl]
+            return "default"
+
+        groups = [zone_of(nd) for nd in slot_nodes]
+        for grp, price in group_prices(
+            stats, groups, valid=batch.valid
+        ).items():
+            metrics.relax_dual_price.labels(grp).set(price)
+        self._log.info(
+            "backlog warm-start: ranked %d/%d pods in %d relax "
+            "iterations (residual %.4f)",
+            report.warm_start_ranked, len(pods),
+            stats.iterations, stats.residual,
+            extra={"step": self._trace_step},
+        )
+
     def drain_backlog(
         self,
         *,
         chunk_pods: int = 0,
         budget_bytes: int = 0,
         max_batches: int = 1_000_000,
+        warm_start: bool | None = None,
     ) -> BacklogDrainReport:
         """Drain the queued backlog through the streaming dispatcher in
         chunk-aligned sub-batches against the resident session — the
@@ -5407,6 +5496,12 @@ class Scheduler:
             backlog, chunk, splits, est.per_device_bytes, budget,
             extra={"step": self._trace_step},
         )
+        if (
+            warm_start
+            if warm_start is not None
+            else self.config.backlog_warm_start
+        ):
+            self._warm_start_backlog(report)
 
         old_batch = self.config.batch_size
         self.config.batch_size = chunk

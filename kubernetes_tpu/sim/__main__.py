@@ -123,6 +123,20 @@ def _print_result(res) -> None:
             f"partial_gangs={g['partial_gangs']} "
             f"quarantined_gangs={g['quarantined_gangs']}"
         )
+    mp = s.get("megaplan")
+    if mp:
+        # the CI megaplan smoke greps ranked/iterations/plan_valid/
+        # objective_ratio off this line — keep the key=value shape
+        print(
+            f"  megaplan: pods={mp.get('pods', 0)} "
+            f"ranked={mp.get('ranked', 0)} "
+            f"iterations={mp.get('iterations', 0)} "
+            f"repaired={mp.get('repaired', 0)} "
+            f"relax_placed={mp.get('relax_placed', 0)} "
+            f"exact_placed={mp.get('exact_placed', 0)} "
+            f"objective_ratio={mp.get('objective_ratio', 0.0)} "
+            f"plan_valid={mp.get('plan_valid', False)}"
+        )
     tel = s.get("telemetry")
     if tel:
         # the CI telemetry smoke greps anomalies/bundles_captured

@@ -56,6 +56,7 @@ from .invariants import (
     check_constraints,
     check_journal_completeness,
     check_lost_pods,
+    check_megaplan,
     check_no_partial_gangs,
     check_rebalance,
     check_recovery,
@@ -417,6 +418,10 @@ class SimHarness:
         # backlog drain (backlog_drain profiles): cycle 0's
         # drain_backlog report, surfaced in the footer summary
         self._backlog_report = None
+        # mega-planner probe result (megaplan profiles, ISSUE 19):
+        # relax-vs-oracle A/B on the pre-drain snapshot, counts and
+        # rounded ratios only so --selfcheck stays byte-identical
+        self._megaplan = None
         # was the tuner settled when the profile's workload shift
         # landed? Shift detection compares against the SETTLED
         # baseline signature, so a tuner still mid-convergence at the
@@ -550,6 +555,102 @@ class SimHarness:
             undelivered=self.bus.pending_pod_adds,
         )
 
+    def _megaplan_probe(self) -> None:
+        """Mega-planner acceptance probe (megaplan profiles, ISSUE 19):
+        on the FROZEN pre-drain cycle-0 snapshot, solve the whole
+        backlog with the convex relaxation (dual ascent + deterministic
+        rounding + auction tail repair — the exact engine the planner
+        and warm-start use) and replay the plan against the sequential
+        oracle:
+
+        - **validity** — every placed pick must be in the oracle's
+          feasible set at that step (``FullOracle.validate_feasible``:
+          no overcommit, every filter honored — tie-set parity is
+          deliberately NOT required of a global plan);
+        - **quality** — placements vs the oracle's own greedy run on
+          the identical snapshot; check_megaplan asserts the ratio
+          floor.
+
+        Everything is host python over frozen arrays — counts and
+        rounded ratios only ride the footer, so same-seed runs stay
+        byte-identical under --selfcheck."""
+        import dataclasses
+
+        from ..ops.oracle.profile import FullOracle, make_oracle_nodes
+        from ..solver.relax import RelaxConfig, RelaxSolver
+        from ..solver.single_shot import SingleShotConfig
+        from ..tensorize.plugins import build_static_tensors
+        from ..tensorize.schema import build_pod_batch
+
+        sched = self.scheduler
+        with self.cluster.lock:
+            batch = sched.snapshot.update(sched.cache)
+            pods = sched.queue.active_pods()
+            slot_nodes = []
+            for name in sched.snapshot.names:
+                info = sched.cache.nodes.get(name) if name else None
+                slot_nodes.append(info.node if info is not None else None)
+            bound: dict[str, list] = {}
+            for p in self.cluster.list_pods():
+                if p.node_name:
+                    bound.setdefault(p.node_name, []).append(p)
+        if not pods or batch.num_nodes == 0:
+            return
+        # the queue's own pop order: priority bands first, FIFO within
+        pods = sorted(
+            pods, key=lambda p: (-p.effective_priority, p.key)
+        )
+        pbatch = build_pod_batch(pods, batch.vocab)
+        static = build_static_tensors(
+            pods, pbatch, slot_nodes, batch.padded
+        )
+        plan_batch = dataclasses.replace(
+            batch,
+            allocatable=batch.allocatable.copy(),
+            used=batch.used.copy(),
+            nonzero_used=batch.used[:2].copy(),
+            pod_count=batch.pod_count.copy(),
+        )
+        solver = RelaxSolver(RelaxConfig(), repair=SingleShotConfig())
+        assigned = solver.solve(plan_batch, pbatch, static)
+        stats = solver.last
+        picks = [int(a) for a in assigned]
+        # a pick into the padding region is a validity failure in its
+        # own right — mask it to unplaced for the replay, count it
+        oob = [
+            (p.key, a)
+            for p, a in zip(pods, picks)
+            if a >= batch.num_nodes
+        ]
+        picks = [a if a < batch.num_nodes else -1 for a in picks]
+        names = [
+            batch.names[a] if a >= 0 else None for a in picks
+        ]
+        real_nodes = [nd for nd in slot_nodes if nd is not None]
+        errors = [
+            f"pod {k}: pick {a} is a padding slot" for k, a in oob
+        ] + FullOracle(
+            make_oracle_nodes(real_nodes, bound)
+        ).validate_feasible(pods, picks, names=names)
+        exact_assigned, _ = FullOracle(
+            make_oracle_nodes(real_nodes, bound)
+        ).schedule(pods)
+        relax_placed = int(sum(1 for a in picks if a >= 0))
+        exact_placed = int(sum(1 for a in exact_assigned if a >= 0))
+        self._megaplan = {
+            "pods": len(pods),
+            "relax_placed": relax_placed,
+            "exact_placed": exact_placed,
+            "objective_ratio": round(
+                relax_placed / max(exact_placed, 1), 4
+            ),
+            "plan_valid": not errors,
+            "plan_errors": len(errors),
+            "iterations": int(stats.iterations),
+            "residual": round(float(stats.residual), 4),
+            "repaired": int(stats.repaired_pods),
+        }
+
     def _drive_once(self, cycle: int) -> None:
         if self.profile.backlog and cycle == 0 and self.streaming:
             # the seeded mega-backlog drains through the HBM-budget-
@@ -565,8 +666,14 @@ class SimHarness:
             if self.profile.backlog_force_split:
                 shape = self.scheduler.drain_shape(chunk)
                 budget_bytes = hbm.estimate(shape).per_device_bytes - 1
+            if self.profile.backlog_warm_start:
+                # mega-planner probe on the FROZEN pre-drain snapshot:
+                # relax+repair vs the sequential oracle anchor —
+                # check_megaplan asserts validity + the ratio floor
+                self._megaplan_probe()
             report = self.scheduler.drain_backlog(
                 chunk_pods=chunk, budget_bytes=budget_bytes,
+                warm_start=self.profile.backlog_warm_start or None,
             )
             self._backlog_report = report
             for r in report.results:
@@ -910,6 +1017,26 @@ class SimHarness:
                 summary=telemetry_summary,
                 bundle_dir=self.bundle_dir,
             )
+        megaplan_summary = None
+        if self.profile.backlog_warm_start:
+            # merge the pre-drain probe with the drain report's
+            # warm-start counters (ranked pods, relax iterations) —
+            # check_megaplan needs both sides to call the feature
+            # engaged non-vacuously
+            rep = self._backlog_report
+            megaplan_summary = dict(self._megaplan or {})
+            megaplan_summary["ranked"] = (
+                rep.warm_start_ranked if rep is not None else 0
+            )
+            if not megaplan_summary.get("iterations"):
+                megaplan_summary["iterations"] = (
+                    rep.relax_iterations if rep is not None else 0
+                )
+            check_megaplan(
+                self.cycles + self.max_settle_rounds,
+                self.violations,
+                summary=megaplan_summary if self._megaplan else None,
+            )
         bindings = {
             p.key: p.node_name
             for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
@@ -1005,6 +1132,11 @@ class SimHarness:
                 if self._backlog_report is not None
                 else None
             ),
+            # convex-relaxation mega-planner (megaplan profiles): the
+            # pre-drain probe's validity/ratio verdict + warm-start
+            # counters — check_megaplan's assertion target; counts and
+            # rounded ratios only (byte-identical under --selfcheck)
+            "megaplan": megaplan_summary,
             # the journal digest rides in the footer, so the trace
             # selfcheck also proves journal byte-identity across runs
             # (all incarnations' lines, in incarnation order)

@@ -1097,3 +1097,66 @@ def check_telemetry(
             f"{len(dirs)} bundles written but none replayed "
             "bit-identical — the forensic loop never closed",
         )
+
+
+def check_megaplan(
+    cycle: int,
+    violations: list[Violation],
+    *,
+    summary: dict | None,
+    ratio_floor: float = 0.9,
+) -> None:
+    """Convex-relaxation mega-planner invariants (megaplan profiles,
+    ISSUE 19), checked after quiescence. Three claims, all asserted
+    non-vacuously:
+
+    - **engaged** — the warm-start relaxation actually iterated AND
+      re-ranked at least one backlog pod before the first chunk
+      popped; a megaplan profile that drains in plain FIFO order is
+      the feature silently disconnected, not a pass;
+    - **valid** — the probe's relaxed+rounded+repaired plan survived
+      the sequential oracle's feasibility replay (every placed pick in
+      the feasible set given identical history — no overcommit, every
+      filter honored). Tie-set parity is deliberately not required: a
+      global plan trades per-step greedy optimality for packing;
+    - **quality** — the plan's placements clear ``ratio_floor`` of the
+      oracle's own greedy run on the identical snapshot. The floor is
+      the acceptance bar for trusting the relaxation to ORDER work:
+      a plan much worse than greedy would make the warm-start an
+      anti-signal.
+    """
+    if summary is None:
+        _record(
+            violations, "megaplan", cycle,
+            "megaplan profile ran but the pre-drain probe produced no "
+            "summary — the probe never saw a backlog",
+        )
+        return
+    if summary.get("iterations", 0) < 1:
+        _record(
+            violations, "megaplan", cycle,
+            "warm-start relaxation never iterated — the mega-planner "
+            "did not engage",
+        )
+    if summary.get("ranked", 0) < 1:
+        _record(
+            violations, "megaplan", cycle,
+            "relaxed plan re-ranked zero backlog pods — the "
+            "warm-start reorder seam is disconnected",
+        )
+    if not summary.get("plan_valid", False):
+        _record(
+            violations, "megaplan", cycle,
+            "relaxed+rounded+repaired plan failed the oracle "
+            f"feasibility replay ({summary.get('plan_errors', '?')} "
+            "errors)",
+        )
+    ratio = summary.get("objective_ratio", 0.0)
+    if ratio < ratio_floor:
+        _record(
+            violations, "megaplan", cycle,
+            f"megaplan objective ratio {ratio} below the "
+            f"{ratio_floor} floor vs the exact anchor "
+            f"({summary.get('relax_placed')} vs "
+            f"{summary.get('exact_placed')} placed)",
+        )

@@ -158,6 +158,14 @@ class Profile:
     # budget_splits>=1 the CI smoke pins, robust to estimator formula
     # changes (an absolute byte figure here would not be)
     backlog_force_split: bool = False
+    # -- convex-relaxation mega-planner (solver/relax.py, ISSUE 19) --
+    # warm-start the cycle-0 backlog drain: one relaxed global solve
+    # over the whole active queue ranks the backlog before the first
+    # chunk pops (Scheduler.drain_backlog(warm_start=True)), and the
+    # harness runs its deterministic megaplan probe — relax+repair vs
+    # the exact anchor on the same frozen snapshot — whose plan
+    # validity and objective ratio ride the footer for check_megaplan.
+    backlog_warm_start: bool = False
     # -- closed-loop auto-tuning (kubernetes_tpu/tuning) --
     # enable the tuning runtime on the sim scheduler (hill-climb
     # controllers over stream_depth / pipeline_split / drain chunk,
@@ -559,6 +567,35 @@ PROFILES: dict[str, Profile] = {
             pod_spread_rate=0.25,
             pod_ports_rate=0.2,
             delete_pod_rate=0.6,
+        ),
+        # megaplan: the convex-relaxation mega-planner acceptance
+        # profile (ISSUE 19). Same seeded-backlog drive as
+        # backlog_drain, but the drain warm-starts: one relaxed global
+        # solve ranks the whole active queue before the first chunk
+        # pops, and the harness's megaplan probe runs relax+repair vs
+        # the exact anchor on the frozen cycle-0 snapshot.
+        # check_megaplan asserts the relaxation actually engaged
+        # (iterations + ranked pods non-zero), the relaxed-then-
+        # rounded plan is valid against the snapshot (no overcommit,
+        # every placement schedulable), and the probe's objective
+        # ratio clears the floor vs exact. Plain fit-scoped pods only
+        # (no spread/ports) so the probe compares the two engines on
+        # the scope both solve; mixed priorities exercise the
+        # warm-start's within-priority-band reorder contract.
+        # Byte-deterministic under --selfcheck like every profile.
+        Profile(
+            name="megaplan",
+            streaming=True,
+            nodes=12,
+            zones=3,
+            batch_size=16,
+            group_size=8,
+            backlog=120,
+            backlog_chunk=16,
+            backlog_warm_start=True,
+            arrivals=(1, 3),
+            pod_priorities=(0, 3, 7),
+            delete_pod_rate=0.4,
         ),
         # tuning_convergence: the auto-tuning acceptance profile — a
         # sustained streaming drive long enough for the hill-climb

@@ -241,6 +241,71 @@ def estimate(shape: DrainShape) -> ShapeEstimate:
     )
 
 
+@dataclass(frozen=True)
+class RelaxEstimate:
+    """Analytic per-device footprint of one relaxation solve
+    (solver/relax.py): the [RC, N] class tables + [K, N] duals shard
+    over the node axis; the per-pod rank/searchsorted workspace
+    replicates. Same WORKSPACE_FACTOR discipline as the drain model."""
+
+    node_pad: int
+    pod_pad: int
+    rc_pad: int
+    sharded_bytes: int
+    replicated_bytes: int
+    per_device_bytes: int
+    components: tuple
+
+
+def relax_estimate(
+    nodes: int,
+    pods: int,
+    rc: int,
+    vocab_k: int = 3,
+    mesh_devices: int = 1,
+    group: int = 64,
+) -> RelaxEstimate:
+    """Byte model of the relaxation's resident set at (pods, nodes,
+    rc): what ``RelaxSolver`` asserts against the device budget before
+    the 2M-pod mega-shape dispatches. Mirrors the arrays ``_relax``
+    materializes — fractional mass / logits / quota tables on [RC, N],
+    duals and integer capacities on [K, N], the flat quota prefix on
+    [RC * N], and the per-pod sort/rank/searchsorted workspace."""
+    pad_mult = mesh_devices if mesh_devices > 1 else 1
+    n = node_padding(nodes, pad_mult)
+    p = pod_padding(pods, group)
+    k = vocab_k
+    # [RC, N] lanes: x + softmax workspace (z, logits, pen) f32, the
+    # static ok mask (bool), desired + clamped quotas (int32)
+    class_tables = rc * n * (4 * 4 + 1 + 2 * 4)
+    # [K, N]: lam f32, free int64, alloc/used int64, inv_free f32
+    duals = k * n * (4 + 8 + 8 + 8 + 4) + n * (4 + 4 + 4)  # + mu/cnt/score
+    flat_prefix = rc * n * 8 * 2  # flat_q + gcum, int64
+    sharded = class_tables + duals + flat_prefix
+    # per-pod workspace: sort key + argsort (int64), rc_of/priority/
+    # rank/assigned (int32), valid (bool), g/flat_cell (int64)
+    per_pod = 8 + 8 + 4 * 4 + 1 + 8 + 8
+    replicated = p * per_pod
+    devices = max(mesh_devices, 1)
+    per_device = int(
+        WORKSPACE_FACTOR * (math.ceil(sharded / devices) + replicated)
+    )
+    return RelaxEstimate(
+        node_pad=n,
+        pod_pad=p,
+        rc_pad=rc,
+        sharded_bytes=sharded,
+        replicated_bytes=replicated,
+        per_device_bytes=per_device,
+        components=(
+            ("class_tables", class_tables, True),
+            ("duals", duals, True),
+            ("flat_prefix", flat_prefix, True),
+            ("pod_workspace", replicated, False),
+        ),
+    )
+
+
 def device_budget_bytes(override: int = 0) -> int:
     """The per-device HBM budget: an explicit override, else the
     runtime-reported ``bytes_limit`` (PJRT memory stats), else the
@@ -299,6 +364,7 @@ def assert_index_headroom(
     d_pad: int = DOM_PAD,
     group: int = 64,
     max_rounds_shift: int = 32,
+    rc_pad: int = 0,
 ) -> None:
     """Typed overflow audit for the flattened-index arithmetic the
     compiled solve programs form at this shape (the 512k x 102k scale
@@ -315,7 +381,13 @@ def assert_index_headroom(
     - class-rank keys (`rc_of * P + pod_idx`, single_shot.py): int64
       needs pod_pad^2 < 2^62 (rc count is bounded by pod count);
     - int32 per-pod/segment counters (cumsum ranks, pod counts):
-      pod_pad and node_pad and d_pad each < 2^31.
+      pod_pad and node_pad and d_pad each < 2^31;
+    - with ``rc_pad`` > 0 (the relaxation mega-planner, solver/
+      relax.py): the flat quota-prefix cell index (`rc * N`, int64)
+      needs rc_pad * node_pad < 2^63, and the class-priority rank key
+      (`rc * 2^32 + inv_prio`, int64) must stay strictly below the
+      2^62 invalid-pod sentinel — the relaxation's own flattened-index
+      lanes, audited at dispatch like the auction's.
     """
     i32 = 1 << 31
     i63 = 1 << 63
@@ -343,3 +415,14 @@ def assert_index_headroom(
         raise IndexWidthError(
             f"class-rank key (P^2, P={pod_pad}) would overflow int64"
         )
+    if rc_pad > 0:
+        if rc_pad * node_pad >= i63:
+            raise IndexWidthError(
+                f"relax flat quota-prefix cell (rc={rc_pad} x "
+                f"nodes={node_pad}) would overflow int64"
+            )
+        if rc_pad * (1 << 32) + (1 << 32) >= (1 << 62):
+            raise IndexWidthError(
+                f"relax class-priority rank key (rc={rc_pad} << 32) "
+                "would cross the invalid-pod sentinel (2^62)"
+            )

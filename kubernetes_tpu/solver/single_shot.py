@@ -291,9 +291,10 @@ def _single_shot(
         return rounds + 1, placed, carry
 
     init_placed = jnp.int32(1)
-    _, _, carry = jax.lax.while_loop(
+    main_rounds, _, carry = jax.lax.while_loop(
         cond, body, (jnp.int32(0), init_placed, (used0, pod_count0, price0, assigned0))
     )
+    rounds_total = main_rounds
 
     if repair_rounds > 0 and p > 0:
         # full-width repair: every feasible node is biddable, and the
@@ -314,13 +315,14 @@ def _single_shot(
             carry_r, placed, rejected = repair_round(carry_r)
             return rounds + 1, (placed + rejected) > 0, carry_r
 
-        _, _, carry = jax.lax.while_loop(
+        rep_rounds, _, carry = jax.lax.while_loop(
             cond_rep, body_rep, (jnp.int32(0), jnp.bool_(True), carry)
         )
+        rounds_total = rounds_total + rep_rounds
 
     used, pod_count, _, assigned_to = carry
     placed_total = jnp.sum((assigned_to >= 0).astype(jnp.int32))
-    return assigned_to, used, pod_count, placed_total
+    return assigned_to, used, pod_count, placed_total, rounds_total
 
 
 _single_shot_jit = jax.jit(
@@ -407,7 +409,7 @@ class SingleShotSolver:
             ]
         else:
             args = [jnp.asarray(a) for a in args]
-        assigned, used, pod_count, _ = _single_shot_jit(
+        assigned, used, pod_count, _, _ = _single_shot_jit(
             *args,
             max_rounds=self.config.max_rounds,
             price_step=self.config.price_step,

@@ -154,6 +154,46 @@ class PriorityQueue:
         waiting sets) — never a mutation surface."""
         return dict(self._where)
 
+    def active_pods(self) -> list[Pod]:
+        """Live activeQ pods, unordered snapshot — the mega-planner's
+        warm-start reads the POPULATION to plan over (heap order is
+        what ``reorder_active`` is about to rewrite anyway)."""
+        return [
+            self._info[key].pod
+            for key, where in self._where.items()
+            if where == "active"
+        ]
+
+    def reorder_active(self, rank: dict[str, int]) -> int:
+        """Warm-start reorder (ISSUE 19): re-key the activeQ heap's
+        tiebreak slot with an externally computed rank so pods the
+        mega-planner expects to co-locate pop adjacently and the drain
+        chunks pack against pre-fitted capacity. PRIORITY STAYS THE
+        PRIMARY KEY — PrioritySort's contract is untouched; the rank
+        only permutes pods WITHIN a priority band (it replaces the
+        queue-timestamp tiebreak, which carries no cross-pod semantics
+        beyond FIFO fairness). Unranked pods keep popping after ranked
+        ones in their band, FIFO among themselves via the seq slot.
+        No-op (returns 0) under a custom QueueSort ``less`` — an
+        out-of-tree comparator owns the full key and must not be
+        second-guessed. Returns the number of live entries re-keyed."""
+        if self._less is not None or not self._active:
+            return 0
+        fresh: list[tuple[int, float, int, str]] = []
+        rekeyed = 0
+        for neg_prio, _ts, seq, key in self._active:
+            if self._where.get(key) != "active":
+                continue  # stale entry: drop during the rebuild
+            r = rank.get(key)
+            if r is None:
+                fresh.append((neg_prio, float("inf"), seq, key))
+            else:
+                fresh.append((neg_prio, float(r), seq, key))
+                rekeyed += 1
+        heapq.heapify(fresh)
+        self._active = fresh
+        return rekeyed
+
     def _push_active(self, info: QueuedPodInfo) -> None:
         if self._less is not None:
             key0 = _SortKey(info, self._less)

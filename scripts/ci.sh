@@ -127,6 +127,27 @@ echo "$backlog_out" | grep -qE "fallbacks=0 " \
 echo "$backlog_out" | grep -qE "stream_chained=[0-9]+" \
     || { echo "BACKLOG SMOKE: no chain accounting in the footer"; exit 1; }
 
+echo "== megaplan smoke: convex-relaxation warm-started drain =="
+# megaplan: the backlog drain warm-starts — one relaxed global solve
+# (solver/relax.py: dual ascent + deterministic rounding) ranks the
+# whole active queue before the first chunk pops — and the harness's
+# probe replays the relax+repair plan against the sequential oracle.
+# check_megaplan asserts engagement, feasibility, and the objective-
+# ratio floor; the greps pin each leg non-vacuously off the footer so
+# a silently-disconnected warm-start (ranked=0) or a never-iterating
+# relaxation cannot pass. --selfcheck proves the probe + warm-start +
+# drain pipeline byte-deterministic (counts and rounded ratios only
+# ride the footer).
+mega_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 4 \
+    --profile megaplan --selfcheck)
+echo "$mega_out"
+echo "$mega_out" | grep -qE "megaplan: pods=[1-9].* ranked=[1-9]" \
+    || { echo "MEGAPLAN SMOKE: warm-start ranked no backlog pods"; exit 1; }
+echo "$mega_out" | grep -qE "megaplan: .*iterations=[1-9]" \
+    || { echo "MEGAPLAN SMOKE: the relaxation never iterated"; exit 1; }
+echo "$mega_out" | grep -qE "megaplan: .*plan_valid=True" \
+    || { echo "MEGAPLAN SMOKE: relaxed plan failed oracle feasibility"; exit 1; }
+
 echo "== tuning smoke: closed-loop auto-tuning convergence =="
 # tuning_convergence: the hill-climb controllers (stream_depth /
 # pipeline_split, sim-sized evaluation windows) must probe both

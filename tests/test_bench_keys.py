@@ -59,6 +59,10 @@ OWED_KEYS = {
     # flight telemetry (PR 18, ladder #13 refresh)
     "profiler_overhead_fraction",
     "anomaly_detection_lag_batches",
+    # convex-relaxation mega-planner (PR 19, ladder #16)
+    "relax_plan_seconds",
+    "relax_objective_ratio",
+    "megaplan_pods_per_sec",
 }
 
 

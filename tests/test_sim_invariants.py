@@ -703,3 +703,75 @@ def test_telemetry_clean_without_bundle_dir():
     v = []
     check_telemetry(5, v, summary=_tel_summary())
     assert v == []
+
+
+# -- megaplan ---------------------------------------------------------------
+
+
+def _mp_summary(**kw):
+    base = {
+        "pods": 120,
+        "ranked": 90,
+        "iterations": 64,
+        "plan_valid": True,
+        "plan_errors": 0,
+        "objective_ratio": 1.02,
+        "relax_placed": 95,
+        "exact_placed": 93,
+    }
+    base.update(kw)
+    return base
+
+
+def test_megaplan_flags_missing_probe():
+    from kubernetes_tpu.sim.invariants import check_megaplan
+
+    v = []
+    check_megaplan(5, v, summary=None)
+    assert [x.invariant for x in v] == ["megaplan"]
+
+
+def test_megaplan_flags_never_iterated():
+    from kubernetes_tpu.sim.invariants import check_megaplan
+
+    v = []
+    check_megaplan(5, v, summary=_mp_summary(iterations=0))
+    assert [x.invariant for x in v] == ["megaplan"]
+    assert "never iterated" in v[0].detail
+
+
+def test_megaplan_flags_disconnected_reorder_seam():
+    from kubernetes_tpu.sim.invariants import check_megaplan
+
+    v = []
+    check_megaplan(5, v, summary=_mp_summary(ranked=0))
+    assert [x.invariant for x in v] == ["megaplan"]
+    assert "re-ranked zero" in v[0].detail
+
+
+def test_megaplan_flags_infeasible_plan():
+    from kubernetes_tpu.sim.invariants import check_megaplan
+
+    v = []
+    check_megaplan(
+        5, v, summary=_mp_summary(plan_valid=False, plan_errors=3)
+    )
+    assert [x.invariant for x in v] == ["megaplan"]
+    assert "feasibility replay" in v[0].detail
+
+
+def test_megaplan_flags_ratio_below_floor():
+    from kubernetes_tpu.sim.invariants import check_megaplan
+
+    v = []
+    check_megaplan(5, v, summary=_mp_summary(objective_ratio=0.5))
+    assert [x.invariant for x in v] == ["megaplan"]
+    assert "floor" in v[0].detail
+
+
+def test_megaplan_clean_on_good_summary():
+    from kubernetes_tpu.sim.invariants import check_megaplan
+
+    v = []
+    check_megaplan(5, v, summary=_mp_summary())
+    assert v == []
