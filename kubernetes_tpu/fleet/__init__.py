@@ -29,10 +29,23 @@ primary's op log, a ``HubLease`` grants monotone fencing epochs, and
 over with jittered backoff — a deposed primary rejects writes with the
 typed ``HubDeposed`` and clients verify the epoch on every reply is
 monotone, so a partitioned old primary can never accept a CAS the new
-primary doesn't know about.
+primary doesn't know about. ``SqliteHubLease`` (fleet/leasestore.py)
+backs the same lease interface with one SQLite file — persisted
+fencing epochs, provable multi-host offline.
+
+The fleet BACKLOG DRAIN (fleet/drain.py, ROADMAP #5a) shards a cold
+512k-pod backlog across the fleet: the hub-primary-hosted coordinator
+runs the relax mega-plan once globally, partitions pods by
+planned-node shard ownership, and hands each replica an epoch-fenced
+drain lease; replicas drain their partitions concurrently through
+their own ``drain_backlog`` slot rings (``fleet_drain_backlog``), a
+dead replica's lease returns for reassignment, and the cross-shard-
+constrained residual drains serialized at the end.
 """
 
+from . import drain
 from .ha import HubLease, LocalHubClient, StandbyReplicator
+from .leasestore import SqliteHubLease
 from .membership import FleetMembership, shard_index
 from .occupancy import (
     AdmitConflict,
@@ -73,7 +86,9 @@ __all__ = [
     "PeerView",
     "PodRow",
     "RingNode",
+    "SqliteHubLease",
     "decode_rows",
+    "drain",
     "encode_rows",
     "ring_nodes_from",
     "shard_index",

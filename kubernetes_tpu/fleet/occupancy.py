@@ -46,15 +46,27 @@ all of this over the bulk service's ``HubOp`` RPC via
 gRPC ABORTED and fenced conflicts to FAILED_PRECONDITION — semantic
 rejections the BulkClient never retries (unlike UNAVAILABLE).
 
-Granularity scope note: the CAS compares against the ONE hub-wide
-version, so any interleaved write — even a row that cannot touch the
-admitted pod's spread domain — costs the admit a re-fetch/re-check
-round (bounded by FleetRuntime._CAS_ATTEMPTS, then an ordinary
-requeue; ``scheduler_fleet_admit_cas_conflict_total`` is the
-observability). Safe by construction, and the write-behind batching in
-RemoteOccupancyExchange collapses most benign churn into one bump per
-flush; per-domain versioning is the refinement if constrained-cohort
-contention ever shows up in that counter (ROADMAP fleet depth note).
+Granularity scope note: by default the CAS compares against the ONE
+hub-wide version, so any interleaved write — even a row that cannot
+touch the admitted pod's spread domain — costs the admit a
+re-fetch/re-check round (bounded by FleetRuntime._CAS_ATTEMPTS, then
+an ordinary requeue; ``scheduler_fleet_admit_cas_conflict_total`` is
+the observability). The fleet backlog drain made that contention
+measurable (N replicas' write-behind flushes all bump the one
+version), so ``compare_and_stage(domain_scope=True)`` now offers
+PER-DOMAIN versioning, keyed on what actually interferes: a
+LABEL-FREE row's only cross-replica effect is capacity on its node,
+and a node lives in exactly one zone — so label-free rows bump only
+their zone's domain version. Label-bearing rows can shift spread
+skew / anti-affinity evaluation in EVERY zone (selectors are global),
+and membership mutations (publish/replace/retire/handoff) reshape the
+domain inventory itself — those bump the hub-wide domain FLOOR.
+Fleet-drain ledger mutations bump neither (the ledger touches no
+occupancy row), which is precisely the churn the scoped CAS stops
+paying for. A domain-scoped CAS conflicts iff
+``max(zone_version, floor) > expected_version`` — same typed
+rejection, same fence, strictly fewer spurious retries
+(``FleetConfig.cas_domain`` opts a replica in).
 
 High availability (hub HA): the hub is no longer necessarily one
 process. Every mutation appends a version-keyed entry to an
@@ -80,6 +92,7 @@ new primary structurally ignores anything an old one still serves.
 
 from __future__ import annotations
 
+import copy
 import threading
 from dataclasses import dataclass, replace
 from typing import Iterable, Mapping
@@ -87,6 +100,7 @@ from typing import Iterable, Mapping
 import numpy as np
 
 from .. import metrics
+from . import drain as fleet_drain
 
 PENDING = "pending"
 COMMITTED = "committed"
@@ -309,6 +323,22 @@ class OccupancyExchange:
         # keeps serving its own shard (the fallback ladder guarantees
         # forward progress); this flag only shapes cross-shard routing.
         self._degraded: set[str] = set()  # ktpu: replicated
+        # fleet backlog drain ledger (fleet/drain.py state dict, None
+        # while no drain is active): partitions, granted leases, the
+        # done map, and the orphan pool. Replicated as INCREMENTAL
+        # "drain" op-log entries replayed through the same pure
+        # state-machine functions, so a promoted standby continues the
+        # ledger without a gap — a 512k-key ledger must not be
+        # re-shipped wholesale per progress report.
+        self._drain: dict | None = None  # ktpu: replicated
+        # per-domain CAS versions (scope note up top): zone -> hub
+        # version at the last label-free row landing in it, plus the
+        # hub-wide floor every globally-visible mutation advances.
+        # Reset conservatively (floor = version) on snapshot install —
+        # a freshly promoted standby starts strict and relaxes as new
+        # writes refine the map.
+        self._domain_versions: dict[str, int] = {}
+        self._domain_floor = 0
 
     @property
     # ktpu: fence-exempt(down-gated wake-seed read; admission-relevant version reads ride peers_version, which is fenced)
@@ -546,6 +576,27 @@ class OccupancyExchange:
         constrained pod fleet-wide (review-caught)."""
         self._published_at[replica] = self._clock.now()
 
+    # callers hold self._lock and have ALREADY bumped self._version for
+    # the mutation being recorded (domain versions store the post-bump
+    # value — the version a domain-scoped CAS must not be older than).
+    # Scope rule from the module docstring: a label-free row's only
+    # cross-replica effect is capacity on its node, and a node lives in
+    # one zone — zone-local; a label-bearing row can shift spread/anti
+    # evaluation in every zone — hub-wide floor.
+    # ktpu: fenced-by-caller
+    def _bump_domain_row_locked(self, row: PodRow) -> None:
+        if row.labels:
+            self._domain_floor = self._version
+        else:
+            self._domain_versions[row.zone] = self._version
+
+    # callers hold self._lock, post-bump (see above): membership-shaped
+    # mutations (publish/replace/retire/handoff/claim/degraded) change
+    # what EVERY domain's admission can see
+    # ktpu: fenced-by-caller
+    def _bump_domain_floor_locked(self) -> None:
+        self._domain_floor = self._version
+
     def peers_version(self, replica: str) -> int:
         """The hub version as seen from ``replica`` (reachability-
         gated, unlike the raw ``version`` property)."""
@@ -569,6 +620,7 @@ class OccupancyExchange:
             self._ensure_primary_locked(write=True, op="publish_nodes")
             self._revoked.discard(replica)
             self._version += 1
+            self._bump_domain_floor_locked()
             self._node_rows[replica] = {r.node: r for r in rows}
             self._touch(replica)
             self._log(
@@ -589,12 +641,14 @@ class OccupancyExchange:
     # ktpu: fenced-by-caller
     def _stage_locked(self, replica: str, row: PodRow) -> None:
         self._version += 1
+        self._bump_domain_row_locked(row)
         self._pod_rows.setdefault(replica, {})[row.pod] = row
         self._touch(replica)
         self._log("row", [replica, pod_row_to_list(row)])
 
     def compare_and_stage(
-        self, replica: str, row: PodRow, expected_version: int
+        self, replica: str, row: PodRow, expected_version: int,
+        *, domain_scope: bool = False,
     ) -> int:
         """Cross-process atomic admit: land ``row`` as pending ONLY if
         the hub is still at ``expected_version`` — the version the
@@ -604,14 +658,33 @@ class OccupancyExchange:
         caller's view may hide a racing placement: reject with a typed
         ``AdmitConflict`` and let the caller re-fetch + re-admit.
         Returns the new hub version on success. Fenced (retired)
-        replicas reject regardless of version."""
+        replicas reject regardless of version.
+
+        ``domain_scope=True`` narrows the compare to the row's DOMAIN
+        (module-docstring scope note): conflict iff a write that could
+        actually interfere — a row in the same zone, any label-bearing
+        row, any membership mutation — landed past ``expected_version``.
+        Interleaved writes that provably cannot touch this row's
+        admission (label-free rows in OTHER zones, fleet-drain ledger
+        mutations) no longer cost the caller a re-fetch round. The
+        caller still passes the same fetched view version either way —
+        opting in changes only which interleavings reject."""
         with self._lock:
             self._check_reachable(replica)
             self._ensure_primary_locked(write=True, op="cas_stage")
             self._check_write_fence(replica)
-            if self._version != expected_version:
+            if domain_scope:
+                effective = max(
+                    self._domain_versions.get(row.zone, 0),
+                    self._domain_floor,
+                )
+                conflict = effective > expected_version
+            else:
+                effective = self._version
+                conflict = self._version != expected_version
+            if conflict:
                 raise AdmitConflict(
-                    f"hub version moved to {self._version} past the "
+                    f"hub version moved to {effective} past the "
                     f"admitted view at {expected_version}: a peer's row "
                     "landed first — re-fetch and re-admit",
                     version=self._version,
@@ -632,6 +705,7 @@ class OccupancyExchange:
             self._ensure_primary_locked(write=True, op="replace_pod_rows")
             self._revoked.discard(replica)
             self._version += 1
+            self._bump_domain_floor_locked()
             self._pod_rows[replica] = {r.pod: r for r in rows}
             self._touch(replica)
             self._log(
@@ -655,6 +729,7 @@ class OccupancyExchange:
         if row is None or row.state == COMMITTED:
             return False
         self._version += 1
+        self._bump_domain_row_locked(row)
         committed = replace(row, state=COMMITTED)
         self._pod_rows[replica][pod_key] = committed
         self._touch(replica)
@@ -677,9 +752,11 @@ class OccupancyExchange:
     # callers hold self._lock post-checks; True if a row was removed
     # ktpu: fenced-by-caller
     def _withdraw_locked(self, replica: str, pod_key: str) -> bool:
-        if self._pod_rows.get(replica, {}).pop(pod_key, None) is None:
+        row = self._pod_rows.get(replica, {}).pop(pod_key, None)
+        if row is None:
             return False
         self._version += 1
+        self._bump_domain_row_locked(row)
         self._touch(replica)
         self._log("row_del", [replica, pod_key])
         return True
@@ -710,7 +787,17 @@ class OccupancyExchange:
             self._published_at.pop(replica, None)
             if had:
                 self._version += 1
+                self._bump_domain_floor_locked()
             self._log("retire", [replica])
+            # a dead replica's drain lease returns for reassignment:
+            # outstanding keys (and an unclaimed base partition) become
+            # orphans the next claimant adopts — no backlog pod is lost
+            # to a mid-drain death. Rides retire so every death path
+            # (membership change, sim kill, operator) returns it.
+            if self._drain is not None:
+                if fleet_drain.return_leases(self._drain, replica):
+                    self._version += 1
+                self._log("drain", ["return", replica])
         self._m["retired"].inc()
 
     # -- degraded flags (solve-resilience breaker state) --
@@ -730,6 +817,7 @@ class OccupancyExchange:
             else:
                 self._degraded.discard(replica)
             self._version += 1
+            self._bump_domain_floor_locked()
             self._touch(replica)
             self._log("degraded", [replica, bool(degraded)])
 
@@ -790,6 +878,7 @@ class OccupancyExchange:
                 self._check_write_fence(from_replica)
                 self._touch(from_replica)
             self._version += 1
+            self._bump_domain_floor_locked()
             self._handoffs.setdefault(to_replica, {})[pod_key] = (
                 hops, trace,
             )
@@ -809,6 +898,7 @@ class OccupancyExchange:
             if not rows:
                 return []
             self._version += 1
+            self._bump_domain_floor_locked()
             self._log("claim", [replica])
             return [
                 (k, hops, trace)
@@ -828,6 +918,168 @@ class OccupancyExchange:
                 k for rows in self._handoffs.values() for k in rows
             }
 
+    # -- fleet backlog drain (the fleet/drain.py ledger, hub-hosted) --
+
+    def drain_init(
+        self, replica: str, partitions: Mapping, residual,
+        *, membership_version: int = 0,
+    ) -> dict:
+        """Install a fresh drain ledger: the coordinator (whoever
+        hosts the hub primary) ran the global relax plan, partitioned
+        the backlog by planned-node shard ownership, and registers the
+        result here. Epoch-fenced like every hub write — a deposed
+        coordinator's plan never lands — and rejected while a previous
+        drain still has outstanding work (two concurrent global plans
+        would hand the same pod to two leases)."""
+        partitions = {
+            str(r): [str(k) for k in ks]
+            for r, ks in partitions.items()
+        }
+        residual = [str(k) for k in residual]
+        with self._lock:
+            self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="drain_init")
+            self._check_write_fence(replica)
+            if (
+                self._drain is not None
+                and not fleet_drain.status(self._drain)["complete"]
+            ):
+                raise AdmitConflict(
+                    "a fleet backlog drain is already in progress: "
+                    "its ledger must drain dry before a new global "
+                    "plan may land",
+                    version=self._version,
+                )
+            self._drain = fleet_drain.new_state(
+                partitions, residual,
+                epoch=self._epoch,
+                membership_version=int(membership_version),
+            )
+            self._version += 1
+            self._touch(replica)
+            self._log(
+                "drain",
+                ["init", partitions, residual, self._epoch,
+                 int(membership_version)],
+            )
+            st = fleet_drain.status(self._drain)
+        metrics.fleet_drain_partitions.set(st["partitions"])
+        metrics.fleet_drain_residual_pods.set(st["residual"])
+        return st
+
+    def drain_claim(self, replica: str) -> dict | None:
+        """Grant ``replica`` its next drain lease (fleet/drain.py
+        claim order: its own partition, then orphaned work, then the
+        serialized residual cohort). Idempotent — a retried claim
+        re-serves the granted lease verbatim. Returns None when no
+        work is claimable (the replica polls again next cycle)."""
+        with self._lock:
+            self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="drain_claim")
+            self._check_write_fence(replica)
+            # liveness: the claim poll proves contact either way
+            self._touch(replica)
+            if self._drain is None:
+                return None
+            lease, reassigned = fleet_drain.claim(self._drain, replica)
+            if lease is None:
+                return None
+            self._version += 1
+            self._log("drain", ["claim", replica])
+        if reassigned:
+            metrics.fleet_drain_lease_reassignments_total.inc()
+        return lease
+
+    def drain_progress(self, replica: str, keys) -> int:
+        """Record pods ``replica`` drained under its lease (one report
+        per applied chunk). Doubles as the replica's LIVENESS refresh:
+        a long chunk keeps writing nothing else to the hub, and
+        without the touch here its publish stamp would age past
+        ``max_row_age_s`` mid-drain and flip every peer's constrained
+        admission conservative (the staleness interaction the drain
+        tentpole must not regress)."""
+        keys = [str(k) for k in keys]
+        with self._lock:
+            self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="drain_progress")
+            self._check_write_fence(replica)
+            self._touch(replica)
+            if self._drain is None:
+                return 0
+            n = fleet_drain.progress(self._drain, replica, keys)
+            if n:
+                self._version += 1
+                self._log("drain", ["progress", replica, keys])
+        return n
+
+    def drain_complete(self, replica: str, lease_id: str) -> bool:
+        """Mark ``replica``'s granted lease done (its partition slice
+        fully drained through its slot ring)."""
+        with self._lock:
+            self._check_reachable(replica)
+            self._ensure_primary_locked(write=True, op="drain_complete")
+            self._check_write_fence(replica)
+            self._touch(replica)
+            if self._drain is None:
+                return False
+            ok = fleet_drain.complete(
+                self._drain, replica, str(lease_id)
+            )
+            if ok:
+                self._version += 1
+                self._log(
+                    "drain", ["complete", replica, str(lease_id)]
+                )
+        return ok
+
+    # ktpu: fence-exempt(down-gated observability read: footers, metrics, the sim's ledger introspection)
+    def drain_status(self) -> dict:
+        """Counts-only ledger summary (``active=False`` while no drain
+        ledger is installed). Down-gated like every read; served by
+        standbys too — 'how far did the drain get' is a post-failover
+        question."""
+        with self._lock:
+            self._check_down_locked()
+            if self._drain is None:
+                return {"active": False}
+            return dict(fleet_drain.status(self._drain), active=True)
+
+    # ktpu: fence-exempt(down-gated sim-invariant surface, like pending_handoff_keys)
+    def drain_outstanding_keys(self) -> list:
+        """Backlog keys not yet drained — the fleet lost-pod invariant
+        counts these as hub-tracked (mid-reassignment they sit in no
+        replica's queue, exactly like an unclaimed handoff)."""
+        with self._lock:
+            self._check_down_locked()
+            if self._drain is None:
+                return []
+            return fleet_drain.outstanding_keys(self._drain)
+
+    # callers hold self._lock (apply_replicated): replay one "drain"
+    # op-log entry through the SAME pure state-machine functions the
+    # primary ran, so the standby's ledger is bit-identical without
+    # ever shipping the 512k-key state wholesale
+    # ktpu: fence-exempt(standby log replay: the replication apply path MUST write while not primary — fencing it would invert HA)
+    def _apply_drain_locked(self, payload) -> None:
+        sub = payload[0]
+        if sub == "init":
+            _sub, partitions, residual, epoch, mv = payload
+            self._drain = fleet_drain.new_state(
+                partitions, residual,
+                epoch=int(epoch), membership_version=int(mv),
+            )
+            return
+        if self._drain is None:
+            return
+        if sub == "claim":
+            fleet_drain.claim(self._drain, payload[1])
+        elif sub == "progress":
+            fleet_drain.progress(self._drain, payload[1], payload[2])
+        elif sub == "complete":
+            fleet_drain.complete(self._drain, payload[1], payload[2])
+        elif sub == "return":
+            fleet_drain.return_leases(self._drain, payload[1])
+
     # ktpu: fence-exempt(post-mortem bypass: reading a dead process's last state; dispatch_hub_op never exposes it)
     def debug_state(self) -> dict:
         """Harness/post-mortem introspection that deliberately
@@ -844,6 +1096,7 @@ class OccupancyExchange:
                 "degraded": sorted(self._degraded),
                 "version": self._version,
                 "opseq": self._opseq,
+                "drain": copy.deepcopy(self._drain),
             }
 
     # -- reading --
@@ -1041,6 +1294,7 @@ class OccupancyExchange:
                 "flushSeen": {
                     r: [c, s] for r, (c, s) in self._flush_seen.items()
                 },
+                "drain": copy.deepcopy(self._drain),
             }
 
     # ktpu: fence-exempt(standby join: the replication apply path MUST write while not primary — fencing it would invert HA)
@@ -1079,6 +1333,13 @@ class OccupancyExchange:
                 r: (str(c), int(s))
                 for r, (c, s) in (snap.get("flushSeen") or {}).items()
             }
+            self._drain = copy.deepcopy(snap.get("drain"))
+            # domain versions restart conservative: floor at the
+            # installed version means a domain-scoped CAS behaves
+            # hub-wide until new writes refine the per-zone map —
+            # strictly MORE conflicts, never a missed one
+            self._domain_versions = {}
+            self._domain_floor = self._version
             self._oplog.clear()
 
     # ktpu: fence-exempt(standby log replay: the replication apply path MUST write while not primary — fencing it would invert HA)
@@ -1148,10 +1409,23 @@ class OccupancyExchange:
             elif kind == "flush_seen":
                 r, client, seq = payload
                 self._flush_seen[r] = (str(client), int(seq))
+            elif kind == "drain":
+                self._apply_drain_locked(payload)
             # unknown kinds are skipped (forward compatibility), but
             # the cursor still advances — the primary wrote them
             self._opseq = opseq
             self._version = version
+            # replayed mutations refine the standby's domain map with
+            # the same scope rule the primary applied ("row" entries
+            # land in _pod_rows above; everything else that moved the
+            # version is membership-shaped or ledger churn)
+            if kind == "row":
+                r, rowlist = payload
+                self._bump_domain_row_locked(pod_row_from_list(rowlist))
+            elif kind == "drain":
+                pass  # ledger churn bumps no domain (the whole point)
+            else:
+                self._domain_floor = version
             self._oplog.append(list(entry))
 
     # ktpu: fence-exempt(down-gated observability read; role/epoch are part of the PAYLOAD here, not a gate)
@@ -1178,6 +1452,11 @@ class OccupancyExchange:
                 "journal_lines": len(self._journal),
                 "flush_dedup_hits": self.flush_dedup_hits,
                 "deposed_write_rejections": self.deposed_write_rejections,
+                "drain": (
+                    fleet_drain.status(self._drain)
+                    if self._drain is not None
+                    else None
+                ),
             }
 
 
@@ -1308,6 +1587,7 @@ def dispatch_hub_op(hub: OccupancyExchange, op: str, meta: Mapping) -> dict:
             replica,
             pod_row_from_list(meta["row"]),
             int(meta["expect"]),
+            domain_scope=bool(meta.get("domain_scope")),
         )
     elif op == "replace_pod_rows":
         hub.replace_pod_rows(
@@ -1375,6 +1655,27 @@ def dispatch_hub_op(hub: OccupancyExchange, op: str, meta: Mapping) -> dict:
             out["snapshot"] = hub.snapshot()
         else:
             out["ops"] = ops
+    elif op == "drain_init":
+        # the fleet backlog drain ledger (fleet/drain.py): coordinator
+        # installs the global plan's partitions; replicas claim leases,
+        # report per-chunk progress (their liveness refresh mid-drain),
+        # and complete — all epoch-fenced hub writes
+        out["status"] = hub.drain_init(
+            replica,
+            meta.get("partitions") or {},
+            meta.get("residual") or [],
+            membership_version=int(meta.get("membership_version") or 0),
+        )
+    elif op == "drain_claim":
+        out["lease"] = hub.drain_claim(replica)
+    elif op == "drain_progress":
+        out["done"] = hub.drain_progress(replica, meta.get("keys") or [])
+    elif op == "drain_complete":
+        out["ok"] = hub.drain_complete(
+            replica, str(meta.get("lease") or "")
+        )
+    elif op == "drain_status":
+        out["status"] = hub.drain_status()
     elif op == "hub_status":
         out["status"] = hub.hub_status()
     else:

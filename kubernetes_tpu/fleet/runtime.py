@@ -102,6 +102,16 @@ class FleetConfig:
     # (kubernetes_tpu/tuning, knob "fleet_flush"); 0 = the adapter's
     # built-in default. In-process hubs ignore it (no wire to batch).
     flush_batch: int = 0
+    # per-domain CAS versioning (config key fleet.casDomain; the
+    # occupancy module docstring's granularity scope note): scope each
+    # compare_and_stage to the row's interference domain instead of
+    # the one hub-wide version, so N replicas' concurrent write-behind
+    # flushes (a fleet backlog drain's steady state) stop costing
+    # every constrained admit a spurious re-fetch round. Off by
+    # default — measure scheduler_fleet_admit_cas_conflict_total
+    # first; the bench fleet-drain ladder turns it on and reports the
+    # conflict delta.
+    cas_domain: bool = False
 
     def __post_init__(self) -> None:
         if not self.replicas:
@@ -554,7 +564,8 @@ class RemoteOccupancyExchange:
         self._buffered("stage", pod_row_to_list(row))
 
     def compare_and_stage(
-        self, replica: str, row: PodRow, expected_version: int
+        self, replica: str, row: PodRow, expected_version: int,
+        *, domain_scope: bool = False,
     ) -> int:
         from .occupancy import pod_row_to_list
 
@@ -566,6 +577,7 @@ class RemoteOccupancyExchange:
             self._op(
                 "cas_stage", replica=replica, row=pod_row_to_list(row),
                 expect=int(expected_version),
+                domain_scope=bool(domain_scope),
             )["version"]
         )
 
@@ -592,6 +604,52 @@ class RemoteOccupancyExchange:
     def retire(self, replica: str) -> None:
         self.flush()
         self._op("retire", replica=replica)
+
+    # -- fleet backlog drain ledger ops (fleet/drain.py) --
+
+    def drain_init(
+        self, replica: str, partitions, residual,
+        *, membership_version: int = 0,
+    ) -> dict:
+        self.flush()
+        return dict(
+            self._op(
+                "drain_init", replica=replica,
+                partitions={
+                    str(r): list(ks) for r, ks in partitions.items()
+                },
+                residual=list(residual),
+                membership_version=int(membership_version),
+            ).get("status")
+            or {}
+        )
+
+    def drain_claim(self, replica: str) -> dict | None:
+        self.flush()
+        lease = self._op("drain_claim", replica=replica).get("lease")
+        return dict(lease) if lease else None
+
+    def drain_progress(self, replica: str, keys) -> int:
+        # flush first: the progress report asserts this chunk's rows
+        # landed, so the buffered stage/commit ops must precede it
+        self.flush()
+        return int(
+            self._op(
+                "drain_progress", replica=replica, keys=list(keys)
+            ).get("done")
+            or 0
+        )
+
+    def drain_complete(self, replica: str, lease_id: str) -> bool:
+        self.flush()
+        return bool(
+            self._op(
+                "drain_complete", replica=replica, lease=str(lease_id)
+            ).get("ok")
+        )
+
+    def drain_status(self) -> dict:
+        return dict(self._op("drain_status").get("status") or {})
 
     def set_degraded(self, replica: str, degraded: bool) -> None:
         self.flush()
@@ -1370,6 +1428,7 @@ class FleetRuntime:
                         self._zone_of(cache, node_name), PENDING,
                     ),
                     peers.version,
+                    domain_scope=self.config.cas_domain,
                 )
             # ktpu: ignore[RETRY001]: CAS loop, not a replay — each attempt re-fetches peers.version and re-runs the host-side recheck before re-staging, so a version conflict retries a NEW request; fenced conflicts break out below. Bounded by _CAS_ATTEMPTS.
             except AdmitConflict as e:
@@ -1541,3 +1600,141 @@ class FleetRuntime:
             self._exchange_dirty = True
         except AdmitConflict:
             self._needs_resync = True
+
+    # -- fleet backlog drain (fleet/drain.py ledger, hub-hosted) --
+
+    def drain_init_from_plan(self, planned: dict, keys) -> dict:
+        """Coordinator half of the fleet backlog drain: partition the
+        globally-planned backlog by planned-node shard ownership and
+        install the ledger at the hub. ``planned`` maps pod key to its
+        relax-planned node name (None = unplaced); ``keys`` is the
+        backlog in plan order. Cross-shard-constrained pods (the
+        reconciler predicate) and gangs route per fleet/drain.py's
+        partitioner rules. Epoch-fenced at the hub — a deposed
+        coordinator's plan never lands."""
+        from . import drain as drain_mod
+        from ..gang import GangTracker
+
+        with self.cluster.lock:
+            assignment = dict(self._assignment)
+            membership_version = self.membership.version
+
+        def _pod_of(key):
+            try:
+                ns, name = key.split("/", 1)
+                return self.cluster.get_pod(ns, name)
+            except Exception:
+                return None
+
+        def _cross_shard(key):
+            pod = _pod_of(key)
+            return pod is not None and self._needs_reconcile(pod)
+
+        def _gang_of(key):
+            pod = _pod_of(key)
+            if pod is None:
+                return ""
+            return GangTracker.gang_of(pod) or ""
+
+        partitions, residual = drain_mod.partition_backlog(
+            keys, planned, assignment,
+            gang_of=_gang_of, cross_shard=_cross_shard,
+        )
+        return self.exchange.drain_init(
+            self.replica, partitions, residual,
+            membership_version=membership_version,
+        )
+
+    def drain_claim(self, scheduler, plan_keys=None) -> dict | None:
+        """Claim this replica's next drain lease and ADOPT its keys:
+        each becomes this replica's routed pod (the claim_handoffs
+        adoption pattern) and enters its queue. When ``plan_keys`` —
+        the full drain plan's key set — is provided, pods the plan
+        assigns to OTHER replicas' leases are SHED from this queue
+        (ring routing filled it by pod-key hash; the drain partition
+        is by planned-node owner, and a pod queued at two replicas is
+        a double-solve at best). Returns the lease dict (with ``id``
+        and ``keys``) or None when nothing is claimable."""
+        try:
+            lease = self.exchange.drain_claim(self.replica)
+        except ExchangeUnreachable:
+            with self.cluster.lock:
+                self._exchange_dirty = True
+            return None
+        except AdmitConflict:
+            with self.cluster.lock:
+                self._needs_resync = True
+            return None
+        if not lease:
+            return None
+        lease_keys = [str(k) for k in lease.get("keys") or []]
+        with self.cluster.lock:
+            tracked = scheduler.queue.entries()
+            for key in lease_keys:
+                try:
+                    ns, name = key.split("/", 1)
+                    pod = self.cluster.get_pod(ns, name)
+                except Exception:
+                    continue  # deleted while the ledger held it
+                if pod.node_name:
+                    # bound while the ledger held it (a prior lease
+                    # holder's bind landed before its death)
+                    continue
+                self._routed_here[key] = 0
+                self._routed_away.discard(key)
+                if (
+                    key not in tracked
+                    and key not in scheduler._in_flight
+                    and key not in scheduler._waiting
+                    and pod.scheduler_name in scheduler.solvers
+                ):
+                    scheduler.queue.add(pod)
+            if plan_keys is not None:
+                mine = set(lease_keys)
+                tracked = scheduler.queue.entries()
+                for key in sorted(
+                    (set(plan_keys) & set(tracked)) - mine
+                ):
+                    if key in scheduler._in_flight:
+                        continue  # too late: this solve owns it now
+                    self._routed_away.add(key)
+                    self._routed_here.pop(key, None)
+                    scheduler.queue.delete(key)
+        return lease
+
+    def drain_chunk_progress(self, keys) -> int:
+        """Per-applied-chunk progress report — the ledger's done map
+        AND this replica's liveness refresh: a replica deep in a long
+        drain chunk writes nothing else to the hub, and without the
+        report's touch its publish stamp would age past max_row_age_s
+        and flip every peer's constrained admission conservative."""
+        if not keys:
+            return 0
+        try:
+            return self.exchange.drain_progress(
+                self.replica, list(keys)
+            )
+        except ExchangeUnreachable:
+            with self.cluster.lock:
+                self._exchange_dirty = True
+            return 0
+        except AdmitConflict:
+            with self.cluster.lock:
+                self._needs_resync = True
+            return 0
+
+    def drain_complete(self, lease_id: str) -> bool:
+        try:
+            return bool(
+                self.exchange.drain_complete(
+                    self.replica, str(lease_id)
+                )
+            )
+        except ExchangeUnreachable:
+            with self.cluster.lock:
+                self._exchange_dirty = True
+            return False
+        except AdmitConflict:
+            with self.cluster.lock:
+                self._needs_resync = True
+            return False

@@ -553,6 +553,41 @@ fleet_flush_dedup_total = Counter(
     "double-stage and its journal lines double-append.",
     registry=REGISTRY,
 )
+fleet_drain_partitions = Gauge(
+    "scheduler_fleet_drain_partitions",
+    "Replica partitions in the active fleet backlog drain's ledger "
+    "(drain_init): the hub-hosted coordinator ran the global relax "
+    "plan once and split the backlog by planned-node shard ownership; "
+    "each partition drains concurrently under its own drain lease.",
+    registry=REGISTRY,
+)
+fleet_drain_residual_pods = Gauge(
+    "scheduler_fleet_drain_residual_pods",
+    "Pods in the fleet backlog drain's residual cohort: cross-shard-"
+    "constrained (spread / anti-affinity), plan-unplaced, or planned "
+    "onto an unowned node — drained SERIALIZED as one lease after "
+    "every shard partition completes, so constraint correctness is "
+    "never traded for parallelism. A large value means the partitioner "
+    "is forfeiting the fleet speedup.",
+    registry=REGISTRY,
+)
+fleet_drain_lease_reassignments_total = Counter(
+    "scheduler_fleet_drain_lease_reassignments_total",
+    "Drain leases reassigned after a holder died mid-drain: the hub "
+    "retire returned the lease's outstanding keys to the orphan pool "
+    "and a surviving replica claimed them (the no-pod-lost half of the "
+    "drain ledger's exactly-once contract).",
+    registry=REGISTRY,
+)
+fleet_drain_replica_seconds = Histogram(
+    "scheduler_fleet_drain_replica_seconds",
+    "Wall time one replica spent draining one claimed lease through "
+    "its own drain_backlog slot ring (fleet_drain_backlog) — the "
+    "per-replica denominator behind the fleet drain speedup.",
+    buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+             600.0),
+    registry=REGISTRY,
+)
 fleet_mesh_slice_devices = Gauge(
     "scheduler_fleet_mesh_slice_devices",
     "Devices in this replica's EXCLUSIVE mesh slice "

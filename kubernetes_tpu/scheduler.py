@@ -4480,6 +4480,16 @@ class Scheduler:
                 self._discard_streak = 0
                 self._last_discard_step = -1
                 self._commit_all(infos, pending, res)
+                if self._backlog_drain_active and self.fleet is not None:
+                    # fleet drain: the per-chunk progress report feeds
+                    # the hub's lease ledger AND refreshes this
+                    # replica's liveness stamp — a replica deep in a
+                    # long drain writes nothing else to the hub, and
+                    # without the touch it would age past max_row_age_s
+                    # and flip every peer conservative
+                    self.fleet.drain_chunk_progress(
+                        [k for k, _ in res.scheduled]
+                    )
                 res.completed_at = self.clock.perf()
                 return res
         self._discard_flight(flight)
@@ -5588,6 +5598,140 @@ class Scheduler:
         metrics.backlog_drain_seconds.observe(dt)
         metrics.backlog_hbm_measured_bytes.set(report.measured_h2d_bytes)
         return report
+
+    def relax_plan_backlog(self, pods=None) -> "dict[str, str | None]":
+        """The fleet drain COORDINATOR's planning half (ROADMAP #5a):
+        one relax mega-solve over the backlog, returned as a pod-key ->
+        planned-node-name map (None = the relaxation left the pod
+        unplaced). Same solve the warm-start runs (ISSUE 19), but here
+        the OUTPUT is the plan itself — ``fleet/drain.py`` partitions
+        the backlog by the shard that owns each planned node, so every
+        replica drains pods the global plan already packed against its
+        own nodes. Advisory like the warm-start: a stale plan only
+        mis-shards (extra cross-shard CAS traffic), never mis-binds."""
+        import dataclasses
+
+        from .solver.relax import RelaxConfig, RelaxSolver
+
+        with self.cluster.lock:
+            batch = self.snapshot.update(self.cache)
+            if pods is None:
+                pods = self.queue.active_pods()
+            slot_nodes = []
+            for name in self.snapshot.names:
+                info = self.cache.nodes.get(name) if name else None
+                slot_nodes.append(info.node if info is not None else None)
+        if not pods or batch.num_nodes == 0:
+            return {p.key: None for p in pods}
+        pbatch = build_pod_batch(pods, batch.vocab)
+        static = build_static_tensors(
+            pods, pbatch, slot_nodes, batch.padded
+        )
+        plan_batch = dataclasses.replace(
+            batch,
+            allocatable=batch.allocatable.copy(),
+            used=batch.used.copy(),
+            nonzero_used=batch.used[:2].copy(),
+            pod_count=batch.pod_count.copy(),
+        )
+        assigned = RelaxSolver(RelaxConfig(), repair=None).solve(
+            plan_batch, pbatch, static
+        )
+        plan: dict = {}
+        for p, a in zip(pods, assigned):
+            a = int(a)
+            plan[p.key] = (
+                batch.names[a] if 0 <= a < batch.num_nodes else None
+            )
+        return plan
+
+    def fleet_drain_backlog(
+        self,
+        *,
+        chunk_pods: int = 0,
+        budget_bytes: int = 0,
+        max_batches: int = 1_000_000,
+        warm_start: bool | None = False,
+        plan_keys=None,
+    ) -> dict:
+        """Replica half of the FLEET backlog drain (ROADMAP #5a):
+        claim drain leases from the hub ledger and drain each through
+        this replica's own ``drain_backlog`` slot ring until nothing is
+        claimable. The claim adopts the lease's pods into this queue
+        and — given ``plan_keys``, the full plan's key set — sheds pods
+        the plan leased elsewhere (ring routing filled the queue by
+        pod-key hash; the drain re-partitions by planned-node owner).
+        Each pass runs under this replica's slice of the fleet HBM
+        budget (``split_fleet_budget``); a lease completes at the hub
+        only once none of its pods is still live in the queue, so a
+        partially-drained lease stays reassignable. Warm-start defaults
+        OFF — the global plan already packed each partition; pass
+        ``warm_start=True`` to re-rank locally anyway."""
+        from .solver import budget as hbm
+
+        if self.fleet is None:
+            raise RuntimeError("fleet_drain_backlog requires fleet mode")
+        total = hbm.device_budget_bytes(
+            budget_bytes or self.config.hbm_budget_bytes
+        )
+        my_budget = hbm.split_fleet_budget(
+            total,
+            len(self.fleet.membership.universe),
+            replica_index=self.fleet.shard,
+        )
+        t0 = self.clock.perf()
+        leases: list = []
+        results: list = []
+        reports: list = []
+        drained = 0
+        while True:
+            lease = self.fleet.drain_claim(self, plan_keys)
+            if not lease:
+                break
+            lease_keys = [str(k) for k in lease.get("keys") or []]
+            rep = self.drain_backlog(
+                chunk_pods=chunk_pods,
+                budget_bytes=my_budget,
+                max_batches=max_batches,
+                warm_start=warm_start,
+            )
+            drained += rep.drained
+            results.extend(rep.results)
+            reports.append(rep)
+            # complete only when no lease pod is still live in the
+            # queue: unschedulable stragglers stay THIS replica's pods
+            # through the routing the claim adopted them under, and an
+            # un-completed lease re-serves (or returns on death) so the
+            # ledger never strands them
+            with self.cluster.lock:
+                live = set(self.queue.entries())
+            remaining = sum(1 for k in lease_keys if k in live)
+            completed = False
+            if remaining == 0:
+                completed = self.fleet.drain_complete(lease["id"])
+            leases.append(
+                {
+                    "id": lease["id"],
+                    "kind": lease.get("kind", ""),
+                    "pods": len(lease_keys),
+                    "completed": completed,
+                    "remaining": remaining,
+                }
+            )
+            if remaining:
+                break  # stragglers need outside help; don't spin
+        dt = self.clock.perf() - t0
+        metrics.fleet_drain_replica_seconds.observe(dt)
+        return {
+            "replica": self.fleet.replica,
+            "leases": leases,
+            "drained": drained,
+            "seconds": dt,
+            "pods_per_sec": drained / dt if dt > 0 else 0.0,
+            "budget_bytes": my_budget,
+            "results": results,
+            "reports": reports,
+        }
 
     def hub_status(self) -> "dict | None":
         """The ``GET /debug/hub`` body: the occupancy hub's role /

@@ -222,6 +222,18 @@ def _print_fleet_result(res) -> None:
             f"partial_gangs={g['partial_gangs']} "
             f"quarantined_gangs={g['quarantined_gangs']}"
         )
+    fd = s.get("fleet_drain")
+    if fd:
+        # the CI fleet-drain smoke greps leases_reassigned/lost/
+        # double_bind off this line — keep the key=value shape
+        print(
+            f"  fleet_drain: pods={fd['pods']} "
+            f"partitions={fd['partitions']} "
+            f"residual={fd['residual']} drained={fd['drained']} "
+            f"leases={fd['leases']} "
+            f"leases_reassigned={fd['leases_reassigned']} "
+            f"lost={fd['lost']} double_bind={fd['double_bind']}"
+        )
     for rid in sorted(res.journal_digests):
         print(f"  journal[{rid}]={res.journal_digests[rid]}")
     print(

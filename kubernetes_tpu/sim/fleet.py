@@ -52,6 +52,7 @@ from .invariants import (
     Violation,
     _record,
     check_constraints,
+    check_fleet_drain,
     check_fleet_journal_completeness,
     check_hub_failover,
     check_hub_partition,
@@ -320,20 +321,69 @@ class FleetSimHarness:
         self._zombie: str | None = None
         self._zombie_fenced = False
         self._zombie_binds_while_fenced = 0
+        # fleet backlog drain (the fleet_backlog_drain profile): the
+        # cycle-0 backlog drains through the hub's drain-lease ledger
+        # (fleet/drain.py) instead of plain per-replica streaming
+        self._fleet_drain = self.profile.fleet_drain
+        self._drain_plan_keys: set[str] | None = None
+        self._backlog_keys: set[str] = set()
+        # backlog key -> replicas that reported it scheduled: the
+        # drain-partition half of the double-bind story (the tracker
+        # asserts the cluster-level half every cycle)
+        self._drain_bound: dict[str, list[str]] = {}
+        self._planner: Scheduler | None = None
+        if self._fleet_drain:
+            # the coordinator's full-view planner: a NON-fleet
+            # Scheduler on the same cluster — replica caches are
+            # ownership-filtered to their shard's nodes, so only an
+            # unfiltered subscriber can run the relax mega-plan
+            # globally. Never driven: it only plans.
+            self._planner = Scheduler(
+                self.cluster,
+                SchedulerConfig(
+                    batch_size=self.profile.batch_size,
+                    mesh_devices=1,
+                    solver=ExactSolverConfig(
+                        tie_break="first",
+                        group_size=self.profile.group_size,
+                    ),
+                ),
+                clock=self.clock,
+            )
 
     # -- drive --
 
     def _drive_replica(self, rid: str, cycle: int) -> None:
         sched = self.schedulers[rid]
-        if self.streaming:
-            results = sched.run_streaming(max_batches=200)
-        elif self.pipelined:
-            results = sched.run_pipelined(max_batches=200)
-        else:
-            results = sched.run_until_settled(max_batches=200)
+        results = None
+        if self._fleet_drain and self._drain_outstanding():
+            # drain mode: claim-adopt-drain one lease chunk through
+            # this replica's own drain_backlog slot ring (one chunk
+            # per cycle keeps the concurrent-drain interleaving and
+            # the mid-lease kill non-vacuous). No claimable lease ->
+            # fall through to the normal drive so fresh arrivals and
+            # handed-off pods still progress.
+            out = sched.fleet_drain_backlog(
+                chunk_pods=self.profile.backlog_chunk or 0,
+                max_batches=1,
+                plan_keys=self._drain_plan_keys,
+            )
+            if out["leases"]:
+                results = out["results"]
+        if results is None:
+            if self.streaming:
+                results = sched.run_streaming(max_batches=200)
+            elif self.pipelined:
+                results = sched.run_pipelined(max_batches=200)
+            else:
+                results = sched.run_until_settled(max_batches=200)
         scheduled = [
             (pod, node) for r in results for pod, node in r.scheduled
         ]
+        if self._fleet_drain:
+            for pod, _node in scheduled:
+                if pod in self._backlog_keys:
+                    self._drain_bound.setdefault(pod, []).append(rid)
         if rid == self._zombie and self._zombie_fenced and scheduled:
             # a fenced zombie's commit LANDED: the fence leaked
             self._zombie_binds_while_fenced += len(scheduled)
@@ -366,6 +416,27 @@ class FleetSimHarness:
         for rid in order:
             if self.alive[rid]:
                 self._drive_replica(rid, cycle)
+
+    # -- fleet backlog drain (the fleet_backlog_drain profile) --
+
+    def _init_fleet_drain(self) -> None:
+        """The coordinator seam, cycle 0: the full-view planner runs
+        the relax mega-plan once globally; the first replica partitions
+        the backlog by planned-node shard owner and installs the lease
+        ledger at the hub (``FleetRuntime.drain_init_from_plan`` ->
+        epoch-fenced ``drain_init``). Key order is the planner's queue
+        order — the plan order every partition preserves."""
+        plan = self._planner.relax_plan_backlog()
+        keys = list(plan)
+        self._backlog_keys = set(keys)
+        self._drain_plan_keys = set(keys)
+        self.schedulers[self.universe[0]].fleet.drain_init_from_plan(
+            plan, keys
+        )
+
+    def _drain_outstanding(self) -> bool:
+        st = self.exchange.drain_status()
+        return bool(st.get("active")) and st.get("outstanding", 0) > 0
 
     def _kill_replica(self, rid: str, cycle: int) -> None:
         """A process crash as the rest of the fleet perceives it: the
@@ -538,6 +609,11 @@ class FleetSimHarness:
         tracked: set[str] = set(
             self.exchange.debug_state()["pending_handoffs"]
         )
+        if self._fleet_drain:
+            # mid-reassignment a returned drain lease's keys sit in no
+            # replica's queue — the hub ledger tracks them until the
+            # next claimant adopts (like an unclaimed handoff row)
+            tracked |= set(self.exchange.drain_outstanding_keys())
         solver_names: set[str] = set()
         for rid, sched in self.schedulers.items():
             if not self.alive[rid]:
@@ -582,6 +658,21 @@ class FleetSimHarness:
     def _settled(self) -> bool:
         if self.exchange.debug_state()["pending_handoffs"]:
             return False
+        if self._fleet_drain:
+            # not settled while the ledger can still grant work whose
+            # pods sit in NO queue: unclaimed orphans, an in-flight
+            # granted lease, or a residual cohort awaiting its
+            # serialized grant (its keys were shed from every queue)
+            st = self.exchange.drain_status()
+            if st.get("active") and (
+                st.get("orphans", 0)
+                or st.get("granted", 0)
+                or (
+                    st.get("residual", 0)
+                    and not st.get("residualGranted")
+                )
+            ):
+                return False
         for rid, sched in self.schedulers.items():
             if not self.alive[rid]:
                 continue
@@ -621,6 +712,11 @@ class FleetSimHarness:
                 # post-advance, pre-drive: the serving hub's lease
                 # renewal covers this drive's ops
                 self._ha_tick(cycle)
+            if self._fleet_drain and cycle == 0:
+                # after the backlog landed, before any replica drives:
+                # the coordinator plans globally and installs the
+                # drain-lease ledger at the hub
+                self._init_fleet_drain()
             self._drive(cycle)
             self._check(cycle)
         settled = self._quiesce()
@@ -773,6 +869,35 @@ class FleetSimHarness:
             for p in sorted(self.cluster.list_pods(), key=lambda q: q.key)
             if p.node_name
         }
+        fleet_drain = None
+        if self._fleet_drain:
+            st = self.exchange.drain_status()
+            lost = sum(
+                1 for k in self._backlog_keys if k not in bindings
+            )
+            double = sum(
+                1 for v in self._drain_bound.values() if len(v) > 1
+            )
+            fleet_drain = {
+                "pods": len(self._backlog_keys),
+                "partitions": st.get("partitions", 0),
+                "residual": st.get("residual", 0),
+                "drained": st.get("done", 0),
+                "leases": st.get("leases", 0),
+                "leases_reassigned": st.get("reassigned", 0),
+                "lost": lost,
+                "double_bind": double,
+            }
+            check_fleet_drain(
+                self.cycles + self.max_settle_rounds,
+                self.violations,
+                backlog=len(self._backlog_keys),
+                drained=st.get("done", 0),
+                double_binds=double,
+                lost=lost,
+                leases_reassigned=st.get("reassigned", 0),
+                expect_reassign=self.profile.replica_loss_at >= 0,
+            )
         unbound = sorted(
             p.key for p in self.cluster.list_pods() if not p.node_name
         )
@@ -822,6 +947,12 @@ class FleetSimHarness:
             # loss because gangs route whole and commit through one
             # replica's fenced CAS round
             "gang": self._gang_summary(),
+            # fleet backlog drain (fleet_drain profiles; None without):
+            # lost counts backlog keys unbound fleet-wide at end — the
+            # ledger's own done counter may legitimately trail it when
+            # residual pods are handed off and bound by a peer's normal
+            # drive, so the invariant anchors on bindings, not the ledger
+            "fleet_drain": fleet_drain,
         }
         flight_dumps: dict[str, str] = {}
         if self.violations:

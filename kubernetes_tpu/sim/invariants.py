@@ -1160,3 +1160,70 @@ def check_megaplan(
             f"({summary.get('relax_placed')} vs "
             f"{summary.get('exact_placed')} placed)",
         )
+
+
+def check_fleet_drain(
+    cycle: int,
+    violations: list[Violation],
+    *,
+    backlog: int,
+    drained: int,
+    double_binds: int,
+    lost: int,
+    leases_reassigned: int,
+    expect_reassign: bool,
+) -> None:
+    """Fleet backlog-drain invariants (the fleet_backlog_drain
+    profile, ROADMAP #5a), checked after quiescence. The hub's lease
+    ledger promises exactly-once drain semantics across a fleet of
+    concurrent drainers — including through a mid-drain replica kill:
+
+    - **engaged** — a backlog existed and the ledger recorded drain
+      progress; a fleet-drain profile that drains nothing (or whose
+      coordinator never installed a ledger) is the feature silently
+      disconnected, not a pass;
+    - **none lost** — every cycle-0 backlog pod ended bound somewhere
+      in the fleet (``lost`` counts the stragglers). A dead replica's
+      outstanding lease keys must come back as orphans and drain at a
+      survivor;
+    - **none doubled** — zero backlog pods were reported scheduled by
+      more than one replica: the one-granted-lease-per-pod rule held
+      (the per-cycle double-bind tracker asserts the cluster-level
+      half; this clause pins the drain-lease partitioning itself);
+    - **reassignment engaged** (kill profiles) — the dead replica's
+      lease actually returned and a survivor claimed it at least once;
+      zero reassignments under a replica kill means the
+      return-on-retire seam is disconnected.
+    """
+    if backlog < 1:
+        _record(
+            violations, "fleet_drain", cycle,
+            "fleet-drain profile ran with an empty backlog — the "
+            "drain invariants are vacuous",
+        )
+        return
+    if drained < 1:
+        _record(
+            violations, "fleet_drain", cycle,
+            "the drain ledger recorded zero pods drained — the "
+            "coordinator/lease seam never engaged",
+        )
+    if lost > 0:
+        _record(
+            violations, "fleet_drain", cycle,
+            f"{lost} backlog pod(s) ended unbound fleet-wide — the "
+            "drain lost work (a returned lease's keys must be "
+            "reassigned, not dropped)",
+        )
+    if double_binds > 0:
+        _record(
+            violations, "fleet_drain", cycle,
+            f"{double_binds} backlog pod(s) were scheduled by more "
+            "than one replica — a pod belonged to two drain leases",
+        )
+    if expect_reassign and leases_reassigned < 1:
+        _record(
+            violations, "fleet_drain", cycle,
+            "a replica died mid-drain but no lease was ever "
+            "reassigned — the return-on-retire seam is disconnected",
+        )

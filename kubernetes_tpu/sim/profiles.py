@@ -158,6 +158,17 @@ class Profile:
     # budget_splits>=1 the CI smoke pins, robust to estimator formula
     # changes (an absolute byte figure here would not be)
     backlog_force_split: bool = False
+    # -- fleet backlog drain (fleet/drain.py, ROADMAP #5a) --
+    # drain the cycle-0 backlog through the hub's drain-lease ledger
+    # instead of per-replica run_streaming: a full-view planner runs
+    # the relax mega-plan once globally, the first replica installs
+    # the partitioned ledger at the hub (drain_init), and every alive
+    # replica claims/drains epoch-fenced leases per cycle
+    # (Scheduler.fleet_drain_backlog). Combine with replica_loss_at to
+    # kill a replica mid-lease — the reassignment path the
+    # check_fleet_drain invariant pins. Requires backlog > 0,
+    # fleet_replicas >= 2, and the streaming drive.
+    fleet_drain: bool = False
     # -- convex-relaxation mega-planner (solver/relax.py, ISSUE 19) --
     # warm-start the cycle-0 backlog drain: one relaxed global solve
     # over the whole active queue ranks the backlog before the first
@@ -227,6 +238,17 @@ class Profile:
                 "capacity reductions makes transient overcommit legitimate, "
                 "so the capacity invariant would be unsound — see module "
                 "docstring)"
+            )
+        if self.fleet_drain and (
+            not self.backlog
+            or self.fleet_replicas < 2
+            or not self.streaming
+        ):
+            raise ValueError(
+                f"profile {self.name}: fleet_drain needs a cycle-0 "
+                "backlog, fleet_replicas >= 2, and the streaming drive "
+                "(the drain leases feed Scheduler.drain_backlog's "
+                "chunked streaming path)"
             )
         if (self.gang_rate > 0 or self.gang_short_at >= 0) and any(
             self.pod_priorities
@@ -567,6 +589,45 @@ PROFILES: dict[str, Profile] = {
             pod_spread_rate=0.25,
             pod_ports_rate=0.2,
             delete_pod_rate=0.6,
+        ),
+        # fleet_backlog_drain: the fleet-tier drain acceptance profile
+        # (fleet/drain.py, ROADMAP #5a). A seeded backlog lands at
+        # cycle 0 across a 3-replica fleet; the coordinator seam runs
+        # the relax mega-plan ONCE globally on a full-view planner,
+        # partitions pods by planned-node shard owner (spread pods —
+        # cross-shard-constrained — fall to the serialized residual
+        # cohort), and installs the lease ledger at the hub. Replicas
+        # drain concurrently, one chunk per cycle, through their own
+        # drain_backlog slot rings; the LAST replica is killed at
+        # cycle 1 — mid-lease — so its outstanding keys must return as
+        # orphans and drain at a survivor (check_fleet_drain pins
+        # reassigned >= 1, zero lost, zero double-binds; the CI smoke
+        # greps the fleet_drain footer line). Capacity is sized so the
+        # whole backlog binds (node_cpu=16 x 12 vs ~90 requested CPU);
+        # no delete churn, so "every backlog pod ends bound" is exact.
+        # Byte-deterministic under --selfcheck like every profile.
+        Profile(
+            name="fleet_backlog_drain",
+            streaming=True,
+            nodes=12,
+            node_cpu="16",
+            # one zone ON PURPOSE: hard-spread pods still carry a
+            # DoNotSchedule constraint (cross-shard -> residual cohort)
+            # but stay satisfiable from ANY shard. With 3 zones the
+            # ring can hand a replica zero nodes in the underfilled
+            # zone; after one handoff lap such pods legally park
+            # unschedulable, and the drain gate here is lost==0.
+            zones=1,
+            batch_size=16,
+            group_size=8,
+            backlog=120,
+            backlog_chunk=16,
+            fleet_drain=True,
+            fleet_replicas=3,
+            replica_loss_at=1,
+            arrivals=(1, 3),
+            pod_cpu_choices=("500m", "1"),
+            pod_spread_rate=0.2,
         ),
         # megaplan: the convex-relaxation mega-planner acceptance
         # profile (ISSUE 19). Same seeded-backlog drive as

@@ -324,6 +324,22 @@ def device_budget_bytes(override: int = 0) -> int:
     return DEFAULT_DEVICE_BUDGET_BYTES
 
 
+def split_fleet_budget(
+    total_bytes: int, replicas: int, *, replica_index: int = 0
+) -> int:
+    """One replica's slice of a shared per-device HBM budget for the
+    FLEET backlog drain. Multi-process replicas own exclusive device
+    slices and pass the full budget through (replicas=1); co-hosted
+    replicas (sim, tests) drain CONCURRENTLY against the same device,
+    so each must plan its chunks against an even split — the remainder
+    goes to the low indices, and every replica gets at least one byte
+    so ``plan_chunk`` fails typed (BudgetExceeded), not on a zero."""
+    replicas = max(int(replicas), 1)
+    total = max(int(total_bytes), replicas)
+    share, rem = divmod(total, replicas)
+    return share + (1 if int(replica_index) % replicas < rem else 0)
+
+
 def plan_chunk(
     shape: DrainShape,
     budget_bytes: int,

@@ -314,6 +314,30 @@ python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile fleet_mixed \
 python -m kubernetes_tpu.sim --seed 0 --cycles 8 --profile replica_loss \
     --fleet 2
 
+echo "== fleet drain smoke: hub-coordinated backlog drain (ISSUE 20) =="
+# fleet_backlog_drain: a seeded backlog partitioned by the coordinator's
+# global relax plan into per-replica drain leases (hub ledger), drained
+# by a 3-replica fleet with ONE replica killed mid-drain at cycle 1 —
+# its outstanding lease must RETURN to the ledger (retire runs
+# return_leases) and be re-claimed by a survivor, so no backlog pod
+# drains twice and none is lost. The greps pin the fault engaging
+# non-vacuously off the `fleet_drain:` footer line (the header's
+# lost= field is the killed REPLICA, so every grep anchors on the
+# footer key): >= 1 lease reassigned, zero pods lost fleet-wide, zero
+# double-binds. --selfcheck proves the whole coordinator -> lease ->
+# drain -> kill -> reassign pipeline byte-deterministic.
+fdrain_out=$(python -m kubernetes_tpu.sim --seed 0 --cycles 12 \
+    --profile fleet_backlog_drain --fleet 3 --selfcheck)
+echo "$fdrain_out"
+echo "$fdrain_out" | grep -qE "fleet_drain:.* leases_reassigned=[1-9]" \
+    || { echo "FLEET DRAIN SMOKE: the mid-drain kill never returned a lease"; exit 1; }
+echo "$fdrain_out" | grep -qE "fleet_drain:.* lost=0" \
+    || { echo "FLEET DRAIN SMOKE: a backlog pod was lost fleet-wide"; exit 1; }
+echo "$fdrain_out" | grep -qE "fleet_drain:.* double_bind=0" \
+    || { echo "FLEET DRAIN SMOKE: a pod drained through two leases"; exit 1; }
+echo "$fdrain_out" | grep -qE "fleet_drain:.* residual=[1-9]" \
+    || { echo "FLEET DRAIN SMOKE: the serialized residual cohort never engaged"; exit 1; }
+
 echo "== fleet smoke: gRPC-backed occupancy hub =="
 # the same fault profiles re-driven with the hub served behind a
 # localhost bulk gRPC server (--hub-grpc): every stage / fenced

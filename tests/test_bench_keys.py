@@ -63,6 +63,9 @@ OWED_KEYS = {
     "relax_plan_seconds",
     "relax_objective_ratio",
     "megaplan_pods_per_sec",
+    # fleet-tier backlog drain (PR 20, ladder #17)
+    "fleet_drain_pods_per_sec",
+    "fleet_drain_speedup",
 }
 
 

@@ -311,6 +311,43 @@ class TestStalenessBounds:
         with cs.lock:
             assert s0.fleet.admit(spread, owned, s0.cache) is None
 
+    def test_drain_progress_refreshes_liveness_mid_lease(self):
+        """ISSUE 20 satellite: a replica mid-drain-lease may write
+        NOTHING to the hub except per-chunk progress reports for long
+        stretches — no row traffic, no republish. Those reports must
+        refresh its publish stamp, or the lease holder ages past
+        max_row_age_s and flips every peer's constrained admission
+        conservative for the whole drain (the companion failure mode
+        is test_silent_peer_ages_the_view above)."""
+        clock = FakeClock()
+        cs, hub, scheds = _fleet_pair(clock, max_row_age_s=5.0)
+        s0 = scheds["r0"]
+        # r1 holds a drain lease and only ever reports chunk progress
+        hub.drain_init("r1", {"r1": ["default/d0", "default/d1"]}, [])
+        hub.drain_claim("r1")
+        for _ in range(4):
+            clock.advance(3.0)  # 12s total: far past the 5s bound
+            hub.drain_progress("r1", [])  # empty chunk still touches
+        with cs.lock:
+            s0.fleet.publish_inventory()  # r0's own stamp is fresh
+        spread = (
+            MakePod()
+            .name("risky")
+            .label("app", "s")
+            .req({"cpu": "1"})
+            .spread_constraint(
+                1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                {"app": "s"},
+            )
+            .obj()
+        )
+        owned = next(
+            n for n in ("n0", "n1", "n2", "n3") if s0.fleet.owns_node(n)
+        )
+        with cs.lock:
+            assert s0.fleet.admit(spread, owned, s0.cache) is None
+        assert s0.fleet.stale_rejections == 0
+
     def test_partitioned_stage_marks_dirty_and_resync_republishes(self):
         clock = FakeClock()
         cs, hub, scheds = _fleet_pair(clock)

@@ -146,7 +146,7 @@ def test_admit_cas_loser_rechecks_and_rejects():
     real_cas = ex.compare_and_stage
     fired = {"n": 0}
 
-    def interleaved(replica, row, expected_version):
+    def interleaved(replica, row, expected_version, **kw):
         if not fired["n"]:
             fired["n"] += 1
             # the peer wins the race: maxSkew=1 means r0's placement
@@ -159,7 +159,7 @@ def test_admit_cas_loser_rechecks_and_rejects():
                     namespace="default", labels=(("app", "spread"),),
                 ),
             )
-        return real_cas(replica, row, expected_version)
+        return real_cas(replica, row, expected_version, **kw)
 
     ex.compare_and_stage = interleaved
     before = metrics.fleet_admit_cas_conflict_total.labels(
@@ -196,7 +196,7 @@ def test_admit_cas_retries_through_benign_version_churn():
     real_cas = ex.compare_and_stage
     fired = {"n": 0}
 
-    def benign(replica, row, expected_version):
+    def benign(replica, row, expected_version, **kw):
         if not fired["n"]:
             fired["n"] += 1
             ex.stage(
@@ -206,7 +206,7 @@ def test_admit_cas_retries_through_benign_version_churn():
                     namespace="other", labels=(("tier", "db"),),
                 ),
             )
-        return real_cas(replica, row, expected_version)
+        return real_cas(replica, row, expected_version, **kw)
 
     ex.compare_and_stage = benign
     why = r0.fleet.admit(pod, node, r0.cache)

@@ -17,6 +17,7 @@ from kubernetes_tpu.fleet import (
     PENDING,
     PodRow,
     RemoteOccupancyExchange,
+    SqliteHubLease,
     StandbyReplicator,
 )
 from kubernetes_tpu.utils.clock import FakeClock
@@ -29,10 +30,11 @@ def _row(pod="default/p", node="n1", zone="z0", labels=(("app", "x"),)):
     )
 
 
-def _ha_pair(clock=None, lease_s=2.0, **hub_kw):
+def _ha_pair(clock=None, lease_s=2.0, lease=None, **hub_kw):
     """Primary (epoch 1) + standby under one lease on a FakeClock."""
     clock = clock or FakeClock()
-    lease = HubLease(clock=clock, duration_s=lease_s)
+    if lease is None:
+        lease = HubLease(clock=clock, duration_s=lease_s)
     primary = OccupancyExchange(
         clock=clock, hub_id="hub-a", lease=lease, **hub_kw
     )
@@ -46,10 +48,25 @@ def _ha_pair(clock=None, lease_s=2.0, **hub_kw):
 # -- HubLease ----------------------------------------------------------------
 
 
+@pytest.fixture(params=["memory", "sqlite"])
+def make_lease(request, tmp_path):
+    """Lease-store factory covering both backends: the in-memory
+    HubLease and the file-backed SqliteHubLease (ISSUE 20 leg b) must
+    be contract-interchangeable, so the fencing/failover tests in
+    this module run against each."""
+    if request.param == "memory":
+        return lambda clock, lease_s=2.0: HubLease(
+            clock=clock, duration_s=lease_s
+        )
+    return lambda clock, lease_s=2.0: SqliteHubLease(
+        str(tmp_path / "hub_lease.db"), clock=clock, duration_s=lease_s
+    )
+
+
 class TestHubLease:
-    def test_grant_renew_and_expiry_takeover(self):
+    def test_grant_renew_and_expiry_takeover(self, make_lease):
         clock = FakeClock()
-        lease = HubLease(clock=clock, duration_s=2.0)
+        lease = make_lease(clock)
         assert lease.try_acquire("a") == 1
         assert lease.try_acquire("b") is None  # live lease: no takeover
         clock.advance(1.0)
@@ -60,13 +77,13 @@ class TestHubLease:
         assert lease.renew("a") is False  # expired holder can't renew
         assert lease.try_acquire("b") == 2  # takeover bumps the epoch
 
-    def test_same_holder_reacquire_keeps_epoch(self):
+    def test_same_holder_reacquire_keeps_epoch(self, make_lease):
         """The steady-state maintenance path: an incumbent re-acquiring
         (even after its own expiry, unclaimed) renews WITHOUT bumping
         the epoch — otherwise every idle stretch would read as a
         failover."""
         clock = FakeClock()
-        lease = HubLease(clock=clock, duration_s=2.0)
+        lease = make_lease(clock)
         assert lease.try_acquire("a") == 1
         clock.advance(5.0)
         assert lease.try_acquire("a") == 1
@@ -147,19 +164,27 @@ class TestReplication:
 
 
 class TestEpochFencing:
-    def test_standby_rejects_replica_surface(self):
-        _clock, _lease, _primary, standby = _ha_pair()
+    def test_standby_rejects_replica_surface(self, make_lease):
+        clock = FakeClock()
+        _clock, _lease, _primary, standby = _ha_pair(
+            clock=clock, lease=make_lease(clock)
+        )
         with pytest.raises(HubDeposed):
             standby.peers_view("r0")
         with pytest.raises(HubDeposed):
             standby.stage("r0", _row())
 
-    def test_deposed_primary_fences_writes_serves_status(self):
+    def test_deposed_primary_fences_writes_serves_status(
+        self, make_lease
+    ):
         """The partitioned-old-primary contract: after a takeover its
         replica-facing writes reject typed (and are counted — the
         chaos smoke's stale-primary proof) while the debug/read
         surface keeps serving the post-mortem."""
-        clock, _lease, primary, standby = _ha_pair()
+        clock = FakeClock()
+        clock, _lease, primary, standby = _ha_pair(
+            clock=clock, lease=make_lease(clock)
+        )
         primary.stage("r0", _row())
         clock.advance(3.0)  # primary's lease expires unrenewed
         assert standby.try_promote() == 2
@@ -175,8 +200,11 @@ class TestEpochFencing:
             primary.peers_view("r0")
         assert primary.deposed_write_rejections == 1
 
-    def test_heartbeat_self_deposes_on_lost_lease(self):
-        clock, _lease, primary, standby = _ha_pair()
+    def test_heartbeat_self_deposes_on_lost_lease(self, make_lease):
+        clock = FakeClock()
+        clock, _lease, primary, standby = _ha_pair(
+            clock=clock, lease=make_lease(clock)
+        )
         clock.advance(3.0)
         assert standby.try_promote() == 2
         assert primary.heartbeat() is False
@@ -436,14 +464,19 @@ class TestFailoverClient:
 
 
 class TestReviewHardening:
-    def test_deposed_hub_cannot_repromote_until_caught_up(self):
+    def test_deposed_hub_cannot_repromote_until_caught_up(
+        self, make_lease
+    ):
         """Review-caught: a deposed old primary re-acquiring an
         expired lease at a HIGHER epoch while serving PRE-deposition
         state would regress the version counter behind an epoch the
         clients' monotone check must accept. Promotion stays refused
         until replication reaches lag 0 against the successor (or the
         operator overrides with allow_stale for the disaster case)."""
-        clock, _lease, a, b = _ha_pair()
+        clock = FakeClock()
+        clock, _lease, a, b = _ha_pair(
+            clock=clock, lease=make_lease(clock)
+        )
         a.stage("r0", _row())
         StandbyReplicator(b, LocalHubClient(a)).poll()
         clock.advance(3.0)
@@ -461,8 +494,11 @@ class TestReviewHardening:
         assert a.try_promote() == 3  # caught up: eligible again
         assert len(a.replica_rows("r0")[1]) == 2  # B-era row present
 
-    def test_allow_stale_is_the_disaster_override(self):
-        clock, _lease, a, b = _ha_pair()
+    def test_allow_stale_is_the_disaster_override(self, make_lease):
+        clock = FakeClock()
+        clock, _lease, a, b = _ha_pair(
+            clock=clock, lease=make_lease(clock)
+        )
         clock.advance(3.0)
         assert b.try_promote() == 2
         assert a.heartbeat() is False
@@ -510,13 +546,18 @@ class TestReviewHardening:
             assert b.try_promote() == 2  # renewals
         assert metrics.hub_failover_total._value.get() == before + 1
 
-    def test_transient_self_expiry_without_standby_self_heals(self):
+    def test_transient_self_expiry_without_standby_self_heals(
+        self, make_lease
+    ):
         """Review-caught: a lease expiring transiently (GC pause) with
         NO successor taking over must not wedge the only hub behind
         the needs_catchup gate — there is no successor timeline to
         diverge from, so the same-epoch re-grant heals without
         operator action."""
-        clock, _lease, a, _b = _ha_pair()
+        clock = FakeClock()
+        clock, _lease, a, _b = _ha_pair(
+            clock=clock, lease=make_lease(clock)
+        )
         a.stage("r0", _row())
         clock.advance(5.0)  # lease long expired; nobody acquired
         with pytest.raises(HubDeposed):

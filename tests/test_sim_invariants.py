@@ -775,3 +775,83 @@ def test_megaplan_clean_on_good_summary():
     v = []
     check_megaplan(5, v, summary=_mp_summary())
     assert v == []
+
+
+# -- fleet backlog drain -----------------------------------------------------
+
+
+def _fd_kwargs(**kw):
+    base = {
+        "backlog": 120,
+        "drained": 118,
+        "double_binds": 0,
+        "lost": 0,
+        "leases_reassigned": 1,
+        "expect_reassign": True,
+    }
+    base.update(kw)
+    return base
+
+
+def test_fleet_drain_clean_on_good_summary():
+    from kubernetes_tpu.sim.invariants import check_fleet_drain
+
+    v = []
+    check_fleet_drain(5, v, **_fd_kwargs())
+    assert v == []
+
+
+def test_fleet_drain_flags_empty_backlog_as_vacuous():
+    from kubernetes_tpu.sim.invariants import check_fleet_drain
+
+    v = []
+    check_fleet_drain(5, v, **_fd_kwargs(backlog=0))
+    assert [x.invariant for x in v] == ["fleet_drain"]
+    assert "vacuous" in v[0].detail
+
+
+def test_fleet_drain_flags_disengaged_ledger():
+    from kubernetes_tpu.sim.invariants import check_fleet_drain
+
+    v = []
+    check_fleet_drain(5, v, **_fd_kwargs(drained=0))
+    assert [x.invariant for x in v] == ["fleet_drain"]
+    assert "never engaged" in v[0].detail
+
+
+def test_fleet_drain_flags_lost_pods():
+    from kubernetes_tpu.sim.invariants import check_fleet_drain
+
+    v = []
+    check_fleet_drain(5, v, **_fd_kwargs(lost=3))
+    assert [x.invariant for x in v] == ["fleet_drain"]
+    assert "lost work" in v[0].detail
+
+
+def test_fleet_drain_flags_double_binds():
+    from kubernetes_tpu.sim.invariants import check_fleet_drain
+
+    v = []
+    check_fleet_drain(5, v, **_fd_kwargs(double_binds=2))
+    assert [x.invariant for x in v] == ["fleet_drain"]
+    assert "two drain leases" in v[0].detail
+
+
+def test_fleet_drain_flags_disconnected_reassignment_seam():
+    from kubernetes_tpu.sim.invariants import check_fleet_drain
+
+    v = []
+    check_fleet_drain(5, v, **_fd_kwargs(leases_reassigned=0))
+    assert [x.invariant for x in v] == ["fleet_drain"]
+    assert "return-on-retire" in v[0].detail
+
+
+def test_fleet_drain_reassign_clause_scoped_to_kill_profiles():
+    from kubernetes_tpu.sim.invariants import check_fleet_drain
+
+    v = []
+    check_fleet_drain(
+        5, v,
+        **_fd_kwargs(leases_reassigned=0, expect_reassign=False),
+    )
+    assert v == []
